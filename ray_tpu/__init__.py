@@ -1,0 +1,213 @@
+"""ray_tpu: a TPU-native distributed runtime and AI library stack.
+
+A ground-up rebuild of the capabilities of the reference Ray monorepo
+(ray-project/ray, see SURVEY.md) designed for TPU pods: the scheduler
+treats TPU chips and pod slices as first-class resources, collectives run
+over ICI/DCN via XLA, and the training/serving stacks are JAX-first.
+
+Public core API mirrors the reference (python/ray/__init__.py):
+init / shutdown / remote / get / put / wait / kill / get_actor / ...
+"""
+
+from __future__ import annotations
+
+import atexit
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._version import version as __version__  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.worker import ActorHandle, ObjectRef  # noqa: F401
+from ray_tpu.actor import ActorClass, method  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+
+_node = None
+_client = None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    local_mode: bool = False,
+    labels: Optional[Dict[str, str]] = None,
+    ignore_reinit_error: bool = False,
+):
+    """Start (or connect to) a ray_tpu cluster.
+
+    Reference analog: ray.init (python/ray/_private/worker.py:1228). With no
+    address this bootstraps a head node in-process (GCS + raylet services on
+    a background event loop; worker processes are real subprocesses).
+    With address="host:port" it connects to an existing GCS.
+    """
+    global _node, _client
+    if _worker.is_initialized():
+        if ignore_reinit_error:
+            return
+        raise RuntimeError("ray_tpu.init() called twice")
+
+    if local_mode:
+        from ray_tpu._private.local_mode import LocalClient
+
+        client = LocalClient(resources)
+        _worker.set_client(client, "local")
+        _client = client
+        return
+
+    from ray_tpu._private.node import Node
+
+    if address is None:
+        _node = Node(
+            head=True,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            object_store_memory=object_store_memory,
+            labels=labels,
+        )
+        _client = _node.make_client()
+    else:
+        # Join an existing cluster as a new node + driver.
+        _node = Node(
+            head=False,
+            gcs_address=address,
+            num_cpus=num_cpus if num_cpus is not None else 0,
+            num_tpus=num_tpus,
+            resources=resources,
+            object_store_memory=object_store_memory,
+            labels=labels,
+        )
+        _client = _node.make_client()
+    _worker.set_client(_client, "driver", _node)
+    atexit.register(shutdown)
+
+
+def shutdown():
+    """Tear down the cluster started by init() (reference: ray.shutdown)."""
+    global _node, _client
+    if _client is not None:
+        try:
+            _client.disconnect()
+        except Exception:
+            pass
+        _client = None
+    if _node is not None:
+        try:
+            _node.stop()
+        except Exception:
+            pass
+        _node = None
+    _worker.set_client(None, None)
+
+
+def is_initialized() -> bool:
+    return _worker.is_initialized()
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (reference:
+    python/ray/remote_function.py:40, python/ray/actor.py)."""
+
+    def decorate(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, **options)
+        return RemoteFunction(obj, **options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return decorate
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+):
+    """Fetch object values (reference: ray.get, _private/worker.py:2570)."""
+    client = _worker.get_client()
+    if isinstance(refs, ObjectRef):
+        return client.get([refs], timeout)[0]
+    return client.get(list(refs), timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    """Store a value in the object store (reference: ray.put,
+    _private/worker.py:2688)."""
+    return _worker.get_client().put(value)
+
+
+def wait(
+    refs: List[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    """Wait for refs to complete (reference: ray.wait)."""
+    return _worker.get_client().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    """Forcefully stop an actor (reference: ray.kill)."""
+    actor._kill(no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    """Best-effort task cancellation (reference: ray.cancel)."""
+    # Round 1: cancellation only prevents un-dispatched local work.
+    if ref._future is not None:
+        ref._future.cancel()
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    """Look up a named actor (reference: ray.get_actor)."""
+    return _worker.get_client().get_actor_by_name(name, namespace)
+
+
+def nodes() -> List[dict]:
+    """Cluster node table (reference: ray.nodes)."""
+    return _worker.get_client().nodes()
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _worker.get_client().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return _worker.get_client().available_resources()
+
+
+def get_runtime_context():
+    from ray_tpu.runtime_context import get_runtime_context as _grc
+
+    return _grc()
+
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "method",
+    "ObjectRef",
+    "ActorHandle",
+    "exceptions",
+    "__version__",
+]

@@ -1,0 +1,29 @@
+"""Accelerator manager registry.
+
+Analog of python/ray/_private/accelerators/__init__.py:34 in the reference.
+TPU is the first-class citizen here; the registry stays pluggable so other
+accelerators can be added.
+"""
+
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+_MANAGERS = {
+    "TPU": TPUAcceleratorManager,
+}
+
+
+def get_all_accelerator_managers():
+    return dict(_MANAGERS)
+
+
+def get_accelerator_manager(resource_name: str):
+    return _MANAGERS.get(resource_name)
+
+
+__all__ = [
+    "AcceleratorManager",
+    "TPUAcceleratorManager",
+    "get_all_accelerator_managers",
+    "get_accelerator_manager",
+]

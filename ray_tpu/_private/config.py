@@ -1,0 +1,82 @@
+"""Runtime configuration flags.
+
+TPU-native analog of the reference's RayConfig
+(src/ray/common/ray_config.h:60; entries defined in
+src/ray/common/ray_config_def.h — 220 RAY_CONFIG(type, name, default)
+entries, each overridable via a `RAY_<name>` env var). We keep the same
+pattern — a flat typed registry, env-overridable with an `RT_` prefix —
+but only carry the entries this runtime actually consumes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+from typing import Any
+
+
+def _env(name: str, default: Any, typ: type) -> Any:
+    raw = os.environ.get(f"RT_{name}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return typ(raw)
+
+
+@dataclass
+class Config:
+    # -- object store ---------------------------------------------------
+    # Default shared-memory store size; reference sizes plasma from system
+    # memory in _private/services.py (object_store_memory).
+    object_store_memory: int = 256 * 1024 * 1024
+    # Objects at or below this size are passed inline in RPC replies instead
+    # of the shared-memory store (reference: max_direct_call_object_size,
+    # ray_config_def.h — 100KB).
+    max_inline_object_size: int = 100 * 1024
+    # Chunk size for node-to-node object transfer (reference:
+    # object_manager_default_chunk_size, ray_config_def.h:362 — 5 MiB).
+    object_transfer_chunk_size: int = 5 * 1024 * 1024
+
+    # -- scheduling -----------------------------------------------------
+    # Prefer the local node until its critical resource utilization crosses
+    # this threshold (reference: scheduler_spread_threshold,
+    # ray_config_def.h:196).
+    scheduler_spread_threshold: float = 0.5
+    # Max worker processes per node per job (reference sizes the pool from
+    # num_cpus; we keep an explicit cap for tests).
+    max_workers_per_node: int = 16
+    # Seconds an idle worker lives before the pool reaps it (reference:
+    # idle_worker_killing_time_threshold_ms).
+    idle_worker_timeout_s: float = 300.0
+
+    # -- fault tolerance ------------------------------------------------
+    # Default task retries (reference: max_retries default 3,
+    # python/ray/remote_function.py).
+    task_max_retries: int = 3
+    # GCS → raylet health check period/timeout (reference:
+    # GcsHealthCheckManager, gcs_health_check_manager.h:39).
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+
+    # -- rpc ------------------------------------------------------------
+    rpc_connect_timeout_s: float = 10.0
+    rpc_max_message_size: int = 512 * 1024 * 1024
+
+    # -- collective -----------------------------------------------------
+    collective_rendezvous_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        for f in fields(self):
+            cur = getattr(self, f.name)
+            setattr(self, f.name, _env(f.name, cur, type(cur)))
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config

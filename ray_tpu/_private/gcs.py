@@ -1,0 +1,726 @@
+"""Global Control Service: the cluster metadata authority.
+
+TPU-native analog of the reference GCS server
+(src/ray/gcs/gcs_server/gcs_server.h:78, entry gcs_server_main.cc:40) with
+its managers collapsed into one asyncio process:
+
+  * node table + health checks   (GcsNodeManager, GcsHealthCheckManager,
+                                  gcs_health_check_manager.h:39)
+  * resource views               (GcsResourceManager + ray_syncer — here the
+                                  raylets push deltas over their persistent
+                                  RPC connection instead of a separate
+                                  bidi-stream service, ray_syncer.h:88)
+  * actor table + scheduling     (GcsActorManager, gcs_actor_manager.cc:255,
+                                  GcsActorScheduler::ScheduleByGcs,
+                                  gcs_actor_scheduler.cc:60)
+  * placement groups             (GcsPlacementGroupManager two-phase
+                                  prepare/commit, gcs_placement_group_scheduler.h)
+  * KV store                     (GcsKvManager / StoreClientInternalKV,
+                                  store_client_kv.h; in-memory store client,
+                                  in_memory_store_client.h:31)
+  * object directory             (ownership_based_object_directory.h — here a
+                                  GCS table since owners and the directory
+                                  share a process boundary anyway on TPU pods)
+  * pubsub                       (src/ray/pubsub/publisher.h:307 — long-poll
+                                  replaced by server-push frames)
+  * job table + function exports (GcsJobManager, GcsFunctionManager)
+  * task events                  (GcsTaskManager task-event sink, powers the
+                                  state API)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.protocol import RpcServer, ServerConnection
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.rpc = RpcServer(host, port)
+        self.host = host
+        # tables
+        self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespace -> k -> v
+        self.nodes: Dict[bytes, dict] = {}  # node_id -> info
+        self.node_conns: Dict[bytes, ServerConnection] = {}
+        self.actors: Dict[bytes, dict] = {}  # actor_id -> info
+        self.named_actors: Dict[tuple, bytes] = {}  # (namespace, name) -> actor_id
+        self.jobs: Dict[bytes, dict] = {}
+        self.placement_groups: Dict[bytes, dict] = {}
+        self.object_dir: Dict[bytes, dict] = {}  # object_id -> {nodes: set, size}
+        self.object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
+        self.task_events: List[dict] = []  # ring buffer of task state events
+        self.subscribers: Dict[str, Set[ServerConnection]] = defaultdict(set)
+        self.pending_actors: Set[bytes] = set()
+        self.pending_pgs: Set[bytes] = set()
+        self.pg_counter = 0
+        self._started = asyncio.Event()
+        self._health_task: Optional[asyncio.Task] = None
+
+        r = self.rpc.register
+        # kv
+        r("kv_put", self.h_kv_put)
+        r("kv_get", self.h_kv_get)
+        r("kv_del", self.h_kv_del)
+        r("kv_keys", self.h_kv_keys)
+        # nodes
+        r("register_node", self.h_register_node)
+        r("get_nodes", self.h_get_nodes)
+        r("resource_update", self.h_resource_update)
+        r("drain_node", self.h_drain_node)
+        # actors
+        r("register_actor", self.h_register_actor)
+        r("actor_ready", self.h_actor_ready)
+        r("get_actor", self.h_get_actor)
+        r("get_named_actor", self.h_get_named_actor)
+        r("list_actors", self.h_list_actors)
+        r("kill_actor", self.h_kill_actor)
+        r("worker_dead", self.h_worker_dead)
+        # jobs
+        r("register_job", self.h_register_job)
+        r("list_jobs", self.h_list_jobs)
+        # objects
+        r("object_location_add", self.h_object_location_add)
+        r("object_location_get", self.h_object_location_get)
+        r("object_location_wait", self.h_object_location_wait)
+        r("object_location_remove", self.h_object_location_remove)
+        # placement groups
+        r("create_placement_group", self.h_create_pg)
+        r("remove_placement_group", self.h_remove_pg)
+        r("get_placement_group", self.h_get_pg)
+        r("list_placement_groups", self.h_list_pgs)
+        # pubsub
+        r("subscribe", self.h_subscribe)
+        # task events / state API
+        r("add_task_events", self.h_add_task_events)
+        r("list_task_events", self.h_list_task_events)
+        # misc
+        r("ping", self.h_ping)
+
+        self.rpc.on_disconnect = self._on_disconnect
+
+    # ------------------------------------------------------------------
+    async def start(self) -> int:
+        port = await self.rpc.start()
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        self._started.set()
+        return port
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.rpc.stop()
+
+    async def publish(self, channel: str, payload: Any):
+        dead = []
+        for conn in list(self.subscribers.get(channel, ())):
+            if conn.closed:
+                dead.append(conn)
+            else:
+                await conn.push(channel, payload)
+        for c in dead:
+            self.subscribers[channel].discard(c)
+
+    async def _on_disconnect(self, conn: ServerConnection):
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+        node_id = conn.meta.get("node_id")
+        if node_id and node_id in self.nodes:
+            await self._mark_node_dead(node_id, "connection lost")
+
+    async def _health_loop(self):
+        cfg = get_config()
+        tick = 0
+        while True:
+            await asyncio.sleep(min(0.25, cfg.health_check_period_s))
+            tick += 1
+            # Retry pending actors as the resource view changes.
+            for actor_id in list(self.pending_actors):
+                a = self.actors.get(actor_id)
+                if a is None or a["state"] not in ("PENDING", "RESTARTING"):
+                    self.pending_actors.discard(actor_id)
+                    continue
+                if await self._schedule_actor(actor_id):
+                    self.pending_actors.discard(actor_id)
+            # Retry pending placement groups.
+            for pg_id in list(self.pending_pgs):
+                pg = self.placement_groups.get(pg_id)
+                if pg is None or pg["state"] != "PENDING":
+                    self.pending_pgs.discard(pg_id)
+                    continue
+                result = await self._try_reserve_pg(pg)
+                if result.get("ok"):
+                    self.pending_pgs.discard(pg_id)
+            if tick * 0.25 < cfg.health_check_period_s:
+                continue
+            tick = 0
+            now = time.monotonic()
+            timeout = cfg.health_check_period_s * cfg.health_check_failure_threshold
+            for node_id, info in list(self.nodes.items()):
+                if info["state"] == "ALIVE" and now - info["last_heartbeat"] > timeout:
+                    await self._mark_node_dead(node_id, "health check timeout")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        info = self.nodes.get(node_id)
+        if not info or info["state"] == "DEAD":
+            return
+        info["state"] = "DEAD"
+        info["death_reason"] = reason
+        self.node_conns.pop(node_id, None)
+        # Fail actors living on that node; restart if budget remains.
+        for actor_id, a in list(self.actors.items()):
+            if a.get("node_id") == node_id and a["state"] in ("ALIVE", "PENDING", "RESTARTING"):
+                await self._on_actor_failure(actor_id, f"node died: {reason}")
+        # Drop object locations on that node.
+        for oid, entry in self.object_dir.items():
+            entry["nodes"].discard(node_id)
+        await self.publish("node_dead", {"node_id": node_id, "reason": reason})
+
+    # -- kv -------------------------------------------------------------
+    async def h_kv_put(self, d, conn):
+        ns = d.get("ns", "")
+        overwrite = d.get("overwrite", True)
+        table = self.kv[ns]
+        if not overwrite and d["key"] in table:
+            return {"added": False}
+        table[d["key"]] = d["value"]
+        return {"added": True}
+
+    async def h_kv_get(self, d, conn):
+        return {"value": self.kv[d.get("ns", "")].get(d["key"])}
+
+    async def h_kv_del(self, d, conn):
+        return {"deleted": self.kv[d.get("ns", "")].pop(d["key"], None) is not None}
+
+    async def h_kv_keys(self, d, conn):
+        prefix = d.get("prefix", b"")
+        return {"keys": [k for k in self.kv[d.get("ns", "")] if k.startswith(prefix)]}
+
+    # -- nodes ----------------------------------------------------------
+    async def h_register_node(self, d, conn):
+        node_id = d["node_id"]
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "address": d["address"],
+            "port": d["port"],
+            "object_store_name": d.get("object_store_name"),
+            "resources_total": d["resources"],
+            "resources_available": dict(d["resources"]),
+            "labels": d.get("labels", {}),
+            "state": "ALIVE",
+            "last_heartbeat": time.monotonic(),
+            "is_head": d.get("is_head", False),
+        }
+        conn.meta["node_id"] = node_id
+        self.node_conns[node_id] = conn
+        await self.publish("node_added", {"node_id": node_id})
+        return {"ok": True}
+
+    async def h_get_nodes(self, d, conn):
+        out = []
+        for info in self.nodes.values():
+            out.append({k: v for k, v in info.items() if k != "last_heartbeat"})
+        return {"nodes": out}
+
+    async def h_resource_update(self, d, conn):
+        """Raylet pushes its resource view delta (ray_syncer analog)."""
+        info = self.nodes.get(d["node_id"])
+        if info:
+            info["resources_available"] = d["available"]
+            if "total" in d:
+                info["resources_total"] = d["total"]
+            info["last_heartbeat"] = time.monotonic()
+        return {"ok": True}
+
+    async def h_drain_node(self, d, conn):
+        await self._mark_node_dead(d["node_id"], "drained")
+        return {"ok": True}
+
+    # -- jobs -----------------------------------------------------------
+    async def h_register_job(self, d, conn):
+        self.jobs[d["job_id"]] = {
+            "job_id": d["job_id"],
+            "driver_pid": d.get("pid"),
+            "start_time": time.time(),
+            "state": "RUNNING",
+            "entrypoint": d.get("entrypoint", ""),
+        }
+        return {"ok": True}
+
+    async def h_list_jobs(self, d, conn):
+        return {"jobs": list(self.jobs.values())}
+
+    # -- actor scheduling ------------------------------------------------
+    def _pick_node_for_resources(self, resources: Dict[str, float],
+                                 exclude: Set[bytes] = frozenset()) -> Optional[bytes]:
+        """Least-utilized feasible node (GcsActorScheduler::ScheduleByGcs).
+
+        Feasibility is judged against node *totals* (availability views are
+        advisory and may be stale mid-burst); availability breaks ties.
+        """
+        best, best_score = None, None
+        for node_id, info in self.nodes.items():
+            if info["state"] != "ALIVE" or node_id in exclude:
+                continue
+            avail, total = info["resources_available"], info["resources_total"]
+            if not all(total.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()):
+                continue
+            has_now = all(
+                avail.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()
+            )
+            util = 0.0
+            for k, t in total.items():
+                if t > 0:
+                    util = max(util, 1.0 - avail.get(k, 0.0) / t)
+            score = (0 if has_now else 1, util)
+            if best_score is None or score < best_score:
+                best, best_score = node_id, score
+        return best
+
+    async def h_register_actor(self, d, conn):
+        actor_id = d["actor_id"]
+        name, ns = d.get("name"), d.get("namespace", "")
+        if name:
+            key = (ns, name)
+            if key in self.named_actors and \
+               self.actors[self.named_actors[key]]["state"] != "DEAD":
+                return {"ok": False, "error": f"actor name {name!r} already taken"}
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "name": name,
+            "namespace": ns,
+            "class_name": d.get("class_name", ""),
+            "job_id": d.get("job_id"),
+            "state": "PENDING",
+            "resources": d.get("resources", {}),
+            "max_restarts": d.get("max_restarts", 0),
+            "restarts_used": 0,
+            "create_spec": d["create_spec"],  # opaque: replayed on restart
+            "node_id": None,
+            "address": None,
+            "port": None,
+            "death_cause": None,
+            "detached": d.get("detached", False),
+            "scheduling": d.get("scheduling"),
+        }
+        ok = await self._schedule_actor(actor_id)
+        if not ok:
+            # Stay PENDING and retry as the cluster view changes — actor
+            # creation is asynchronous in the reference too
+            # (GcsActorManager keeps pending actors, gcs_actor_manager.cc).
+            self.pending_actors.add(actor_id)
+        return {"ok": True}
+
+    async def _schedule_actor(self, actor_id: bytes) -> bool:
+        a = self.actors[actor_id]
+        node_id = None
+        sched = a.get("scheduling") or {}
+        if sched.get("type") == "node_affinity":
+            nid = sched["node_id"]
+            info = self.nodes.get(nid)
+            if info and info["state"] == "ALIVE":
+                node_id = nid
+            elif not sched.get("soft", False):
+                return False
+        if node_id is None and sched.get("type") == "placement_group":
+            pg = self.placement_groups.get(sched["pg_id"])
+            if not pg or pg["state"] != "CREATED":
+                return False
+            node_id = pg["bundle_nodes"][sched.get("bundle_index") or 0]
+        if node_id is None and sched.get("type") == "node_label":
+            hard, soft = sched.get("hard", {}), sched.get("soft", {})
+            best, best_soft = None, -1
+            for nid, info in self.nodes.items():
+                if info["state"] != "ALIVE":
+                    continue
+                labels = info.get("labels") or {}
+                if not all(labels.get(k) == v for k, v in hard.items()):
+                    continue
+                nsoft = sum(1 for k, v in soft.items() if labels.get(k) == v)
+                if nsoft > best_soft:
+                    best, best_soft = nid, nsoft
+            node_id = best
+            if node_id is None:
+                return False
+        if node_id is None:
+            node_id = self._pick_node_for_resources(a["resources"])
+        if node_id is None:
+            return False
+        # Deduct from the advisory view so a burst of registrations spreads
+        # correctly; the raylet heartbeat is the ground truth.
+        if sched.get("type") != "placement_group":
+            info = self.nodes.get(node_id)
+            if info:
+                for k, v in a["resources"].items():
+                    info["resources_available"][k] = (
+                        info["resources_available"].get(k, 0) - v
+                    )
+        a["node_id"] = node_id
+        a["state"] = "PENDING"
+        conn = self.node_conns.get(node_id)
+        if conn is None:
+            return False
+        # Fire-and-forget: the raylet spawns a dedicated worker and the worker
+        # reports back via actor_ready (gcs_actor_scheduler.cc lease flow).
+        await conn.push(
+            "create_actor",
+            {"actor_id": actor_id, "create_spec": a["create_spec"],
+             "resources": a["resources"], "scheduling": a.get("scheduling")},
+        )
+        return True
+
+    async def h_actor_ready(self, d, conn):
+        a = self.actors.get(d["actor_id"])
+        if not a:
+            return {"ok": False}
+        if d.get("error"):
+            a["state"] = "DEAD"
+            a["death_cause"] = d["error"]
+        else:
+            a["state"] = "ALIVE"
+            a["address"] = d["address"]
+            a["port"] = d["port"]
+            a["worker_id"] = d.get("worker_id")
+        await self.publish(
+            "actor_update:" + d["actor_id"].hex(), self._actor_view(a)
+        )
+        return {"ok": True}
+
+    def _actor_view(self, a: dict) -> dict:
+        return {
+            "actor_id": a["actor_id"],
+            "state": a["state"],
+            "address": a["address"],
+            "port": a["port"],
+            "node_id": a["node_id"],
+            "name": a["name"],
+            "namespace": a["namespace"],
+            "class_name": a["class_name"],
+            "death_cause": a["death_cause"],
+            "restarts_used": a["restarts_used"],
+        }
+
+    async def h_get_actor(self, d, conn):
+        a = self.actors.get(d["actor_id"])
+        return {"actor": self._actor_view(a) if a else None}
+
+    async def h_get_named_actor(self, d, conn):
+        aid = self.named_actors.get((d.get("namespace", ""), d["name"]))
+        a = self.actors.get(aid) if aid else None
+        return {"actor": self._actor_view(a) if a else None}
+
+    async def h_list_actors(self, d, conn):
+        return {"actors": [self._actor_view(a) for a in self.actors.values()]}
+
+    async def _on_actor_failure(self, actor_id: bytes, reason: str):
+        a = self.actors[actor_id]
+        if a["restarts_used"] < a["max_restarts"] or a["max_restarts"] == -1:
+            a["restarts_used"] += 1
+            a["state"] = "RESTARTING"
+            await self.publish("actor_update:" + actor_id.hex(), self._actor_view(a))
+            ok = await self._schedule_actor(actor_id)
+            if not ok:
+                self.pending_actors.add(actor_id)
+            return
+        a["state"] = "DEAD"
+        a["death_cause"] = reason
+        await self.publish("actor_update:" + actor_id.hex(), self._actor_view(a))
+
+    async def h_worker_dead(self, d, conn):
+        """Raylet reports a worker process exit; fail any actor it hosted."""
+        actor_id = d.get("actor_id")
+        if actor_id and actor_id in self.actors:
+            a = self.actors[actor_id]
+            if a["state"] != "DEAD":
+                if d.get("intended") and d.get("no_restart", True):
+                    a["state"] = "DEAD"
+                    a["death_cause"] = d.get("reason", "killed")
+                    await self.publish(
+                        "actor_update:" + actor_id.hex(), self._actor_view(a)
+                    )
+                else:
+                    await self._on_actor_failure(
+                        actor_id, d.get("reason", "worker process died")
+                    )
+        return {"ok": True}
+
+    async def h_kill_actor(self, d, conn):
+        actor_id = d["actor_id"]
+        a = self.actors.get(actor_id)
+        if not a:
+            return {"ok": False}
+        if d.get("no_restart", True):
+            a["max_restarts"] = 0
+        node = self.node_conns.get(a.get("node_id"))
+        if node:
+            await node.push("kill_actor_worker", {"actor_id": actor_id})
+        return {"ok": True}
+
+    # -- object directory ------------------------------------------------
+    async def h_object_location_add(self, d, conn):
+        oid = d["object_id"]
+        entry = self.object_dir.setdefault(oid, {"nodes": set(), "size": 0})
+        entry["nodes"].add(d["node_id"])
+        entry["size"] = d.get("size", entry["size"])
+        for ev in self.object_waiters.pop(oid, []):
+            ev.set()
+        return {"ok": True}
+
+    async def h_object_location_get(self, d, conn):
+        entry = self.object_dir.get(d["object_id"])
+        if not entry or not entry["nodes"]:
+            return {"nodes": [], "size": 0}
+        return {"nodes": list(entry["nodes"]), "size": entry["size"]}
+
+    async def h_object_location_wait(self, d, conn):
+        """Block until the object has at least one location (or timeout)."""
+        oid = d["object_id"]
+        timeout = d.get("timeout", 60.0)
+        entry = self.object_dir.get(oid)
+        if entry and entry["nodes"]:
+            return {"nodes": list(entry["nodes"]), "size": entry["size"]}
+        ev = asyncio.Event()
+        self.object_waiters[oid].append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            return {"nodes": [], "size": 0, "timeout": True}
+        entry = self.object_dir.get(oid, {"nodes": set(), "size": 0})
+        return {"nodes": list(entry["nodes"]), "size": entry["size"]}
+
+    async def h_object_location_remove(self, d, conn):
+        entry = self.object_dir.get(d["object_id"])
+        if entry:
+            entry["nodes"].discard(d["node_id"])
+        return {"ok": True}
+
+    # -- placement groups -------------------------------------------------
+    async def h_create_pg(self, d, conn):
+        """Two-phase reserve of bundles across raylets.
+
+        Mirrors GcsPlacementGroupScheduler's prepare/commit
+        (gcs/gcs_server/gcs_placement_group_scheduler.h): all bundles are
+        prepared on their raylets first; any failure rolls back. Failed
+        reservations stay PENDING and are retried from the health loop as
+        the resource view changes.
+        """
+        pg_id = d["pg_id"]
+        pg = {
+            "pg_id": pg_id,
+            "name": d.get("name", ""),
+            "bundles": d["bundles"],
+            "strategy": d.get("strategy", "PACK"),
+            "state": "PENDING",
+            "bundle_nodes": [None] * len(d["bundles"]),
+        }
+        self.placement_groups[pg_id] = pg
+        result = await self._try_reserve_pg(pg)
+        if not result.get("ok"):
+            self.pending_pgs.add(pg_id)
+        return result
+
+    async def _try_reserve_pg(self, pg: dict):
+        pg_id = pg["pg_id"]
+        bundles: List[Dict[str, float]] = pg["bundles"]
+        strategy = pg["strategy"]
+        nodes = self._place_bundles(bundles, strategy)
+        if nodes is None:
+            return {"ok": False, "error": "infeasible placement group"}
+        # Phase 1: prepare.
+        prepared = []
+        ok = True
+        for i, node_id in enumerate(nodes):
+            node_conn = self.node_conns.get(node_id)
+            if node_conn is None:
+                ok = False
+                break
+            try:
+                # The GCS view is the source of truth for reservation; the
+                # raylet is informed so its local dispatcher accounts for the
+                # bundle (prepare). Resource deltas roll back on failure.
+                info = self.nodes[node_id]
+                avail = info["resources_available"]
+                b = bundles[i]
+                if not all(avail.get(k, 0) + 1e-9 >= v for k, v in b.items()):
+                    ok = False
+                    break
+                for k, v in b.items():
+                    avail[k] = avail.get(k, 0) - v
+                await node_conn.push(
+                    "reserve_bundle",
+                    {"pg_id": pg_id, "bundle_index": i, "resources": b},
+                )
+                prepared.append((i, node_id))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for i, node_id in prepared:
+                node_conn = self.node_conns.get(node_id)
+                if node_conn:
+                    await node_conn.push(
+                        "cancel_bundle", {"pg_id": pg_id, "bundle_index": i}
+                    )
+                info = self.nodes.get(node_id)
+                if info:
+                    for k, v in bundles[i].items():
+                        info["resources_available"][k] = (
+                            info["resources_available"].get(k, 0) + v
+                        )
+            pg["state"] = "PENDING"
+            return {"ok": False, "error": "placement group reservation failed"}
+        pg["bundle_nodes"] = nodes
+        pg["state"] = "CREATED"
+        await self.publish("pg_update:" + pg_id.hex(), {"state": "CREATED"})
+        return {"ok": True, "bundle_nodes": nodes}
+
+    def _place_bundles(self, bundles, strategy) -> Optional[List[bytes]]:
+        """Bundle placement policies (bundle_scheduling_policy.cc:
+        PACK/SPREAD/STRICT_PACK/STRICT_SPREAD)."""
+        alive = {
+            nid: dict(info["resources_available"])
+            for nid, info in self.nodes.items()
+            if info["state"] == "ALIVE"
+        }
+
+        def fits(avail, b):
+            return all(avail.get(k, 0) + 1e-9 >= v for k, v in b.items())
+
+        def take(avail, b):
+            for k, v in b.items():
+                avail[k] = avail.get(k, 0) - v
+
+        if strategy in ("STRICT_PACK",):
+            for nid, avail in alive.items():
+                trial = dict(avail)
+                good = True
+                for b in bundles:
+                    if not fits(trial, b):
+                        good = False
+                        break
+                    take(trial, b)
+                if good:
+                    return [nid] * len(bundles)
+            return None
+        if strategy in ("STRICT_SPREAD",):
+            result, used = [], set()
+            for b in bundles:
+                placed = False
+                for nid, avail in alive.items():
+                    if nid in used or not fits(avail, b):
+                        continue
+                    take(avail, b)
+                    result.append(nid)
+                    used.add(nid)
+                    placed = True
+                    break
+                if not placed:
+                    return None
+            return result
+        # PACK (soft): prefer fewest nodes; SPREAD (soft): prefer distinct.
+        result = []
+        order = list(alive.items())
+        if strategy == "SPREAD":
+            idx = 0
+            for b in bundles:
+                placed = False
+                for j in range(len(order)):
+                    nid, avail = order[(idx + j) % len(order)] if order else (None, None)
+                    if nid is not None and fits(avail, b):
+                        take(avail, b)
+                        result.append(nid)
+                        idx = (idx + j + 1) % len(order)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return result
+        # PACK
+        for b in bundles:
+            placed = False
+            for nid, avail in order:
+                if fits(avail, b):
+                    take(avail, b)
+                    result.append(nid)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return result
+
+    async def h_remove_pg(self, d, conn):
+        pg = self.placement_groups.get(d["pg_id"])
+        if not pg:
+            return {"ok": False}
+        if pg["state"] == "CREATED":
+            for i, node_id in enumerate(pg["bundle_nodes"]):
+                info = self.nodes.get(node_id)
+                if info and info["state"] == "ALIVE":
+                    for k, v in pg["bundles"][i].items():
+                        info["resources_available"][k] = (
+                            info["resources_available"].get(k, 0) + v
+                        )
+                    node_conn = self.node_conns.get(node_id)
+                    if node_conn:
+                        await node_conn.push(
+                            "cancel_bundle", {"pg_id": d["pg_id"], "bundle_index": i}
+                        )
+        pg["state"] = "REMOVED"
+        return {"ok": True}
+
+    async def h_get_pg(self, d, conn):
+        pg = self.placement_groups.get(d["pg_id"])
+        return {"pg": pg and {k: v for k, v in pg.items()}}
+
+    async def h_list_pgs(self, d, conn):
+        return {"pgs": list(self.placement_groups.values())}
+
+    # -- pubsub ----------------------------------------------------------
+    async def h_subscribe(self, d, conn):
+        self.subscribers[d["channel"]].add(conn)
+        return {"ok": True}
+
+    # -- task events ------------------------------------------------------
+    async def h_add_task_events(self, d, conn):
+        self.task_events.extend(d["events"])
+        if len(self.task_events) > 100_000:
+            del self.task_events[: len(self.task_events) - 100_000]
+        return {"ok": True}
+
+    async def h_list_task_events(self, d, conn):
+        limit = d.get("limit", 1000)
+        return {"events": self.task_events[-limit:]}
+
+    async def h_ping(self, d, conn):
+        return {"pong": True, "time": time.time()}
+
+
+def main():  # pragma: no cover - exercised as a subprocess
+    """Entry point when GCS runs as its own process (gcs_server_main.cc:40)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+
+    async def run():
+        server = GcsServer(args.host, args.port)
+        port = await server.start()
+        print(f"GCS_PORT={port}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

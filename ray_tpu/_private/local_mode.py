@@ -1,0 +1,155 @@
+"""Local (in-process) execution mode.
+
+Analog of the reference's local_mode in ray.init: tasks run synchronously
+in the driver process, actors are plain in-process instances. Useful for
+debugging user code and for fast unit tests of library layers.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.worker import ActorHandle, ObjectRef, make_task_error, _rebuild_task_error
+from ray_tpu.exceptions import ActorDiedError
+
+
+class LocalClient:
+    """Implements the CoreClient surface with synchronous local execution."""
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None):
+        self.objects: Dict[bytes, object] = {}
+        self.actors: Dict[bytes, object] = {}
+        self.named: Dict[tuple, ActorID] = {}
+        self.kv: Dict[tuple, bytes] = {}
+        self.resources = dict(resources or {"CPU": 8.0})
+        self.mode = "local"
+        self.known_refs: Dict[bytes, ObjectRef] = {}
+
+    # -- objects ---------------------------------------------------------
+    def _store(self, value) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self.objects[oid.binary()] = value
+        fut = concurrent.futures.Future()
+        fut.set_result(value)
+        return ObjectRef(oid, fut)
+
+    def put(self, value) -> ObjectRef:
+        return self._store(value)
+
+    def get(self, refs: List[ObjectRef], timeout=None):
+        out = []
+        for r in refs:
+            if r._future is not None:
+                r._future.result(timeout)
+            if r.id.binary() not in self.objects:
+                raise KeyError(f"object {r.hex()} not found (local mode)")
+            out.append(self.objects[r.id.binary()])
+        return out
+
+    def wait(self, refs, num_returns, timeout, fetch_local=True):
+        return refs[:num_returns], refs[num_returns:]
+
+    # -- tasks -----------------------------------------------------------
+    def submit_task(self, fn, args, kwargs, name="", num_returns=1,
+                    resources=None, scheduling=None, max_retries=None):
+        try:
+            value = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            err = _rebuild_task_error(make_task_error(e))
+            refs = []
+            for _ in range(num_returns):
+                fut = concurrent.futures.Future()
+                fut.set_exception(err)
+                oid = ObjectID.from_random()
+                r = ObjectRef(oid, fut)
+                refs.append(r)
+            return refs
+        values = [value] if num_returns == 1 else list(value)
+        return [self._store(v) for v in values]
+
+    # -- actors ----------------------------------------------------------
+    def create_actor(self, cls, args, kwargs, name=None, namespace="",
+                     resources=None, max_restarts=0, max_task_retries=0,
+                     max_concurrency=1, scheduling=None, detached=False):
+        instance = cls(*args, **kwargs)
+        actor_id = ActorID.from_random()
+        self.actors[actor_id.binary()] = instance
+        if name:
+            self.named[(namespace, name)] = actor_id
+        methods = [m for m in dir(instance)
+                   if callable(getattr(instance, m, None)) and not m.startswith("__")]
+        return ActorHandle(actor_id, cls.__name__, methods, max_task_retries)
+
+    def submit_actor_call(self, actor_id, method, args, kwargs,
+                          num_returns=1, max_task_retries=0):
+        instance = self.actors.get(actor_id.binary())
+        if instance is None:
+            raise ActorDiedError(f"actor {actor_id.hex()} not found (local mode)")
+        import inspect, asyncio
+
+        m = getattr(instance, method)
+        try:
+            if inspect.iscoroutinefunction(m):
+                value = asyncio.run(m(*args, **kwargs))
+            else:
+                value = m(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            err = _rebuild_task_error(make_task_error(e))
+            refs = []
+            for _ in range(num_returns):
+                fut = concurrent.futures.Future()
+                fut.set_exception(err)
+                refs.append(ObjectRef(ObjectID.from_random(), fut))
+            return refs
+        values = [value] if num_returns == 1 else list(value)
+        return [self._store(v) for v in values]
+
+    def kill_actor(self, actor_id, no_restart=True):
+        self.actors.pop(actor_id.binary(), None)
+
+    def get_actor_by_name(self, name, namespace=""):
+        aid = self.named.get((namespace, name))
+        if aid is None or aid.binary() not in self.actors:
+            raise ValueError(f"no live actor named {name!r}")
+        instance = self.actors[aid.binary()]
+        methods = [m for m in dir(instance)
+                   if callable(getattr(instance, m, None)) and not m.startswith("__")]
+        return ActorHandle(aid, type(instance).__name__, methods)
+
+    # -- kv / cluster ----------------------------------------------------
+    def kv_put(self, key, value, ns="", overwrite=True):
+        if not overwrite and (ns, key) in self.kv:
+            return False
+        self.kv[(ns, key)] = value
+        return True
+
+    def kv_get(self, key, ns=""):
+        return self.kv.get((ns, key))
+
+    def kv_del(self, key, ns=""):
+        return self.kv.pop((ns, key), None) is not None
+
+    def kv_keys(self, prefix=b"", ns=""):
+        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    def nodes(self):
+        return [{
+            "node_id": b"local" * 3 + b"x",
+            "state": "ALIVE",
+            "address": "127.0.0.1",
+            "resources_total": self.resources,
+            "resources_available": self.resources,
+            "is_head": True,
+        }]
+
+    def cluster_resources(self):
+        return dict(self.resources)
+
+    def available_resources(self):
+        return dict(self.resources)
+
+    def disconnect(self):
+        self.objects.clear()
+        self.actors.clear()

@@ -1,0 +1,233 @@
+"""Wire protocol for control-plane RPC.
+
+The reference uses gRPC with 24 .proto services (src/ray/protobuf/,
+src/ray/rpc/grpc_server.h). This runtime uses a leaner scheme suited to the
+one-process-per-TPU-host world: length-prefixed msgpack frames over asyncio
+TCP streams, with request/response correlation ids and server-push frames
+for pubsub. Binary payloads (pickled functions, inlined objects) ride as
+msgpack bin values.
+
+Frame layout: u32 length | msgpack map {
+    "k": kind ("req" | "resp" | "push"),
+    "i": correlation id (int, for req/resp),
+    "m": method name (req) or channel (push),
+    "d": payload (any msgpack value),
+    "e": error string or null (resp),
+}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+_LEN = struct.Struct("<I")
+
+
+def pack_frame(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    (length,) = _LEN.unpack(header)
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class Connection:
+    """A bidirectional RPC connection: concurrent requests + push handling."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        push_handler: Optional[Callable[[str, Any], None]] = None,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.push_handler = push_handler
+        self._ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        self._write_lock = asyncio.Lock()
+
+    async def _read_loop(self):
+        try:
+            while True:
+                frame = await read_frame(self.reader)
+                kind = frame.get("k")
+                if kind == "resp":
+                    fut = self._pending.pop(frame["i"], None)
+                    if fut is not None and not fut.done():
+                        if frame.get("e"):
+                            fut.set_exception(RpcError(frame["e"]))
+                        else:
+                            fut.set_result(frame.get("d"))
+                elif kind == "push":
+                    if self.push_handler is not None:
+                        self.push_handler(frame["m"], frame.get("d"))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost("connection closed"))
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None, timeout: float = None) -> Any:
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        cid = next(self._ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[cid] = fut
+        frame = pack_frame({"k": "req", "i": cid, "m": method, "d": payload})
+        async with self._write_lock:
+            self.writer.write(frame)
+            await self.writer.drain()
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def notify(self, method: str, payload: Any = None):
+        """Fire-and-forget request (no response expected)."""
+        frame = pack_frame({"k": "req", "i": 0, "m": method, "d": payload})
+        async with self._write_lock:
+            self.writer.write(frame)
+            await self.writer.drain()
+
+    async def close(self):
+        self._closed = True
+        self._reader_task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+
+
+Handler = Callable[[Any, "ServerConnection"], Awaitable[Any]]
+
+
+class ServerConnection:
+    """Server side of one accepted connection; supports pushes to the peer."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self._write_lock = asyncio.Lock()
+        self.meta: Dict[str, Any] = {}  # e.g. node_id / worker_id after register
+        self.closed = False
+
+    async def push(self, channel: str, payload: Any):
+        if self.closed:
+            return
+        frame = pack_frame({"k": "push", "m": channel, "d": payload})
+        try:
+            async with self._write_lock:
+                self.writer.write(frame)
+                await self.writer.drain()
+        except (ConnectionError, RuntimeError):
+            self.closed = True
+
+    async def respond(self, cid: int, data: Any = None, error: str = None):
+        frame = pack_frame({"k": "resp", "i": cid, "d": data, "e": error})
+        try:
+            async with self._write_lock:
+                self.writer.write(frame)
+                await self.writer.drain()
+        except (ConnectionError, RuntimeError):
+            self.closed = True
+
+
+class RpcServer:
+    """Dispatches method calls to registered async handlers.
+
+    Analog of the reference's GrpcServer (src/ray/rpc/grpc_server.h) +
+    ServerCall dispatch (src/ray/rpc/server_call.h).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.handlers: Dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set[ServerConnection] = set()
+        self.on_disconnect: Optional[Callable[[ServerConnection], Awaitable[None]]] = None
+
+    def register(self, method: str, handler: Handler):
+        self.handlers[method] = handler
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _on_client(self, reader, writer):
+        conn = ServerConnection(reader, writer)
+        self.connections.add(conn)
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame.get("k") != "req":
+                    continue
+                asyncio.ensure_future(self._dispatch(conn, frame))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            conn.closed = True
+            self.connections.discard(conn)
+            if self.on_disconnect is not None:
+                try:
+                    await self.on_disconnect(conn)
+                except Exception:
+                    pass
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn: ServerConnection, frame):
+        cid = frame.get("i", 0)
+        method = frame.get("m")
+        handler = self.handlers.get(method)
+        if handler is None:
+            if cid:
+                await conn.respond(cid, error=f"no such method: {method}")
+            return
+        try:
+            result = await handler(frame.get("d"), conn)
+            if cid:
+                await conn.respond(cid, data=result)
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            import traceback
+
+            if cid:
+                await conn.respond(cid, error=f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def connect(host: str, port: int, push_handler=None, timeout: float = 10.0) -> Connection:
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    return Connection(reader, writer, push_handler)

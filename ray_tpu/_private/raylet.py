@@ -1,0 +1,790 @@
+"""Raylet: the per-node daemon.
+
+TPU-native analog of the reference raylet (src/ray/raylet/main.cc:119,
+NodeManager at raylet/node_manager.h:125). Collapses into one asyncio
+process:
+
+  * cluster + local task scheduling  (ClusterTaskManager::QueueAndScheduleTask
+                                      cluster_task_manager.cc:44,
+                                      LocalTaskManager::Dispatch...
+                                      local_task_manager.cc:105; hybrid policy
+                                      policy/hybrid_scheduling_policy.cc:186)
+  * worker pool                      (WorkerPool, raylet/worker_pool.h — here
+                                      sized for the TPU world: a handful of
+                                      whole-host workers, not hundreds)
+  * dependency management            (raylet/dependency_manager.h — waits for
+                                      arg objects to land in the local store
+                                      before dispatch)
+  * object transfer                  (ObjectManager::Push/Pull,
+                                      object_manager.cc:339 — chunked pulls
+                                      over the raylet RPC connection)
+  * placement group bundles          (raylet/placement_group_resource_manager.h)
+
+The shared-memory store is created and owned here (the reference runs plasma
+in-process in the raylet: object_manager/plasma/store_runner.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, connect
+
+
+class WorkerHandle:
+    def __init__(self, proc: subprocess.Popen, worker_id: bytes):
+        self.proc = proc
+        self.worker_id = worker_id
+        self.conn: Optional[ServerConnection] = None  # worker -> raylet conn
+        self.port: Optional[int] = None  # worker's own RPC port
+        self.idle = True
+        self.actor_id: Optional[bytes] = None
+        self.actor_resources: Dict[str, float] = {}  # held while actor alive
+        self.current_task: Optional[bytes] = None
+        self.last_idle_time = time.monotonic()
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_host: str,
+        gcs_port: int,
+        resources: Dict[str, float],
+        labels: Dict[str, str] | None = None,
+        object_store_memory: int | None = None,
+        is_head: bool = False,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        cfg = get_config()
+        self.node_id = NodeID.from_random()
+        self.gcs_host, self.gcs_port = gcs_host, gcs_port
+        self.host = host
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = labels or {}
+        self.is_head = is_head
+        self.store_name = f"/rtstore_{self.node_id.hex()[:12]}"
+        self.store = ObjectStore(
+            self.store_name,
+            object_store_memory or cfg.object_store_memory,
+            create=True,
+        )
+        self.rpc = RpcServer(host, port)
+        self.gcs: Optional[Connection] = None
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.task_queue: deque = deque()  # (spec, reply_future)
+        # Resources demanded by queued-but-undispatched tasks; makes the
+        # submit-time spillover decision aware of committed local work
+        # (ClusterResourceScheduler accounts for queued demand the same way).
+        self.queued_demand: Dict[str, float] = {}
+        self.inflight: Dict[bytes, dict] = {}  # task_id -> {spec, fut, worker}
+        self.bundles: Dict[tuple, Dict[str, float]] = {}  # (pg_id, idx) -> resources
+        self.peer_conns: Dict[bytes, Connection] = {}
+        self.node_cache: Dict[bytes, dict] = {}
+        self._dispatch_event = asyncio.Event()
+        self._stopping = False
+        self._bg: List[asyncio.Task] = []
+        self._object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
+
+        r = self.rpc.register
+        r("register_worker", self.h_register_worker)
+        r("submit_task", self.h_submit_task)
+        r("task_done", self.h_task_done)
+        r("pull_object", self.h_pull_object)
+        r("fetch_chunk", self.h_fetch_chunk)
+        r("wait_object_local", self.h_wait_object_local)
+        r("get_info", self.h_get_info)
+        r("prestart_workers", self.h_prestart_workers)
+
+    # ------------------------------------------------------------------
+    async def start(self) -> int:
+        port = await self.rpc.start()
+        self.port = port
+        self.gcs = await connect(
+            self.gcs_host, self.gcs_port, push_handler=self._on_gcs_push
+        )
+        await self.gcs.call(
+            "register_node",
+            {
+                "node_id": self.node_id.binary(),
+                "address": self.host,
+                "port": port,
+                "object_store_name": self.store_name,
+                "resources": self.resources_total,
+                "labels": self.labels,
+                "is_head": self.is_head,
+            },
+        )
+        for ch in ("create_actor", "kill_actor_worker", "reserve_bundle",
+                   "cancel_bundle", "node_dead"):
+            await self.gcs.call("subscribe", {"channel": ch})
+        self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
+        self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._bg.append(asyncio.ensure_future(self._reap_loop()))
+        return port
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._bg:
+            t.cancel()
+        for w in self.workers.values():
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        for w in self.workers.values():
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        await self.rpc.stop()
+        if self.gcs:
+            await self.gcs.close()
+        self.store.destroy()
+
+    # -- GCS pushes ------------------------------------------------------
+    def _on_gcs_push(self, channel: str, payload: Any):
+        asyncio.ensure_future(self._handle_gcs_push(channel, payload))
+
+    async def _handle_gcs_push(self, channel: str, payload: Any):
+        if channel == "create_actor":
+            await self._create_actor_worker(payload)
+        elif channel == "kill_actor_worker":
+            aid = payload["actor_id"]
+            for w in list(self.workers.values()):
+                if w.actor_id == aid:
+                    await self._report_worker_dead(w, intended=True, reason="rt.kill")
+                    w.proc.kill()
+                    self._forget_worker(w)
+        elif channel == "reserve_bundle":
+            # Prepare phase: deduct from local availability so heartbeats
+            # reflect the reservation and plain tasks cannot steal the
+            # gang-reserved resources (placement_group_resource_manager.h).
+            key = (payload["pg_id"], payload["bundle_index"])
+            if key not in self.bundles:
+                self.bundles[key] = {
+                    "resources": dict(payload["resources"]),
+                    "available": dict(payload["resources"]),
+                }
+                self._acquire(payload["resources"])
+        elif channel == "cancel_bundle":
+            bundle = self.bundles.pop(
+                (payload["pg_id"], payload["bundle_index"]), None
+            )
+            if bundle is not None:
+                for k, v in bundle["resources"].items():
+                    self.resources_available[k] = (
+                        self.resources_available.get(k, 0) + v
+                    )
+        elif channel == "node_dead":
+            nid = payload["node_id"]
+            conn = self.peer_conns.pop(nid, None)
+            if conn:
+                await conn.close()
+            self.node_cache.pop(nid, None)
+
+    # -- worker pool -----------------------------------------------------
+    def _spawn_worker(self) -> WorkerHandle:
+        """Fork a worker process (WorkerPool::StartWorkerProcess analog)."""
+        worker_id = os.urandom(16)
+        env = dict(os.environ)
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(getattr(self, "spawn_env_overrides", None) or {})
+        env["RT_WORKER_ID"] = worker_id.hex()
+        env["RT_NODE_ID"] = self.node_id.hex()
+        env["RT_RAYLET_PORT"] = str(self.port)
+        env["RT_GCS_ADDR"] = f"{self.gcs_host}:{self.gcs_port}"
+        env["RT_STORE_NAME"] = self.store_name
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        handle = WorkerHandle(proc, worker_id)
+        self.workers[worker_id] = handle
+        return handle
+
+    async def h_register_worker(self, d, conn: ServerConnection):
+        w = self.workers.get(d["worker_id"])
+        if w is None:  # externally started (tests)
+            w = WorkerHandle(None, d["worker_id"])
+            self.workers[d["worker_id"]] = w
+        w.conn = conn
+        w.port = d["port"]
+        conn.meta["worker_id"] = d["worker_id"]
+        self._dispatch_event.set()
+        return {"node_id": self.node_id.binary()}
+
+    def _forget_worker(self, w: WorkerHandle):
+        self.workers.pop(w.worker_id, None)
+        # Return an actor worker's held resources.
+        if w.actor_id is not None and w.actor_resources:
+            bundle_key = getattr(w, "actor_bundle", None)
+            bundle = self.bundles.get(bundle_key) if bundle_key else None
+            if bundle is not None:
+                for k, v in w.actor_resources.items():
+                    bundle["available"][k] = bundle["available"].get(k, 0) + v
+            else:
+                for k, v in w.actor_resources.items():
+                    self.resources_available[k] = (
+                        self.resources_available.get(k, 0) + v
+                    )
+            w.actor_resources = {}
+
+    async def _report_worker_dead(self, w: WorkerHandle, intended=False, reason=""):
+        if w.actor_id is not None:
+            await self.gcs.call(
+                "worker_dead",
+                {
+                    "actor_id": w.actor_id,
+                    "intended": intended,
+                    "reason": reason,
+                    "no_restart": False,
+                },
+            )
+
+    async def _reap_loop(self):
+        """Detect dead worker processes; fail their tasks/actors."""
+        while True:
+            await asyncio.sleep(0.2)
+            for w in list(self.workers.values()):
+                if w.proc is not None and w.proc.poll() is not None:
+                    self._forget_worker(w)
+                    # fail in-flight task
+                    if w.current_task is not None:
+                        entry = self.inflight.pop(w.current_task, None)
+                        if entry and not entry["fut"].done():
+                            entry["fut"].set_result(
+                                {"status": "worker_crashed",
+                                 "error": f"worker exited with code {w.proc.returncode}"}
+                            )
+                        self._release_task_resources(entry["spec"]) if entry else None
+                    await self._report_worker_dead(
+                        w, intended=False,
+                        reason=f"worker process exited ({w.proc.returncode})",
+                    )
+                    self._dispatch_event.set()
+
+    async def _create_actor_worker(self, payload):
+        """Spawn a dedicated worker for an actor and hand it the create spec.
+
+        The actor's resources are held for the worker's lifetime (the
+        reference acquires them through the lease protocol; tasks here
+        release per-call, actors release on death)."""
+        resources = payload.get("resources", {})
+        sched = payload.get("scheduling") or {}
+        bundle = None
+        if sched.get("type") == "placement_group":
+            bundle = self.bundles.get(
+                (sched["pg_id"], sched.get("bundle_index") or 0)
+            )
+        if bundle is not None:
+            for k, v in resources.items():
+                bundle["available"][k] = bundle["available"].get(k, 0) - v
+        else:
+            self._acquire(resources)
+        w = self._spawn_worker()
+        w.idle = False
+        w.actor_id = payload["actor_id"]
+        w.actor_resources = dict(resources)
+        w.actor_bundle = (sched["pg_id"], sched.get("bundle_index") or 0) if bundle is not None else None
+        # Wait for registration, then push the creation task.
+        for _ in range(600):
+            if w.conn is not None:
+                break
+            await asyncio.sleep(0.05)
+        if w.conn is None:
+            await self.gcs.call(
+                "worker_dead",
+                {"actor_id": w.actor_id, "reason": "actor worker failed to start"},
+            )
+            return
+        await w.conn.push("create_actor", payload["create_spec"])
+
+    async def h_prestart_workers(self, d, conn):
+        n = d.get("num", 1)
+        for _ in range(n):
+            self._spawn_worker()
+        return {"ok": True}
+
+    # -- scheduling ------------------------------------------------------
+    def _feasible_locally(self, resources: Dict[str, float]) -> bool:
+        return all(
+            self.resources_total.get(k, 0) + 1e-9 >= v for k, v in resources.items()
+        )
+
+    def _available_locally(self, resources: Dict[str, float]) -> bool:
+        return all(
+            self.resources_available.get(k, 0) + 1e-9 >= v
+            for k, v in resources.items()
+        )
+
+    def _available_for_new_work(self, resources: Dict[str, float]) -> bool:
+        """Availability minus demand already committed to the local queue."""
+        return all(
+            self.resources_available.get(k, 0) - self.queued_demand.get(k, 0) + 1e-9
+            >= v
+            for k, v in resources.items()
+        )
+
+    def _queued_demand_add(self, resources: Dict[str, float], sign: float):
+        for k, v in resources.items():
+            self.queued_demand[k] = self.queued_demand.get(k, 0) + sign * v
+
+    def _acquire(self, resources: Dict[str, float]):
+        for k, v in resources.items():
+            self.resources_available[k] = self.resources_available.get(k, 0) - v
+
+    def _bundle_for(self, spec) -> Optional[dict]:
+        pb = spec.get("pg_bundle")
+        if not pb:
+            return None
+        return self.bundles.get((pb[0], pb[1]))
+
+    def _try_acquire_for(self, spec) -> bool:
+        """Acquire task resources — from its placement-group bundle if the
+        task targets one, else from node availability."""
+        resources = spec.get("resources", {})
+        bundle = self._bundle_for(spec)
+        if spec.get("pg_bundle") is not None:
+            if bundle is None:
+                return False  # bundle cancelled; caller errors the task
+            avail = bundle["available"]
+            if not all(avail.get(k, 0) + 1e-9 >= v for k, v in resources.items()):
+                return False
+            for k, v in resources.items():
+                avail[k] = avail.get(k, 0) - v
+            return True
+        if not self._available_locally(resources):
+            return False
+        self._acquire(resources)
+        return True
+
+    def _release_task_resources(self, spec):
+        resources = spec.get("resources", {})
+        bundle = self._bundle_for(spec)
+        if spec.get("pg_bundle") is not None:
+            if bundle is not None:
+                for k, v in resources.items():
+                    bundle["available"][k] = bundle["available"].get(k, 0) + v
+            return
+        for k, v in resources.items():
+            self.resources_available[k] = self.resources_available.get(k, 0) + v
+
+    def _critical_utilization(self) -> float:
+        util = 0.0
+        for k, total in self.resources_total.items():
+            if total > 0:
+                util = max(
+                    util, 1.0 - self.resources_available.get(k, 0) / total
+                )
+        return util
+
+    async def _pick_node_by_labels(self, hard: Dict[str, str],
+                                   soft: Dict[str, str]) -> Optional[bytes]:
+        """NodeLabelSchedulingStrategy (util/scheduling_strategies.py:135
+        in the reference): hard labels must all match; soft labels break
+        ties."""
+        resp = await self.gcs.call("get_nodes", {})
+        best, best_soft = None, -1
+        for n in resp["nodes"]:
+            if n["state"] != "ALIVE":
+                continue
+            labels = n.get("labels") or {}
+            if not all(labels.get(k) == v for k, v in hard.items()):
+                continue
+            nsoft = sum(1 for k, v in soft.items() if labels.get(k) == v)
+            if nsoft > best_soft:
+                best, best_soft = n["node_id"], nsoft
+        return best
+
+    async def _pick_remote_node(self, resources) -> Optional[dict]:
+        """Best remote node by lowest utilization (hybrid policy tail)."""
+        resp = await self.gcs.call("get_nodes", {})
+        best, best_util = None, None
+        for n in resp["nodes"]:
+            if n["state"] != "ALIVE" or n["node_id"] == self.node_id.binary():
+                continue
+            avail, total = n["resources_available"], n["resources_total"]
+            if not all(avail.get(k, 0) + 1e-9 >= v for k, v in resources.items()):
+                continue
+            util = 0.0
+            for k, t in total.items():
+                if t > 0:
+                    util = max(util, 1.0 - avail.get(k, 0) / t)
+            if best_util is None or util < best_util:
+                best, best_util = n, util
+        return best
+
+    async def h_submit_task(self, d, conn):
+        """Queue a task; the response resolves when the task completes.
+
+        This fuses the reference's RequestWorkerLease
+        (node_manager.cc:1722) + PushTask into a single call: the driver's
+        submit RPC stays open (pipelined with others on the connection) and
+        its response carries the result or its location.
+        """
+        spec = d
+        fut = asyncio.get_event_loop().create_future()
+
+        sched = spec.get("scheduling") or {}
+        resources = spec.get("resources", {})
+        target_node: Optional[bytes] = None
+
+        if sched.get("type") == "node_affinity":
+            target_node = sched["node_id"]
+        elif sched.get("type") == "placement_group":
+            pg = await self.gcs.call("get_placement_group", {"pg_id": sched["pg_id"]})
+            if not pg["pg"] or pg["pg"]["state"] != "CREATED":
+                return {"status": "error", "error": "placement group not ready"}
+            idx = sched.get("bundle_index") or 0
+            target_node = pg["pg"]["bundle_nodes"][idx]
+            spec["pg_bundle"] = [sched["pg_id"], idx]
+        elif sched.get("type") == "node_label":
+            target_node = await self._pick_node_by_labels(
+                sched.get("hard", {}), sched.get("soft", {})
+            )
+            if target_node is None:
+                return {
+                    "status": "error",
+                    "error": f"no node matches hard labels {sched.get('hard')}",
+                }
+        elif sched.get("type") == "spread":
+            node = await self._pick_remote_node(resources)
+            if node is not None and self._critical_utilization() > 0:
+                target_node = node["node_id"]
+
+        if target_node is not None and target_node != self.node_id.binary():
+            return await self._forward_task(spec, target_node)
+
+        if target_node is None and not spec.get("forwarded"):
+            # Hybrid policy (hybrid_scheduling_policy.cc:186): prefer local
+            # until the critical resource passes the spread threshold, then
+            # pick the least-utilized feasible remote node. Queued-but-
+            # undispatched demand counts as local load. Forwarded tasks are
+            # pinned here (single spillback, like the reference's lease
+            # spillback counting).
+            cfg = get_config()
+            if not self._feasible_locally(resources) or not self._available_for_new_work(resources):
+                node = await self._pick_remote_node(resources)
+                if node is not None:
+                    return await self._forward_task(spec, node["node_id"])
+                if not self._feasible_locally(resources):
+                    return {
+                        "status": "error",
+                        "error": f"no node can satisfy resources {resources}",
+                    }
+
+        self.task_queue.append((spec, fut))
+        self._queued_demand_add(resources, +1)
+        self._dispatch_event.set()
+        return await fut
+
+    async def _forward_task(self, spec, node_id: bytes):
+        conn = await self._peer(node_id)
+        if conn is None:
+            return {"status": "error", "error": "target node unavailable"}
+        spec = dict(spec)
+        spec["scheduling"] = None  # already routed
+        spec["forwarded"] = True
+        return await conn.call("submit_task", spec, timeout=None)
+
+    async def _peer(self, node_id: bytes) -> Optional[Connection]:
+        conn = self.peer_conns.get(node_id)
+        if conn is not None and not conn._closed:
+            return conn
+        info = self.node_cache.get(node_id)
+        if info is None:
+            resp = await self.gcs.call("get_nodes", {})
+            for n in resp["nodes"]:
+                self.node_cache[n["node_id"]] = n
+            info = self.node_cache.get(node_id)
+        if info is None or info["state"] != "ALIVE":
+            return None
+        try:
+            conn = await connect(info["address"], info["port"])
+        except OSError:
+            return None
+        self.peer_conns[node_id] = conn
+        return conn
+
+    async def _dispatch_loop(self):
+        """LocalTaskManager::DispatchScheduledTasksToWorkers analog."""
+        cfg = get_config()
+        while True:
+            await self._dispatch_event.wait()
+            self._dispatch_event.clear()
+            requeue = []
+            while self.task_queue:
+                spec, fut = self.task_queue.popleft()
+                if fut.done():
+                    self._queued_demand_add(spec.get("resources", {}), -1)
+                    continue
+                resources = spec.get("resources", {})
+                if spec.get("pg_bundle") is not None and self._bundle_for(spec) is None:
+                    self._queued_demand_add(resources, -1)
+                    if not fut.done():
+                        fut.set_result(
+                            {"status": "error",
+                             "error": "placement group bundle was removed"}
+                        )
+                    continue
+                deps = spec.get("deps") or []
+                missing = [d for d in deps if not self.store.contains_raw(d)]
+                if missing:
+                    asyncio.ensure_future(self._fetch_then_requeue(spec, fut, missing))
+                    continue
+                worker = self._idle_worker()
+                if worker is None:
+                    # Spawn only as many workers as there is queued work,
+                    # counting ones still starting up (WorkerPool prestart
+                    # logic, worker_pool.h:347) — never a spawn storm.
+                    n_live = sum(
+                        1 for w in self.workers.values() if w.actor_id is None
+                    )
+                    n_starting = sum(
+                        1
+                        for w in self.workers.values()
+                        if w.actor_id is None and w.conn is None
+                    )
+                    wanted = 1 + len(self.task_queue) + len(requeue)
+                    if n_live < cfg.max_workers_per_node and n_starting < wanted:
+                        self._spawn_worker()
+                    requeue.append((spec, fut))
+                    continue
+                if not self._try_acquire_for(spec):
+                    requeue.append((spec, fut))
+                    continue
+                self._queued_demand_add(resources, -1)
+                worker.idle = False
+                worker.current_task = spec["task_id"]
+                self.inflight[spec["task_id"]] = {
+                    "spec": spec,
+                    "fut": fut,
+                    "worker": worker,
+                }
+                await worker.conn.push("run_task", spec)
+            for item in requeue:
+                self.task_queue.append(item)
+            if requeue:
+                await asyncio.sleep(0.02)
+                self._dispatch_event.set()
+
+    def _idle_worker(self) -> Optional[WorkerHandle]:
+        for w in self.workers.values():
+            if w.idle and w.conn is not None and w.actor_id is None:
+                return w
+        return None
+
+    async def _fetch_then_requeue(self, spec, fut, missing):
+        """DependencyManager analog: pull remote deps then requeue."""
+        try:
+            await asyncio.gather(*[self._ensure_local(oid) for oid in missing])
+        except Exception as e:  # noqa: BLE001
+            self._queued_demand_add(spec.get("resources", {}), -1)
+            if not fut.done():
+                fut.set_result({"status": "error", "error": f"dependency fetch failed: {e}"})
+            return
+        self.task_queue.append((spec, fut))
+        self._dispatch_event.set()
+
+    async def h_task_done(self, d, conn):
+        """Worker reports task completion (the PushTask reply path)."""
+        entry = self.inflight.pop(d["task_id"], None)
+        if entry is None:
+            return {"ok": False}
+        w = entry["worker"]
+        w.idle = True
+        w.current_task = None
+        w.last_idle_time = time.monotonic()
+        self._release_task_resources(entry["spec"])
+        if not entry["fut"].done():
+            entry["fut"].set_result(d["result"])
+        self._dispatch_event.set()
+        return {"ok": True}
+
+    # -- object transfer -------------------------------------------------
+    async def _ensure_local(self, oid_bytes: bytes, timeout: float = 60.0):
+        """Pull an object into the local store (PullManager analog)."""
+        if self.store.contains_raw(oid_bytes):
+            return
+        resp = await self.gcs.call(
+            "object_location_wait", {"object_id": oid_bytes, "timeout": timeout}
+        )
+        nodes = [n for n in resp["nodes"] if n != self.node_id.binary()]
+        if resp.get("timeout") or (not nodes and not self.store.contains_raw(oid_bytes)):
+            if self.store.contains_raw(oid_bytes):
+                return
+            raise KeyError(f"object {oid_bytes.hex()} has no locations")
+        if self.store.contains_raw(oid_bytes):
+            return
+        last_err = None
+        for nid in nodes:
+            peer = await self._peer(nid)
+            if peer is None:
+                continue
+            try:
+                await self._pull_from(peer, oid_bytes, resp["size"])
+                await self.gcs.call(
+                    "object_location_add",
+                    {
+                        "object_id": oid_bytes,
+                        "node_id": self.node_id.binary(),
+                        "size": resp["size"],
+                    },
+                )
+                return
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+        raise KeyError(f"failed to pull object {oid_bytes.hex()}: {last_err}")
+
+    async def _pull_from(self, peer: Connection, oid_bytes: bytes, size: int):
+        """Chunked pull (ObjectManager::Push sends 5MiB chunks,
+        object_manager.cc:325; chunk size ray_config_def.h:362)."""
+        cfg = get_config()
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(oid_bytes)
+        meta = await peer.call("pull_object", {"object_id": oid_bytes})
+        if not meta.get("ok"):
+            raise KeyError(meta.get("error", "remote miss"))
+        total = meta["size"]
+        if self.store.contains(oid):
+            return
+        try:
+            buf = self.store.create(oid, total)
+        except ValueError:
+            return  # concurrent pull
+        try:
+            off = 0
+            chunk = cfg.object_transfer_chunk_size
+            while off < total:
+                n = min(chunk, total - off)
+                resp = await peer.call(
+                    "fetch_chunk",
+                    {"object_id": oid_bytes, "offset": off, "size": n},
+                )
+                data = resp["data"]
+                buf[off : off + len(data)] = data
+                off += len(data)
+        except Exception:
+            del buf
+            self.store.abort(oid)
+            raise
+        del buf
+        self.store.seal(oid)
+        self.store.release(oid)
+
+    async def h_pull_object(self, d, conn):
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(d["object_id"])
+        view = self.store.get(oid)
+        if view is None:
+            return {"ok": False, "error": "not found"}
+        size = len(view)
+        del view
+        self.store.release(oid)
+        return {"ok": True, "size": size}
+
+    async def h_fetch_chunk(self, d, conn):
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(d["object_id"])
+        view = self.store.get(oid)
+        if view is None:
+            raise KeyError("object evicted mid-transfer")
+        try:
+            data = bytes(view[d["offset"] : d["offset"] + d["size"]])
+        finally:
+            del view
+            self.store.release(oid)
+        return {"data": data}
+
+    async def h_wait_object_local(self, d, conn):
+        """Driver asks: make this object available in the local store."""
+        await self._ensure_local(d["object_id"], d.get("timeout", 60.0))
+        return {"ok": True}
+
+    async def h_get_info(self, d, conn):
+        return {
+            "node_id": self.node_id.binary(),
+            "store_name": self.store_name,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "store_stats": self.store.stats(),
+        }
+
+    # -- sync ------------------------------------------------------------
+    async def _heartbeat_loop(self):
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.health_check_period_s / 2)
+            try:
+                await self.gcs.call(
+                    "resource_update",
+                    {
+                        "node_id": self.node_id.binary(),
+                        "available": self.resources_available,
+                    },
+                )
+            except Exception:
+                if self._stopping:
+                    return
+
+
+def main():  # pragma: no cover - run as subprocess
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs-host", default="127.0.0.1")
+    p.add_argument("--gcs-port", type=int, required=True)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--head", action="store_true")
+    args = p.parse_args()
+
+    import json
+
+    resources = json.loads(args.resources)
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    resources.setdefault("CPU", float(os.cpu_count() or 1))
+
+    async def run():
+        raylet = Raylet(
+            args.gcs_host,
+            args.gcs_port,
+            resources,
+            object_store_memory=args.object_store_memory,
+            is_head=args.head,
+        )
+        port = await raylet.start()
+        print(f"RAYLET_PORT={port}", flush=True)
+        print(f"RAYLET_NODE_ID={raylet.node_id.hex()}", flush=True)
+        print(f"RAYLET_STORE={raylet.store_name}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,900 @@
+"""Client runtime shared by drivers and workers.
+
+Analog of the reference CoreWorker (src/ray/core_worker/core_worker.h:290)
+plus the driver plumbing in python/ray/_private/worker.py: object refs,
+task submission (SubmitTask core_worker.cc:1935), actor calls
+(SubmitActorTask core_worker.cc:2241), get/put (core_worker.cc:1406/:1168),
+and the in-process memory store for small/inline objects
+(store_provider/memory_store/memory_store.h:43).
+
+Threading model: all I/O runs on one asyncio loop (a background thread in
+drivers, the main loop in workers); the public API is synchronous and posts
+coroutines to that loop. User task code executes on executor threads and can
+reenter the API (e.g. rt.get inside a task).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import itertools
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import get_config
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, object_id_for_task
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.protocol import Connection, ConnectionLost, connect
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+# Thread-local flag: serializing task args => promote refs to the shared store.
+_ser_ctx = threading.local()
+
+
+class _InStoreSentinel:
+    """Marks a completion future whose value lives in the shared store."""
+
+    def __repr__(self):
+        return "<in-store>"
+
+
+_IN_STORE = _InStoreSentinel()
+
+
+class ObjectRef:
+    """A reference to a (possibly pending) remote object.
+
+    Reference analog: ObjectRef in python/ray/includes/object_ref.pxi; the
+    completion future mirrors the owner's TaskManager bookkeeping.
+    """
+
+    __slots__ = ("id", "_future", "__weakref__")
+
+    def __init__(self, id: ObjectID, future: Optional[concurrent.futures.Future] = None):
+        self.id = id
+        self._future = future
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def future(self) -> concurrent.futures.Future:
+        """A concurrent future resolving to the object's value."""
+        fut = concurrent.futures.Future()
+
+        def fill():
+            try:
+                fut.set_result(get_client().get([self], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=fill, daemon=True).start()
+        return fut
+
+    def __reduce__(self):
+        if getattr(_ser_ctx, "promote", False):
+            client = _global_client
+            if client is not None:
+                client.promote_ref(self)
+        return (_ref_from_binary, (self.id.binary(),))
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+
+def _ref_from_binary(b: bytes) -> ObjectRef:
+    client = _global_client
+    if client is not None:
+        existing = client.known_refs.get(b)
+        if existing is not None:
+            return existing
+    return ObjectRef(ObjectID(b))
+
+
+class ActorHandle:
+    """Client-side handle to an actor (reference: python/ray/actor.py ActorHandle)."""
+
+    def __init__(self, actor_id: ActorID, class_name: str, method_names: List[str],
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_names = list(method_names)
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        # An empty method list means the handle was looked up before the
+        # actor finished creation; defer validation to the receiving worker.
+        if self._method_names and item not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name} has no method {item!r}"
+            )
+        return ActorMethod(self, item)
+
+    def _kill(self, no_restart: bool = True):
+        get_client().kill_actor(self._actor_id, no_restart)
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_name, self._method_names,
+             self._max_task_retries),
+        )
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+
+class ActorMethod:
+    def __init__(self, handle: ActorHandle, name: str, num_returns: int = 1,
+                 max_task_retries: Optional[int] = None):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+        self._max_task_retries = max_task_retries
+
+    def options(self, num_returns: int = 1, max_task_retries: Optional[int] = None):
+        return ActorMethod(self._handle, self._name, num_returns, max_task_retries)
+
+    def remote(self, *args, **kwargs):
+        retries = (
+            self._max_task_retries
+            if self._max_task_retries is not None
+            else self._handle._max_task_retries
+        )
+        refs = get_client().submit_actor_call(
+            self._handle._actor_id,
+            self._name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=retries,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor methods cannot be called directly; use "
+            f".{self._name}.remote(...)"
+        )
+
+
+class _Pin:
+    """Keeps a store object pinned while a deserialized value is alive."""
+
+    __slots__ = ("store", "oid")
+
+    def __init__(self, store: ObjectStore, oid: ObjectID):
+        self.store = store
+        self.oid = oid
+
+    def release(self):
+        if self.store is not None:
+            try:
+                self.store.release(self.oid)
+            except Exception:
+                pass
+            self.store = None
+
+
+class CoreClient:
+    """Synchronous facade over the asyncio control plane."""
+
+    def __init__(
+        self,
+        loop: asyncio.AbstractEventLoop,
+        gcs_addr: Tuple[str, int],
+        raylet_addr: Tuple[str, int],
+        store_name: str,
+        node_id: bytes,
+        job_id: JobID,
+        mode: str = "driver",
+    ):
+        import os as _os
+
+        self.loop = loop
+        self.gcs_addr = gcs_addr
+        self.raylet_addr = raylet_addr
+        self.node_id = node_id
+        self.job_id = job_id
+        self.mode = mode
+        self.client_id = _os.urandom(16)
+        self.store = ObjectStore(store_name)
+        # LRU-bounded cache of inline results (the in-process memory store,
+        # memory_store.h:43). Values remain recoverable from a live ref's
+        # completion future after eviction, so the bound is safe.
+        from collections import OrderedDict
+
+        self.memory_store: "OrderedDict[bytes, Any]" = OrderedDict()
+        self.memory_store_max_entries = 8192
+        self.known_refs: "weakref.WeakValueDictionary[bytes, ObjectRef]" = (
+            weakref.WeakValueDictionary()
+        )
+        self.fn_manager = FunctionManager(self)
+        self.gcs: Optional[Connection] = None
+        self.raylet: Optional[Connection] = None
+        self._actor_cache: Dict[bytes, dict] = {}
+        self._actor_conns: Dict[Tuple[str, int], Connection] = {}
+        self._actor_locks: Dict[bytes, asyncio.Lock] = {}
+        self._actor_events: Dict[bytes, threading.Event] = {}
+        self._pins: Dict[bytes, _Pin] = {}
+        self._in_store: set = set()  # oids known to live in shared store
+        self._push_handlers = {}
+        self._connected = False
+
+    # -- bootstrap -------------------------------------------------------
+    def connect(self):
+        fut = asyncio.run_coroutine_threadsafe(self._connect(), self.loop)
+        fut.result(timeout=get_config().rpc_connect_timeout_s * 3)
+        self._connected = True
+
+    async def _connect(self):
+        self.gcs = await connect(*self.gcs_addr, push_handler=self._on_push)
+        self.raylet = await connect(*self.raylet_addr)
+
+    def _on_push(self, channel: str, payload):
+        if channel.startswith("actor_update:"):
+            aid = bytes.fromhex(channel.split(":", 1)[1])
+            self._actor_cache[aid] = payload
+            ev = self._actor_events.get(aid)
+            if ev:
+                ev.set()
+        handler = self._push_handlers.get(channel)
+        if handler:
+            handler(payload)
+
+    def disconnect(self):
+        for pin in self._pins.values():
+            pin.release()
+        self._pins.clear()
+
+        async def _close():
+            for c in list(self._actor_conns.values()):
+                await c.close()
+            if self.gcs:
+                await self.gcs.close()
+            if self.raylet:
+                await self.raylet.close()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_close(), self.loop).result(timeout=5)
+        except Exception:
+            pass
+        self.store.close()
+        self._connected = False
+
+    def _run(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    # -- kv --------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes, ns: str = "", overwrite=True) -> bool:
+        r = self._run(
+            self.gcs.call(
+                "kv_put", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
+            )
+        )
+        return r["added"]
+
+    def kv_get(self, key: bytes, ns: str = "") -> Optional[bytes]:
+        return self._run(self.gcs.call("kv_get", {"ns": ns, "key": key}))["value"]
+
+    def kv_del(self, key: bytes, ns: str = "") -> bool:
+        return self._run(self.gcs.call("kv_del", {"ns": ns, "key": key}))["deleted"]
+
+    def kv_keys(self, prefix: bytes = b"", ns: str = "") -> List[bytes]:
+        return self._run(self.gcs.call("kv_keys", {"ns": ns, "prefix": prefix}))["keys"]
+
+    # -- serialization helpers -------------------------------------------
+    def serialize_args(self, args, kwargs) -> Tuple[bytes, List[bytes]]:
+        """Serialize (args, kwargs); top-level refs become _ArgRef markers,
+        nested refs are promoted to the shared store.
+
+        Mirrors the reference's plasma-promotion of serialized ObjectRefs
+        and inline substitution of resolved top-level args
+        (transport/dependency_resolver.cc).
+        """
+        deps: List[bytes] = []
+        processed_args = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                a = self._arg_for_ref(a, deps)
+            processed_args.append(a)
+        processed_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ObjectRef):
+                v = self._arg_for_ref(v, deps)
+            processed_kwargs[k] = v
+        _ser_ctx.promote = True
+        try:
+            payload = ser.serialize_to_bytes((processed_args, processed_kwargs))
+        finally:
+            _ser_ctx.promote = False
+        return payload, deps
+
+    def _arg_for_ref(self, ref: ObjectRef, deps: List[bytes]):
+        oid = ref.id.binary()
+        if oid in self.memory_store and oid not in self._in_store:
+            return _InlineArg(self.memory_store[oid])
+        # Wait for pending local task results so the dep is materialized.
+        if ref._future is not None:
+            value = ref._future.result()
+            if value is not _IN_STORE and oid not in self._in_store:
+                return _InlineArg(value)
+        deps.append(oid)
+        return _StoreArg(oid)
+
+    def deserialize_args(self, payload: bytes):
+        args, kwargs = ser.deserialize_from_bytes(payload)
+        args = tuple(self._resolve_arg(a) for a in args)
+        kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _resolve_arg(self, a):
+        if isinstance(a, _InlineArg):
+            return a.value
+        if isinstance(a, _StoreArg):
+            return self.get([ObjectRef(ObjectID(a.oid))], timeout=60.0)[0]
+        return a
+
+    def promote_ref(self, ref: ObjectRef):
+        """Ensure a ref's value is resolvable from the shared store."""
+        oid = ref.id.binary()
+        if oid in self._in_store or self.store.contains_raw(oid):
+            return
+        value = None
+        have_value = False
+        if oid in self.memory_store:
+            value = self.memory_store[oid]
+            have_value = True
+        elif ref._future is not None:
+            value = ref._future.result()
+            have_value = value is not _IN_STORE
+        if have_value:
+            self._put_to_store(ObjectID(oid), value)
+        # else: remote object; the directory resolves it
+
+    def _put_to_store(self, oid: ObjectID, value) -> int:
+        so = ser.serialize(value)
+        if self.store.put_serialized(oid, so):
+            self._run(
+                self.gcs.call(
+                    "object_location_add",
+                    {
+                        "object_id": oid.binary(),
+                        "node_id": self.node_id,
+                        "size": so.total_size,
+                    },
+                )
+            )
+        self._in_store.add(oid.binary())
+        return so.total_size
+
+    # -- put / get / wait -------------------------------------------------
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self._put_to_store(oid, value)
+        ref = ObjectRef(oid)
+        self.known_refs[oid.binary()] = ref
+        return ref
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            out.append(self._get_one(ref, deadline))
+        return out
+
+    def _memory_store_put(self, oid: bytes, value):
+        ms = self.memory_store
+        ms[oid] = value
+        ms.move_to_end(oid)
+        while len(ms) > self.memory_store_max_entries:
+            ms.popitem(last=False)
+
+    def _get_one(self, ref: ObjectRef, deadline):
+        oid = ref.id.binary()
+        if ref._future is not None:
+            remaining = None if deadline is None else max(0, deadline - time.monotonic())
+            try:
+                completed = ref._future.result(remaining)
+            except concurrent.futures.TimeoutError:
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            if completed is not _IN_STORE and oid not in self.memory_store:
+                # Inline result evicted from the LRU cache; the completion
+                # future still holds it.
+                return completed
+        if oid in self.memory_store:
+            return self.memory_store[oid]
+        if self.store.contains_raw(oid):
+            return self._read_store(ObjectID(oid))
+        # Remote: ask our raylet to pull it locally.
+        remaining = 60.0 if deadline is None else max(0.1, deadline - time.monotonic())
+        try:
+            self._run(
+                self.raylet.call(
+                    "wait_object_local", {"object_id": oid, "timeout": remaining},
+                    timeout=remaining + 5,
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            raise ObjectLostError(
+                f"object {ref.hex()} could not be retrieved: {e}"
+            ) from None
+        return self._read_store(ObjectID(oid))
+
+    def _read_store(self, oid: ObjectID):
+        view = self.store.get(oid)
+        if view is None:
+            raise ObjectLostError(f"object {oid.hex()} missing from local store")
+        value = ser.deserialize(view)
+        # Pin until the session ends or the value is re-fetched; eviction
+        # must not unmap memory under live zero-copy arrays.
+        old = self._pins.get(oid.binary())
+        if old is not None:
+            self.store.release(oid)  # only keep one pin per object
+        else:
+            self._pins[oid.binary()] = _Pin(self.store, oid)
+        self._in_store.add(oid.binary())
+        return value
+
+    def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
+             fetch_local: bool = True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while True:
+            still = []
+            for ref in pending:
+                oid = ref.id.binary()
+                done = (
+                    (ref._future is not None and ref._future.done())
+                    or oid in self.memory_store
+                    or self.store.contains_raw(oid)
+                )
+                if not done and ref._future is None:
+                    # Check the cluster directory for remote completion.
+                    loc = self._run(
+                        self.gcs.call("object_location_get", {"object_id": oid})
+                    )
+                    done = bool(loc["nodes"])
+                (ready if done else still).append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready[:num_returns], ready[num_returns:] + pending
+
+    # -- task submission ---------------------------------------------------
+    def submit_task(
+        self,
+        fn,
+        args,
+        kwargs,
+        name: str = "",
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        scheduling=None,
+        max_retries: Optional[int] = None,
+    ) -> List[ObjectRef]:
+        cfg = get_config()
+        fn_key = self.fn_manager.export(fn)
+        payload, deps = self.serialize_args(args, kwargs)
+        task_id = TaskID.from_random()
+        spec = {
+            "task_id": task_id.binary(),
+            "job_id": self.job_id.binary(),
+            "name": name,
+            "fn_key": fn_key,
+            "args": payload,
+            "deps": deps,
+            "num_returns": num_returns,
+            "resources": resources if resources is not None else {"CPU": 1.0},
+            "scheduling": scheduling,
+        }
+        retries = cfg.task_max_retries if max_retries is None else max_retries
+        refs = []
+        futures = []
+        for i in range(num_returns):
+            oid = object_id_for_task(task_id, i)
+            fut = concurrent.futures.Future()
+            ref = ObjectRef(oid, fut)
+            self.known_refs[oid.binary()] = ref
+            refs.append(ref)
+            futures.append(fut)
+        asyncio.run_coroutine_threadsafe(
+            self._submit_with_retries(spec, futures, retries), self.loop
+        )
+        return refs
+
+    async def _submit_with_retries(self, spec, futures, retries):
+        attempt = 0
+        while True:
+            try:
+                result = await self.raylet.call("submit_task", spec, timeout=None)
+            except ConnectionLost:
+                result = {"status": "worker_crashed", "error": "raylet connection lost"}
+            status = result.get("status")
+            if status == "worker_crashed" and attempt < retries:
+                attempt += 1
+                await asyncio.sleep(min(0.1 * attempt, 1.0))
+                continue
+            self._complete_task(spec, result, futures)
+            return
+
+    def _complete_task(self, spec, result, futures):
+        status = result.get("status")
+        if status == "ok":
+            for i, entry in enumerate(result["returns"]):
+                oid = object_id_for_task(TaskID(spec["task_id"]), i).binary()
+                if entry["kind"] == "inline":
+                    try:
+                        value = ser.deserialize_from_bytes(entry["data"])
+                    except Exception as e:  # noqa: BLE001
+                        futures[i].set_exception(
+                            TaskError(type(e).__name__, f"result deserialization failed: {e}")
+                        )
+                        continue
+                    self._memory_store_put(oid, value)
+                    futures[i].set_result(value)
+                else:  # in the shared store
+                    self._in_store.add(oid)
+                    futures[i].set_result(_IN_STORE)
+        elif status == "error":
+            err = _rebuild_task_error(result)
+            for f in futures:
+                if not f.done():
+                    f.set_exception(err)
+        else:
+            err = WorkerCrashedError(result.get("error", "worker crashed"))
+            for f in futures:
+                if not f.done():
+                    f.set_exception(err)
+
+    # -- actors ------------------------------------------------------------
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        name: Optional[str] = None,
+        namespace: str = "",
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        scheduling=None,
+        detached: bool = False,
+    ) -> ActorHandle:
+        cls_key = self.fn_manager.export(cls)
+        payload, deps = self.serialize_args(args, kwargs)
+        actor_id = ActorID.from_random()
+        create_spec = {
+            "actor_id": actor_id.binary(),
+            "cls_key": cls_key,
+            "args": payload,
+            "deps": deps,
+            "max_concurrency": max_concurrency,
+        }
+        resp = self._run(
+            self.gcs.call(
+                "register_actor",
+                {
+                    "actor_id": actor_id.binary(),
+                    "name": name,
+                    "namespace": namespace,
+                    "class_name": getattr(cls, "__name__", str(cls)),
+                    "job_id": self.job_id.binary(),
+                    "resources": resources if resources is not None else {"CPU": 1.0},
+                    "max_restarts": max_restarts,
+                    "create_spec": create_spec,
+                    "detached": detached,
+                    "scheduling": scheduling,
+                },
+            )
+        )
+        if not resp.get("ok"):
+            raise ValueError(resp.get("error", "actor registration failed"))
+        self._run(
+            self.gcs.call("subscribe", {"channel": "actor_update:" + actor_id.hex()})
+        )
+        method_names = [
+            m
+            for m in dir(cls)
+            if callable(getattr(cls, m, None)) and not m.startswith("__")
+        ]
+        return ActorHandle(
+            actor_id,
+            getattr(cls, "__name__", str(cls)),
+            method_names,
+            max_task_retries,
+        )
+
+    def _actor_info(self, actor_id: ActorID, wait_alive_timeout: float = 30.0) -> dict:
+        aid = actor_id.binary()
+        info = self._actor_cache.get(aid)
+        if info is None or info["state"] not in ("ALIVE", "DEAD"):
+            info = self._run(self.gcs.call("get_actor", {"actor_id": aid}))["actor"]
+            if info is not None:
+                self._actor_cache[aid] = info
+        if info is None:
+            raise ActorDiedError(f"unknown actor {actor_id.hex()}")
+        deadline = time.monotonic() + wait_alive_timeout
+        while info["state"] in ("PENDING", "RESTARTING"):
+            ev = self._actor_events.setdefault(aid, threading.Event())
+            ev.clear()
+            self._run(
+                self.gcs.call("subscribe", {"channel": "actor_update:" + actor_id.hex()})
+            )
+            info = self._run(self.gcs.call("get_actor", {"actor_id": aid}))["actor"]
+            self._actor_cache[aid] = info
+            if info["state"] not in ("PENDING", "RESTARTING"):
+                break
+            if not ev.wait(timeout=max(0.05, deadline - time.monotonic())):
+                if time.monotonic() >= deadline:
+                    raise ActorUnavailableError(
+                        f"actor {actor_id.hex()} not ready after {wait_alive_timeout}s"
+                    )
+            info = self._actor_cache.get(aid) or info
+        if info["state"] == "DEAD":
+            raise ActorDiedError(
+                f"actor {actor_id.hex()} is dead: {info.get('death_cause')}"
+            )
+        return info
+
+    def _actor_conn(self, info) -> Connection:
+        key = (info["address"], info["port"])
+        conn = self._actor_conns.get(key)
+        if conn is None or conn._closed:
+            conn = self._run(connect_coro(self.loop, info["address"], info["port"]))
+            self._actor_conns[key] = conn
+        return conn
+
+    def submit_actor_call(
+        self,
+        actor_id: ActorID,
+        method: str,
+        args,
+        kwargs,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> List[ObjectRef]:
+        payload, deps = self.serialize_args(args, kwargs)
+        task_id = TaskID.from_random()
+        request = {
+            "actor_id": actor_id.binary(),
+            "task_id": task_id.binary(),
+            "method": method,
+            "args": payload,
+            "deps": deps,
+            "caller": self.client_id,
+            "num_returns": num_returns,
+        }
+        refs, futures = [], []
+        for i in range(num_returns):
+            oid = object_id_for_task(task_id, i)
+            fut = concurrent.futures.Future()
+            ref = ObjectRef(oid, fut)
+            self.known_refs[oid.binary()] = ref
+            refs.append(ref)
+            futures.append(fut)
+        spec = {"task_id": task_id.binary()}
+        asyncio.run_coroutine_threadsafe(
+            self._actor_call_with_retries(
+                actor_id, request, spec, futures, max_task_retries
+            ),
+            self.loop,
+        )
+        return refs
+
+    async def _actor_call_with_retries(self, actor_id, request, spec, futures, retries):
+        """Send an ordered actor call, retrying across restarts.
+
+        Sequence numbers are assigned at *send* time under a per-actor lock
+        and keyed by the connection instance, so a restarted actor (fresh
+        receiver queue) sees a fresh sequence starting at 0 — the client
+        side of the reference's SequentialActorSubmitQueue contract.
+        """
+        attempt = 0
+        lock = self._actor_locks.setdefault(actor_id.binary(), asyncio.Lock())
+        while True:
+            try:
+                async with lock:
+                    info = await asyncio.get_event_loop().run_in_executor(
+                        None, self._actor_info, actor_id
+                    )
+                    key = (info["address"], info["port"])
+                    conn = self._actor_conns.get(key)
+                    if conn is None or conn._closed:
+                        conn = await connect(info["address"], info["port"])
+                        self._actor_conns[key] = conn
+                    # Counters live on the Connection object itself: their
+                    # lifetime is exactly the connection's, so a restarted
+                    # actor (new connection) always restarts seq at 0 and a
+                    # recycled id() can never resurrect a stale counter.
+                    seqs = getattr(conn, "_rt_actor_seq", None)
+                    if seqs is None:
+                        seqs = conn._rt_actor_seq = {}
+                    counter = seqs.setdefault(actor_id.binary(), itertools.count())
+                    request["seq"] = next(counter)
+                    # Start the call inside the lock so the write order on
+                    # the connection matches seq order; await outside.
+                    call_task = asyncio.ensure_future(
+                        conn.call("actor_call", request, timeout=None)
+                    )
+                result = await call_task
+            except (ConnectionLost, OSError):
+                self._actor_cache.pop(actor_id.binary(), None)
+                if attempt < retries:
+                    attempt += 1
+                    await asyncio.sleep(min(0.2 * attempt, 2.0))
+                    continue
+                err = ActorUnavailableError(
+                    f"actor {actor_id.hex()} connection lost"
+                )
+                for f in futures:
+                    if not f.done():
+                        f.set_exception(err)
+                return
+            except (ActorDiedError, ActorUnavailableError) as e:
+                for f in futures:
+                    if not f.done():
+                        f.set_exception(e)
+                return
+            except BaseException as e:  # noqa: BLE001
+                for f in futures:
+                    if not f.done():
+                        f.set_exception(e)
+                return
+            self._complete_task(spec, result, futures)
+            return
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._run(
+            self.gcs.call(
+                "kill_actor",
+                {"actor_id": actor_id.binary(), "no_restart": no_restart},
+            )
+        )
+
+    def get_actor_by_name(self, name: str, namespace: str = "") -> ActorHandle:
+        info = self._run(
+            self.gcs.call("get_named_actor", {"name": name, "namespace": namespace})
+        )["actor"]
+        if info is None or info["state"] == "DEAD":
+            raise ValueError(f"no live actor named {name!r}")
+        aid = ActorID(info["actor_id"])
+        self._actor_cache[aid.binary()] = info
+        self._run(self.gcs.call("subscribe", {"channel": "actor_update:" + aid.hex()}))
+        # Method names are discovered lazily server-side; fetch from KV.
+        meta = self.kv_get(b"actor_methods:" + aid.binary(), ns="actor")
+        methods = cloudpickle.loads(meta) if meta else []
+        return ActorHandle(aid, info["class_name"], methods)
+
+    # -- cluster introspection --------------------------------------------
+    def nodes(self) -> List[dict]:
+        return self._run(self.gcs.call("get_nodes", {}))["nodes"]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for n in self.nodes():
+            if n["state"] != "ALIVE":
+                continue
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    def available_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for n in self.nodes():
+            if n["state"] != "ALIVE":
+                continue
+            for k, v in n["resources_available"].items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+
+class _InlineArg:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __reduce__(self):
+        return (_InlineArg, (self.value,))
+
+
+class _StoreArg:
+    __slots__ = ("oid",)
+
+    def __init__(self, oid: bytes):
+        self.oid = oid
+
+    def __reduce__(self):
+        return (_StoreArg, (self.oid,))
+
+
+def _rebuild_task_error(result) -> TaskError:
+    cause = None
+    if result.get("data"):
+        try:
+            cause = cloudpickle.loads(result["data"])
+        except Exception:  # noqa: BLE001
+            cause = None
+    return TaskError(result.get("cls", "Exception"), result.get("tb", ""), cause)
+
+
+async def connect_coro(loop, host, port):
+    return await connect(host, port)
+
+
+def make_task_error(exc: BaseException) -> dict:
+    import traceback
+
+    try:
+        data = cloudpickle.dumps(exc)
+    except Exception:  # noqa: BLE001
+        data = None
+    return {
+        "status": "error",
+        "cls": type(exc).__name__,
+        "tb": "".join(traceback.format_exception(type(exc), exc, exc.__traceback__)),
+        "data": data,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Global state (reference: python/ray/_private/worker.py global_worker)
+# ---------------------------------------------------------------------------
+
+_global_client: Optional[CoreClient] = None
+_global_node = None  # the in-process Node when this process started the cluster
+_mode: Optional[str] = None  # "driver" | "worker" | "local"
+_local_state = None
+
+
+def get_client() -> CoreClient:
+    if _global_client is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init() first")
+    return _global_client
+
+
+def set_client(client: Optional[CoreClient], mode: Optional[str], node=None):
+    global _global_client, _mode, _global_node
+    _global_client = client
+    _mode = mode
+    _global_node = node
+
+
+def is_initialized() -> bool:
+    return _global_client is not None or _mode == "local"
+
+
+def get_mode() -> Optional[str]:
+    return _mode

@@ -1,0 +1,268 @@
+"""Worker process entry point.
+
+Analog of the reference's default_worker.py + the task-execution callback in
+the Cython layer (_raylet.pyx:2177 task_execution_handler,
+execute_task_with_cancellation_handler :2009): registers with the raylet,
+receives task pushes, executes user code on executor threads, and serves
+direct actor calls from other processes
+(CoreWorkerDirectTaskReceiver::HandleTask,
+transport/direct_actor_transport.cc:37).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import heapq
+import inspect
+import os
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import JobID, ObjectID, TaskID, object_id_for_task
+from ray_tpu._private.protocol import RpcServer, connect
+from ray_tpu._private.worker import CoreClient, make_task_error
+
+
+class _CallerQueue:
+    """Ordered execution state for one caller (SequentialActorSubmitQueue
+    receiver side, transport/sequential_actor_submit_queue.cc)."""
+
+    def __init__(self):
+        self.next_seq = 0
+        self.pending: list = []  # heap of (seq, tiebreak, request, future)
+        self.draining = False
+
+
+class ActorState:
+    def __init__(self, actor_id: bytes, instance: Any, max_concurrency: int):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.lock = threading.Lock()
+        self.queues: Dict[bytes, _CallerQueue] = {}
+        self.sema = asyncio.Semaphore(max(1, max_concurrency))
+
+
+class WorkerProcess:
+    def __init__(self):
+        self.worker_id = bytes.fromhex(os.environ["RT_WORKER_ID"])
+        self.node_id = bytes.fromhex(os.environ["RT_NODE_ID"])
+        gcs_host, gcs_port = os.environ["RT_GCS_ADDR"].rsplit(":", 1)
+        self.gcs_addr = (gcs_host, int(gcs_port))
+        self.raylet_port = int(os.environ["RT_RAYLET_PORT"])
+        self.store_name = os.environ["RT_STORE_NAME"]
+        self.rpc = RpcServer("127.0.0.1", 0)
+        self.rpc.register("actor_call", self.h_actor_call)
+        self.rpc.register("ping", self.h_ping)
+        self.client: Optional[CoreClient] = None
+        self.raylet_conn = None
+        self.actor: Optional[ActorState] = None
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, get_config().max_workers_per_node)
+        )
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+
+    async def run(self):
+        self.loop = asyncio.get_event_loop()
+        port = await self.rpc.start()
+        self.raylet_conn = await connect(
+            "127.0.0.1", self.raylet_port, push_handler=self._on_raylet_push
+        )
+        self.client = CoreClient(
+            self.loop,
+            self.gcs_addr,
+            ("127.0.0.1", self.raylet_port),
+            self.store_name,
+            self.node_id,
+            JobID.nil(),
+            mode="worker",
+        )
+        await self.client._connect()
+        self.client._connected = True
+        worker_mod.set_client(self.client, "worker")
+        resp = await self.raylet_conn.call(
+            "register_worker", {"worker_id": self.worker_id, "port": port}
+        )
+        assert resp["node_id"] == self.node_id
+        await asyncio.Event().wait()
+
+    # -- raylet pushes ----------------------------------------------------
+    def _on_raylet_push(self, channel: str, payload):
+        if channel == "run_task":
+            asyncio.ensure_future(self._run_task(payload))
+        elif channel == "create_actor":
+            asyncio.ensure_future(self._create_actor(payload))
+
+    async def _run_task(self, spec):
+        result = await self.loop.run_in_executor(
+            self.executor, self._execute_task, spec
+        )
+        await self.raylet_conn.call(
+            "task_done", {"task_id": spec["task_id"], "result": result}
+        )
+
+    def _execute_task(self, spec) -> dict:
+        try:
+            fn = self.client.fn_manager.fetch(spec["fn_key"])
+            args, kwargs = self.client.deserialize_args(spec["args"])
+            value = fn(*args, **kwargs)
+            return self._package_returns(spec, value)
+        except BaseException as e:  # noqa: BLE001 — shipped to the caller
+            return make_task_error(e)
+
+    def _package_returns(self, spec, value) -> dict:
+        cfg = get_config()
+        num_returns = spec.get("num_returns", 1)
+        if num_returns == 1:
+            values = [value]
+        else:
+            values = list(value)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)} values"
+                )
+        returns = []
+        task_id = TaskID(spec["task_id"])
+        for i, v in enumerate(values):
+            so = ser.serialize(v)
+            if so.total_size <= cfg.max_inline_object_size:
+                returns.append({"kind": "inline", "data": so.to_bytes()})
+            else:
+                oid = object_id_for_task(task_id, i)
+                if self.client.store.put_serialized(oid, so):
+                    self.client._run(
+                        self.client.gcs.call(
+                            "object_location_add",
+                            {
+                                "object_id": oid.binary(),
+                                "node_id": self.node_id,
+                                "size": so.total_size,
+                            },
+                        )
+                    )
+                returns.append({"kind": "store", "size": so.total_size})
+        return {"status": "ok", "returns": returns}
+
+    # -- actor lifecycle --------------------------------------------------
+    async def _create_actor(self, payload):
+        def do_create():
+            cls = self.client.fn_manager.fetch(payload["cls_key"])
+            args, kwargs = self.client.deserialize_args(payload["args"])
+            return cls(*args, **kwargs)
+
+        try:
+            instance = await self.loop.run_in_executor(self.executor, do_create)
+            self.actor = ActorState(
+                payload["actor_id"], instance, payload.get("max_concurrency", 1)
+            )
+            methods = [
+                m
+                for m in dir(instance)
+                if callable(getattr(instance, m, None)) and not m.startswith("__")
+            ]
+            import cloudpickle
+
+            await self.client.gcs.call(
+                "kv_put",
+                {
+                    "ns": "actor",
+                    "key": b"actor_methods:" + payload["actor_id"],
+                    "value": cloudpickle.dumps(methods),
+                    "overwrite": True,
+                },
+            )
+            await self.client.gcs.call(
+                "actor_ready",
+                {
+                    "actor_id": payload["actor_id"],
+                    "address": "127.0.0.1",
+                    "port": self.rpc.port,
+                    "worker_id": self.worker_id,
+                },
+            )
+        except BaseException as e:  # noqa: BLE001
+            await self.client.gcs.call(
+                "actor_ready",
+                {
+                    "actor_id": payload["actor_id"],
+                    "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+                },
+            )
+
+    # -- actor calls -------------------------------------------------------
+    async def h_actor_call(self, d, conn):
+        actor = self.actor
+        if actor is None or actor.actor_id != d["actor_id"]:
+            return make_task_error(
+                RuntimeError("actor not hosted by this worker")
+            )
+        if actor.max_concurrency > 1:
+            async with actor.sema:
+                return await self._invoke_actor_method(actor, d)
+        # Ordered path: execute strictly by per-caller sequence number.
+        q = actor.queues.setdefault(d.get("caller", b""), _CallerQueue())
+        fut = self.loop.create_future()
+        heapq.heappush(q.pending, (d["seq"], id(d), d, fut))
+        if not q.draining:
+            q.draining = True
+            try:
+                while q.pending and q.pending[0][0] == q.next_seq:
+                    _, _, req, rfut = heapq.heappop(q.pending)
+                    q.next_seq += 1
+                    result = await self._invoke_actor_method(actor, req)
+                    if not rfut.done():
+                        rfut.set_result(result)
+            finally:
+                q.draining = False
+        return await fut
+
+    async def _invoke_actor_method(self, actor: ActorState, d) -> dict:
+        def do_call():
+            method = getattr(actor.instance, d["method"])
+            args, kwargs = self.client.deserialize_args(d["args"])
+            if inspect.iscoroutinefunction(method):
+                return asyncio.run(method(*args, **kwargs))
+            return method(*args, **kwargs)
+
+        try:
+            value = await self.loop.run_in_executor(self.executor, do_call)
+            spec = {"task_id": d["task_id"], "num_returns": d.get("num_returns", 1)}
+            # _package_returns may block on GCS (location registration), so
+            # it must not run on the event loop.
+            return await self.loop.run_in_executor(
+                self.executor, self._package_returns, spec, value
+            )
+        except BaseException as e:  # noqa: BLE001
+            return make_task_error(e)
+
+    async def h_ping(self, d, conn):
+        return {"pong": True, "actor": self.actor is not None}
+
+
+def main():
+    log_path = os.environ.get("RT_WORKER_BOOT_LOG")
+    if log_path:
+        import time
+
+        with open(log_path, "a") as f:
+            f.write(f"{os.getpid()} start {time.time()}\n")
+    wp = WorkerProcess()
+    if log_path:
+        import time
+
+        with open(log_path, "a") as f:
+            f.write(f"{os.getpid()} constructed {time.time()}\n")
+    try:
+        asyncio.run(wp.run())
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+
+
+if __name__ == "__main__":
+    main()

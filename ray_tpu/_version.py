@@ -1,0 +1,7 @@
+"""Version of ray_tpu.
+
+Reference analog: python/ray/_version.py (version string consumed by
+python/ray/__init__.py:82).
+"""
+
+version = "0.1.0"

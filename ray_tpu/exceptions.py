@@ -1,0 +1,65 @@
+"""User-facing exceptions.
+
+Mirrors the taxonomy in the reference's python/ray/exceptions.py: task
+errors wrap the remote traceback, actor errors/unavailability, object loss,
+and cancellation.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception remotely (reference: RayTaskError).
+
+    Raised on `get()` of the task's return ref; carries the remote traceback.
+    """
+
+    def __init__(self, cause_cls_name: str, traceback_str: str, cause=None):
+        self.cause_cls_name = cause_cls_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"Remote task failed with {cause_cls_name}:\n{traceback_str}")
+
+
+class ActorError(RayTpuError):
+    """Base class for actor-related failures (reference: RayActorError)."""
+
+
+class ActorDiedError(ActorError):
+    """The actor process is dead; calls on its handle will fail."""
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """All copies of the object are gone and it cannot be reconstructed."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The shared-memory store could not allocate after eviction."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before or during execution."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get()` exceeded its timeout."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died unexpectedly."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing the runtime environment for a task/actor failed."""
+
+
+class PlacementGroupSchedulingError(RayTpuError):
+    """The placement group could not be scheduled (infeasible bundles)."""

@@ -1,0 +1,182 @@
+"""Collective communication API.
+
+Mirrors the reference's python/ray/util/collective/collective.py surface
+(init_collective_group :120, create_collective_group :151, allreduce :258,
+barrier :298, reduce :311, broadcast :373, allgather :423, reducescatter
+:472, send/recv :531/:594, GroupManager :40) with TPU-native backends:
+
+  * Backend.DCN  — cross-process eager collectives over TCP rings with
+    GCS-KV rendezvous (role of the reference's gloo backend).
+  * Backend.XLA  — jit-compiled collectives over this process's local
+    devices (role of the reference's nccl multi-GPU entry points).
+
+The high-bandwidth training path does NOT use this module: gradients reduce
+inside pjit-compiled programs over ICI (see ray_tpu/parallel/). This module
+serves control-plane sync, weight broadcast outside jit, and CPU testing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.util.collective.dcn_group import DcnGroup
+from ray_tpu.util.collective.types import Backend, ReduceOp
+from ray_tpu.util.collective.xla_group import XlaLocalGroup
+
+
+class GroupManager:
+    """Per-process registry of collective groups (reference: GroupManager
+    collective.py:40)."""
+
+    def __init__(self):
+        self._groups: Dict[str, object] = {}
+        self._meta: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def create(self, backend: Backend, world_size: int, rank: int, group_name: str):
+        with self._lock:
+            if group_name in self._groups:
+                raise ValueError(f"collective group {group_name!r} already exists")
+        if backend == Backend.DCN:
+            client = worker_mod.get_client()
+            group = DcnGroup(client, world_size, rank, group_name)
+        elif backend == Backend.XLA:
+            group = XlaLocalGroup(world_size if world_size > 0 else None)
+        else:
+            raise ValueError(backend)
+        with self._lock:
+            self._groups[group_name] = group
+            self._meta[group_name] = {
+                "backend": backend,
+                "world_size": world_size,
+                "rank": rank,
+            }
+        return group
+
+    def get(self, group_name: str):
+        g = self._groups.get(group_name)
+        if g is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in this "
+                f"process; call init_collective_group first"
+            )
+        return g
+
+    def meta(self, group_name: str) -> dict:
+        return self._meta[group_name]
+
+    def destroy(self, group_name: str):
+        with self._lock:
+            g = self._groups.pop(group_name, None)
+            self._meta.pop(group_name, None)
+        if g is not None:
+            g.destroy()
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "dcn",
+    group_name: str = "default",
+):
+    """Join this process to a collective group (reference :120)."""
+    b = Backend.validate(backend)
+    return _manager.create(b, world_size, rank, group_name)
+
+
+def create_collective_group(
+    actors: List,
+    world_size: int,
+    ranks: List[int],
+    backend: str = "dcn",
+    group_name: str = "default",
+):
+    """Declaratively set up a group across actors (reference :151).
+
+    Each actor must expose the reference convention of running
+    `init_collective_group` inside itself; here we call a well-known
+    method name via an internal task.
+    """
+    import ray_tpu as rt
+
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(
+            actor._do_init_collective.remote(world_size, rank, backend, group_name)
+            if hasattr(actor, "_do_init_collective")
+            else actor.init_collective.remote(world_size, rank, backend, group_name)
+        )
+    rt.get(refs)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.meta(group_name)["rank"]
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.meta(group_name)["world_size"]
+
+
+def _as_numpy(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    """In-place-style allreduce (reference :258). Returns the reduced value
+    (numpy for DCN; device arrays for XLA)."""
+    g = _manager.get(group_name)
+    if isinstance(g, XlaLocalGroup):
+        return g.allreduce(tensor, op)
+    return g.allreduce(_as_numpy(tensor), op)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: ReduceOp = ReduceOp.SUM):
+    g = _manager.get(group_name)
+    return g.reduce(_as_numpy(tensor), dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _manager.get(group_name)
+    if isinstance(g, XlaLocalGroup):
+        return g.broadcast(tensor, src_rank)
+    return g.broadcast(_as_numpy(tensor), src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    g = _manager.get(group_name)
+    if isinstance(g, XlaLocalGroup):
+        return g.allgather(tensor)
+    return g.allgather(_as_numpy(tensor))
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    g = _manager.get(group_name)
+    if isinstance(g, XlaLocalGroup):
+        return g.reducescatter(tensor, op)
+    return g.reducescatter(_as_numpy(tensor), op)
+
+
+def barrier(group_name: str = "default"):
+    _manager.get(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    g = _manager.get(group_name)
+    g.send(_as_numpy(tensor), dst_rank)
+
+
+def recv(tensor_shape, src_rank: int, group_name: str = "default"):
+    g = _manager.get(group_name)
+    return g.recv(src_rank)

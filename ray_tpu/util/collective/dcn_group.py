@@ -1,0 +1,273 @@
+"""Eager cross-process collectives over TCP rings (the DCN path).
+
+Plays the role of the reference's GLOOGroup
+(python/ray/util/collective/collective_group/gloo_collective_group.py, 565
+LoC, pygloo with rendezvous through the GCS internal KV — gloo_util.py:271):
+pure-python ring algorithms over persistent sockets, used for host-side
+tensors and control data. On TPU pods this is the cross-slice/DCN fallback;
+the high-bandwidth path is XLA collectives over ICI inside compiled
+programs (see parallel/).
+
+Algorithms:
+  * allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
+    2*(n-1)/n * bytes per link)
+  * allgather / reducescatter: single ring pass
+  * broadcast: ring forward from root
+  * barrier: zero-byte ring token
+  * send/recv: direct socket between ranks
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.types import ReduceOp
+
+_LEN = struct.Struct("<Q")
+
+
+def _reduce(op: ReduceOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if op == ReduceOp.SUM:
+        return a + b
+    if op == ReduceOp.PRODUCT:
+        return a * b
+    if op == ReduceOp.MIN:
+        return np.minimum(a, b)
+    if op == ReduceOp.MAX:
+        return np.maximum(a, b)
+    raise ValueError(op)
+
+
+class _Peer:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.lock = threading.Lock()
+
+    def send_bytes(self, data: bytes):
+        with self.lock:
+            self.sock.sendall(_LEN.pack(len(data)) + data)
+
+    def recv_bytes(self) -> bytes:
+        header = self._recv_exact(8)
+        (n,) = _LEN.unpack(header)
+        return self._recv_exact(n)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self.sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("collective peer closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+
+def _send_array(peer: _Peer, arr: np.ndarray):
+    header = f"{arr.dtype.str}|{','.join(map(str, arr.shape))}".encode()
+    peer.send_bytes(header)
+    peer.send_bytes(np.ascontiguousarray(arr).tobytes())
+
+
+def _recv_array(peer: _Peer) -> np.ndarray:
+    header = peer.recv_bytes().decode()
+    dtype_str, shape_str = header.split("|")
+    shape = tuple(int(s) for s in shape_str.split(",")) if shape_str else ()
+    data = peer.recv_bytes()
+    return np.frombuffer(data, dtype=np.dtype(dtype_str)).reshape(shape).copy()
+
+
+class DcnGroup:
+    """One rank's membership in a TCP collective ring."""
+
+    def __init__(self, kv, world_size: int, rank: int, group_name: str,
+                 timeout: float = 60.0):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._kv = kv
+        self._timeout = timeout
+        # Listening socket for incoming peers.
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("127.0.0.1", 0))
+        self._server.listen(world_size + 2)
+        self.addr = self._server.getsockname()
+        self._accepted: Dict[int, _Peer] = {}
+        self._outgoing: Dict[int, _Peer] = {}
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        self._register()
+
+    # -- rendezvous through the GCS KV ----------------------------------
+    def _key(self, rank: int) -> bytes:
+        return f"collective:{self.group_name}:{rank}".encode()
+
+    def _register(self):
+        self._kv.kv_put(
+            self._key(self.rank),
+            f"{self.addr[0]}:{self.addr[1]}".encode(),
+            ns="collective",
+        )
+
+    def _lookup(self, rank: int) -> tuple:
+        deadline = time.monotonic() + self._timeout
+        while time.monotonic() < deadline:
+            raw = self._kv.kv_get(self._key(rank), ns="collective")
+            if raw:
+                host, port = raw.decode().rsplit(":", 1)
+                return host, int(port)
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"rendezvous timeout waiting for rank {rank} of group "
+            f"{self.group_name!r}"
+        )
+
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            peer = _Peer(sock)
+            # First frame on an accepted socket identifies the sender rank.
+            rank = int.from_bytes(peer.recv_bytes(), "little")
+            self._accepted[rank] = peer
+
+    def _peer_out(self, rank: int) -> _Peer:
+        """Connection this rank initiated (used for sends to `rank`)."""
+        peer = self._outgoing.get(rank)
+        if peer is None:
+            host, port = self._lookup(rank)
+            sock = socket.create_connection((host, port), timeout=self._timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            peer = _Peer(sock)
+            peer.send_bytes(self.rank.to_bytes(4, "little"))
+            self._outgoing[rank] = peer
+        return peer
+
+    def _peer_in(self, rank: int) -> _Peer:
+        """Connection initiated by `rank` toward us (used for receives)."""
+        deadline = time.monotonic() + self._timeout
+        while time.monotonic() < deadline:
+            peer = self._accepted.get(rank)
+            if peer is not None:
+                return peer
+            time.sleep(0.002)
+        raise TimeoutError(f"no inbound connection from rank {rank}")
+
+    # -- collectives -----------------------------------------------------
+    @property
+    def _right(self) -> int:
+        return (self.rank + 1) % self.world_size
+
+    @property
+    def _left(self) -> int:
+        return (self.rank - 1) % self.world_size
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        n = self.world_size
+        if n == 1:
+            return arr.copy()
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        chunks: List[np.ndarray] = [c.copy() for c in np.array_split(flat, n)]
+        right, left = self._peer_out(self._right), self._peer_in(self._left)
+        # Phase 1: ring reduce-scatter.
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            _send_array(right, chunks[send_idx])
+            incoming = _recv_array(left)
+            chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
+        # Phase 2: ring allgather of reduced chunks.
+        for step in range(n - 1):
+            send_idx = (self.rank + 1 - step) % n
+            recv_idx = (self.rank - step) % n
+            _send_array(right, chunks[send_idx])
+            chunks[recv_idx] = _recv_array(left)
+        return np.concatenate(chunks).reshape(arr.shape).astype(arr.dtype, copy=False)
+
+    def allgather(self, arr: np.ndarray) -> List[np.ndarray]:
+        n = self.world_size
+        out: List[Optional[np.ndarray]] = [None] * n
+        out[self.rank] = np.asarray(arr).copy()
+        if n == 1:
+            return out  # type: ignore[return-value]
+        right, left = self._peer_out(self._right), self._peer_in(self._left)
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            _send_array(right, out[send_idx])
+            out[recv_idx] = _recv_array(left)
+        return out  # type: ignore[return-value]
+
+    def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        """Each rank gets the reduction of its 1/n slice.
+
+        Ring reduce-scatter with the schedule shifted so that rank r ends
+        holding fully-reduced chunk r.
+        """
+        n = self.world_size
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        chunks = [c.copy() for c in np.array_split(flat, n)]
+        if n == 1:
+            return chunks[0]
+        right, left = self._peer_out(self._right), self._peer_in(self._left)
+        for step in range(n - 1):
+            send_idx = (self.rank - step + n - 1) % n
+            recv_idx = (self.rank - step + n - 2) % n
+            _send_array(right, chunks[send_idx])
+            incoming = _recv_array(left)
+            chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
+        return chunks[self.rank]
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        if self.world_size == 1:
+            return np.asarray(arr).copy()
+        if self.rank == root:
+            out = np.asarray(arr).copy()
+        # Forward around the ring, skipping the wrap back to root.
+        if self.rank != root:
+            out = _recv_array(self._peer_in(self._left))
+        if self._right != root:
+            _send_array(self._peer_out(self._right), out)
+        return out
+
+    def reduce(self, arr: np.ndarray, root: int = 0,
+               op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        # Simple: allreduce then root keeps (fine at control-plane sizes).
+        out = self.allreduce(arr, op)
+        return out if self.rank == root else np.asarray(arr).copy()
+
+    def barrier(self):
+        self.allreduce(np.zeros(1, dtype=np.int32))
+
+    def send(self, arr: np.ndarray, dst_rank: int):
+        _send_array(self._peer_out(dst_rank), np.asarray(arr))
+
+    def recv(self, src_rank: int) -> np.ndarray:
+        return _recv_array(self._peer_in(src_rank))
+
+    def destroy(self):
+        # Drop the rendezvous entry so a recreated group with the same name
+        # never resolves to this (now dead) listener.
+        try:
+            self._kv.kv_del(self._key(self.rank), ns="collective")
+        except Exception:
+            pass
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for p in list(self._accepted.values()) + list(self._outgoing.values()):
+            try:
+                p.sock.close()
+            except OSError:
+                pass

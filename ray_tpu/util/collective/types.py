@@ -1,0 +1,39 @@
+"""Collective types.
+
+Analog of python/ray/util/collective/types.py (Backend enum at :29-34,
+ReduceOp). The reference ships NCCL and GLOO; the TPU-native backends are:
+
+  * "xla": collectives executed by XLA over the devices attached to this
+    process (ICI on a TPU host; the virtual CPU mesh in tests). Eager calls
+    JIT tiny collective programs against a persistent mesh context.
+  * "dcn": eager cross-process collectives over TCP rings between hosts
+    (the role gloo plays for the reference's CPU path; on TPU pods this is
+    the DCN control path). Rendezvous goes through the GCS KV, as the
+    reference's gloo backend does (gloo_util.py:271 RayInternalKvStore).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+
+class Backend(str, Enum):
+    XLA = "xla"
+    DCN = "dcn"
+
+    @classmethod
+    def validate(cls, value: str) -> "Backend":
+        try:
+            return cls(value)
+        except ValueError:
+            raise ValueError(
+                f"unknown collective backend {value!r}; valid: "
+                f"{[b.value for b in cls]}"
+            ) from None
+
+
+class ReduceOp(str, Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"

@@ -1,0 +1,60 @@
+"""Test fixtures.
+
+Mirrors the reference's python/ray/tests/conftest.py fixture strategy
+(ray_start_regular at conftest.py:411, ray_start_cluster at :492) and its
+CPU-device collective testing approach (SURVEY.md §4.2): JAX runs on a
+virtual 8-device CPU mesh so all sharding/collective code paths execute
+without TPU hardware.
+"""
+
+import os
+
+# Tests always run on a virtual 8-device CPU mesh. The environment may
+# preset a live TPU tunnel (JAX_PLATFORMS=axon via sitecustomize, which
+# imports jax before this file runs) — so override through jax.config, not
+# just env vars.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # worker subprocesses skip the tunnel
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("RT_TPU_CHIPS", "0")  # no fake TPU detection in tests
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt_local():
+    import ray_tpu as rt
+
+    rt.init(local_mode=True)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture
+def rt_start(request):
+    """A real single-node runtime: in-process GCS+raylet, subprocess workers."""
+    import ray_tpu as rt
+
+    kwargs = getattr(request, "param", {}) or {}
+    kwargs.setdefault("num_cpus", 4)
+    rt.init(**kwargs)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture
+def rt_cluster():
+    """Multi-raylet cluster harness (reference: cluster_utils.Cluster)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,93 @@
+"""TPU accelerator manager tests (no hardware required).
+
+Modeled on the reference's python/ray/tests/accelerators/test_tpu.py:
+detection, pod topology, gang resources, and visible-chips isolation are
+all driven by patched env.
+"""
+
+import pytest
+
+from ray_tpu._private.accelerators import TPUAcceleratorManager, get_accelerator_manager
+from ray_tpu._private.node import resolve_resources
+
+
+@pytest.fixture
+def tpu_host_env(monkeypatch):
+    monkeypatch.setenv("RT_TPU_CHIPS", "8")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_NAME", "slice-abc")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    yield
+
+
+def test_registry():
+    assert get_accelerator_manager("TPU") is TPUAcceleratorManager
+
+
+def test_detection(tpu_host_env):
+    assert TPUAcceleratorManager.get_current_node_num_accelerators() == 8
+    assert TPUAcceleratorManager.get_current_node_accelerator_type() == "TPU-V5LITEPOD"
+    assert TPUAcceleratorManager.get_current_node_tpu_pod_type() == "v5litepod-16"
+
+
+def test_pod_topology(tpu_host_env):
+    assert TPUAcceleratorManager.get_current_node_tpu_name() == "slice-abc"
+    assert TPUAcceleratorManager.get_current_node_tpu_worker_id() == 0
+    assert TPUAcceleratorManager.get_num_workers_in_current_tpu_pod() == 2
+
+
+def test_gang_resources_worker0(tpu_host_env):
+    extra = TPUAcceleratorManager.get_current_node_additional_resources()
+    assert extra == {"slice-abc": 1.0, "TPU-v5litepod-16-head": 1.0}
+
+
+def test_gang_resources_worker1(tpu_host_env, monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    extra = TPUAcceleratorManager.get_current_node_additional_resources()
+    assert extra == {"slice-abc": 1.0}  # no head resource off worker 0
+
+
+def test_resolve_resources_includes_tpu(tpu_host_env):
+    res = resolve_resources(num_cpus=4)
+    assert res["CPU"] == 4.0
+    assert res["TPU"] == 8.0
+    assert res["TPU-V5LITEPOD"] == 1.0
+    assert res["slice-abc"] == 1.0
+    assert res["TPU-v5litepod-16-head"] == 1.0
+
+
+def test_chip_quantity_validation():
+    ok, _ = TPUAcceleratorManager.validate_resource_request_quantity(4)
+    assert ok
+    bad, msg = TPUAcceleratorManager.validate_resource_request_quantity(3)
+    assert not bad and "chips" in msg
+
+
+def test_visible_chips_isolation(tpu_host_env, monkeypatch):
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+    TPUAcceleratorManager.set_current_process_visible_accelerator_ids(["0", "1"])
+    assert TPUAcceleratorManager.get_current_process_visible_accelerator_ids() == [
+        "0",
+        "1",
+    ]
+
+
+def test_all_chips_passthrough(tpu_host_env, monkeypatch):
+    """Whole-host lease: taking all chips unsets TPU_VISIBLE_CHIPS so libtpu
+    owns the host (reference tpu.py:158 'not set when task takes all 4')."""
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0")
+    TPUAcceleratorManager.set_current_process_visible_accelerator_ids(
+        [str(i) for i in range(8)]
+    )
+    assert TPUAcceleratorManager.get_current_process_visible_accelerator_ids() is None
+
+
+def test_pod_helpers(tpu_host_env):
+    from ray_tpu._private.accelerators.tpu import (
+        get_current_pod_name,
+        get_current_pod_worker_count,
+    )
+
+    assert get_current_pod_name() == "slice-abc"
+    assert get_current_pod_worker_count() == 2

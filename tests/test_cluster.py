@@ -1,0 +1,208 @@
+"""Multi-node scheduling, placement groups, and fault-tolerance tests.
+
+Modeled on the reference's python/ray/tests/test_scheduling*.py,
+test_placement_group*.py, and the Cluster harness usage
+(cluster_utils.py:108).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def test_two_node_scheduling(rt_cluster):
+    cluster = rt_cluster
+    n1 = cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    @rt.remote
+    def where():
+        import os
+        import time as _t
+
+        _t.sleep(2)  # hold the slot so later tasks must spill
+        return os.environ["RT_NODE_ID"]
+
+    # Saturate: 2-CPU tasks on 2-CPU nodes; overlap forces spillover.
+    refs = [where.options(num_cpus=2).remote() for _ in range(4)]
+    nodes = set(rt.get(refs, timeout=120))
+    assert len(nodes) == 2  # spilled over to the second node
+
+
+def test_node_affinity(rt_cluster):
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    @rt.remote
+    def where():
+        import os
+
+        return os.environ["RT_NODE_ID"]
+
+    strategy = NodeAffinitySchedulingStrategy(node_id=n2.node_id.binary())
+    got = rt.get(where.options(scheduling_strategy=strategy).remote())
+    assert got == n2.node_id.hex()
+
+
+def test_custom_resources(rt_cluster):
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=1)
+    special = cluster.add_node(num_cpus=1, resources={"special": 2})
+    cluster.connect()
+
+    @rt.remote(resources={"special": 1})
+    def on_special():
+        import os
+
+        return os.environ["RT_NODE_ID"]
+
+    assert rt.get(on_special.remote()) == special.node_id.hex()
+
+
+def test_placement_group_strict_spread(rt_cluster):
+    cluster = rt_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=10)
+    nodes = pg.bundle_node_ids()
+    assert len(set(nodes)) == 3
+
+    @rt.remote
+    def where():
+        import os
+
+        return os.environ["RT_NODE_ID"]
+
+    refs = [
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(3)
+    ]
+    got = rt.get(refs)
+    assert [bytes.fromhex(g) for g in got] == nodes
+    remove_placement_group(pg)
+
+
+def test_placement_group_strict_pack(rt_cluster):
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4)
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=10)
+    nodes = pg.bundle_node_ids()
+    assert len(set(nodes)) == 1
+
+
+def test_placement_group_infeasible(rt_cluster):
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 16}], strategy="PACK")
+    assert not pg.ready(timeout=1.5)
+
+
+def test_tpu_gang_resources(rt_cluster):
+    """TPU pod topology: head resource + per-host pod-name resource
+    (reference pattern: _private/accelerators/tpu.py:335)."""
+    cluster = rt_cluster
+    pod = "my-tpu-pod"
+    # 2-host v5e slice: worker 0 advertises the head resource.
+    cluster.add_node(
+        num_cpus=1,
+        resources={"TPU": 8, pod: 1, "TPU-v5litepod-16-head": 1},
+    )
+    cluster.add_node(num_cpus=1, resources={"TPU": 8, pod: 1})
+    cluster.connect()
+
+    @rt.remote(resources={"TPU-v5litepod-16-head": 1}, num_cpus=0)
+    def on_head():
+        import os
+
+        return os.environ["RT_NODE_ID"]
+
+    @rt.remote(num_cpus=0)
+    def on_pod_host():
+        import os
+
+        return os.environ["RT_NODE_ID"]
+
+    head_node = rt.get(on_head.remote())
+    # Fan out one whole-host task per pod worker via the pod-name resource.
+    refs = [
+        on_pod_host.options(resources={pod: 1, "TPU": 8}).remote()
+        for _ in range(2)
+    ]
+    hosts = set(rt.get(refs))
+    assert len(hosts) == 2
+    assert head_node in hosts
+
+
+def test_object_transfer_between_nodes(rt_cluster):
+    cluster = rt_cluster
+    n1 = cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    import numpy as np
+
+    @rt.remote
+    def produce():
+        return np.ones(500_000)  # ~4MB -> goes to the shared store
+
+    @rt.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    strategy1 = NodeAffinitySchedulingStrategy(node_id=n1.node_id.binary())
+    strategy2 = NodeAffinitySchedulingStrategy(node_id=n2.node_id.binary())
+    ref = produce.options(scheduling_strategy=strategy1).remote()
+    out = rt.get(consume.options(scheduling_strategy=strategy2).remote(ref))
+    assert out == 500_000.0
+
+
+def test_actor_restart_after_kill(rt_cluster):
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    @rt.remote(max_restarts=1, max_task_retries=2)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def call(self):
+            self.calls += 1
+            return self.calls
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert rt.get(p.call.remote()) == 1
+    # A poison call must not be retried onto the restarted actor
+    # (at-least-once retries would replay the kill).
+    p.die.options(max_task_retries=0).remote()
+    time.sleep(1.0)
+    # Restarted actor: state reset, calls start over.
+    assert rt.get(p.call.remote(), timeout=30) == 1

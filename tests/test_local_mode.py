@@ -1,0 +1,49 @@
+"""Local (in-process) mode tests — reference: ray.init(local_mode=True)."""
+
+import pytest
+
+from ray_tpu.exceptions import TaskError
+
+
+def test_local_task(rt_local):
+    rt = rt_local
+
+    @rt.remote
+    def mul(a, b):
+        return a * b
+
+    assert rt.get(mul.remote(6, 7)) == 42
+
+
+def test_local_actor(rt_local):
+    rt = rt_local
+
+    @rt.remote
+    class Acc:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, x):
+            self.n += x
+            return self.n
+
+    a = Acc.remote()
+    a.add.remote(1)
+    assert rt.get(a.add.remote(2)) == 3
+
+
+def test_local_error(rt_local):
+    rt = rt_local
+
+    @rt.remote
+    def bad():
+        raise KeyError("nope")
+
+    with pytest.raises(TaskError):
+        rt.get(bad.remote())
+
+
+def test_local_put_get(rt_local):
+    rt = rt_local
+    ref = rt.put([1, 2, 3])
+    assert rt.get(ref) == [1, 2, 3]

@@ -1,0 +1,37 @@
+import numpy as np
+
+from ray_tpu._private import serialization as ser
+
+
+def test_roundtrip_basic():
+    value = {"a": 1, "b": [1, 2, 3], "c": "hello", "d": (4, 5)}
+    assert ser.deserialize_from_bytes(ser.serialize_to_bytes(value)) == value
+
+
+def test_roundtrip_numpy_zero_copy():
+    arr = np.random.rand(1000, 100)
+    data = ser.serialize_to_bytes({"x": arr})
+    out = ser.deserialize_from_bytes(data)["x"]
+    assert np.array_equal(out, arr)
+
+
+def test_small_arrays_inline():
+    arr = np.arange(10)
+    so = ser.serialize(arr)
+    assert len(so.buffers) == 0  # tiny buffers ride inline
+
+
+def test_large_arrays_out_of_band():
+    arr = np.zeros(100_000)
+    so = ser.serialize(arr)
+    assert len(so.buffers) == 1
+
+
+def test_closure_roundtrip():
+    x = 42
+
+    def f(y):
+        return x + y
+
+    g = ser.deserialize_from_bytes(ser.serialize_to_bytes(f))
+    assert g(1) == 43
