@@ -204,7 +204,17 @@ class Raylet:
         import ray_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # Propagate this process's import paths so by-reference cloudpickle
+        # functions (modules outside site-packages, e.g. the driver's
+        # project) resolve in workers — the role the reference's
+        # working_dir runtime env plays for the common co-located case.
+        # (Standalone raylet daemons on other machines still need proper
+        # code shipping via the GCS — future runtime-env work.)
+        # Keep zipimport entries (files); drop empties so no implicit-cwd
+        # component is ever synthesized by a trailing separator.
+        extra_paths = [p for p in sys.path if p and os.path.exists(p)]
+        parts = [pkg_root, *extra_paths, env.get("PYTHONPATH", "")]
+        env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
         env.update(getattr(self, "spawn_env_overrides", None) or {})
         env["RT_WORKER_ID"] = worker_id.hex()
         env["RT_NODE_ID"] = self.node_id.hex()

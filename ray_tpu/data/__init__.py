@@ -1,0 +1,25 @@
+"""ray_tpu.data: distributed datasets.
+
+Public surface mirrors the reference's ray.data creation APIs:
+range / from_items / from_numpy / read_parquet / read_csv / read_json.
+"""
+
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    range_dataset as range,  # noqa: A001 — mirrors ray.data.range
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "Dataset",
+    "from_items",
+    "from_numpy",
+    "range",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+]

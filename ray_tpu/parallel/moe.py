@@ -1,0 +1,91 @@
+"""Mixture-of-experts with expert parallelism.
+
+The reference has no MoE/EP support (SURVEY.md §2.4: EP "Absent"). This is
+the TPU-native design: experts shard over the "ep" mesh axis; tokens are
+routed top-k with a capacity factor and dispatched via einsum against
+one-hot combine tensors (the Switch/GShard formulation), which XLA lowers
+to all-to-alls over ICI when the expert dim is sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_routing(
+    router_logits: jax.Array,  # [tokens, num_experts]
+    k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k token->expert assignment with per-expert capacity.
+
+    Returns:
+      dispatch: [tokens, num_experts, capacity] one-hot dispatch mask
+      combine:  [tokens, num_experts, capacity] combine weights
+      aux_loss: load-balancing auxiliary loss (Switch-style)
+    """
+    tokens, num_experts = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)
+
+    # Load-balance loss: mean prob * mean assignment fraction per expert.
+    top1 = jnp.argmax(probs, axis=-1)
+    me = probs.mean(axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, num_experts), axis=0)
+    aux_loss = num_experts * jnp.sum(me * ce)
+
+    dispatch = jnp.zeros((tokens, num_experts, capacity), dtype=probs.dtype)
+    combine = jnp.zeros((tokens, num_experts, capacity), dtype=probs.dtype)
+    remaining = probs
+    # Track how many slots each expert has filled so far across the k picks.
+    fill = jnp.zeros((num_experts,), dtype=jnp.int32)
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)  # [tokens]
+        gate = jnp.take_along_axis(remaining, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, num_experts, dtype=jnp.int32)
+        # Position of each token within its chosen expert's queue.
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot
+        pos = (pos_in_expert.sum(axis=-1) + fill[choice]).astype(jnp.int32)
+        keep = pos < capacity
+        pos = jnp.clip(pos, 0, capacity - 1)
+        tok_idx = jnp.arange(tokens)
+        dispatch = dispatch.at[tok_idx, choice, pos].add(
+            keep.astype(probs.dtype)
+        )
+        combine = combine.at[tok_idx, choice, pos].add(
+            keep.astype(probs.dtype) * gate
+        )
+        fill = fill + (onehot * keep[:, None]).sum(axis=0)
+        # Mask out the chosen expert for the next pick.
+        remaining = remaining * (1.0 - onehot.astype(probs.dtype))
+    return dispatch, combine, aux_loss
+
+
+def moe_layer(
+    x: jax.Array,  # [tokens, d_model]
+    router_w: jax.Array,  # [d_model, num_experts]
+    expert_fn: Callable,  # (expert_params, [num_experts, capacity, d]) -> same
+    expert_params,  # leaves with leading num_experts axis (sharded over "ep")
+    k: int = 2,
+    capacity_factor: float = 1.25,
+):
+    """Dense-dispatch MoE layer (GShard formulation).
+
+    The einsum dispatch produces [num_experts, capacity, d_model]; with
+    expert_params sharded over "ep", XLA inserts the all-to-alls.
+    """
+    tokens, d_model = x.shape
+    num_experts = router_w.shape[-1]
+    capacity = max(1, int(capacity_factor * tokens * k / num_experts))
+
+    logits = x @ router_w
+    dispatch, combine, aux_loss = top_k_routing(logits, k, capacity)
+
+    # Dispatch: [E, C, D]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    expert_out = expert_fn(expert_params, expert_in)
+    # Combine: [T, D]
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out, aux_loss

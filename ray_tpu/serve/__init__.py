@@ -1,0 +1,91 @@
+"""ray_tpu.serve: model serving.
+
+Public surface mirrors the reference's ray.serve: @serve.deployment,
+serve.run / serve.delete / serve.status / serve.shutdown,
+DeploymentHandle composition, queue-length autoscaling, and an HTTP proxy.
+TPU-aware replica placement comes from ray_actor_options resources (e.g.
+{"TPU": 4} or a pod gang resource) flowing into the actor scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import ray_tpu as rt
+from ray_tpu.serve.controller import CONTROLLER_NAME, get_or_create_controller
+from ray_tpu.serve.deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    deployment,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.proxy import ProxyActor
+
+_proxy = None
+
+
+def run(app: Application, name: Optional[str] = None,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy an application (reference: serve.run, serve/api.py:429)."""
+    controller = get_or_create_controller()
+    app_name = name or app.deployment.name
+    rt.get(
+        controller.deploy.remote(
+            app_name, app.deployment, app.init_args, app.init_kwargs
+        ),
+        timeout=300,
+    )
+    return DeploymentHandle(app_name)
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str):
+    controller = get_or_create_controller()
+    rt.get(controller.delete.remote(name), timeout=60)
+
+
+def status() -> dict:
+    controller = get_or_create_controller()
+    return rt.get(controller.status.remote(), timeout=60)
+
+
+def shutdown():
+    global _proxy
+    try:
+        controller = rt.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        rt.get(controller.shutdown.remote(), timeout=60)
+        rt.kill(controller)
+    except Exception:
+        pass
+    _proxy = None
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
+    """Start the HTTP ingress (reference: proxies start with serve.start)."""
+    global _proxy
+    if _proxy is None:
+        _proxy = ProxyActor.options(num_cpus=0.1).remote(host, port)
+        rt.get(_proxy.ready.remote(), timeout=30)
+    return rt.get(_proxy.address.remote(), timeout=30)
+
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "AutoscalingConfig",
+    "DeploymentHandle",
+    "run",
+    "get_app_handle",
+    "delete",
+    "status",
+    "shutdown",
+    "start_http_proxy",
+]

@@ -1,0 +1,182 @@
+"""Serve controller: reconciles deployments to replica actors.
+
+Analog of the reference's ServeController (serve/_private/controller.py:91)
++ DeploymentState reconciliation (deployment_state.py:1211) + the basic
+autoscaling loop (autoscaling_policy.py): a named actor owning the desired
+state; a background thread reconciles replica counts and applies
+queue-length-based autoscaling; handles fetch the replica list with a
+version number and long-poll-style refresh on change
+(serve/_private/long_poll.py analog via polling).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment
+from ray_tpu.serve.replica import ReplicaActor
+
+CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
+
+
+@rt.remote
+class ServeController:
+    def __init__(self):
+        # app name -> {deployment, replicas: [handles], version}
+        self.apps: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._thread.start()
+
+    # -- API -------------------------------------------------------------
+    def deploy(self, name: str, deployment: Deployment, init_args, init_kwargs):
+        with self._lock:
+            old = self.apps.get(name)
+            if old:
+                for r in old["replicas"]:
+                    _kill_quietly(r)
+            self.apps[name] = {
+                "deployment": deployment,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "replicas": [],
+                "version": 0,
+                "target": deployment.num_replicas,
+                "last_scale_up": 0.0,
+                "last_scale_down": time.monotonic(),
+            }
+        self._reconcile_once(name)
+        return True
+
+    def delete(self, name: str):
+        with self._lock:
+            app = self.apps.pop(name, None)
+        if app:
+            for r in app["replicas"]:
+                _kill_quietly(r)
+        return True
+
+    def get_replicas(self, name: str):
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return {"version": -1, "replicas": []}
+            return {"version": app["version"], "replicas": list(app["replicas"])}
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": app["target"],
+                    "running_replicas": len(app["replicas"]),
+                    "deployment": app["deployment"].name,
+                }
+                for name, app in self.apps.items()
+            }
+
+    def shutdown(self):
+        self._stop = True
+        with self._lock:
+            names = list(self.apps)
+        for n in names:
+            self.delete(n)
+        return True
+
+    # -- reconciliation ---------------------------------------------------
+    def _reconcile_once(self, name: str):
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            dep: Deployment = app["deployment"]
+            current = len(app["replicas"])
+            target = app["target"]
+        if current < target:
+            new = []
+            for _ in range(target - current):
+                opts = dict(dep.ray_actor_options)
+                replica = ReplicaActor.options(
+                    num_cpus=opts.pop("num_cpus", 0.1),
+                    resources=opts.pop("resources", None),
+                ).remote(
+                    dep.func_or_class,
+                    app["init_args"],
+                    app["init_kwargs"],
+                    dep.user_config,
+                )
+                new.append(replica)
+            with self._lock:
+                app["replicas"].extend(new)
+                app["version"] += 1
+        elif current > target:
+            with self._lock:
+                excess = app["replicas"][target:]
+                app["replicas"] = app["replicas"][:target]
+                app["version"] += 1
+            for r in excess:
+                _kill_quietly(r)
+
+    def _reconcile_loop(self):
+        while not self._stop:
+            time.sleep(0.5)
+            try:
+                with self._lock:
+                    names = list(self.apps)
+                for name in names:
+                    self._autoscale(name)
+                    self._reconcile_once(name)
+            except Exception:
+                pass
+
+    def _autoscale(self, name: str):
+        """Queue-length autoscaling (reference: autoscaling_policy.py)."""
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            cfg: Optional[AutoscalingConfig] = app["deployment"].autoscaling_config
+            replicas = list(app["replicas"])
+        if cfg is None or not replicas:
+            return
+        try:
+            qlens = rt.get([r.queue_len.remote() for r in replicas], timeout=5)
+        except Exception:
+            return
+        avg = sum(qlens) / len(qlens)
+        now = time.monotonic()
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            target = app["target"]
+            if avg > cfg.target_ongoing_requests and target < cfg.max_replicas:
+                if now - app["last_scale_up"] > cfg.upscale_delay_s:
+                    app["target"] = min(target + 1, cfg.max_replicas)
+                    app["last_scale_up"] = now
+            elif avg < cfg.target_ongoing_requests * 0.5 and target > cfg.min_replicas:
+                if now - app["last_scale_down"] > cfg.downscale_delay_s:
+                    app["target"] = max(target - 1, cfg.min_replicas)
+                    app["last_scale_down"] = now
+
+
+def _kill_quietly(actor):
+    try:
+        rt.kill(actor)
+    except Exception:
+        pass
+
+
+def get_or_create_controller():
+    try:
+        return rt.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    try:
+        return ServeController.options(name=CONTROLLER_NAME, num_cpus=0.1).remote()
+    except ValueError:
+        # Raced with another creator.
+        return rt.get_actor(CONTROLLER_NAME)

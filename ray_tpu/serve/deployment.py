@@ -1,0 +1,85 @@
+"""Deployments: the unit of serving.
+
+Analog of the reference's @serve.deployment + Deployment/Application
+objects (python/ray/serve/api.py, serve/deployment.py): a decorated class
+or function plus replica/autoscaling config; `.bind(...)` produces an
+application graph node for `serve.run`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Per-deployment autoscaling (reference:
+    serve/_private/autoscaling_policy.py + serve/config.py)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    max_ongoing_requests: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    user_config: Optional[Dict] = None
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def options(self, **overrides) -> "Deployment":
+        import copy
+
+        d = copy.copy(self)
+        for k, v in overrides.items():
+            if not hasattr(d, k):
+                raise ValueError(f"unknown deployment option {k!r}")
+            setattr(d, k, v)
+        return d
+
+
+@dataclass
+class Application:
+    """A bound deployment graph node (reference: Application from .bind())."""
+
+    deployment: Deployment
+    init_args: tuple
+    init_kwargs: dict
+
+
+def deployment(
+    _func_or_class: Optional[Any] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    ray_actor_options: Optional[Dict] = None,
+    max_ongoing_requests: int = 100,
+    autoscaling_config: Optional[AutoscalingConfig] = None,
+    user_config: Optional[Dict] = None,
+):
+    """@serve.deployment decorator (reference: serve/api.py)."""
+
+    def wrap(obj):
+        return Deployment(
+            func_or_class=obj,
+            name=name or getattr(obj, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            ray_actor_options=ray_actor_options or {},
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            user_config=user_config,
+        )
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap

@@ -1,0 +1,78 @@
+"""DeploymentHandle: route requests to replicas.
+
+Analog of the reference's DeploymentHandle (serve/handle.py:830) + Router
+(serve/_private/router.py:924, assign_request :1040) with the
+PowerOfTwoChoicesReplicaScheduler (:295): pick two random replicas, probe
+their queue lengths, send to the shorter queue.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, List, Optional
+
+import ray_tpu as rt
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, method: str = "__call__"):
+        self.app_name = app_name
+        self.method = method
+        self._replicas: List = []
+        self._version = -1
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
+        h = DeploymentHandle(self.app_name, method_name)
+        return h
+
+    def _controller(self):
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        return rt.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._replicas and now - self._last_refresh < 1.0:
+                return
+        info = rt.get(self._controller().get_replicas.remote(self.app_name),
+                      timeout=30)
+        with self._lock:
+            self._version = info["version"]
+            self._replicas = info["replicas"]
+            self._last_refresh = now
+
+    def _pick_replica(self):
+        """Power-of-two-choices (reference: router.py:295)."""
+        self._refresh()
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            self._refresh(force=True)
+            with self._lock:
+                replicas = list(self._replicas)
+            if not replicas:
+                raise RuntimeError(
+                    f"no running replicas for app {self.app_name!r}"
+                )
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        try:
+            qa, qb = rt.get([a.queue_len.remote(), b.queue_len.remote()],
+                            timeout=5)
+        except Exception:
+            return a
+        return a if qa <= qb else b
+
+    def remote(self, *args, **kwargs):
+        """Async call: returns an ObjectRef resolving to the response."""
+        replica = self._pick_replica()
+        return replica.handle_request.remote(self.method, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("use handle.remote(...) for deployment calls")

@@ -1,0 +1,82 @@
+"""HTTP proxy: the ingress data plane.
+
+Analog of the reference's ProxyActor/HTTPProxy (serve/_private/proxy.py:1115
+/ :759, uvicorn+starlette) built on aiohttp: JSON requests POSTed to
+/{app_name} are routed through a DeploymentHandle (power-of-two balancing)
+and the JSON response returned.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import ray_tpu as rt
+
+
+@rt.remote
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        import asyncio
+
+        from aiohttp import web
+
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._ready = threading.Event()
+
+        async def handle_request(request: web.Request):
+            app_name = request.match_info["app"]
+            handle = self._handles.get(app_name)
+            if handle is None:
+                handle = DeploymentHandle(app_name)
+                self._handles[app_name] = handle
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = None
+            loop = asyncio.get_event_loop()
+
+            def call():
+                if isinstance(payload, dict):
+                    return rt.get(handle.remote(**payload), timeout=60)
+                if payload is None:
+                    return rt.get(handle.remote(), timeout=60)
+                return rt.get(handle.remote(payload), timeout=60)
+
+            try:
+                result = await loop.run_in_executor(None, call)
+                return web.json_response({"result": result})
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500
+                )
+
+        async def healthz(request):
+            return web.json_response({"status": "ok"})
+
+        def run_server():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            app = web.Application()
+            app.router.add_get("/-/healthz", healthz)
+            app.router.add_post("/{app}", handle_request)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._ready.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run_server, daemon=True)
+        self._thread.start()
+        self._ready.wait(timeout=10)
+
+    def address(self):
+        return f"http://{self.host}:{self.port}"
+
+    def ready(self) -> bool:
+        return self._ready.is_set()

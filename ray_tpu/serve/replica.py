@@ -1,0 +1,64 @@
+"""Replica actors: host the user callable.
+
+Analog of the reference's ReplicaActor (serve/_private/replica.py:240;
+UserCallableWrapper :667): wraps the deployment's class/function, tracks
+ongoing requests (the queue-length signal the router and autoscaler
+consume), and executes calls.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+import ray_tpu as rt
+
+
+@rt.remote
+class ReplicaActor:
+    def __init__(self, cls_or_fn, init_args, init_kwargs, user_config=None):
+        self._is_function = not inspect.isclass(cls_or_fn)
+        if self._is_function:
+            self.callable = cls_or_fn
+        else:
+            self.callable = cls_or_fn(*init_args, **init_kwargs)
+            if user_config is not None and hasattr(
+                self.callable, "reconfigure"
+            ):
+                self.callable.reconfigure(user_config)
+        self.ongoing = 0
+        self.total_served = 0
+
+    def handle_request(self, method: str, args, kwargs):
+        """Execute one request (reference: replica.py handle_request)."""
+        self.ongoing += 1
+        try:
+            if self._is_function:
+                target = self.callable
+            else:
+                target = getattr(self.callable, method or "__call__")
+            if inspect.iscoroutinefunction(target):
+                import asyncio
+
+                return asyncio.run(target(*args, **kwargs))
+            return target(*args, **kwargs)
+        finally:
+            self.ongoing -= 1
+            self.total_served += 1
+
+    def queue_len(self) -> int:
+        """Queue-length probe (reference: power-of-two router probes)."""
+        return self.ongoing
+
+    def stats(self) -> Dict:
+        return {"ongoing": self.ongoing, "total_served": self.total_served}
+
+    def reconfigure(self, user_config):
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+        return True
+
+    def health_check(self) -> bool:
+        if hasattr(self.callable, "check_health"):
+            self.callable.check_health()
+        return True

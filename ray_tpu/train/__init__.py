@@ -1,0 +1,54 @@
+"""ray_tpu.train: distributed training on TPU gangs.
+
+Public surface mirrors the reference's ray.train/ray.air:
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig/Result,
+Checkpoint, JaxTrainer (the TorchTrainer replacement), DataParallelTrainer,
+and the session API (report / get_checkpoint / get_dataset_shard /
+get_world_rank ...).
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig, allreduce_gradients
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_dataset_shard,
+    get_local_rank,
+    get_session,
+    get_trial_dir,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "JaxConfig",
+    "allreduce_gradients",
+    "Checkpoint",
+    "CheckpointManager",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "BaseTrainer",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "report",
+    "get_checkpoint",
+    "get_dataset_shard",
+    "get_world_rank",
+    "get_world_size",
+    "get_local_rank",
+    "get_trial_dir",
+    "get_session",
+]

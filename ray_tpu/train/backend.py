@@ -1,0 +1,132 @@
+"""Training backends: how a worker group becomes a distributed group.
+
+Analog of the reference's Backend/BackendConfig ABC (train/backend.py:15,27
+with on_start/on_shutdown/on_training_start hooks) and _TorchBackend
+(train/torch/config.py:148, which runs dist.init_process_group on every
+worker). The TPU-native backend instead:
+
+  * whole-host workers: each worker owns all local chips
+    (TPU_VISIBLE_CHIPS passthrough),
+  * multi-host: jax.distributed.initialize with worker 0 as coordinator
+    (rendezvous through the GCS KV, the same channel the reference's gloo
+    backend uses),
+  * gradient allreduce happens INSIDE pjit-compiled programs over ICI —
+    the backend only sets the group up; no NCCL-style eager loop.
+  * CPU test mode: a "dcn" collective group is created across workers so
+    pure-DP training syncs gradients over TCP rings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config):
+        pass
+
+    def on_training_start(self, worker_group, backend_config):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config):
+        pass
+
+
+@dataclass
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Configure the JAX distributed runtime across the worker group.
+
+    distributed=True: call jax.distributed.initialize on every worker
+    (multi-host TPU pods). With distributed=False (default for CPU tests
+    and single-host), workers run independent jax processes and gradient
+    sync uses the eager "dcn" collective group when dp_sync="dcn".
+    """
+
+    distributed: bool = False
+    dp_sync: str = "dcn"  # "dcn" | "none" (in-program collectives)
+    coordinator_port: int = 0
+
+    def backend_cls(self):
+        return _JaxBackend
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig):
+        n = len(worker_group)
+        if backend_config.distributed:
+            # Worker 0 is the jax.distributed coordinator; its address is
+            # published through the GCS KV (gloo_util.py:271 pattern).
+            addrs = worker_group.execute(_get_host_ip)
+            port = backend_config.coordinator_port or 47533
+            coordinator = f"{addrs[0]}:{port}"
+            worker_group.execute_with_rank(
+                _jax_distributed_init, coordinator=coordinator, world_size=n
+            )
+        elif backend_config.dp_sync == "dcn" and n > 1:
+            worker_group.execute_with_rank(_init_dcn_group, world_size=n)
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig):
+        if backend_config.dp_sync == "dcn" and len(worker_group) > 1:
+            try:
+                worker_group.execute(_destroy_dcn_group)
+            except Exception:
+                pass
+
+
+def _get_host_ip():
+    import socket
+
+    return socket.gethostbyname(socket.gethostname())
+
+
+def _jax_distributed_init(rank: int, coordinator: str, world_size: int):
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=world_size,
+        process_id=rank,
+    )
+    return True
+
+
+def _init_dcn_group(rank: int, world_size: int):
+    from ray_tpu.util import collective as col
+
+    col.init_collective_group(world_size, rank, backend="dcn",
+                              group_name="train_dp")
+    return True
+
+
+def _destroy_dcn_group():
+    from ray_tpu.util import collective as col
+
+    col.destroy_collective_group("train_dp")
+    return True
+
+
+def allreduce_gradients(grads, group_name: str = "train_dp"):
+    """Mean-allreduce a gradient pytree across the training DP group.
+
+    For CPU tests / eager DP mode. On TPU meshes, prefer in-program psum
+    via pjit shardings — this helper is the fallback data path.
+    """
+    import jax
+    import numpy as np
+
+    from ray_tpu.util import collective as col
+
+    n = col.get_collective_group_size(group_name)
+    leaves, treedef = jax.tree.flatten(grads)
+    out = []
+    for leaf in leaves:
+        reduced = col.allreduce(np.asarray(leaf), group_name)
+        out.append(reduced / n)
+    return jax.tree.unflatten(treedef, out)

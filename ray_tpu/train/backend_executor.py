@@ -1,0 +1,76 @@
+"""BackendExecutor: orchestrates the worker group through one training run.
+
+Analog of the reference's BackendExecutor
+(train/_internal/backend_executor.py:65): start() creates the WorkerGroup
+in a placement group and runs backend.on_start; start_training launches
+the user loop on every worker; get_next_results gathers reports; restarts
+recreate the group from the latest checkpoint (:701 _restart).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+    ):
+        self.backend_config = backend_config
+        self.scaling_config = scaling_config
+        self.backend = backend_config.backend_cls()()
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self):
+        self.worker_group = WorkerGroup(
+            self.scaling_config.num_workers,
+            self.scaling_config.worker_resources(),
+            self.scaling_config.placement_strategy,
+        )
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(
+        self,
+        train_fn: Callable,
+        config: Dict,
+        checkpoint: Optional[Checkpoint],
+        trial_dir: str,
+        dataset_shards: Optional[List[Any]] = None,
+    ):
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        refs = []
+        import ray_tpu as rt
+
+        for i, w in enumerate(self.worker_group.workers):
+            shard = dataset_shards[i] if dataset_shards else None
+            refs.append(
+                w.start_training.remote(train_fn, config, checkpoint, trial_dir,
+                                        shard)
+            )
+        rt.get(refs, timeout=600)
+
+    def poll(self) -> List[Dict]:
+        """One poll of every worker: list of per-rank status dicts."""
+        import ray_tpu as rt
+
+        return rt.get(
+            [w.poll.remote() for w in self.worker_group.workers], timeout=600
+        )
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group, self.backend_config)
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,153 @@
+"""Checkpoints: directory-based with orbax for sharded arrays.
+
+Analog of the reference's Checkpoint (train/_checkpoint.py:55, a directory
+plus a pyarrow-fs handle) and CheckpointManager
+(train/_internal/checkpoint_manager.py, top-k retention). The TPU twist
+(SURVEY.md §5): sharded-array checkpoints are written per-host via orbax
+so every host persists only its shards.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A directory full of checkpoint data (reference: from_directory
+    train/_checkpoint.py:178)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        import cloudpickle
+
+        d = tempfile.mkdtemp(prefix="rt_ckpt_")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            cloudpickle.dump(data, f)
+        return cls(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        import cloudpickle
+
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # -- sharded pytrees via orbax --------------------------------------
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: str) -> "Checkpoint":
+        """Save a (possibly sharded) jax pytree with orbax."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(path, "pytree"), tree, force=True)
+        return cls(path)
+
+    def to_pytree(self, template: Any = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        if template is not None:
+            return ckptr.restore(os.path.join(self.path, "pytree"),
+                                 item=template)
+        return ckptr.restore(os.path.join(self.path, "pytree"))
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Top-k retention by score (reference:
+    train/_internal/checkpoint_manager.py)."""
+
+    def __init__(
+        self,
+        directory: str,
+        num_to_keep: Optional[int] = None,
+        score_attribute: Optional[str] = None,
+        score_order: str = "max",
+    ):
+        self.directory = directory
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self.registered: List[Dict] = []
+        os.makedirs(directory, exist_ok=True)
+        self._index = 0
+
+    def next_checkpoint_path(self) -> str:
+        path = os.path.join(self.directory, f"checkpoint_{self._index:06d}")
+        self._index += 1
+        return path
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict) -> None:
+        self.registered.append({"checkpoint": checkpoint, "metrics": metrics})
+        self._enforce_retention()
+        self._write_index()
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self.registered:
+            return None
+        if self.score_attribute is None:
+            return self.registered[-1]["checkpoint"]
+        key = lambda e: e["metrics"].get(
+            self.score_attribute, float("-inf") if self.score_order == "max" else float("inf")
+        )
+        best = (max if self.score_order == "max" else min)(self.registered, key=key)
+        return best["checkpoint"]
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return self.registered[-1]["checkpoint"] if self.registered else None
+
+    def _enforce_retention(self):
+        if self.num_to_keep is None or len(self.registered) <= self.num_to_keep:
+            return
+        if self.score_attribute is not None:
+            order = sorted(
+                self.registered,
+                key=lambda e: e["metrics"].get(self.score_attribute, 0),
+                reverse=self.score_order == "max",
+            )
+        else:
+            order = list(reversed(self.registered))  # newest first
+        keep = order[: self.num_to_keep]
+        drop = [e for e in self.registered if e not in keep]
+        for e in drop:
+            try:
+                shutil.rmtree(e["checkpoint"].path, ignore_errors=True)
+            except OSError:
+                pass
+            self.registered.remove(e)
+
+    def _write_index(self):
+        index = [
+            {"path": e["checkpoint"].path, "metrics": _json_safe(e["metrics"])}
+            for e in self.registered
+        ]
+        with open(os.path.join(self.directory, "checkpoints.json"), "w") as f:
+            json.dump(index, f, indent=2)
+
+
+def _json_safe(d: Dict) -> Dict:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = str(v)
+    return out

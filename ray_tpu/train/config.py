@@ -1,0 +1,85 @@
+"""Shared training configuration objects.
+
+Analogs of the reference's ray.air config surface (python/ray/air/config.py):
+ScalingConfig (:101), FailureConfig (:377), CheckpointConfig (:427),
+RunConfig (:576) — reshaped for TPU: ScalingConfig speaks in TPU hosts and
+chips and carries the mesh factorization.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How to scale training (reference: air/config.py:101).
+
+    num_workers: worker processes (on TPU pods: one per host).
+    use_tpu / tpus_per_worker: chips each worker owns (whole-host = all).
+    mesh: optional parallel.MeshConfig describing the global mesh the
+      workers jointly build (dp/fsdp/tp/sp/pp/ep factorization).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: Optional[float] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    mesh: Optional[Any] = None  # parallel.MeshConfig
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if self.use_tpu:
+            res.setdefault("TPU", self.tpus_per_worker or 4.0)
+            res.setdefault("CPU", 1.0)
+        else:
+            res.setdefault("CPU", 1.0)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """Trial-level failure handling (reference: air/config.py:377)."""
+
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    """Checkpoint retention (reference: air/config.py:427)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    """Run-level config (reference: air/config.py:576)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "run"
+        return os.path.join(base, name)
+
+
+@dataclass
+class Result:
+    """Outcome of a training run (reference: ray.air.Result)."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional["Checkpoint"]  # noqa: F821
+    error: Optional[Exception]
+    path: Optional[str] = None
+    metrics_history: list = field(default_factory=list)

@@ -1,0 +1,107 @@
+"""Per-worker training session.
+
+Analog of the reference's _TrainSession (train/_internal/session.py:109):
+the user's train loop calls session.report(metrics, checkpoint=...)
+(reference :393/:653) which streams results back to the trainer; rank info
+and dataset shards are exposed the same way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: Optional["TrainSession"] = None
+
+
+class TrainSession:
+    def __init__(
+        self,
+        world_rank: int,
+        world_size: int,
+        local_rank: int = 0,
+        config: Optional[Dict] = None,
+        checkpoint: Optional[Checkpoint] = None,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        trial_dir: str = "",
+    ):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.config = config or {}
+        self._start_checkpoint = checkpoint
+        self._dataset_shards = dataset_shards or {}
+        self.trial_dir = trial_dir
+        self._lock = threading.Lock()
+        self._reports: List[Dict] = []
+        self._finished = False
+        self._error: Optional[BaseException] = None
+
+    # -- user API --------------------------------------------------------
+    def report(self, metrics: Dict, checkpoint: Optional[Checkpoint] = None):
+        with self._lock:
+            self._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._start_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        return self._dataset_shards.get(name)
+
+    # -- trainer side ----------------------------------------------------
+    def drain(self) -> List[Dict]:
+        with self._lock:
+            out = self._reports
+            self._reports = []
+            return out
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    _session = TrainSession(**kwargs)
+    return _session
+
+
+def shutdown_session():
+    global _session
+    _session = None
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No train session active — this API must be called inside a "
+            "train_loop_per_worker"
+        )
+    return _session
+
+
+# Public module-level API mirroring `ray.train` usage.
+def report(metrics: Dict, checkpoint: Optional[Checkpoint] = None):
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().get_dataset_shard(name)
+
+
+def get_world_rank() -> int:
+    return get_session().world_rank
+
+
+def get_world_size() -> int:
+    return get_session().world_size
+
+
+def get_local_rank() -> int:
+    return get_session().local_rank
+
+
+def get_trial_dir() -> str:
+    return get_session().trial_dir

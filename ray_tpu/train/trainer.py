@@ -1,0 +1,205 @@
+"""Trainers: BaseTrainer → DataParallelTrainer → JaxTrainer.
+
+Analogs of the reference's BaseTrainer (train/base_trainer.py:74, fit()
+:579) and DataParallelTrainer (train/data_parallel_trainer.py:26,
+training_loop :432). Differences by design:
+
+  * fit() drives the BackendExecutor directly with an inline result loop;
+    `as_trainable()` adapts the trainer for the Tune controller instead of
+    the reference's always-through-Tune layering (base_trainer.py:839).
+  * JaxTrainer replaces TorchTrainer: the worker group is one whole-host
+    process per TPU host; collectives run inside compiled programs over
+    ICI (or the eager DCN group on CPU gangs). There is no torch/DDP
+    anywhere in the gradient path (the reference has no JAX backend at
+    all — SURVEY.md §2.3 "No JAX/XLA backend exists").
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Adapt this trainer into a Tune trainable (reference:
+        base_trainer.py:839)."""
+        trainer = self
+
+        def trainable(config, session):
+            import copy
+
+            t = copy.copy(trainer)
+            merged = dict(getattr(t, "train_loop_config", None) or {})
+            merged.update(config)
+            t.train_loop_config = merged
+            result = t.fit()
+            if result.error:
+                raise result.error
+            session.report(result.metrics, checkpoint=result.checkpoint)
+
+        return trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """SPMD training: the same loop on every worker of the gang."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or BackendConfig()
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        failure_config = self.run_config.failure_config
+        attempts = failure_config.max_failures + 1
+        last_error: Optional[Exception] = None
+        checkpoint = self.resume_from_checkpoint
+        for attempt in range(max(1, attempts)):
+            try:
+                return self._run_once(checkpoint)
+            except TrainingFailedError as e:  # worker failure: restart
+                last_error = e
+                if failure_config.fail_fast or attempt + 1 >= attempts:
+                    break
+                # Resume from the newest checkpoint (reference: _restart
+                # backend_executor.py:701).
+                checkpoint = self._latest_checkpoint or checkpoint
+        return Result(metrics={}, checkpoint=self._latest_checkpoint,
+                      error=last_error, path=self._trial_dir)
+
+    def _run_once(self, checkpoint: Optional[Checkpoint]) -> Result:
+        trial_dir = self.run_config.resolved_storage_path()
+        os.makedirs(trial_dir, exist_ok=True)
+        self._trial_dir = trial_dir
+        ckpt_config = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(trial_dir, "checkpoints"),
+            num_to_keep=ckpt_config.num_to_keep,
+            score_attribute=ckpt_config.checkpoint_score_attribute,
+            score_order=ckpt_config.checkpoint_score_order,
+        )
+        self._latest_checkpoint = None
+
+        executor = BackendExecutor(self.backend_config, self.scaling_config)
+        executor.start()
+        dataset_shards = self._shard_datasets(self.scaling_config.num_workers)
+        metrics_history: List[Dict] = []
+        final_metrics: Dict = {}
+        try:
+            executor.start_training(
+                self.train_loop_per_worker,
+                self.train_loop_config,
+                checkpoint,
+                trial_dir,
+                dataset_shards,
+            )
+            while True:
+                statuses = executor.poll()
+                for st in statuses:
+                    if st["error"]:
+                        raise TrainingFailedError(st["error"])
+                # Rank-0 reports carry the canonical metrics (reference:
+                # first-worker results in TrainingIterator).
+                rank0 = statuses[0]["reports"]
+                for rep in rank0:
+                    final_metrics = rep["metrics"]
+                    metrics_history.append(rep["metrics"])
+                    if rep["checkpoint_path"]:
+                        ckpt = Checkpoint.from_directory(rep["checkpoint_path"])
+                        manager.register(ckpt, rep["metrics"])
+                        self._latest_checkpoint = ckpt
+                if all(st["done"] for st in statuses):
+                    # Final drain.
+                    for st in executor.poll():
+                        for rep in st["reports"]:
+                            final_metrics = rep["metrics"]
+                            metrics_history.append(rep["metrics"])
+                            if rep["checkpoint_path"]:
+                                ckpt = Checkpoint.from_directory(
+                                    rep["checkpoint_path"]
+                                )
+                                manager.register(ckpt, rep["metrics"])
+                                self._latest_checkpoint = ckpt
+                    break
+                time.sleep(0.05)
+        finally:
+            executor.shutdown()
+        best = manager.best_checkpoint() or self._latest_checkpoint
+        return Result(
+            metrics=final_metrics,
+            checkpoint=best,
+            error=None,
+            path=trial_dir,
+            metrics_history=metrics_history,
+        )
+
+    def _shard_datasets(self, num_workers: int):
+        if not self.datasets:
+            return None
+        train_ds = self.datasets.get("train")
+        if train_ds is None:
+            return None
+        if hasattr(train_ds, "split"):
+            return train_ds.split(num_workers)
+        # Fallback: same dataset everywhere; workers shard by rank.
+        return [train_ds for _ in range(num_workers)]
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Distributed JAX training on TPU gangs (replaces TorchTrainer).
+
+    The worker group is one process per TPU host; JaxConfig wires
+    jax.distributed + mesh construction; inside the loop users build
+    pjit-compiled steps whose collectives ride ICI. On CPU test gangs the
+    eager DCN group provides gradient sync.
+    """
+
+    def __init__(self, train_loop_per_worker, *, jax_config: Optional[JaxConfig] = None,
+                 **kwargs):
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=jax_config or JaxConfig(),
+            **kwargs,
+        )

@@ -1,0 +1,155 @@
+"""Worker group: the actor gang that runs training.
+
+Analog of the reference's WorkerGroup (train/_internal/worker_group.py) +
+the placement/rank parts of BackendExecutor
+(train/_internal/backend_executor.py:124-358): N actors created inside a
+placement group, rank/world mappings computed, functions executed on all
+workers in parallel.
+
+On TPU pods the idiomatic gang is one whole-host worker per pod host,
+reserved via the pod-name gang resource or a STRICT_SPREAD placement
+group over {TPU: chips_per_host} bundles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.train.session import TrainSession, get_session, init_session, shutdown_session
+from ray_tpu.util.placement_group import PlacementGroup, placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@rt.remote
+class TrainWorker:
+    """Hosts one rank's training loop (reference: per-worker _TrainSession)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self.session: Optional[TrainSession] = None
+        self._thread = None
+        self._error = None
+        self._done = False
+
+    def execute(self, fn, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def execute_with_rank(self, fn, *args, **kwargs):
+        return fn(self.rank, *args, **kwargs)
+
+    def start_training(self, train_fn, config, checkpoint, trial_dir,
+                       dataset_shard=None):
+        import threading
+
+        self.session = init_session(
+            world_rank=self.rank,
+            world_size=self.world_size,
+            config=config,
+            checkpoint=checkpoint,
+            dataset_shards={"train": dataset_shard} if dataset_shard is not None else {},
+            trial_dir=trial_dir,
+        )
+        self._done = False
+        self._error = None
+
+        def run():
+            try:
+                train_fn(config) if _wants_arg(train_fn) else train_fn()
+            except BaseException as e:  # noqa: BLE001
+                import traceback
+
+                self._error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self):
+        """Drain queued reports (reference: get_next_results
+        backend_executor.py:552)."""
+        reports = self.session.drain() if self.session else []
+        out = []
+        for r in reports:
+            ckpt = r["checkpoint"]
+            out.append(
+                {
+                    "metrics": r["metrics"],
+                    "checkpoint_path": ckpt.path if ckpt else None,
+                }
+            )
+        return {"reports": out, "done": self._done, "error": self._error}
+
+    def shutdown(self):
+        shutdown_session()
+        return True
+
+
+def _wants_arg(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return len(sig.parameters) >= 1
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_strategy: str = "PACK",
+    ):
+        self.num_workers = num_workers
+        self._pg: Optional[PlacementGroup] = None
+        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
+        self._pg = placement_group(bundles, strategy=placement_strategy)
+        if not self._pg.ready(timeout=120):
+            raise RuntimeError(
+                f"worker group placement group not ready "
+                f"(bundles={bundles}, strategy={placement_strategy})"
+            )
+        self.workers = [
+            TrainWorker.options(
+                num_cpus=0,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=i,
+                ),
+            ).remote(i, num_workers)
+            for i in range(num_workers)
+        ]
+
+    def __len__(self):
+        return self.num_workers
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run fn on every worker; returns per-rank results."""
+        return rt.get(
+            [w.execute.remote(fn, *args, **kwargs) for w in self.workers],
+            timeout=600,
+        )
+
+    def execute_with_rank(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return rt.get(
+            [w.execute_with_rank.remote(fn, *args, **kwargs) for w in self.workers],
+            timeout=600,
+        )
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass
+        if self._pg is not None:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass

@@ -1,0 +1,65 @@
+"""ray_tpu.tune: hyperparameter search.
+
+Public surface mirrors the reference's ray.tune: Tuner/TuneConfig/
+ResultGrid, sample domains (uniform/loguniform/choice/randint/grid_search),
+schedulers (ASHA, median stopping), and tune.report inside trials.
+"""
+
+from typing import Dict, Optional
+
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Choice,
+    ConcurrencyLimiter,
+    Domain,
+    GridSearch,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+
+def report(metrics: Dict, checkpoint=None):
+    """Report metrics from inside a trial (reference: tune.report /
+    session.report)."""
+    from ray_tpu.tune.session_bridge import get_active_session
+
+    get_active_session().report(metrics, checkpoint)
+
+
+def get_checkpoint():
+    from ray_tpu.tune.session_bridge import get_active_session
+
+    return get_active_session().get_checkpoint()
+
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "ResultGrid",
+    "report",
+    "get_checkpoint",
+    "uniform",
+    "loguniform",
+    "choice",
+    "randint",
+    "grid_search",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "ASHAScheduler",
+    "MedianStoppingRule",
+    "FIFOScheduler",
+    "TrialScheduler",
+    "Domain",
+    "Choice",
+    "GridSearch",
+]

@@ -1,0 +1,120 @@
+"""Trial schedulers.
+
+Analog of the reference's tune/schedulers: FIFO and ASHA
+(async_hyperband.py) plus median stopping (median_stopping_rule.py).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial_id: str, result: Optional[Dict] = None):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference:
+    tune/schedulers/async_hyperband.py).
+
+    Rungs at time_attr values grace_period * reduction_factor^k; a trial
+    reaching a rung stops unless its metric is in the top 1/reduction_factor
+    of results recorded at that rung so far.
+    """
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        max_t: int = 100,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.reduction_factor = reduction_factor
+        self.max_t = max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung value -> recorded metrics
+        self.recorded: Dict[int, List[float]] = defaultdict(list)
+        self._passed: Dict[str, set] = defaultdict(set)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in self.rungs:
+            if t >= rung and rung not in self._passed[trial_id]:
+                self._passed[trial_id].add(rung)
+                recorded = self.recorded[rung]
+                recorded.append(value)
+                if len(recorded) >= self.reduction_factor:
+                    ordered = sorted(recorded, reverse=(self.mode == "max"))
+                    cutoff_idx = max(
+                        0, math.ceil(len(ordered) / self.reduction_factor) - 1
+                    )
+                    cutoff = ordered[cutoff_idx]
+                    good = value >= cutoff if self.mode == "max" else value <= cutoff
+                    if not good:
+                        return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running average falls below the median of other
+    trials at the same step (reference: tune/schedulers/median_stopping_rule.py).
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.histories: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        value = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if value is None:
+            return CONTINUE
+        self.histories[trial_id].append(value)
+        if t < self.grace_period or len(self.histories) < self.min_samples:
+            return CONTINUE
+        import statistics
+
+        avgs = [
+            sum(h) / len(h) for tid, h in self.histories.items() if tid != trial_id and h
+        ]
+        if len(avgs) < self.min_samples - 1:
+            return CONTINUE
+        median = statistics.median(avgs)
+        mine = sum(self.histories[trial_id]) / len(self.histories[trial_id])
+        worse = mine > median if self.mode == "min" else mine < median
+        return STOP if worse else CONTINUE

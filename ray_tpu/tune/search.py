@@ -1,0 +1,153 @@
+"""Search spaces and search algorithms.
+
+Analog of the reference's tune.search surface: sample domains
+(tune/search/sample.py), grid/random generation (basic_variant.py), and
+ConcurrencyLimiter.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(list(categories))
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(list(values))
+
+
+class Searcher:
+    """Suggest configs one at a time (reference: tune/search/searcher.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid x random sampling (reference: tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: Dict, num_samples: int = 1, seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = list(self._generate())
+        self._idx = 0
+
+    def _generate(self) -> Iterator[Dict]:
+        grid_keys = [
+            k for k, v in self.param_space.items() if isinstance(v, GridSearch)
+        ]
+        grid_values = [self.param_space[k].values for k in grid_keys]
+        combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+        for _ in range(self.num_samples):
+            for combo in combos:
+                config = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        config[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        config[k] = v.sample(self.rng)
+                    elif callable(v) and not isinstance(v, type):
+                        config[k] = v()
+                    else:
+                        config[k] = v
+                yield config
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._idx >= len(self._variants):
+            return None
+        config = self._variants[self._idx]
+        self._idx += 1
+        return config
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions (reference: tune/search/ConcurrencyLimiter)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self.live: set = set()
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if len(self.live) >= self.max_concurrent:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None:
+            self.live.add(trial_id)
+        return config
+
+    def on_trial_complete(self, trial_id: str, result=None):
+        self.live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)

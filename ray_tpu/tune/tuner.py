@@ -1,0 +1,293 @@
+"""Tuner: the experiment controller.
+
+Analog of the reference's Tuner.fit (tune/tuner.py:346) → tune.run
+(tune/tune.py:234) → TuneController (tune/execution/tune_controller.py:72,
+event loop step() :709) managing Trials as remote actors. Collapsed here
+into one controller loop: trials run as session-carrying actors
+(reference: Trainable actors in placement groups), the searcher feeds
+configs, the scheduler may stop trials early, and experiment state is
+snapshotted to storage for restore.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import Result, RunConfig
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+
+
+@dataclass
+class TuneConfig:
+    """Analog of tune.TuneConfig."""
+
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    trial_resources: Optional[Dict[str, float]] = None
+    seed: Optional[int] = None
+
+
+@rt.remote
+class _TrialActor:
+    """Runs one trial's function with a reporting session (reference:
+    Trainable actor, tune/trainable/trainable.py:61)."""
+
+    def __init__(self, trial_id: str, trial_dir: str):
+        from ray_tpu.train.session import init_session
+
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self._thread = None
+        self._error = None
+        self._done = False
+        self.session = None
+
+    def run(self, fn, config, checkpoint):
+        import threading
+
+        from ray_tpu.train.session import TrainSession
+
+        self.session = TrainSession(
+            world_rank=0,
+            world_size=1,
+            config=config,
+            checkpoint=checkpoint,
+            trial_dir=self.trial_dir,
+        )
+        import ray_tpu.tune.session_bridge as bridge
+
+        bridge.set_active_session(self.session)
+
+        def go():
+            try:
+                import inspect
+
+                params = list(inspect.signature(fn).parameters)
+                if len(params) >= 2:
+                    fn(config, self.session)
+                else:
+                    fn(config)
+            except BaseException as e:  # noqa: BLE001
+                import traceback
+
+                self._error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=go, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self):
+        reports = self.session.drain() if self.session else []
+        return {
+            "reports": [
+                {
+                    "metrics": r["metrics"],
+                    "checkpoint_path": r["checkpoint"].path if r["checkpoint"] else None,
+                }
+                for r in reports
+            ],
+            "done": self._done,
+            "error": self._error,
+        }
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict
+    state: str = "PENDING"  # PENDING RUNNING TERMINATED STOPPED ERROR
+    actor: Any = None
+    last_metrics: Dict = field(default_factory=dict)
+    metrics_history: List[Dict] = field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    iteration: int = 0
+
+
+class ResultGrid:
+    """Analog of tune.ResultGrid."""
+
+    def __init__(self, results: List[Result], trials: List[Trial],
+                 metric: Optional[str], mode: str):
+        self._results = results
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        candidates = [r for r in self._results if metric in (r.metrics or {})]
+        if not candidates:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            candidates, key=lambda r: r.metrics[metric]
+        )
+
+    def get_dataframe(self):
+        rows = []
+        for t, r in zip(self._trials, self._results):
+            row = {"trial_id": t.trial_id, **{f"config/{k}": v for k, v in t.config.items()}}
+            row.update(r.metrics or {})
+            rows.append(row)
+        return rows
+
+
+class Tuner:
+    """Analog of tune.Tuner (tuner.py:346)."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        # Trainers adapt via as_trainable() (reference: base_trainer.py:839).
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples, seed=tc.seed
+        )
+        scheduler = tc.scheduler or FIFOScheduler()
+        exp_dir = self.run_config.resolved_storage_path()
+        os.makedirs(exp_dir, exist_ok=True)
+
+        max_concurrent = tc.max_concurrent_trials or 4
+        resources = tc.trial_resources or {"CPU": 1.0}
+
+        trials: List[Trial] = []
+        live: List[Trial] = []
+        exhausted = False
+
+        # Controller event loop (reference: TuneController.step :709).
+        while True:
+            # Launch new trials up to the concurrency cap.
+            while not exhausted and len(live) < max_concurrent:
+                trial_id = uuid.uuid4().hex[:8]
+                config = searcher.suggest(trial_id)
+                if config is None:
+                    exhausted = True
+                    break
+                trial = Trial(trial_id=trial_id, config=config)
+                trial_dir = os.path.join(exp_dir, f"trial_{trial_id}")
+                os.makedirs(trial_dir, exist_ok=True)
+                trial.actor = _TrialActor.options(
+                    num_cpus=resources.get("CPU", 1.0),
+                    resources={k: v for k, v in resources.items() if k != "CPU"},
+                ).remote(trial_id, trial_dir)
+                rt.get(trial.actor.run.remote(self.trainable, config, None),
+                       timeout=300)
+                trial.state = "RUNNING"
+                trials.append(trial)
+                live.append(trial)
+
+            if not live and exhausted:
+                break
+
+            # Poll live trials.
+            polls = rt.get([t.actor.poll.remote() for t in live], timeout=300)
+            still_live = []
+            for trial, st in zip(live, polls):
+                for rep in st["reports"]:
+                    trial.iteration += 1
+                    metrics = dict(rep["metrics"])
+                    metrics.setdefault("training_iteration", trial.iteration)
+                    trial.last_metrics = metrics
+                    trial.metrics_history.append(metrics)
+                    if rep["checkpoint_path"]:
+                        trial.checkpoint = Checkpoint.from_directory(
+                            rep["checkpoint_path"]
+                        )
+                    decision = scheduler.on_result(trial.trial_id, metrics)
+                    if decision == STOP and not st["done"]:
+                        trial.state = "STOPPED"
+                if st["error"]:
+                    trial.state = "ERROR"
+                    trial.error = st["error"]
+                elif st["done"] and trial.state == "RUNNING":
+                    trial.state = "TERMINATED"
+                if trial.state in ("RUNNING",):
+                    still_live.append(trial)
+                else:
+                    scheduler.on_complete(trial.trial_id, trial.last_metrics)
+                    searcher.on_trial_complete(trial.trial_id, trial.last_metrics)
+                    try:
+                        rt.kill(trial.actor)
+                    except Exception:
+                        pass
+            live = still_live
+            self._snapshot(exp_dir, trials)
+            if live:
+                time.sleep(0.05)
+
+        results = [
+            Result(
+                metrics=t.last_metrics,
+                checkpoint=t.checkpoint,
+                error=RuntimeError(t.error) if t.error else None,
+                path=os.path.join(exp_dir, f"trial_{t.trial_id}"),
+                metrics_history=t.metrics_history,
+            )
+            for t in trials
+        ]
+        return ResultGrid(results, trials, tc.metric, tc.mode)
+
+    def _snapshot(self, exp_dir: str, trials: List[Trial]):
+        """Experiment state snapshot (reference:
+        tune/execution/experiment_state.py)."""
+        state = [
+            {
+                "trial_id": t.trial_id,
+                "config": _json_safe(t.config),
+                "state": t.state,
+                "last_metrics": _json_safe(t.last_metrics),
+                "error": t.error,
+            }
+            for t in trials
+        ]
+        with open(os.path.join(exp_dir, "experiment_state.json"), "w") as f:
+            json.dump(state, f, indent=2)
+
+
+def _json_safe(d):
+    try:
+        json.dumps(d)
+        return d
+    except TypeError:
+        return {k: str(v) for k, v in d.items()}

@@ -1,0 +1,159 @@
+"""Dataset tests (reference model: python/ray/data/tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import data as rtd
+
+pytestmark = pytest.mark.usefixtures("rt_start")
+
+
+def test_range_count_take():
+    ds = rtd.range(100)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+
+def test_map_and_filter():
+    ds = rtd.range(20).map(lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+    ds = ds.filter(lambda r: r["sq"] % 2 == 0)
+    rows = ds.take_all()
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+    assert all(r["sq"] % 2 == 0 for r in rows)
+    assert len(rows) == 10
+
+
+def test_map_batches_numpy():
+    ds = rtd.range(32).map_batches(
+        lambda batch: {"id": batch["id"], "double": batch["id"] * 2}
+    )
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert rows[5] == {"id": 5, "double": 10}
+
+
+def test_flat_map():
+    ds = rtd.from_items([{"n": 2}, {"n": 3}]).flat_map(
+        lambda r: [{"v": r["n"]} for _ in range(r["n"])]
+    )
+    assert ds.count() == 5
+
+
+def test_repartition_and_num_blocks():
+    ds = rtd.range(100).repartition(10)
+    assert ds.materialize().num_blocks() == 10
+    assert ds.count() == 100
+
+
+def test_random_shuffle_preserves_rows():
+    ds = rtd.range(50).random_shuffle(seed=42)
+    ids = sorted(r["id"] for r in ds.take_all())
+    assert ids == list(range(50))
+    # Actually shuffled
+    assert [r["id"] for r in rtd.range(50).random_shuffle(seed=42).take_all()] != list(range(50))
+
+
+def test_sort():
+    ds = rtd.from_items([{"x": 3}, {"x": 1}, {"x": 2}]).sort("x")
+    assert [r["x"] for r in ds.take_all()] == [1, 2, 3]
+    ds = rtd.from_items([{"x": 3}, {"x": 1}, {"x": 2}]).sort("x", descending=True)
+    assert [r["x"] for r in ds.take_all()] == [3, 2, 1]
+
+
+def test_aggregations():
+    ds = rtd.from_items([{"v": float(i)} for i in range(10)])
+    assert ds.sum("v") == 45.0
+    assert ds.mean("v") == 4.5
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 9.0
+
+
+def test_groupby():
+    ds = rtd.from_items(
+        [{"k": i % 3, "v": i} for i in range(9)]
+    )
+    counts = ds.groupby("k").count().take_all()
+    assert counts == [
+        {"k": 0, "count()": 3},
+        {"k": 1, "count()": 3},
+        {"k": 2, "count()": 3},
+    ]
+    sums = ds.groupby("k").sum("v").take_all()
+    assert sums[0]["sum(v)"] == 0 + 3 + 6
+
+
+def test_iter_batches_rebatching():
+    ds = rtd.range(25).repartition(4)
+    batches = list(ds.iter_batches(batch_size=10))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [10, 10, 5]
+    all_ids = sorted(int(i) for b in batches for i in b["id"])
+    assert all_ids == list(range(25))
+
+
+def test_split_for_training():
+    shards = rtd.range(30).split(3)
+    assert len(shards) == 3
+    counts = [s.count() for s in shards]
+    assert sum(counts) == 30
+    assert all(c == 10 for c in counts)
+
+
+def test_from_numpy_roundtrip():
+    ds = rtd.from_numpy({"x": np.arange(10), "y": np.arange(10) * 2})
+    batch = next(ds.iter_batches(batch_size=10))
+    assert list(batch["y"]) == [i * 2 for i in range(10)]
+
+
+def test_read_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({"a": list(range(20)), "b": [str(i) for i in range(20)]})
+    path = os.path.join(str(tmp_path), "t.parquet")
+    pq.write_table(table, path)
+    ds = rtd.read_parquet(path)
+    assert ds.count() == 20
+    assert ds.sum("a") == sum(range(20))
+
+
+def test_read_csv(tmp_path):
+    path = os.path.join(str(tmp_path), "t.csv")
+    with open(path, "w") as f:
+        f.write("a,b\n1,x\n2,y\n3,z\n")
+    ds = rtd.read_csv(path)
+    assert ds.count() == 3
+    assert ds.sum("a") == 6
+
+
+def test_union_and_limit():
+    a = rtd.range(5)
+    b = rtd.range(5).map(lambda r: {"id": r["id"] + 5})
+    u = a.union(b)
+    assert u.count() == 10
+    assert u.limit(3).count() == 3
+
+
+def test_dataset_with_trainer(tmp_path):
+    """Dataset shards feed JaxTrainer workers (train ingest path)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ds = rtd.range(20)
+
+    def loop(config):
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        total = sum(r["id"] for r in shard.iter_rows())
+        train.report({"total": total, "rank": train.get_world_rank()})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ds", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.error is None

@@ -1,0 +1,194 @@
+"""JaxTrainer tests on CPU gangs.
+
+Modeled on the reference's python/ray/train/tests (tiny models, CPU
+workers, gloo-role collectives — here the DCN TCP group), per SURVEY.md
+§4.2.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+pytestmark = pytest.mark.usefixtures("rt_start")
+
+
+def _simple_loop(config):
+    from ray_tpu import train
+
+    rank = train.get_world_rank()
+    for step in range(config["steps"]):
+        train.report({"step": step, "rank": rank, "loss": 1.0 / (step + 1)})
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_single_worker_reports(tmp_path):
+    trainer = JaxTrainer(
+        _simple_loop,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def _dp_loop(config):
+    """Real data-parallel training: grads sync over the DCN group."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import train
+    from ray_tpu.models.mlp import init_mlp, mlp_classifier_loss
+    from ray_tpu.train import allreduce_gradients
+
+    rank = train.get_world_rank()
+    world = train.get_world_size()
+
+    params = init_mlp(jax.random.PRNGKey(0), [4, 16, 2])  # same init all ranks
+    # Rank-dependent data shard.
+    key = jax.random.PRNGKey(100 + rank)
+    x = jax.random.normal(key, (32, 4))
+    y = (x.sum(axis=1) > 0).astype(jnp.int32)
+
+    grad_fn = jax.value_and_grad(mlp_classifier_loss, has_aux=True)
+    lr = 0.1
+    for step in range(config["steps"]):
+        (loss, metrics), grads = grad_fn(params, {"x": x, "y": y})
+        if world > 1:
+            grads = allreduce_gradients(grads)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        train.report({"loss": float(loss), "rank": rank, "step": step})
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_data_parallel_two_workers(tmp_path):
+    trainer = JaxTrainer(
+        _dp_loop,
+        train_loop_config={"steps": 4},
+        jax_config=JaxConfig(dp_sync="dcn"),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="dp", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # Loss decreased over training.
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses[-1] < losses[0]
+
+
+def _ckpt_loop(config):
+    import os
+
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint
+
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start = ckpt.to_dict()["step"] + 1
+    for step in range(start, config["steps"]):
+        if train.get_world_rank() == 0:
+            c = Checkpoint.from_dict({"step": step})
+            train.report({"step": step}, checkpoint=c)
+        else:
+            train.report({"step": step})
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_checkpoints_and_resume(tmp_path):
+    trainer = JaxTrainer(
+        _ckpt_loop,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="ck",
+            storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict()["step"] == 2
+
+    # Resume continues from the saved step.
+    trainer2 = JaxTrainer(
+        _ckpt_loop,
+        train_loop_config={"steps": 5},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="ck2", storage_path=str(tmp_path)),
+        resume_from_checkpoint=result.checkpoint,
+    )
+    result2 = trainer2.fit()
+    assert result2.error is None
+    steps = [m["step"] for m in result2.metrics_history]
+    assert steps == [3, 4]
+
+
+def _fail_once_loop(config):
+    import os
+
+    from ray_tpu import train
+
+    marker = os.path.join(config["dir"], "failed_once")
+    if not os.path.exists(marker):
+        open(marker, "w").close()
+        raise RuntimeError("injected failure")
+    train.report({"recovered": True})
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_failure_recovery(tmp_path):
+    trainer = JaxTrainer(
+        _fail_once_loop,
+        train_loop_config={"dir": str(tmp_path)},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="fr",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["recovered"] is True
+
+
+def _pytree_ckpt_loop(config):
+    import jax.numpy as jnp
+
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint
+
+    tree = {"w": jnp.arange(8.0), "step": jnp.array(7)}
+    c = Checkpoint.from_pytree(
+        tree, os.path.join(train.get_trial_dir(), "ptc")
+    )
+    train.report({"saved": True}, checkpoint=c)
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_orbax_pytree_checkpoint(tmp_path):
+    trainer = JaxTrainer(
+        _pytree_ckpt_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="ptc", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    restored = result.checkpoint.to_pytree()
+    assert list(np.asarray(restored["w"])) == list(range(8))

@@ -1,0 +1,149 @@
+"""Tune tests: random/grid search, ASHA early stopping, best-result
+selection, experiment snapshots (reference: python/ray/tune/tests)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+
+pytestmark = pytest.mark.usefixtures("rt_start")
+
+
+def _objective(config):
+    # Quadratic bowl: best at x=3.
+    loss = (config["x"] - 3.0) ** 2
+    tune.report({"loss": loss, "x": config["x"]})
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_grid_search_finds_best(tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.metrics["x"] == 3.0
+    # Experiment state snapshot written.
+    state_file = os.path.join(str(tmp_path), "grid", "experiment_state.json")
+    assert os.path.exists(state_file)
+    assert len(json.load(open(state_file))) == 4
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_random_search_samples(tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.uniform(-1.0, 1.0)},
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=5, seed=7),
+        run_config=RunConfig(name="rand", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 5
+    xs = [r.metrics["x"] for r in grid]
+    assert all(-1.0 <= x <= 1.0 for x in xs)
+    assert len(set(xs)) > 1  # actually sampled
+
+
+def _iterative(config):
+    # Good configs (high "quality") improve faster.
+    for i in range(1, 17):
+        tune.report({"score": config["quality"] * i, "training_iteration": i})
+        time.sleep(0.05)
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_asha_stops_bad_trials(tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    tuner = Tuner(
+        _iterative,
+        # Good trials first: ASHA is asynchronous, so a rung's cutoff only
+        # exists once earlier trials recorded scores there; later bad
+        # trials are then culled against it.
+        param_space={"quality": tune.grid_search([1.0, 0.9, 0.2, 0.1])},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=ASHAScheduler(
+                metric="score", mode="max", grace_period=2,
+                reduction_factor=2, max_t=16,
+            ),
+            max_concurrent_trials=4,
+        ),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 0.9 * 16 * 0.9  # a good trial won
+    # At least one bad trial was stopped early.
+    iters = [len(r.metrics_history) for r in grid]
+    assert min(iters) < 16
+
+
+def _failing(config):
+    if config["x"] == 1:
+        raise ValueError("boom")
+    tune.report({"loss": 0.0})
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_trial_errors_surface(tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    tuner = Tuner(
+        _failing,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid.errors) == 1
+    assert "boom" in str(grid.errors[0])
+    assert grid.get_best_result().metrics["loss"] == 0.0
+
+
+def _trainer_objective(tmp_path):
+    """Tuning a JaxTrainer end to end (Train-on-Tune integration)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        train.report({"final": config["lr"] * 10})
+
+    return JaxTrainer(
+        loop,
+        train_loop_config={"lr": 0.0},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="inner", storage_path=str(tmp_path)),
+    )
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_tune_over_trainer(tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    trainer = _trainer_objective(tmp_path)
+    tuner = Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.1, 0.3])},
+        tune_config=TuneConfig(metric="final", mode="max",
+                               max_concurrent_trials=1),
+        run_config=RunConfig(name="outer", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    assert abs(grid.get_best_result().metrics["final"] - 3.0) < 1e-6
