@@ -1,0 +1,25 @@
+"""ray_tpu.rl: reinforcement learning on TPU learner actors.
+
+The reference's RLlib capability rebuilt on the new-API-stack shape
+(SURVEY.md §2.3: Algorithm / Learner / LearnerGroup / RLModule /
+EnvRunner), JAX-first: modules are pure functions, learner updates are
+jit-compiled, multi-learner sync is collective-based.
+"""
+
+from ray_tpu.rl.core.learner import Learner
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
+from ray_tpu.rl.env_runner import EnvRunner, compute_gae
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, ppo_loss
+
+__all__ = [
+    "Learner",
+    "LearnerGroup",
+    "RLModuleSpec",
+    "DiscretePolicyModule",
+    "EnvRunner",
+    "compute_gae",
+    "PPO",
+    "PPOConfig",
+    "ppo_loss",
+]

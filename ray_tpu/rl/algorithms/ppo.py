@@ -1,0 +1,179 @@
+"""PPO: proximal policy optimization on the new learner stack.
+
+Analog of the reference's PPO (rllib/algorithms/ppo/ppo.py on the new API
+stack: Algorithm.training_step samples via env runners, updates via the
+LearnerGroup, then broadcasts weights — algorithm.py:1582 flow). Config
+uses the builder pattern of AlgorithmConfig (algorithm_config.py:121).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
+from ray_tpu.rl.env_runner import EnvRunner, compute_gae
+
+
+def ppo_loss(params, module, batch):
+    """Clipped-surrogate PPO loss (standard formulation)."""
+    out = module.forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(out["action_logits"])
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    ratio = jnp.exp(logp - batch["logp"])
+    adv = batch["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    clip = 0.2
+    surr = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+    )
+    policy_loss = -surr.mean()
+    value_loss = ((out["value"] - batch["returns"]) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    loss = policy_loss + 0.5 * value_loss - 0.01 * entropy
+    metrics = {
+        "total_loss": loss,
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+        "kl": (batch["logp"] - logp).mean(),
+    }
+    return loss, metrics
+
+
+@dataclass
+class PPOConfig:
+    """Builder-style config (reference: AlgorithmConfig/PPOConfig)."""
+
+    env_creator: Optional[Callable] = None
+    obs_dim: int = 4
+    num_actions: int = 2
+    hidden: tuple = (64, 64)
+    num_env_runners: int = 2
+    rollout_length: int = 200
+    num_learners: int = 1
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    seed: int = 0
+
+    def environment(self, env_creator=None, obs_dim=None, num_actions=None):
+        if env_creator is not None:
+            self.env_creator = env_creator
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def env_runners(self, num_env_runners=None, rollout_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, lr=None, num_epochs=None, minibatch_size=None,
+                 gamma=None, lambda_=None, num_learners=None):
+        for name, val in (
+            ("lr", lr), ("num_epochs", num_epochs),
+            ("minibatch_size", minibatch_size), ("gamma", gamma),
+            ("lambda_", lambda_), ("num_learners", num_learners),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """The algorithm object (reference: Algorithm, a Tune Trainable —
+    train() returns one iteration's metrics)."""
+
+    def __init__(self, config: PPOConfig):
+        assert config.env_creator is not None, "config.environment(...) first"
+        self.config = config
+        spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
+        module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
+
+        import optax
+
+        self.learner_group = LearnerGroup(
+            module_factory,
+            ppo_loss,
+            num_learners=config.num_learners,
+            seed=config.seed,
+        )
+        self.env_runners = [
+            EnvRunner.options(num_cpus=0.5).remote(
+                config.env_creator,
+                module_factory,
+                seed=config.seed + 1 + i,
+                rollout_length=config.rollout_length,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self._iteration = 0
+        self._broadcast_weights()
+
+    def _broadcast_weights(self):
+        weights = self.learner_group.get_weights()
+        rt.get([r.set_weights.remote(weights) for r in self.env_runners],
+               timeout=300)
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference: Algorithm.step :795 /
+        training_step :1582)."""
+        cfg = self.config
+        # 1. parallel rollout collection
+        rollouts = rt.get(
+            [r.sample.remote() for r in self.env_runners], timeout=600
+        )
+        processed = [compute_gae(b, cfg.gamma, cfg.lambda_) for b in rollouts]
+        batch = {
+            k: np.concatenate([p[k] for p in processed])
+            for k in ("obs", "actions", "logp", "values", "advantages", "returns")
+        }
+        # 2. minibatch SGD epochs on the learner group
+        n = len(batch["obs"])
+        rng = np.random.default_rng(cfg.seed + self._iteration)
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - cfg.minibatch_size + 1, cfg.minibatch_size):
+                idx = perm[start : start + cfg.minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                metrics = self.learner_group.update_from_batch(mb)
+        # 3. broadcast new weights to env runners
+        self._broadcast_weights()
+        self._iteration += 1
+        stats = rt.get(
+            [r.episode_stats.remote() for r in self.env_runners], timeout=300
+        )
+        returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        self.learner_group.shutdown()
+        for r in self.env_runners:
+            try:
+                rt.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,72 @@
+"""Learner: gradient-based policy improvement.
+
+Analog of the reference's Learner (rllib/core/learner/learner.py:106;
+compute_gradients :455, apply_gradients :585, update_from_batch :1128) and
+TorchLearner (torch_learner.py:52, DDP wrap :369). The TPU-native version
+jit-compiles the whole update; multi-learner data parallelism is sharding,
+not DDP.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Learner:
+    def __init__(
+        self,
+        module,
+        loss_fn: Callable,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        seed: int = 0,
+        grad_clip: Optional[float] = 0.5,
+    ):
+        self.module = module
+        self.loss_fn = loss_fn
+        tx = optimizer or optax.adam(3e-4)
+        if grad_clip:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+        self.optimizer = tx
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._update_impl)
+
+    def _update_impl(self, params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True
+        )(params, self.module, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = gnorm
+        return params, opt_state, metrics
+
+    # -- reference API shape ---------------------------------------------
+    def update_from_batch(self, batch: Dict[str, jnp.ndarray]) -> Dict:
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def compute_gradients(self, batch) -> Tuple[Any, Dict]:
+        (loss, metrics), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True
+        )(self.params, self.module, batch)
+        return grads, {k: float(v) for k, v in metrics.items()}
+
+    def apply_gradients(self, grads):
+        updates, self.opt_state = self.optimizer.update(
+            grads, self.opt_state, self.params
+        )
+        self.params = optax.apply_updates(self.params, updates)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = weights

@@ -1,0 +1,124 @@
+"""LearnerGroup: one or more learner actors updating in data parallel.
+
+Analog of the reference's LearnerGroup (rllib/core/learner/learner_group.py:71,
+which reuses Ray Train's BackendExecutor :148-170 for multi-GPU learners).
+Here learner actors are placed like Train workers (TPU resources flow
+through actor options); with N learners each takes 1/N of the batch and
+gradients sync through the eager DCN group (CPU) — on TPU learner gangs
+the update itself is pjit-sharded instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu as rt
+
+
+@rt.remote
+class _LearnerActor:
+    def __init__(self, module_factory, loss_fn, seed, rank, world_size):
+        from ray_tpu.rl.core.learner import Learner
+
+        self.learner = Learner(module_factory(), loss_fn, seed=seed)
+        self.rank = rank
+        self.world_size = world_size
+
+    def init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend, group_name)
+        self._group = group_name
+        return True
+
+    def update(self, batch_shard) -> Dict:
+        if self.world_size == 1:
+            return self.learner.update_from_batch(batch_shard)
+        import jax
+
+        from ray_tpu.util import collective as col
+
+        grads, metrics = self.learner.compute_gradients(batch_shard)
+        leaves, treedef = jax.tree.flatten(grads)
+        reduced = [
+            col.allreduce(np.asarray(leaf), self._group) / self.world_size
+            for leaf in leaves
+        ]
+        self.learner.apply_gradients(jax.tree.unflatten(treedef, reduced))
+        return metrics
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        self.learner.set_weights(weights)
+        return True
+
+
+class LearnerGroup:
+    def __init__(
+        self,
+        module_factory,
+        loss_fn,
+        num_learners: int = 1,
+        resources_per_learner: Optional[Dict[str, float]] = None,
+        seed: int = 0,
+    ):
+        self.num_learners = max(1, num_learners)
+        res = resources_per_learner or {"CPU": 1}
+        self.actors = [
+            _LearnerActor.options(
+                num_cpus=res.get("CPU", 1),
+                resources={k: v for k, v in res.items() if k != "CPU"},
+            ).remote(module_factory, loss_fn, seed, i, self.num_learners)
+            for i in range(self.num_learners)
+        ]
+        if self.num_learners > 1:
+            from ray_tpu.util import collective as col
+
+            col.create_collective_group(
+                self.actors,
+                self.num_learners,
+                list(range(self.num_learners)),
+                backend="dcn",
+                group_name="learner_group",
+            )
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict:
+        """Split the batch across learners; return averaged metrics
+        (reference: learner_group.py:210 update_from_batch)."""
+        if self.num_learners == 1:
+            return rt.get(self.actors[0].update.remote(batch), timeout=300)
+        shards = _split_batch(batch, self.num_learners)
+        all_metrics = rt.get(
+            [a.update.remote(s) for a, s in zip(self.actors, shards)],
+            timeout=300,
+        )
+        out: Dict = {}
+        for k in all_metrics[0]:
+            out[k] = float(np.mean([m[k] for m in all_metrics]))
+        return out
+
+    def get_weights(self):
+        return rt.get(self.actors[0].get_weights.remote(), timeout=300)
+
+    def set_weights(self, weights):
+        rt.get([a.set_weights.remote(weights) for a in self.actors], timeout=300)
+
+    def shutdown(self):
+        for a in self.actors:
+            try:
+                rt.kill(a)
+            except Exception:
+                pass
+
+
+def _split_batch(batch: Dict[str, np.ndarray], n: int) -> List[Dict]:
+    keys = list(batch.keys())
+    size = len(batch[keys[0]])
+    per = size // n
+    return [
+        {k: batch[k][i * per : (i + 1) * per] for k in keys} for i in range(n)
+    ]

@@ -1,0 +1,57 @@
+"""RLModule: the neural policy/value container.
+
+Analog of the reference's RLModule (rllib/core/rl_module/rl_module.py:237)
+reworked functional-JAX: a module is init/forward pure functions over a
+params pytree, so the same module runs in env-runner actors (CPU
+inference) and learner actors (TPU training) without framework adapters
+(the reference needs torch/tf-specific subclasses).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.mlp import init_mlp, mlp_forward
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Analog of RLModuleSpec: architecture + spaces."""
+
+    obs_dim: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+class DiscretePolicyModule:
+    """Separate policy and value MLP towers over a shared spec."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict:
+        k1, k2 = jax.random.split(rng)
+        sizes = [self.spec.obs_dim, *self.spec.hidden]
+        return {
+            "pi": init_mlp(k1, sizes + [self.spec.num_actions]),
+            "vf": init_mlp(k2, sizes + [1]),
+        }
+
+    def forward(self, params: Dict, obs: jax.Array) -> Dict[str, jax.Array]:
+        logits = mlp_forward(params["pi"], obs)
+        value = mlp_forward(params["vf"], obs)[..., 0]
+        return {"action_logits": logits, "value": value}
+
+    def action_dist(self, logits: jax.Array):
+        return jax.nn.log_softmax(logits)
+
+    def sample_action(self, params: Dict, obs: jax.Array, rng: jax.Array):
+        out = self.forward(params, obs)
+        action = jax.random.categorical(rng, out["action_logits"])
+        logp = jax.nn.log_softmax(out["action_logits"])
+        chosen_logp = jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
+        return action, chosen_logp, out["value"]

@@ -1,0 +1,116 @@
+"""Env runners: collect experience with the current policy.
+
+Analog of the reference's EnvRunner/SingleAgentEnvRunner
+(rllib/env/env_runner.py, env/single_agent_env_runner.py:29): actors that
+step gymnasium envs with the current weights and return sample batches
+(obs/actions/logp/values/rewards/dones arranged for GAE).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu as rt
+
+
+@rt.remote
+class EnvRunner:
+    def __init__(self, env_creator, module_factory, seed: int = 0,
+                 rollout_length: int = 200):
+        import jax
+
+        self.env = env_creator()
+        self.module = module_factory()
+        self.rollout_length = rollout_length
+        self.rng = jax.random.PRNGKey(seed)
+        self.params = None
+        self._obs = None
+        self._episode_return = 0.0
+        self._episode_returns: list = []
+        self._sample = None  # jitted sampler
+
+    def set_weights(self, weights):
+        self.params = weights
+        return True
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """One rollout of fixed length (truncated episodes carry value
+        bootstrap info via `last_value`)."""
+        import jax
+
+        assert self.params is not None, "set_weights first"
+        if self._sample is None:
+            self._sample = jax.jit(self.module.sample_action)
+
+        if self._obs is None:
+            self._obs, _ = self.env.reset()
+            self._episode_return = 0.0
+
+        T = self.rollout_length
+        obs_buf, act_buf, logp_buf, val_buf = [], [], [], []
+        rew_buf, done_buf = [], []
+        for _ in range(T):
+            self.rng, key = jax.random.split(self.rng)
+            obs = np.asarray(self._obs, dtype=np.float32)
+            action, logp, value = self._sample(self.params, obs[None], key)
+            action = int(np.asarray(action)[0])
+            obs_buf.append(obs)
+            act_buf.append(action)
+            logp_buf.append(float(np.asarray(logp)[0]))
+            val_buf.append(float(np.asarray(value)[0]))
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            self._episode_return += float(reward)
+            rew_buf.append(float(reward))
+            done_buf.append(bool(terminated))
+            if terminated or truncated:
+                self._episode_returns.append(self._episode_return)
+                self._obs, _ = self.env.reset()
+                self._episode_return = 0.0
+            else:
+                self._obs = nxt
+        # Bootstrap value of the final observation.
+        obs = np.asarray(self._obs, dtype=np.float32)
+        self.rng, key = jax.random.split(self.rng)
+        _, _, last_value = self._sample(self.params, obs[None], key)
+        return {
+            "obs": np.stack(obs_buf),
+            "actions": np.asarray(act_buf, dtype=np.int32),
+            "logp": np.asarray(logp_buf, dtype=np.float32),
+            "values": np.asarray(val_buf, dtype=np.float32),
+            "rewards": np.asarray(rew_buf, dtype=np.float32),
+            "dones": np.asarray(done_buf, dtype=np.float32),
+            "last_value": float(np.asarray(last_value)[0]),
+        }
+
+    def episode_stats(self) -> Dict[str, Any]:
+        stats = {
+            "episodes": len(self._episode_returns),
+            "mean_return": (
+                float(np.mean(self._episode_returns[-20:]))
+                if self._episode_returns
+                else 0.0
+            ),
+        }
+        return stats
+
+
+def compute_gae(batch: Dict[str, np.ndarray], gamma: float = 0.99,
+                lam: float = 0.95) -> Dict[str, np.ndarray]:
+    """Generalized advantage estimation over one rollout."""
+    rewards, values, dones = batch["rewards"], batch["values"], batch["dones"]
+    T = len(rewards)
+    adv = np.zeros(T, dtype=np.float32)
+    last_gae = 0.0
+    next_value = batch["last_value"]
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    out = dict(batch)
+    out["advantages"] = adv
+    out["returns"] = adv + values
+    return out
