@@ -27,6 +27,21 @@ import msgpack
 
 _LEN = struct.Struct("<I")
 
+# asyncio holds only weak references to tasks: a fire-and-forget
+# ensure_future() whose result is dropped can be garbage-collected
+# mid-flight, silently killing the coroutine (observed as RPC handlers
+# vanishing while awaiting a forwarded call). Every background task must
+# be anchored here until done.
+_background_tasks: set = set()
+
+
+def spawn(coro) -> asyncio.Task:
+    """ensure_future with a strong reference for the task's lifetime."""
+    task = asyncio.ensure_future(coro)
+    _background_tasks.add(task)
+    task.add_done_callback(_background_tasks.discard)
+    return task
+
 
 def pack_frame(obj: Any) -> bytes:
     body = msgpack.packb(obj, use_bin_type=True)
@@ -63,7 +78,7 @@ class Connection:
         self._ids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
-        self._reader_task = asyncio.ensure_future(self._read_loop())
+        self._reader_task = spawn(self._read_loop())
         self._write_lock = asyncio.Lock()
 
     async def _read_loop(self):
@@ -186,7 +201,7 @@ class RpcServer:
                 frame = await read_frame(reader)
                 if frame.get("k") != "req":
                     continue
-                asyncio.ensure_future(self._dispatch(conn, frame))
+                spawn(self._dispatch(conn, frame))
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:

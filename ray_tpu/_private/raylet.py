@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.object_store import ObjectStore
-from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, connect
+from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, connect, spawn
 
 
 class WorkerHandle:
@@ -90,6 +90,7 @@ class Raylet:
         self.inflight: Dict[bytes, dict] = {}  # task_id -> {spec, fut, worker}
         self.bundles: Dict[tuple, Dict[str, float]] = {}  # (pg_id, idx) -> resources
         self.peer_conns: Dict[bytes, Connection] = {}
+        self._peer_locks: Dict[bytes, asyncio.Lock] = {}
         self.node_cache: Dict[bytes, dict] = {}
         self._dispatch_event = asyncio.Event()
         self._stopping = False
@@ -157,7 +158,7 @@ class Raylet:
 
     # -- GCS pushes ------------------------------------------------------
     def _on_gcs_push(self, channel: str, payload: Any):
-        asyncio.ensure_future(self._handle_gcs_push(channel, payload))
+        spawn(self._handle_gcs_push(channel, payload))
 
     async def _handle_gcs_push(self, channel: str, payload: Any):
         if channel == "create_actor":
@@ -195,6 +196,7 @@ class Raylet:
             if conn:
                 await conn.close()
             self.node_cache.pop(nid, None)
+            self._peer_locks.pop(nid, None)
 
     # -- worker pool -----------------------------------------------------
     def _spawn_worker(self) -> WorkerHandle:
@@ -517,23 +519,30 @@ class Raylet:
         return await conn.call("submit_task", spec, timeout=None)
 
     async def _peer(self, node_id: bytes) -> Optional[Connection]:
-        conn = self.peer_conns.get(node_id)
-        if conn is not None and not conn._closed:
-            return conn
-        info = self.node_cache.get(node_id)
-        if info is None:
-            resp = await self.gcs.call("get_nodes", {})
-            for n in resp["nodes"]:
-                self.node_cache[n["node_id"]] = n
+        # Single-flight per node: concurrent forwards must share one
+        # connection (racing connects leaked Connections whose GC closed
+        # sockets under pending calls).
+        lock = self._peer_locks.setdefault(node_id, asyncio.Lock())
+        async with lock:
+            conn = self.peer_conns.get(node_id)
+            if conn is not None and not conn._closed:
+                return conn
             info = self.node_cache.get(node_id)
-        if info is None or info["state"] != "ALIVE":
-            return None
-        try:
-            conn = await connect(info["address"], info["port"])
-        except OSError:
-            return None
-        self.peer_conns[node_id] = conn
-        return conn
+            if info is None:
+                resp = await self.gcs.call("get_nodes", {})
+                for n in resp["nodes"]:
+                    self.node_cache[n["node_id"]] = n
+                info = self.node_cache.get(node_id)
+            if info is None or info["state"] != "ALIVE":
+                return None
+            try:
+                # Short dial timeout: waiters queue behind this lock, so a
+                # blackholed peer must fail fast, not serialize 10s stalls.
+                conn = await connect(info["address"], info["port"], timeout=2.0)
+            except OSError:
+                return None
+            self.peer_conns[node_id] = conn
+            return conn
 
     async def _dispatch_loop(self):
         """LocalTaskManager::DispatchScheduledTasksToWorkers analog."""
@@ -559,7 +568,7 @@ class Raylet:
                 deps = spec.get("deps") or []
                 missing = [d for d in deps if not self.store.contains_raw(d)]
                 if missing:
-                    asyncio.ensure_future(self._fetch_then_requeue(spec, fut, missing))
+                    spawn(self._fetch_then_requeue(spec, fut, missing))
                     continue
                 worker = self._idle_worker()
                 if worker is None:

@@ -24,7 +24,7 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import JobID, ObjectID, TaskID, object_id_for_task
-from ray_tpu._private.protocol import RpcServer, connect
+from ray_tpu._private.protocol import RpcServer, connect, spawn
 from ray_tpu._private.worker import CoreClient, make_task_error
 
 
@@ -94,9 +94,9 @@ class WorkerProcess:
     # -- raylet pushes ----------------------------------------------------
     def _on_raylet_push(self, channel: str, payload):
         if channel == "run_task":
-            asyncio.ensure_future(self._run_task(payload))
+            spawn(self._run_task(payload))
         elif channel == "create_actor":
-            asyncio.ensure_future(self._create_actor(payload))
+            spawn(self._create_actor(payload))
 
     async def _run_task(self, spec):
         result = await self.loop.run_in_executor(
