@@ -1,0 +1,5 @@
+"""`python -m ray_tpu` == the `rt` CLI."""
+
+from ray_tpu.scripts.scripts import main
+
+main()

@@ -83,11 +83,19 @@ class GcsServer:
         # jobs
         r("register_job", self.h_register_job)
         r("list_jobs", self.h_list_jobs)
+        # job submission (dashboard/modules/job analog)
+        r("submit_job", self.h_submit_job)
+        r("get_job", self.h_get_job)
+        r("job_update", self.h_job_update)
+        r("job_log_append", self.h_job_log_append)
+        r("job_logs", self.h_job_logs)
+        r("stop_job", self.h_stop_job)
         # objects
         r("object_location_add", self.h_object_location_add)
         r("object_location_get", self.h_object_location_get)
         r("object_location_wait", self.h_object_location_wait)
         r("object_location_remove", self.h_object_location_remove)
+        r("list_objects", self.h_list_objects)
         # placement groups
         r("create_placement_group", self.h_create_pg)
         r("remove_placement_group", self.h_remove_pg)
@@ -178,6 +186,16 @@ class GcsServer:
         # Drop object locations on that node.
         for oid, entry in self.object_dir.items():
             entry["nodes"].discard(node_id)
+        # Fail submitted jobs supervised by that node — their drivers died
+        # with it, and no further state updates will ever arrive.
+        for j in self.jobs.values():
+            if (
+                j.get("node_id") == node_id
+                and j.get("state") in ("PENDING", "RUNNING")
+            ):
+                j["state"] = "FAILED"
+                j["end_time"] = time.time()
+                j["message"] = f"supervising node died: {reason}"
         await self.publish("node_dead", {"node_id": node_id, "reason": reason})
 
     # -- kv -------------------------------------------------------------
@@ -252,7 +270,107 @@ class GcsServer:
         return {"ok": True}
 
     async def h_list_jobs(self, d, conn):
-        return {"jobs": list(self.jobs.values())}
+        return {"jobs": [self._job_view(j) for j in self.jobs.values()]}
+
+    # -- job submission ---------------------------------------------------
+    # The head raylet plays JobSupervisor (dashboard/modules/job/
+    # job_manager.py:525 + the per-job JobSupervisor actor :140): the GCS
+    # pushes run_job to it, it spawns the detached driver subprocess and
+    # streams state/logs back.
+    @staticmethod
+    def _job_view(j: dict) -> dict:
+        return {k: v for k, v in j.items() if k != "logs"}
+
+    def _find_supervisor_node(self) -> Optional[bytes]:
+        for nid, info in self.nodes.items():
+            if info["state"] == "ALIVE" and info.get("is_head"):
+                return nid
+        for nid, info in self.nodes.items():  # headless test clusters
+            if info["state"] == "ALIVE":
+                return nid
+        return None
+
+    async def h_submit_job(self, d, conn):
+        submission_id = d.get("submission_id") or f"rtjob_{len(self.jobs):05d}_{int(time.time())}"
+        job_key = submission_id.encode()
+        if job_key in self.jobs:
+            return {"ok": False, "error": f"job {submission_id} already exists"}
+        node_id = self._find_supervisor_node()
+        if node_id is None:
+            return {"ok": False, "error": "no alive node to run the job"}
+        self.jobs[job_key] = {
+            "job_id": job_key,
+            "submission_id": submission_id,
+            "entrypoint": d["entrypoint"],
+            "state": "PENDING",
+            "start_time": time.time(),
+            "end_time": None,
+            "node_id": node_id,
+            "runtime_env": d.get("runtime_env") or {},
+            "metadata": d.get("metadata") or {},
+            "logs": [],
+        }
+        try:
+            await self.node_conns[node_id].push(
+                "run_job",
+                {
+                    "submission_id": submission_id,
+                    "entrypoint": d["entrypoint"],
+                    "runtime_env": d.get("runtime_env") or {},
+                },
+            )
+        except Exception as e:  # noqa: BLE001 — roll back the record
+            self.jobs.pop(job_key, None)
+            return {"ok": False, "error": f"failed to dispatch job: {e}"}
+        return {"ok": True, "submission_id": submission_id}
+
+    def _find_job(self, submission_id: str) -> Optional[dict]:
+        return self.jobs.get(submission_id.encode())
+
+    async def h_get_job(self, d, conn):
+        j = self._find_job(d["submission_id"])
+        return {"job": self._job_view(j) if j else None}
+
+    async def h_job_update(self, d, conn):
+        j = self._find_job(d["submission_id"])
+        if j is None:
+            return {"ok": False}
+        j["state"] = d["state"]
+        if d.get("message"):
+            j["message"] = d["message"]
+        if d["state"] in ("SUCCEEDED", "FAILED", "STOPPED"):
+            j["end_time"] = time.time()
+        return {"ok": True}
+
+    async def h_job_log_append(self, d, conn):
+        j = self._find_job(d["submission_id"])
+        if j is None:
+            return {"ok": False}
+        logs = j["logs"]
+        logs.append(d["data"])
+        # Bound memory: keep the newest ~4 MB of log text.
+        total = sum(len(c) for c in logs)
+        while len(logs) > 1 and total > 4_000_000:
+            total -= len(logs.pop(0))
+        return {"ok": True}
+
+    async def h_job_logs(self, d, conn):
+        j = self._find_job(d["submission_id"])
+        if j is None:
+            return {"logs": None}
+        return {"logs": "".join(j["logs"])}
+
+    async def h_stop_job(self, d, conn):
+        j = self._find_job(d["submission_id"])
+        if j is None:
+            return {"ok": False, "error": "no such job"}
+        if j["state"] in ("SUCCEEDED", "FAILED", "STOPPED"):
+            return {"ok": True}
+        node_conn = self.node_conns.get(j.get("node_id"))
+        if node_conn is None:
+            return {"ok": False, "error": "supervising node is unreachable"}
+        await node_conn.push("stop_job", {"submission_id": j["submission_id"]})
+        return {"ok": True}
 
     # -- actor scheduling ------------------------------------------------
     def _pick_node_for_resources(self, resources: Dict[str, float],
@@ -492,6 +610,18 @@ class GcsServer:
             return {"nodes": [], "size": 0, "timeout": True}
         entry = self.object_dir.get(oid, {"nodes": set(), "size": 0})
         return {"nodes": list(entry["nodes"]), "size": entry["size"]}
+
+    async def h_list_objects(self, d, conn):
+        limit = d.get("limit", 10_000)
+        out = []
+        for oid, entry in self.object_dir.items():
+            if len(out) >= limit:
+                break
+            out.append(
+                {"object_id": oid, "nodes": list(entry["nodes"]),
+                 "size": entry["size"]}
+            )
+        return {"objects": out}
 
     async def h_object_location_remove(self, d, conn):
         entry = self.object_dir.get(d["object_id"])

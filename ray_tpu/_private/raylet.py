@@ -95,6 +95,11 @@ class Raylet:
         self._dispatch_event = asyncio.Event()
         self._stopping = False
         self._bg: List[asyncio.Task] = []
+        # Task state-transition events, batched to the GCS task-event sink
+        # (TaskEventBuffer -> GcsTaskManager, task_event_buffer.h:206).
+        self._task_events: List[dict] = []
+        self._jobs: Dict[str, subprocess.Popen] = {}  # submission_id -> driver
+        self._job_stops: set = set()  # submission_ids with a stop requested
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
 
         r = self.rpc.register
@@ -127,7 +132,7 @@ class Raylet:
             },
         )
         for ch in ("create_actor", "kill_actor_worker", "reserve_bundle",
-                   "cancel_bundle", "node_dead"):
+                   "cancel_bundle", "node_dead", "run_job", "stop_job"):
             await self.gcs.call("subscribe", {"channel": ch})
         self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
@@ -190,6 +195,22 @@ class Raylet:
                     self.resources_available[k] = (
                         self.resources_available.get(k, 0) + v
                     )
+        elif channel == "run_job":
+            await self._run_job(payload)
+        elif channel == "stop_job":
+            proc = self._jobs.get(payload["submission_id"])
+            self._job_stops.add(payload["submission_id"])
+            if proc is not None and proc.poll() is None:
+                # The entrypoint runs under a shell: signal the whole
+                # process group so the driver (and its children) die too,
+                # not just the shell — otherwise the inherited stdout pipe
+                # keeps the log stream (and job state) alive.
+                import signal
+
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    proc.terminate()
         elif channel == "node_dead":
             nid = payload["node_id"]
             conn = self.peer_conns.pop(nid, None)
@@ -287,7 +308,11 @@ class Raylet:
                                 {"status": "worker_crashed",
                                  "error": f"worker exited with code {w.proc.returncode}"}
                             )
-                        self._release_task_resources(entry["spec"]) if entry else None
+                        if entry:
+                            self._release_task_resources(entry["spec"])
+                            self._record_task_event(
+                                entry["spec"], "FAILED", worker_id=w.worker_id
+                            )
                     await self._report_worker_dead(
                         w, intended=False,
                         reason=f"worker process exited ({w.proc.returncode})",
@@ -330,11 +355,95 @@ class Raylet:
             return
         await w.conn.push("create_actor", payload["create_spec"])
 
+    # -- job supervision -------------------------------------------------
+    async def _run_job(self, payload):
+        """Spawn a detached driver subprocess for a submitted job and
+        stream its output + exit state to the GCS (JobSupervisor analog,
+        dashboard/modules/job/job_manager.py:140)."""
+        submission_id = payload["submission_id"]
+        env = dict(os.environ)
+        env["RT_GCS_ADDR"] = f"{self.gcs_host}:{self.gcs_port}"
+        env["RT_JOB_SUBMISSION_ID"] = submission_id
+        renv = payload.get("runtime_env") or {}
+        for k, v in (renv.get("env_vars") or {}).items():
+            env[k] = str(v)
+        try:
+            proc = subprocess.Popen(
+                payload["entrypoint"],
+                shell=True,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        except OSError as e:
+            await self.gcs.call(
+                "job_update",
+                {"submission_id": submission_id, "state": "FAILED",
+                 "message": f"failed to start: {e}"},
+            )
+            return
+        self._jobs[submission_id] = proc
+        await self.gcs.call(
+            "job_update", {"submission_id": submission_id, "state": "RUNNING"}
+        )
+        spawn(self._stream_job(submission_id, proc))
+
+    async def _stream_job(self, submission_id: str, proc: subprocess.Popen):
+        loop = asyncio.get_event_loop()
+        fd = proc.stdout.fileno()
+        while True:
+            # Raw fd read: returns as soon as ANY bytes arrive, so sparse
+            # driver output streams live instead of waiting for a full
+            # 64 KB buffered-read quantum.
+            chunk = await loop.run_in_executor(None, os.read, fd, 65536)
+            if not chunk:
+                break
+            try:
+                await self.gcs.call(
+                    "job_log_append",
+                    {"submission_id": submission_id,
+                     "data": chunk.decode(errors="replace")},
+                )
+            except Exception:
+                pass
+        rc = await loop.run_in_executor(None, proc.wait)
+        self._jobs.pop(submission_id, None)
+        stop_requested = submission_id in self._job_stops
+        self._job_stops.discard(submission_id)
+        # A signal exit counts as STOPPED only when a stop was actually
+        # requested; an OOM-kill or external SIGKILL is a failure.
+        state = "SUCCEEDED" if rc == 0 else (
+            "STOPPED" if rc < 0 and stop_requested else "FAILED"
+        )
+        try:
+            await self.gcs.call(
+                "job_update",
+                {"submission_id": submission_id, "state": state,
+                 "message": f"driver exited with code {rc}"},
+            )
+        except Exception:
+            pass
+
     async def h_prestart_workers(self, d, conn):
         n = d.get("num", 1)
         for _ in range(n):
             self._spawn_worker()
         return {"ok": True}
+
+    # -- task events -----------------------------------------------------
+    def _record_task_event(self, spec: dict, state: str, **extra):
+        ev = {
+            "task_id": spec.get("task_id", b""),
+            "name": spec.get("name") or "",
+            "job_id": spec.get("job_id", b""),
+            "node_id": self.node_id.binary(),
+            "type": "NORMAL_TASK",
+            "state": state,
+            "ts": time.time(),
+        }
+        ev.update(extra)
+        self._task_events.append(ev)
 
     # -- scheduling ------------------------------------------------------
     def _feasible_locally(self, resources: Dict[str, float]) -> bool:
@@ -506,6 +615,7 @@ class Raylet:
 
         self.task_queue.append((spec, fut))
         self._queued_demand_add(resources, +1)
+        self._record_task_event(spec, "PENDING_SCHEDULING")
         self._dispatch_event.set()
         return await fut
 
@@ -599,6 +709,9 @@ class Raylet:
                     "fut": fut,
                     "worker": worker,
                 }
+                self._record_task_event(
+                    spec, "RUNNING", worker_id=worker.worker_id
+                )
                 await worker.conn.push("run_task", spec)
             for item in requeue:
                 self.task_queue.append(item)
@@ -634,6 +747,11 @@ class Raylet:
         w.current_task = None
         w.last_idle_time = time.monotonic()
         self._release_task_resources(entry["spec"])
+        self._record_task_event(
+            entry["spec"],
+            "FINISHED" if d["result"].get("status") == "ok" else "FAILED",
+            worker_id=w.worker_id,
+        )
         if not entry["fut"].done():
             entry["fut"].set_result(d["result"])
         self._dispatch_event.set()
@@ -749,6 +867,16 @@ class Raylet:
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,
             "store_stats": self.store.stats(),
+            "workers": [
+                {
+                    "worker_id": w.worker_id,
+                    "pid": w.proc.pid if w.proc is not None else None,
+                    "idle": w.idle,
+                    "actor_id": w.actor_id,
+                    "current_task": w.current_task,
+                }
+                for w in self.workers.values()
+            ],
         }
 
     # -- sync ------------------------------------------------------------
@@ -764,6 +892,15 @@ class Raylet:
                         "available": self.resources_available,
                     },
                 )
+                if self._task_events:
+                    events, self._task_events = self._task_events, []
+                    try:
+                        await self.gcs.call("add_task_events", {"events": events})
+                    except Exception:
+                        # Transient GCS hiccup: keep the batch for retry so
+                        # tasks don't stick in stale states in the state API.
+                        self._task_events = events + self._task_events
+                        raise
             except Exception:
                 if self._stopping:
                     return

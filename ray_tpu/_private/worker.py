@@ -885,6 +885,10 @@ def get_client() -> CoreClient:
     return _global_client
 
 
+def get_client_or_none() -> Optional[CoreClient]:
+    return _global_client
+
+
 def set_client(client: Optional[CoreClient], mode: Optional[str], node=None):
     global _global_client, _mode, _global_node
     _global_client = client

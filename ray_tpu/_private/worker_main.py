@@ -66,6 +66,9 @@ class WorkerProcess:
             max_workers=max(4, get_config().max_workers_per_node)
         )
         self.loop: Optional[asyncio.AbstractEventLoop] = None
+        # Actor-call state events (normal-task events are recorded by the
+        # raylet; actor calls bypass it, so the receiving worker reports).
+        self._task_events: list = []
 
     async def run(self):
         self.loop = asyncio.get_event_loop()
@@ -89,7 +92,34 @@ class WorkerProcess:
             "register_worker", {"worker_id": self.worker_id, "port": port}
         )
         assert resp["node_id"] == self.node_id
+        spawn(self._flush_events_loop())
         await asyncio.Event().wait()
+
+    def _record_task_event(self, task_id: bytes, name: str, state: str):
+        import time
+
+        self._task_events.append(
+            {
+                "task_id": task_id,
+                "name": name,
+                "job_id": b"",
+                "node_id": self.node_id,
+                "worker_id": self.worker_id,
+                "type": "ACTOR_TASK",
+                "state": state,
+                "ts": time.time(),
+            }
+        )
+
+    async def _flush_events_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            if self._task_events:
+                events, self._task_events = self._task_events, []
+                try:
+                    await self.client.gcs.call("add_task_events", {"events": events})
+                except Exception:
+                    pass
 
     # -- raylet pushes ----------------------------------------------------
     def _on_raylet_push(self, channel: str, payload):
@@ -223,6 +253,8 @@ class WorkerProcess:
         return await fut
 
     async def _invoke_actor_method(self, actor: ActorState, d) -> dict:
+        self._record_task_event(d["task_id"], d["method"], "RUNNING")
+
         def do_call():
             method = getattr(actor.instance, d["method"])
             args, kwargs = self.client.deserialize_args(d["args"])
@@ -235,10 +267,13 @@ class WorkerProcess:
             spec = {"task_id": d["task_id"], "num_returns": d.get("num_returns", 1)}
             # _package_returns may block on GCS (location registration), so
             # it must not run on the event loop.
-            return await self.loop.run_in_executor(
+            result = await self.loop.run_in_executor(
                 self.executor, self._package_returns, spec, value
             )
+            self._record_task_event(d["task_id"], d["method"], "FINISHED")
+            return result
         except BaseException as e:  # noqa: BLE001
+            self._record_task_event(d["task_id"], d["method"], "FAILED")
             return make_task_error(e)
 
     async def h_ping(self, d, conn):

@@ -1,0 +1,318 @@
+"""The `rt` command-line interface.
+
+Analog of the reference CLI (python/ray/scripts/scripts.py: `ray start`
+:566, `ray stop` :1042, `ray status`, `ray list/summary` via state_cli.py,
+`ray timeline`, `ray memory`). Cluster services start as real subprocesses
+(the standalone GCS and raylet mains), tracked through a session file so
+`rt stop` can tear them down.
+
+Usage:
+    rt start --head [--port 6379] [--num-cpus N] [--resources '{...}']
+    rt start --address HOST:PORT [--num-cpus N]
+    rt stop
+    rt status [--address HOST:PORT]
+    rt list {nodes,tasks,actors,objects,jobs,placement-groups,workers}
+    rt summary tasks
+    rt timeline [--output FILE]
+    rt memory
+    rt job submit|status|logs|list|stop ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+SESSION_FILE = os.path.join(
+    os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "session.json"
+)
+
+
+def _read_session() -> Optional[dict]:
+    try:
+        with open(SESSION_FILE) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _write_session(data: dict):
+    os.makedirs(os.path.dirname(SESSION_FILE), exist_ok=True)
+    with open(SESSION_FILE, "w") as f:
+        json.dump(data, f)
+
+
+def _log_dir() -> str:
+    d = os.path.join(os.path.dirname(SESSION_FILE), "logs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _spawn_service(name: str, cmd: list) -> subprocess.Popen:
+    """Start a daemon with stdout/stderr to a session log file, NOT
+    inherited — inherited pipes keep `rt start | ...` pipelines open
+    forever and break user prints once the CLI exits."""
+    log = open(os.path.join(_log_dir(), f"{name}-{os.getpid()}.log"), "ab")
+    return subprocess.Popen(
+        cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True
+    )
+
+
+def _wait_for_key(proc: subprocess.Popen, log_path: str, prefix: str,
+                  timeout: float = 60.0) -> str:
+    """Poll the service's log until its `KEY=value` bootstrap line appears."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            tail = ""
+            try:
+                with open(log_path) as f:
+                    tail = f.read()[-2000:]
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"process exited while waiting for {prefix}\n{tail}"
+            )
+        try:
+            with open(log_path) as f:
+                for line in f:
+                    if line.startswith(prefix):
+                        return line.strip().split("=", 1)[1]
+        except OSError:
+            pass
+        time.sleep(0.1)
+    raise TimeoutError(f"timed out waiting for {prefix}")
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None)
+    if addr:
+        return addr
+    addr = os.environ.get("RT_GCS_ADDR")
+    if addr:
+        return addr
+    sess = _read_session()
+    if sess:
+        return sess["gcs_address"]
+    sys.exit("error: no running session found; pass --address host:port")
+
+
+def cmd_start(args):
+    logdir = _log_dir()
+    if args.head:
+        gcs = _spawn_service(
+            "gcs",
+            [sys.executable, "-m", "ray_tpu._private.gcs", "--port", str(args.port)],
+        )
+        gcs_log = os.path.join(logdir, f"gcs-{os.getpid()}.log")
+        gcs_port = int(_wait_for_key(gcs, gcs_log, "GCS_PORT="))
+        raylet_cmd = [
+            sys.executable, "-m", "ray_tpu._private.raylet",
+            "--gcs-port", str(gcs_port), "--head",
+        ]
+    else:
+        address = _resolve_address(args)
+        host, port = address.rsplit(":", 1)
+        gcs = None
+        gcs_port = int(port)
+        raylet_cmd = [
+            sys.executable, "-m", "ray_tpu._private.raylet",
+            "--gcs-host", host, "--gcs-port", str(gcs_port),
+        ]
+    if args.num_cpus is not None:
+        raylet_cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.resources:
+        raylet_cmd += ["--resources", args.resources]
+    if args.object_store_memory:
+        raylet_cmd += ["--object-store-memory", str(args.object_store_memory)]
+    raylet = _spawn_service("raylet", raylet_cmd)
+    raylet_log = os.path.join(logdir, f"raylet-{os.getpid()}.log")
+    raylet_port = int(_wait_for_key(raylet, raylet_log, "RAYLET_PORT="))
+    node_id = _wait_for_key(raylet, raylet_log, "RAYLET_NODE_ID=")
+
+    gcs_address = f"127.0.0.1:{gcs_port}" if args.head else _resolve_address(args)
+    sess = _read_session() if not args.head else None
+    pids = (sess or {}).get("pids", [])
+    if gcs is not None:
+        pids.append(gcs.pid)
+    pids.append(raylet.pid)
+    _write_session(
+        {"gcs_address": gcs_address, "pids": pids, "raylet_port": raylet_port}
+    )
+    print(f"started node {node_id[:12]} (raylet port {raylet_port})")
+    print(f"GCS address: {gcs_address}")
+    print(f'connect with:  ray_tpu.init(address="{gcs_address}")')
+    if args.block:
+        try:
+            raylet.wait()
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_stop(args):
+    sess = _read_session()
+    if not sess:
+        print("no running session")
+        return
+    for pid in reversed(sess.get("pids", [])):
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped pid {pid}")
+        except ProcessLookupError:
+            pass
+    deadline = time.monotonic() + 5
+    for pid in sess.get("pids", []):
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.1)
+            except ProcessLookupError:
+                break
+        else:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+    try:
+        os.unlink(SESSION_FILE)
+    except OSError:
+        pass
+
+
+def cmd_status(args):
+    from ray_tpu.util.state import list_nodes
+
+    nodes = list_nodes(address=_resolve_address(args))
+    alive = [n for n in nodes if n["state"] == "ALIVE"]
+    print(f"{len(alive)}/{len(nodes)} nodes alive")
+    totals: dict = {}
+    avail: dict = {}
+    for n in alive:
+        for k, v in n["resources_total"].items():
+            totals[k] = totals.get(k, 0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0) + v
+    print("resources:")
+    for k in sorted(totals):
+        print(f"  {avail.get(k, 0):g}/{totals[k]:g} {k}")
+    for n in nodes:
+        head = " (head)" if n.get("is_head") else ""
+        print(f"  node {n['node_id'][:12]} {n['state']}{head} @ {n['address']}")
+
+
+def cmd_list(args):
+    from ray_tpu.util import state as state_api
+
+    fns = {
+        "nodes": state_api.list_nodes,
+        "tasks": state_api.list_tasks,
+        "actors": state_api.list_actors,
+        "objects": state_api.list_objects,
+        "jobs": state_api.list_jobs,
+        "placement-groups": state_api.list_placement_groups,
+        "workers": state_api.list_workers,
+    }
+    rows = fns[args.entity](address=_resolve_address(args))
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args):
+    from ray_tpu.util.state import summarize_tasks
+
+    print(json.dumps(summarize_tasks(address=_resolve_address(args)), indent=2))
+
+
+def cmd_timeline(args):
+    from ray_tpu.util.state import get_timeline
+
+    trace = get_timeline(address=_resolve_address(args))
+    out = args.output or f"timeline-{int(time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {out} (open in chrome://tracing)")
+
+
+def cmd_memory(args):
+    from ray_tpu.util.state import list_objects
+
+    objs = list_objects(address=_resolve_address(args))
+    total = sum(o["size"] for o in objs)
+    print(f"{len(objs)} objects, {total / 1e6:.1f} MB total")
+    for o in sorted(objs, key=lambda o: -o["size"])[:50]:
+        locs = ",".join(loc[:8] for loc in o["locations"])
+        print(f"  {o['object_id'][:16]}  {o['size']:>12} B  on [{locs}]")
+
+
+def cmd_job(args):
+    from ray_tpu.job import job_cli
+
+    job_cli(args, _resolve_address(args))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="rt", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start cluster services on this host")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="existing GCS address (worker nodes)")
+    sp.add_argument("--port", type=int, default=0, help="GCS port (head)")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--resources", help="JSON resource map")
+    sp.add_argument("--object-store-memory", type=int)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop services started by `rt start`")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resource overview")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument(
+        "entity",
+        choices=["nodes", "tasks", "actors", "objects", "jobs",
+                 "placement-groups", "workers"],
+    )
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize tasks by name/state")
+    sp.add_argument("entity", choices=["tasks"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome-trace task timeline")
+    sp.add_argument("--output", "-o")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory", help="object store usage by object")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("job", help="submit and manage jobs")
+    sp.add_argument("job_command",
+                    choices=["submit", "status", "logs", "list", "stop"])
+    sp.add_argument("args", nargs=argparse.REMAINDER)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_job)
+
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

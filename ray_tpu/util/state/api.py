@@ -1,0 +1,270 @@
+"""State API client: list/summarize cluster entities from the GCS.
+
+Reference analog: python/ray/util/state/api.py (StateApiClient over the
+dashboard REST API) + state_manager.py (aggregation from GcsTaskManager and
+raylets). Here the client talks straight to the GCS over the control-plane
+protocol; per-node worker listings fan out to each raylet's get_info.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.node import EventLoopThread
+from ray_tpu._private.protocol import connect
+
+
+def _hex(b) -> str:
+    return b.hex() if isinstance(b, (bytes, bytearray)) else str(b)
+
+
+class StateApiClient:
+    """Dial the GCS directly (or reuse the connected driver's session)."""
+
+    def __init__(self, address: Optional[str] = None):
+        self._own_io: Optional[EventLoopThread] = None
+        self._conn = None
+        client = worker_mod.get_client_or_none()
+        if address is None and client is not None and getattr(client, "gcs", None):
+            self._loop = client.loop
+            self._conn = client.gcs
+        else:
+            if address is None:
+                address = os.environ.get("RT_GCS_ADDR")
+            if address is None:
+                raise RuntimeError(
+                    "not connected: call rt.init() or pass address='host:port'"
+                )
+            host, port = address.rsplit(":", 1)
+            self._own_io = EventLoopThread("rt-state")
+            self._loop = self._own_io.loop
+            self._conn = self._run_new(connect(host, int(port)))
+
+    def _run_new(self, coro, timeout=30.0):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def call(self, method: str, payload: Dict[str, Any] | None = None):
+        return self._run_new(self._conn.call(method, payload or {}))
+
+    def close(self):
+        if self._own_io is not None:
+            try:
+                self._run_new(self._conn.close(), timeout=5)
+            except Exception:
+                pass
+            self._own_io.stop()
+            self._own_io = None
+
+    # -- listings --------------------------------------------------------
+    def nodes(self) -> List[dict]:
+        out = []
+        for n in self.call("get_nodes")["nodes"]:
+            out.append(
+                {
+                    "node_id": _hex(n["node_id"]),
+                    "state": n["state"],
+                    "address": f"{n['address']}:{n['port']}",
+                    "is_head": n.get("is_head", False),
+                    "resources_total": n.get("resources_total", {}),
+                    "resources_available": n.get("resources_available", {}),
+                    "labels": n.get("labels", {}),
+                }
+            )
+        return out
+
+    def tasks(self, limit: int = 1000) -> List[dict]:
+        events = self.call("list_task_events", {"limit": 100_000})["events"]
+        # Collapse the event log into latest-state-per-task
+        # (GcsTaskManager's task view).
+        tasks: Dict[bytes, dict] = {}
+        for ev in events:
+            t = tasks.setdefault(
+                ev["task_id"],
+                {
+                    "task_id": _hex(ev["task_id"]),
+                    "name": ev.get("name", ""),
+                    "job_id": _hex(ev.get("job_id", b"")),
+                    "type": ev.get("type", "NORMAL_TASK"),
+                    "events": [],
+                },
+            )
+            t["state"] = ev["state"]
+            t["node_id"] = _hex(ev.get("node_id", b""))
+            if ev.get("worker_id"):
+                t["worker_id"] = _hex(ev["worker_id"])
+            t["events"].append({"state": ev["state"], "ts": ev["ts"]})
+        out = list(tasks.values())[-limit:]
+        for t in out:
+            ts = {e["state"]: e["ts"] for e in t.pop("events")}
+            if "RUNNING" in ts:
+                end = ts.get("FINISHED") or ts.get("FAILED")
+                if end is not None:
+                    t["duration_s"] = round(end - ts["RUNNING"], 6)
+        return out
+
+    def actors(self) -> List[dict]:
+        out = []
+        for a in self.call("list_actors")["actors"]:
+            out.append(
+                {
+                    "actor_id": _hex(a["actor_id"]),
+                    "class_name": a.get("class_name", ""),
+                    "state": a.get("state", ""),
+                    "name": a.get("name") or "",
+                    "node_id": _hex(a.get("node_id") or b""),
+                    "pid": a.get("pid"),
+                    "restarts": a.get("restarts_used", 0),
+                }
+            )
+        return out
+
+    def objects(self, limit: int = 10_000) -> List[dict]:
+        out = []
+        for o in self.call("list_objects", {"limit": limit})["objects"]:
+            out.append(
+                {
+                    "object_id": _hex(o["object_id"]),
+                    "size": o["size"],
+                    "locations": [_hex(n) for n in o["nodes"]],
+                }
+            )
+        return out
+
+    def jobs(self) -> List[dict]:
+        return [
+            {**j, "job_id": _hex(j.get("job_id", b""))}
+            for j in self.call("list_jobs")["jobs"]
+        ]
+
+    def placement_groups(self) -> List[dict]:
+        out = []
+        for pg in self.call("list_placement_groups")["pgs"]:
+            out.append(
+                {
+                    "pg_id": _hex(pg["pg_id"]),
+                    "name": pg.get("name", ""),
+                    "state": pg["state"],
+                    "strategy": pg["strategy"],
+                    "bundles": pg["bundles"],
+                    "bundle_nodes": [
+                        _hex(n) if n else None for n in pg.get("bundle_nodes", [])
+                    ],
+                }
+            )
+        return out
+
+    def workers(self) -> List[dict]:
+        """Fan out to every raylet for its worker pool state."""
+        out = []
+        for n in self.call("get_nodes")["nodes"]:
+            if n["state"] != "ALIVE":
+                continue
+            try:
+                conn = self._run_new(connect(n["address"], n["port"]))
+                info = self._run_new(conn.call("get_info", {}))
+                self._run_new(conn.close(), timeout=5)
+            except Exception:
+                continue
+            for w in info.get("workers", []):
+                out.append(
+                    {
+                        "worker_id": _hex(w["worker_id"]),
+                        "node_id": _hex(n["node_id"]),
+                        "pid": w.get("pid"),
+                        "idle": w.get("idle"),
+                        "actor_id": _hex(w["actor_id"]) if w.get("actor_id") else None,
+                    }
+                )
+        return out
+
+    def timeline(self) -> List[dict]:
+        """Chrome-trace events (ray timeline analog,
+        _private/profiling.py:124 chrome_tracing_dump)."""
+        events = self.call("list_task_events", {"limit": 100_000})["events"]
+        spans: Dict[bytes, dict] = {}
+        trace: List[dict] = []
+        for ev in events:
+            key = ev["task_id"]
+            if ev["state"] == "RUNNING":
+                spans[key] = ev
+            elif ev["state"] in ("FINISHED", "FAILED") and key in spans:
+                start = spans.pop(key)
+                trace.append(
+                    {
+                        "name": ev.get("name") or _hex(key)[:8],
+                        "cat": ev.get("type", "NORMAL_TASK").lower(),
+                        "ph": "X",
+                        "ts": start["ts"] * 1e6,
+                        "dur": max(0.0, (ev["ts"] - start["ts"]) * 1e6),
+                        "pid": "node:" + _hex(ev.get("node_id", b""))[:8],
+                        "tid": "worker:" + _hex(ev.get("worker_id", b""))[:8],
+                        "args": {"state": ev["state"]},
+                    }
+                )
+        return trace
+
+
+def _with_client(fn):
+    def wrapper(*args, address: Optional[str] = None, **kwargs):
+        client = StateApiClient(address)
+        try:
+            return fn(client, *args, **kwargs)
+        finally:
+            client.close()
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+@_with_client
+def list_nodes(c):
+    return c.nodes()
+
+
+@_with_client
+def list_tasks(c, limit: int = 1000):
+    return c.tasks(limit)
+
+
+@_with_client
+def list_actors(c):
+    return c.actors()
+
+
+@_with_client
+def list_objects(c, limit: int = 10_000):
+    return c.objects(limit)
+
+
+@_with_client
+def list_jobs(c):
+    return c.jobs()
+
+
+@_with_client
+def list_placement_groups(c):
+    return c.placement_groups()
+
+
+@_with_client
+def list_workers(c):
+    return c.workers()
+
+
+@_with_client
+def get_timeline(c):
+    return c.timeline()
+
+
+@_with_client
+def summarize_tasks(c):
+    """`ray summary tasks` analog: counts by (name, state)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for t in c.tasks(limit=100_000):
+        by_state = summary.setdefault(t["name"] or "<anonymous>", {})
+        by_state[t.get("state", "?")] = by_state.get(t.get("state", "?"), 0) + 1
+    return summary
