@@ -54,6 +54,9 @@ class GcsServer:
         self.object_dir: Dict[bytes, dict] = {}  # object_id -> {nodes: set, size}
         self.object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
         self.task_events: List[dict] = []  # ring buffer of task state events
+        # Aggregated user metrics: name -> {type, description, boundaries?,
+        #   series: {tags_tuple -> value | histogram-state}}
+        self.metrics: Dict[str, dict] = {}
         self.subscribers: Dict[str, Set[ServerConnection]] = defaultdict(set)
         self.pending_actors: Set[bytes] = set()
         self.pending_pgs: Set[bytes] = set()
@@ -106,6 +109,9 @@ class GcsServer:
         # task events / state API
         r("add_task_events", self.h_add_task_events)
         r("list_task_events", self.h_list_task_events)
+        # metrics (stats agent + prometheus_exporter analog)
+        r("metrics_report", self.h_metrics_report)
+        r("metrics_snapshot", self.h_metrics_snapshot)
         # misc
         r("ping", self.h_ping)
 
@@ -825,6 +831,66 @@ class GcsServer:
     async def h_list_task_events(self, d, conn):
         limit = d.get("limit", 1000)
         return {"events": self.task_events[-limit:]}
+
+    # -- metrics ----------------------------------------------------------
+    async def h_metrics_report(self, d, conn):
+        """Merge a client's metric deltas into the cluster aggregate.
+
+        Counters accumulate deltas; gauges are last-writer-wins per tag
+        set; histogram bucket counts/sums accumulate.
+        """
+        for rec in d["records"]:
+            m = self.metrics.setdefault(
+                rec["name"],
+                {
+                    "type": rec["type"],
+                    "description": rec.get("description", ""),
+                    "boundaries": rec.get("boundaries"),
+                    "series": {},
+                },
+            )
+            if m["type"] != rec["type"] or (
+                rec["type"] == "histogram"
+                and m["boundaries"] != rec.get("boundaries")
+            ):
+                # Conflicting re-registration under the same name: skip this
+                # record rather than corrupting (or aborting) the batch.
+                continue
+            series = m["series"]
+            for tags_list, payload in rec["data"]:
+                key = tuple(tuple(t) for t in tags_list)
+                if rec["type"] == "counter":
+                    series[key] = series.get(key, 0.0) + payload
+                elif rec["type"] == "gauge":
+                    series[key] = payload
+                else:  # histogram
+                    st = series.setdefault(
+                        key,
+                        {"buckets": [0] * len(payload["buckets"]),
+                         "sum": 0.0, "count": 0},
+                    )
+                    for i, c in enumerate(payload["buckets"]):
+                        st["buckets"][i] += c
+                    st["sum"] += payload["sum"]
+                    st["count"] += payload["count"]
+        return {"ok": True}
+
+    async def h_metrics_snapshot(self, d, conn):
+        out = []
+        for name, m in self.metrics.items():
+            out.append(
+                {
+                    "name": name,
+                    "type": m["type"],
+                    "description": m["description"],
+                    "boundaries": m.get("boundaries"),
+                    "series": [
+                        [[list(t) for t in key], val]
+                        for key, val in m["series"].items()
+                    ],
+                }
+            )
+        return {"metrics": out}
 
     async def h_ping(self, d, conn):
         return {"pong": True, "time": time.time()}

@@ -1,0 +1,1 @@
+from ray_tpu.dashboard.dashboard import Dashboard, run_dashboard  # noqa: F401

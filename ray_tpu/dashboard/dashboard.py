@@ -1,0 +1,349 @@
+"""Cluster dashboard: HTTP JSON API + Prometheus metrics + minimal UI.
+
+Analog of the reference dashboard head process (dashboard/dashboard.py,
+head.py with its pluggable modules: node, actor, job, state, metrics,
+healthz — dashboard/modules/) collapsed into one aiohttp app fed directly
+from the GCS. The reference's React frontend is replaced by a single
+self-contained HTML page; the REST surface mirrors the module routes the
+CLI/SDK consume (jobs REST = dashboard/modules/job/job_head.py).
+
+Run standalone:  python -m ray_tpu.dashboard --address HOST:PORT [--port 8265]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ray_tpu._private.protocol import Connection, connect
+
+_HTML = """<!DOCTYPE html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.6rem; }
+ table { border-collapse: collapse; margin-top: .4rem; min-width: 40rem; }
+ th, td { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem;
+          text-align: left; }
+ th { background: #f3f3f3; }
+ code { background: #f6f6f6; padding: 0 .2rem; }
+</style></head>
+<body>
+<h1>ray_tpu cluster</h1>
+<div id="root">loading…</div>
+<script>
+const fmt = (o) => typeof o === "object" ? JSON.stringify(o) : o;
+async function refresh() {
+  const [status, nodes, actors, jobs] = await Promise.all([
+    fetch("api/cluster_status").then(r => r.json()),
+    fetch("api/nodes").then(r => r.json()),
+    fetch("api/actors").then(r => r.json()),
+    fetch("api/jobs").then(r => r.json()),
+  ]);
+  const rows = (items, cols) =>
+    "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>" +
+    items.map(it => "<tr>" + cols.map(c => `<td>${fmt(it[c] ?? "")}</td>`)
+      .join("") + "</tr>").join("") + "</table>";
+  document.getElementById("root").innerHTML =
+    `<p>${status.alive_nodes}/${status.total_nodes} nodes alive · ` +
+    Object.entries(status.resources_total).map(([k, v]) =>
+      `${k}: ${status.resources_available[k] ?? 0}/${v}`).join(" · ") + "</p>" +
+    "<h2>Nodes</h2>" + rows(nodes, ["node_id", "state", "address",
+                                    "resources_total", "resources_available"]) +
+    "<h2>Actors</h2>" + rows(actors, ["actor_id", "class_name", "state",
+                                      "name", "node_id"]) +
+    "<h2>Jobs</h2>" + rows(jobs, ["submission_id", "state", "entrypoint"]);
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+def _hex(b):
+    return b.hex() if isinstance(b, (bytes, bytearray)) else b
+
+
+def _prom_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(tags) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in tags)
+    return "{" + inner + "}"
+
+
+class Dashboard:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1", port: int = 8265):
+        self.gcs_address = gcs_address
+        self.host, self.port = host, port
+        self.gcs: Optional[Connection] = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/", self.page),
+                web.get("/healthz", self.healthz),
+                web.get("/metrics", self.metrics),
+                web.get("/api/cluster_status", self.cluster_status),
+                web.get("/api/nodes", self.nodes),
+                web.get("/api/actors", self.actors),
+                web.get("/api/tasks", self.tasks),
+                web.get("/api/objects", self.objects),
+                web.get("/api/placement_groups", self.placement_groups),
+                web.get("/api/jobs", self.jobs),
+                web.post("/api/jobs", self.submit_job),
+                web.get("/api/jobs/{submission_id}", self.job_info),
+                web.get("/api/jobs/{submission_id}/logs", self.job_logs),
+                web.post("/api/jobs/{submission_id}/stop", self.stop_job),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> int:
+        host, port = self.gcs_address.rsplit(":", 1)
+        self.gcs = await connect(host, int(port))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+        if self.gcs:
+            await self.gcs.close()
+
+    # -- pages -----------------------------------------------------------
+    async def page(self, request):
+        return web.Response(text=_HTML, content_type="text/html")
+
+    async def healthz(self, request):
+        try:
+            await self.gcs.call("ping", {}, timeout=2)
+        except Exception:
+            return web.Response(status=503, text="gcs unreachable")
+        return web.Response(text="ok")
+
+    # -- json api --------------------------------------------------------
+    def _json(self, data):
+        return web.Response(
+            text=json.dumps(data, default=_hex), content_type="application/json"
+        )
+
+    async def cluster_status(self, request):
+        nodes = (await self.gcs.call("get_nodes", {}))["nodes"]
+        alive = [n for n in nodes if n["state"] == "ALIVE"]
+        totals, avail = {}, {}
+        for n in alive:
+            for k, v in n.get("resources_total", {}).items():
+                totals[k] = totals.get(k, 0) + v
+            for k, v in n.get("resources_available", {}).items():
+                avail[k] = avail.get(k, 0) + v
+        return self._json(
+            {
+                "alive_nodes": len(alive),
+                "total_nodes": len(nodes),
+                "resources_total": totals,
+                "resources_available": avail,
+                "timestamp": time.time(),
+            }
+        )
+
+    async def nodes(self, request):
+        nodes = (await self.gcs.call("get_nodes", {}))["nodes"]
+        return self._json(
+            [
+                {
+                    "node_id": _hex(n["node_id"]),
+                    "state": n["state"],
+                    "address": f"{n['address']}:{n['port']}",
+                    "is_head": n.get("is_head", False),
+                    "resources_total": n.get("resources_total", {}),
+                    "resources_available": n.get("resources_available", {}),
+                }
+                for n in nodes
+            ]
+        )
+
+    async def actors(self, request):
+        actors = (await self.gcs.call("list_actors", {}))["actors"]
+        return self._json(
+            [
+                {
+                    "actor_id": _hex(a["actor_id"]),
+                    "class_name": a.get("class_name", ""),
+                    "state": a.get("state", ""),
+                    "name": a.get("name") or "",
+                    "node_id": _hex(a.get("node_id") or b""),
+                }
+                for a in actors
+            ]
+        )
+
+    async def tasks(self, request):
+        events = (await self.gcs.call("list_task_events", {"limit": 100_000}))[
+            "events"
+        ]
+        tasks = {}
+        for ev in events:
+            t = tasks.setdefault(
+                ev["task_id"],
+                {"task_id": _hex(ev["task_id"]), "name": ev.get("name", ""),
+                 "type": ev.get("type")},
+            )
+            t["state"] = ev["state"]
+        return self._json(list(tasks.values()))
+
+    async def objects(self, request):
+        objs = (await self.gcs.call("list_objects", {}))["objects"]
+        return self._json(
+            [
+                {"object_id": _hex(o["object_id"]), "size": o["size"],
+                 "locations": [_hex(n) for n in o["nodes"]]}
+                for o in objs
+            ]
+        )
+
+    async def placement_groups(self, request):
+        pgs = (await self.gcs.call("list_placement_groups", {}))["pgs"]
+        return self._json(
+            [
+                {"pg_id": _hex(p["pg_id"]), "state": p["state"],
+                 "strategy": p["strategy"], "bundles": p["bundles"]}
+                for p in pgs
+            ]
+        )
+
+    async def jobs(self, request):
+        jobs = (await self.gcs.call("list_jobs", {}))["jobs"]
+        return self._json(
+            [{**j, "job_id": _hex(j.get("job_id", b"")),
+              "node_id": _hex(j.get("node_id") or b"")} for j in jobs]
+        )
+
+    async def submit_job(self, request):
+        body = await request.json()
+        r = await self.gcs.call(
+            "submit_job",
+            {
+                "entrypoint": body["entrypoint"],
+                "submission_id": body.get("submission_id"),
+                "runtime_env": body.get("runtime_env"),
+                "metadata": body.get("metadata"),
+            },
+        )
+        status = 200 if r.get("ok") else 400
+        return web.Response(
+            status=status, text=json.dumps(r), content_type="application/json"
+        )
+
+    async def job_info(self, request):
+        sid = request.match_info["submission_id"]
+        r = await self.gcs.call("get_job", {"submission_id": sid})
+        if r["job"] is None:
+            return web.Response(status=404, text="no such job")
+        return self._json(
+            {**r["job"], "job_id": _hex(r["job"].get("job_id", b"")),
+             "node_id": _hex(r["job"].get("node_id") or b"")}
+        )
+
+    async def job_logs(self, request):
+        sid = request.match_info["submission_id"]
+        r = await self.gcs.call("job_logs", {"submission_id": sid})
+        if r["logs"] is None:
+            return web.Response(status=404, text="no such job")
+        return web.Response(text=r["logs"])
+
+    async def stop_job(self, request):
+        sid = request.match_info["submission_id"]
+        r = await self.gcs.call("stop_job", {"submission_id": sid})
+        return self._json(r)
+
+    # -- prometheus ------------------------------------------------------
+    async def metrics(self, request):
+        lines = []
+        # System metrics derived from GCS tables (stats/metric_defs.h
+        # analog: node resources, actor/task/job states).
+        nodes = (await self.gcs.call("get_nodes", {}))["nodes"]
+        lines.append("# TYPE rt_node_resource_total gauge")
+        lines.append("# TYPE rt_node_resource_available gauge")
+        for n in nodes:
+            if n["state"] != "ALIVE":
+                continue
+            nid = _hex(n["node_id"])[:12]
+            for k, v in n.get("resources_total", {}).items():
+                lines.append(
+                    f'rt_node_resource_total{{node="{nid}",resource="{_prom_escape(k)}"}} {v}'
+                )
+            for k, v in n.get("resources_available", {}).items():
+                lines.append(
+                    f'rt_node_resource_available{{node="{nid}",resource="{_prom_escape(k)}"}} {v}'
+                )
+        actors = (await self.gcs.call("list_actors", {}))["actors"]
+        states: dict = {}
+        for a in actors:
+            states[a.get("state", "?")] = states.get(a.get("state", "?"), 0) + 1
+        lines.append("# TYPE rt_actors gauge")
+        for s, c in states.items():
+            lines.append(f'rt_actors{{state="{s}"}} {c}')
+
+        # User metrics (util/metrics.py) from the GCS aggregate.
+        snapshot = (await self.gcs.call("metrics_snapshot", {}))["metrics"]
+        for m in snapshot:
+            name = m["name"]
+            if m["description"]:
+                lines.append(f"# HELP {name} {_prom_escape(m['description'])}")
+            ptype = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram"}[m["type"]]
+            lines.append(f"# TYPE {name} {ptype}")
+            for tags, val in m["series"]:
+                if m["type"] in ("counter", "gauge"):
+                    lines.append(f"{name}{_prom_labels(tags)} {val}")
+                else:
+                    bounds = m["boundaries"]
+                    cum = 0
+                    for i, b in enumerate(bounds):
+                        cum += val["buckets"][i]
+                        lab = list(tags) + [["le", str(b)]]
+                        lines.append(f"{name}_bucket{_prom_labels(lab)} {cum}")
+                    cum += val["buckets"][-1]
+                    lab = list(tags) + [["le", "+Inf"]]
+                    lines.append(f"{name}_bucket{_prom_labels(lab)} {cum}")
+                    lines.append(f"{name}_sum{_prom_labels(tags)} {val['sum']}")
+                    lines.append(f"{name}_count{_prom_labels(tags)} {val['count']}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+
+def run_dashboard(gcs_address: str, host: str = "127.0.0.1", port: int = 8265):
+    """Blocking entry point (standalone dashboard process)."""
+
+    async def main():
+        dash = Dashboard(gcs_address, host, port)
+        actual = await dash.start()
+        print(f"DASHBOARD_PORT={actual}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(main())
+
+
+def main():  # pragma: no cover - subprocess entry
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True, help="GCS host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    args = p.parse_args()
+    run_dashboard(args.address, args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -142,11 +142,35 @@ def cmd_start(args):
     if gcs is not None:
         pids.append(gcs.pid)
     pids.append(raylet.pid)
+    dashboard_port = None
+    if args.head and not args.no_dashboard:
+        dash = _spawn_service(
+            "dashboard",
+            [sys.executable, "-m", "ray_tpu.dashboard",
+             "--address", gcs_address, "--port", str(args.dashboard_port)],
+        )
+        dash_log = os.path.join(_log_dir(), f"dashboard-{os.getpid()}.log")
+        try:
+            dashboard_port = int(
+                _wait_for_key(dash, dash_log, "DASHBOARD_PORT=", timeout=60)
+            )
+            pids.append(dash.pid)
+        except (RuntimeError, TimeoutError) as e:
+            print(f"warning: dashboard failed to start: {e}")
+            try:
+                dash.kill()
+            except OSError:
+                pass
+    if sess and dashboard_port is None:
+        dashboard_port = sess.get("dashboard_port")
     _write_session(
-        {"gcs_address": gcs_address, "pids": pids, "raylet_port": raylet_port}
+        {"gcs_address": gcs_address, "pids": pids, "raylet_port": raylet_port,
+         "dashboard_port": dashboard_port}
     )
     print(f"started node {node_id[:12]} (raylet port {raylet_port})")
     print(f"GCS address: {gcs_address}")
+    if dashboard_port:
+        print(f"dashboard: http://127.0.0.1:{dashboard_port}")
     print(f'connect with:  ray_tpu.init(address="{gcs_address}")')
     if args.block:
         try:
@@ -267,6 +291,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--resources", help="JSON resource map")
     sp.add_argument("--object-store-memory", type=int)
     sp.add_argument("--block", action="store_true")
+    sp.add_argument("--no-dashboard", action="store_true")
+    sp.add_argument("--dashboard-port", type=int, default=8265)
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop services started by `rt start`")

@@ -1,0 +1,167 @@
+"""util.metrics + dashboard tests.
+
+Reference analogs: python/ray/tests/test_metrics_agent.py (user metrics →
+Prometheus exposition) and dashboard module tests.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+
+def _wait_for(fn, timeout=10.0, poll=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(poll)
+    raise TimeoutError("condition not met")
+
+
+def _snapshot(client):
+    return client._run(client.gcs.call("metrics_snapshot", {}))["metrics"]
+
+
+def test_metric_validation(rt_start):
+    c = Counter("val_counter", tag_keys=("a",))
+    with pytest.raises(ValueError):
+        c.inc(0)
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        Histogram("val_hist", boundaries=[2.0, 1.0])
+
+
+def test_metrics_flow_to_gcs(rt_start):
+    client = worker_mod.get_client()
+    c = Counter("req_count", description="requests", tag_keys=("route",))
+    g = Gauge("queue_depth")
+    h = Histogram("latency_s", boundaries=[0.1, 1.0, 10.0])
+
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/b"})
+    g.set(7.0)
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+
+    def ready():
+        names = {m["name"] for m in _snapshot(client)}
+        return {"req_count", "queue_depth", "latency_s"} <= names
+
+    _wait_for(ready)
+    snap = {m["name"]: m for m in _snapshot(client)}
+    counter_series = {tuple(map(tuple, k)): v for k, v in snap["req_count"]["series"]}
+    assert counter_series[(("route", "/a"),)] == 1
+    assert counter_series[(("route", "/b"),)] == 2
+    assert snap["queue_depth"]["series"][0][1] == 7.0
+    hseries = snap["latency_s"]["series"][0][1]
+    assert hseries["count"] == 4
+    assert hseries["buckets"] == [1, 1, 1, 1]
+
+    # Counters accumulate across flushes.
+    c.inc(5, tags={"route": "/a"})
+    _wait_for(
+        lambda: {
+            tuple(map(tuple, k)): v
+            for k, v in {m["name"]: m for m in _snapshot(client)}["req_count"][
+                "series"
+            ]
+        }.get((("route", "/a"),)) == 6
+    )
+
+
+def test_metrics_in_tasks(rt_start):
+    """Metrics recorded inside worker processes reach the GCS aggregate."""
+
+    @rt.remote
+    def work():
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("task_side_counter")
+        c.inc(1)
+        time.sleep(1.5)  # let the worker's flusher run
+        return 1
+
+    assert rt.get(work.remote(), timeout=60) == 1
+    client = worker_mod.get_client()
+    _wait_for(
+        lambda: any(m["name"] == "task_side_counter" for m in _snapshot(client))
+    )
+
+
+@pytest.fixture
+def dashboard(rt_start):
+    """In-process dashboard against the running GCS."""
+    from ray_tpu.dashboard import Dashboard
+
+    node = worker_mod._global_node
+    dash = Dashboard(node.gcs_address, port=0)
+    port = node.io.run(dash.start())
+    yield f"http://127.0.0.1:{port}"
+    node.io.run(dash.stop())
+
+
+def _get(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def test_dashboard_endpoints(dashboard):
+    @rt.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    p = Pinger.remote()
+    assert rt.get(p.ping.remote()) == "pong"
+
+    assert _get(dashboard + "/healthz") == "ok"
+    assert "ray_tpu cluster" in _get(dashboard + "/")
+
+    status = json.loads(_get(dashboard + "/api/cluster_status"))
+    assert status["alive_nodes"] == 1
+    assert status["resources_total"]["CPU"] == 4
+
+    nodes = json.loads(_get(dashboard + "/api/nodes"))
+    assert nodes[0]["state"] == "ALIVE"
+
+    actors = json.loads(_get(dashboard + "/api/actors"))
+    assert actors and actors[0]["class_name"] == "Pinger"
+
+    Counter("dash_counter").inc(3)
+    body = _wait_for(
+        lambda: (lambda t: t if "dash_counter" in t else None)(
+            _get(dashboard + "/metrics")
+        )
+    )
+    assert "rt_node_resource_total" in body
+    assert "dash_counter 3" in body
+
+
+def test_dashboard_job_rest(dashboard):
+    import sys
+
+    payload = json.dumps(
+        {"entrypoint": f"{sys.executable} -c \"print('dash job ran')\""}
+    ).encode()
+    req = urllib.request.Request(
+        dashboard + "/api/jobs", data=payload,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        sid = json.loads(r.read())["submission_id"]
+
+    def done():
+        info = json.loads(_get(dashboard + f"/api/jobs/{sid}"))
+        return info if info["state"] in ("SUCCEEDED", "FAILED", "STOPPED") else None
+
+    info = _wait_for(done, timeout=60)
+    assert info["state"] == "SUCCEEDED"
+    assert "dash job ran" in _get(dashboard + f"/api/jobs/{sid}/logs")
