@@ -37,6 +37,7 @@ def init(
     local_mode: bool = False,
     labels: Optional[Dict[str, str]] = None,
     ignore_reinit_error: bool = False,
+    runtime_env: Optional[Dict] = None,
 ):
     """Start (or connect to) a ray_tpu cluster.
 
@@ -83,6 +84,17 @@ def init(
             labels=labels,
         )
         _client = _node.make_client()
+    if runtime_env:
+        _client.default_runtime_env = runtime_env
+    else:
+        # A driver launched by the job supervisor inherits the job-level
+        # runtime env (already resolved to URIs + hash by the submitter).
+        import json as _json
+        import os as _os
+
+        job_env = _os.environ.get("RT_JOB_RUNTIME_ENV")
+        if job_env:
+            _client.default_runtime_env = _json.loads(job_env)
     _worker.set_client(_client, "driver", _node)
     atexit.register(shutdown)
 

@@ -49,6 +49,10 @@ class Config:
     # Seconds an idle worker lives before the pool reaps it (reference:
     # idle_worker_killing_time_threshold_ms).
     idle_worker_timeout_s: float = 300.0
+    # How long a spawned worker may take to register (runtime-env download
+    # and extraction happen before registration; reference:
+    # worker_register_timeout_seconds).
+    worker_register_timeout_s: float = 120.0
 
     # -- fault tolerance ------------------------------------------------
     # Default task retries (reference: max_retries default 3,

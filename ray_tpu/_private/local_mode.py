@@ -53,7 +53,8 @@ class LocalClient:
 
     # -- tasks -----------------------------------------------------------
     def submit_task(self, fn, args, kwargs, name="", num_returns=1,
-                    resources=None, scheduling=None, max_retries=None):
+                    resources=None, scheduling=None, max_retries=None,
+                    runtime_env=None):
         try:
             value = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
@@ -72,7 +73,8 @@ class LocalClient:
     # -- actors ----------------------------------------------------------
     def create_actor(self, cls, args, kwargs, name=None, namespace="",
                      resources=None, max_restarts=0, max_task_retries=0,
-                     max_concurrency=1, scheduling=None, detached=False):
+                     max_concurrency=1, scheduling=None, detached=False,
+                     runtime_env=None):
         instance = cls(*args, **kwargs)
         actor_id = ActorID.from_random()
         self.actors[actor_id.binary()] = instance

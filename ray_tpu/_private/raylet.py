@@ -41,7 +41,8 @@ from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, c
 
 
 class WorkerHandle:
-    def __init__(self, proc: subprocess.Popen, worker_id: bytes):
+    def __init__(self, proc: subprocess.Popen, worker_id: bytes,
+                 runtime_env_hash: Optional[str] = None):
         self.proc = proc
         self.worker_id = worker_id
         self.conn: Optional[ServerConnection] = None  # worker -> raylet conn
@@ -51,6 +52,9 @@ class WorkerHandle:
         self.actor_resources: Dict[str, float] = {}  # held while actor alive
         self.current_task: Optional[bytes] = None
         self.last_idle_time = time.monotonic()
+        # Workers are cached per runtime-env hash (worker_pool.h); a task
+        # only dispatches to a worker started with its env.
+        self.runtime_env_hash = runtime_env_hash
 
 
 class Raylet:
@@ -100,10 +104,14 @@ class Raylet:
         self._task_events: List[dict] = []
         self._jobs: Dict[str, subprocess.Popen] = {}  # submission_id -> driver
         self._job_stops: set = set()  # submission_ids with a stop requested
+        # runtime_env hash -> (error, ts): envs whose setup failed recently;
+        # tasks targeting them fail fast instead of crash-looping workers.
+        self._bad_runtime_envs: Dict[Optional[str], tuple] = {}
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
 
         r = self.rpc.register
         r("register_worker", self.h_register_worker)
+        r("worker_env_failed", self.h_worker_env_failed)
         r("submit_task", self.h_submit_task)
         r("task_done", self.h_task_done)
         r("pull_object", self.h_pull_object)
@@ -220,10 +228,16 @@ class Raylet:
             self._peer_locks.pop(nid, None)
 
     # -- worker pool -----------------------------------------------------
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, runtime_env: Optional[dict] = None) -> WorkerHandle:
         """Fork a worker process (WorkerPool::StartWorkerProcess analog)."""
         worker_id = os.urandom(16)
         env = dict(os.environ)
+        if runtime_env:
+            import json as _json
+
+            env["RT_RUNTIME_ENV"] = _json.dumps(runtime_env)
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                env[k] = str(v)
         import ray_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
@@ -235,9 +249,7 @@ class Raylet:
         # code shipping via the GCS — future runtime-env work.)
         # Keep zipimport entries (files); drop empties so no implicit-cwd
         # component is ever synthesized by a trailing separator.
-        extra_paths = [p for p in sys.path if p and os.path.exists(p)]
-        parts = [pkg_root, *extra_paths, env.get("PYTHONPATH", "")]
-        env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
+        env["PYTHONPATH"] = self._propagated_pythonpath(env.get("PYTHONPATH", ""))
         env.update(getattr(self, "spawn_env_overrides", None) or {})
         env["RT_WORKER_ID"] = worker_id.hex()
         env["RT_NODE_ID"] = self.node_id.hex()
@@ -250,7 +262,10 @@ class Raylet:
             stdout=None,
             stderr=None,
         )
-        handle = WorkerHandle(proc, worker_id)
+        handle = WorkerHandle(
+            proc, worker_id,
+            runtime_env_hash=runtime_env.get("hash") if runtime_env else None,
+        )
         self.workers[worker_id] = handle
         return handle
 
@@ -262,8 +277,22 @@ class Raylet:
         w.conn = conn
         w.port = d["port"]
         conn.meta["worker_id"] = d["worker_id"]
+        # A successful start clears any recorded env failure for this hash.
+        self._bad_runtime_envs.pop(w.runtime_env_hash, None)
         self._dispatch_event.set()
         return {"node_id": self.node_id.binary()}
+
+    async def h_worker_env_failed(self, d, conn):
+        """A starting worker could not materialize its runtime env: fail
+        queued tasks with that env instead of crash-looping spawns."""
+        renv_hash = d.get("runtime_env_hash")
+        error = d.get("error", "runtime_env setup failed")
+        self._bad_runtime_envs[renv_hash] = (error, time.monotonic())
+        w = self.workers.get(d.get("worker_id"))
+        if w is not None:
+            self._forget_worker(w)
+        self._dispatch_event.set()
+        return {"ok": True}
 
     def _forget_worker(self, w: WorkerHandle):
         self.workers.pop(w.worker_id, None)
@@ -337,14 +366,16 @@ class Raylet:
                 bundle["available"][k] = bundle["available"].get(k, 0) - v
         else:
             self._acquire(resources)
-        w = self._spawn_worker()
+        w = self._spawn_worker(payload["create_spec"].get("runtime_env"))
         w.idle = False
         w.actor_id = payload["actor_id"]
         w.actor_resources = dict(resources)
         w.actor_bundle = (sched["pg_id"], sched.get("bundle_index") or 0) if bundle is not None else None
-        # Wait for registration, then push the creation task.
-        for _ in range(600):
-            if w.conn is not None:
+        # Wait for registration, then push the creation task. The budget
+        # covers runtime-env download/extraction in the starting worker.
+        deadline = time.monotonic() + get_config().worker_register_timeout_s
+        while time.monotonic() < deadline:
+            if w.conn is not None or w.worker_id not in self.workers:
                 break
             await asyncio.sleep(0.05)
         if w.conn is None:
@@ -354,6 +385,18 @@ class Raylet:
             )
             return
         await w.conn.push("create_actor", payload["create_spec"])
+
+    @staticmethod
+    def _propagated_pythonpath(existing: str = "") -> str:
+        """This process's import paths, for child processes (workers, job
+        drivers) so by-reference code and ray_tpu itself resolve."""
+        import ray_tpu
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(ray_tpu.__file__))
+        )
+        extra = [p for p in sys.path if p and os.path.exists(p)]
+        return os.pathsep.join(p for p in [pkg_root, *extra, existing] if p)
 
     # -- job supervision -------------------------------------------------
     async def _run_job(self, payload):
@@ -367,11 +410,46 @@ class Raylet:
         renv = payload.get("runtime_env") or {}
         for k, v in (renv.get("env_vars") or {}).items():
             env[k] = str(v)
+        cwd = None
+        pkg_uris = list(renv.get("py_module_uris") or ())
+        wd_uri = renv.get("working_dir_uri")
+        if wd_uri or pkg_uris:
+            from ray_tpu.runtime_env.runtime_env import GcsKvAdapter, _materialize
+
+            kv = GcsKvAdapter(self.gcs, asyncio.get_event_loop())
+            loop = asyncio.get_event_loop()
+            try:
+                extra_paths = []
+                for uri in pkg_uris:
+                    extra_paths.append(
+                        await loop.run_in_executor(None, _materialize, kv, uri)
+                    )
+                if wd_uri:
+                    cwd = await loop.run_in_executor(None, _materialize, kv, wd_uri)
+                    extra_paths.insert(0, cwd)
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [*extra_paths, env.get("PYTHONPATH", "")]
+                ).rstrip(os.pathsep)
+            except Exception as e:  # noqa: BLE001
+                await self.gcs.call(
+                    "job_update",
+                    {"submission_id": submission_id, "state": "FAILED",
+                     "message": f"runtime_env setup failed: {e}"},
+                )
+                return
+        env["PYTHONPATH"] = self._propagated_pythonpath(env.get("PYTHONPATH", ""))
+        if renv:
+            import json as _json
+
+            # The driver's ray_tpu.init() picks this up so the job's own
+            # tasks/actors inherit the job runtime env.
+            env["RT_JOB_RUNTIME_ENV"] = _json.dumps(renv)
         try:
             proc = subprocess.Popen(
                 payload["entrypoint"],
                 shell=True,
                 env=env,
+                cwd=cwd,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
@@ -680,7 +758,17 @@ class Raylet:
                 if missing:
                     spawn(self._fetch_then_requeue(spec, fut, missing))
                     continue
-                worker = self._idle_worker()
+                renv_hash = spec.get("runtime_env_hash")
+                bad = self._bad_runtime_envs.get(renv_hash)
+                if bad is not None and time.monotonic() - bad[1] < 60.0:
+                    self._queued_demand_add(resources, -1)
+                    if not fut.done():
+                        fut.set_result(
+                            {"status": "error",
+                             "error": f"runtime_env setup failed: {bad[0]}"}
+                        )
+                    continue
+                worker = self._idle_worker(renv_hash)
                 if worker is None:
                     # Spawn only as many workers as there is queued work,
                     # counting ones still starting up (WorkerPool prestart
@@ -692,10 +780,32 @@ class Raylet:
                         1
                         for w in self.workers.values()
                         if w.actor_id is None and w.conn is None
+                        and w.runtime_env_hash == renv_hash
                     )
                     wanted = 1 + len(self.task_queue) + len(requeue)
+                    if n_live >= cfg.max_workers_per_node and n_starting == 0:
+                        # Pool full of other-env workers: replace an idle one
+                        # so a new env hash can't starve (the reference kills
+                        # idle workers to make room the same way).
+                        victim = next(
+                            (
+                                w
+                                for w in self.workers.values()
+                                if w.idle and w.actor_id is None
+                                and w.conn is not None
+                                and w.runtime_env_hash != renv_hash
+                            ),
+                            None,
+                        )
+                        if victim is not None:
+                            try:
+                                victim.proc.kill()
+                            except Exception:
+                                pass
+                            self._forget_worker(victim)
+                            n_live -= 1
                     if n_live < cfg.max_workers_per_node and n_starting < wanted:
-                        self._spawn_worker()
+                        self._spawn_worker(spec.get("runtime_env"))
                     requeue.append((spec, fut))
                     continue
                 if not self._try_acquire_for(spec):
@@ -719,9 +829,14 @@ class Raylet:
                 await asyncio.sleep(0.02)
                 self._dispatch_event.set()
 
-    def _idle_worker(self) -> Optional[WorkerHandle]:
+    def _idle_worker(self, renv_hash: Optional[str] = None) -> Optional[WorkerHandle]:
         for w in self.workers.values():
-            if w.idle and w.conn is not None and w.actor_id is None:
+            if (
+                w.idle
+                and w.conn is not None
+                and w.actor_id is None
+                and w.runtime_env_hash == renv_hash
+            ):
                 return w
         return None
 

@@ -243,6 +243,8 @@ class CoreClient:
         self._in_store: set = set()  # oids known to live in shared store
         self._push_handlers = {}
         self._connected = False
+        self.default_runtime_env = None  # job-level env from init()
+        self._runtime_env_cache: Dict[str, Optional[dict]] = {}
 
     # -- bootstrap -------------------------------------------------------
     def connect(self):
@@ -492,6 +494,26 @@ class CoreClient:
         return ready[:num_returns], ready[num_returns:] + pending
 
     # -- task submission ---------------------------------------------------
+    def _resolve_runtime_env(self, renv) -> Optional[dict]:
+        """Resolve + cache a runtime env (upload working_dir/py_modules once
+        per content); falls back to the job-level env from init()."""
+        if renv is None:
+            renv = self.default_runtime_env
+        if not renv:
+            return None
+        if "hash" in renv:  # already resolved (job-inherited env)
+            return dict(renv)
+        import json as _json
+
+        from ray_tpu.runtime_env import prepare_runtime_env
+
+        cache_key = _json.dumps(dict(renv), sort_keys=True, default=str)
+        hit = self._runtime_env_cache.get(cache_key)
+        if hit is None:
+            hit = prepare_runtime_env(renv, self)
+            self._runtime_env_cache[cache_key] = hit
+        return hit
+
     def submit_task(
         self,
         fn,
@@ -502,11 +524,13 @@ class CoreClient:
         resources: Optional[Dict[str, float]] = None,
         scheduling=None,
         max_retries: Optional[int] = None,
+        runtime_env=None,
     ) -> List[ObjectRef]:
         cfg = get_config()
         fn_key = self.fn_manager.export(fn)
         payload, deps = self.serialize_args(args, kwargs)
         task_id = TaskID.from_random()
+        resolved_env = self._resolve_runtime_env(runtime_env)
         spec = {
             "task_id": task_id.binary(),
             "job_id": self.job_id.binary(),
@@ -517,6 +541,8 @@ class CoreClient:
             "num_returns": num_returns,
             "resources": resources if resources is not None else {"CPU": 1.0},
             "scheduling": scheduling,
+            "runtime_env": resolved_env,
+            "runtime_env_hash": resolved_env["hash"] if resolved_env else None,
         }
         retries = cfg.task_max_retries if max_retries is None else max_retries
         refs = []
@@ -591,16 +617,19 @@ class CoreClient:
         max_concurrency: int = 1,
         scheduling=None,
         detached: bool = False,
+        runtime_env=None,
     ) -> ActorHandle:
         cls_key = self.fn_manager.export(cls)
         payload, deps = self.serialize_args(args, kwargs)
         actor_id = ActorID.from_random()
+        resolved_env = self._resolve_runtime_env(runtime_env)
         create_spec = {
             "actor_id": actor_id.binary(),
             "cls_key": cls_key,
             "args": payload,
             "deps": deps,
             "max_concurrency": max_concurrency,
+            "runtime_env": resolved_env,
         }
         resp = self._run(
             self.gcs.call(

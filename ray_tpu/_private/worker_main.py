@@ -88,6 +88,34 @@ class WorkerProcess:
         await self.client._connect()
         self.client._connected = True
         worker_mod.set_client(self.client, "worker")
+        # Materialize the runtime env (working_dir/py_modules download from
+        # the GCS KV) before any task runs. Blocking KV reads must not run
+        # on the event loop.
+        renv_json = os.environ.get("RT_RUNTIME_ENV")
+        if renv_json:
+            import json
+
+            from ray_tpu.runtime_env import apply_runtime_env
+
+            try:
+                await self.loop.run_in_executor(
+                    self.executor, apply_runtime_env, json.loads(renv_json),
+                    self.client,
+                )
+            except Exception as e:  # noqa: BLE001
+                # Report so the raylet fails queued tasks for this env
+                # instead of respawning us in a crash loop.
+                try:
+                    await self.raylet_conn.call(
+                        "worker_env_failed",
+                        {
+                            "worker_id": self.worker_id,
+                            "runtime_env_hash": json.loads(renv_json).get("hash"),
+                            "error": f"{type(e).__name__}: {e}",
+                        },
+                    )
+                finally:
+                    raise SystemExit(1)
         resp = await self.raylet_conn.call(
             "register_worker", {"worker_id": self.worker_id, "port": port}
         )

@@ -33,6 +33,7 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", 1),
             scheduling=_scheduling_from_options(opts),
             detached=opts.get("lifetime") == "detached",
+            runtime_env=opts.get("runtime_env"),
         )
 
     def options(self, **new_options):

@@ -48,6 +48,20 @@ class JobSubmissionClient:
             pass
         self._io.stop()
 
+    def _prepare_job_runtime_env(self, renv: Optional[Dict]) -> Optional[Dict]:
+        """Resolve the job env exactly like task envs (upload working_dir /
+        py_modules to the GCS KV) so the supervisor and the job's own
+        workers can materialize it."""
+        if not renv:
+            return None
+        from ray_tpu.runtime_env.runtime_env import (
+            GcsKvAdapter,
+            prepare_runtime_env,
+        )
+
+        kv = GcsKvAdapter(self._conn, self._io.loop)
+        return prepare_runtime_env(renv, kv)
+
     def submit_job(
         self,
         *,
@@ -56,6 +70,7 @@ class JobSubmissionClient:
         runtime_env: Optional[Dict] = None,
         metadata: Optional[Dict[str, str]] = None,
     ) -> str:
+        runtime_env = self._prepare_job_runtime_env(runtime_env)
         r = self._run(
             self._conn.call(
                 "submit_job",

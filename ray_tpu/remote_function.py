@@ -61,6 +61,7 @@ class RemoteFunction:
             resources=_resources_from_options(opts),
             scheduling=_scheduling_from_options(opts),
             max_retries=opts.get("max_retries"),
+            runtime_env=opts.get("runtime_env"),
         )
         return refs[0] if num_returns == 1 else refs
 

@@ -1,0 +1,5 @@
+from ray_tpu.runtime_env.runtime_env import (  # noqa: F401
+    RuntimeEnv,
+    apply_runtime_env,
+    prepare_runtime_env,
+)

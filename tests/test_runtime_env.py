@@ -1,0 +1,205 @@
+"""Runtime environment tests.
+
+Reference analogs: python/ray/tests/test_runtime_env*.py (env_vars,
+working_dir packaging via the GCS KV, per-env worker isolation).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.runtime_env import RuntimeEnv
+
+
+def test_runtime_env_validation():
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=["requests"])
+    with pytest.raises(ValueError):
+        RuntimeEnv(conda="env.yml")
+    with pytest.raises(ValueError):
+        RuntimeEnv(bogus_field=1)
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+
+
+def test_env_vars_per_task(rt_start):
+    @rt.remote
+    def read_env(name):
+        return os.environ.get(name)
+
+    assert rt.get(read_env.remote("RT_TEST_FLAG")) is None
+    got = rt.get(
+        read_env.options(
+            runtime_env={"env_vars": {"RT_TEST_FLAG": "on"}}
+        ).remote("RT_TEST_FLAG")
+    )
+    assert got == "on"
+    # Plain tasks keep using env-less workers.
+    assert rt.get(read_env.remote("RT_TEST_FLAG")) is None
+
+
+def test_working_dir_ships_code(rt_start, tmp_path):
+    pkg = tmp_path / "proj"
+    pkg.mkdir()
+    (pkg / "shipped_mod.py").write_text("MAGIC = 'shipped-42'\n")
+    (pkg / "data.txt").write_text("payload\n")
+
+    @rt.remote(runtime_env={"working_dir": str(pkg)})
+    def use_shipped():
+        import shipped_mod  # importable because cwd/sys.path include the pkg
+
+        with open("data.txt") as f:
+            data = f.read().strip()
+        return shipped_mod.MAGIC, data, os.path.basename(os.getcwd()) != "proj"
+
+    magic, data, relocated = rt.get(use_shipped.remote(), timeout=60)
+    assert magic == "shipped-42"
+    assert data == "payload"
+
+
+def test_py_modules(rt_start, tmp_path):
+    mod_dir = tmp_path / "libs"
+    mod_dir.mkdir()
+    (mod_dir / "extra_lib.py").write_text("def f():\n    return 99\n")
+
+    @rt.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_lib():
+        import extra_lib
+
+        return extra_lib.f()
+
+    assert rt.get(use_lib.remote(), timeout=60) == 99
+
+
+def test_actor_runtime_env(rt_start):
+    @rt.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert rt.get(a.read.remote(), timeout=60) == "yes"
+
+
+def test_job_level_runtime_env(rt_start_env):
+    """runtime_env passed to init() applies to all tasks of the job."""
+
+    @rt.remote
+    def read():
+        return os.environ.get("JOB_WIDE")
+
+    assert rt.get(read.remote(), timeout=60) == "set"
+
+
+@pytest.fixture
+def rt_start_env():
+    rt.init(num_cpus=2, runtime_env={"env_vars": {"JOB_WIDE": "set"}})
+    yield rt
+    rt.shutdown()
+
+
+def test_bad_runtime_env_fails_fast(rt_start):
+    """A broken env must error the task, not crash-loop worker spawns."""
+
+    @rt.remote(max_retries=0)
+    def never_runs():
+        return 1
+
+    with pytest.raises(rt.exceptions.TaskError):
+        rt.get(
+            never_runs.options(
+                runtime_env={"working_dir": "gcs://_rt_pkg_bogus.zip"}
+            ).remote(),
+            timeout=60,
+        )
+
+
+def test_new_env_when_pool_is_full(rt_start):
+    """A task with a fresh env hash must not starve behind a pool full of
+    plain workers (an idle one is replaced)."""
+    import ray_tpu._private.config as config_mod
+
+    @rt.remote
+    def plain():
+        return os.getpid()
+
+    # Fill the pool with plain workers.
+    rt.get([plain.remote() for _ in range(4)])
+
+    @rt.remote
+    def with_env():
+        return os.environ.get("POOLTEST")
+
+    old = config_mod.get_config().max_workers_per_node
+    config_mod.get_config().max_workers_per_node = len(
+        rt._worker._global_node.raylet.workers
+    )
+    try:
+        got = rt.get(
+            with_env.options(
+                runtime_env={"env_vars": {"POOLTEST": "yes"}}
+            ).remote(),
+            timeout=60,
+        )
+        assert got == "yes"
+    finally:
+        config_mod.get_config().max_workers_per_node = old
+
+
+def test_job_env_inherited_by_tasks(rt_start, tmp_path):
+    """Tasks spawned by a submitted job's driver see the job working_dir."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.job import JobSubmissionClient
+
+    proj = tmp_path / "inheritproj"
+    proj.mkdir()
+    (proj / "helper_mod.py").write_text("TOKEN = 'inherited-7'\n")
+    (proj / "driver.py").write_text(
+        "import ray_tpu as rt\n"
+        "import os\n"
+        "rt.init(address=os.environ['RT_GCS_ADDR'])\n"
+        "@rt.remote\n"
+        "def task():\n"
+        "    import helper_mod\n"
+        "    return helper_mod.TOKEN\n"
+        "print('task got', rt.get(task.remote(), timeout=60))\n"
+        "rt.shutdown()\n"
+    )
+
+    client = JobSubmissionClient(worker_mod._global_node.gcs_address)
+    try:
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} driver.py",
+            runtime_env={"working_dir": str(proj)},
+        )
+        state = client.wait_until_finished(sid, timeout=120)
+        logs = client.get_job_logs(sid)
+        assert state == "SUCCEEDED", logs
+        assert "task got inherited-7" in logs
+    finally:
+        client.close()
+
+
+def test_job_submission_working_dir(rt_start, tmp_path):
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.job import JobSubmissionClient
+
+    proj = tmp_path / "jobproj"
+    proj.mkdir()
+    (proj / "main.py").write_text("print('job saw', open('marker.txt').read().strip())\n")
+    (proj / "marker.txt").write_text("m4rk3r\n")
+
+    client = JobSubmissionClient(worker_mod._global_node.gcs_address)
+    try:
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} main.py",
+            runtime_env={"working_dir": str(proj),
+                         "env_vars": {"IGNORED": "1"}},
+        )
+        assert client.wait_until_finished(sid, timeout=60) == "SUCCEEDED"
+        assert "job saw m4rk3r" in client.get_job_logs(sid)
+    finally:
+        client.close()
