@@ -37,6 +37,14 @@ class Config:
     # Chunk size for node-to-node object transfer (reference:
     # object_manager_default_chunk_size, ray_config_def.h:362 — 5 MiB).
     object_transfer_chunk_size: int = 5 * 1024 * 1024
+    # Store utilization that triggers spilling of pinned primary copies
+    # (reference: object_spilling_threshold, ray_config_def.h).
+    object_spilling_threshold: float = 0.8
+    # Spill down to this utilization once triggered.
+    object_spilling_low_water: float = 0.6
+    # Directory for spilled objects (RT_SPILL_DIR env; reference:
+    # object_spilling_config).
+    spill_dir: str = ""
 
     # -- scheduling -----------------------------------------------------
     # Prefer the local node until its critical resource utilization crosses

@@ -98,6 +98,7 @@ class GcsServer:
         r("object_location_get", self.h_object_location_get)
         r("object_location_wait", self.h_object_location_wait)
         r("object_location_remove", self.h_object_location_remove)
+        r("object_spilled", self.h_object_spilled)
         r("list_objects", self.h_list_objects)
         # placement groups
         r("create_placement_group", self.h_create_pg)
@@ -189,9 +190,12 @@ class GcsServer:
         for actor_id, a in list(self.actors.items()):
             if a.get("node_id") == node_id and a["state"] in ("ALIVE", "PENDING", "RESTARTING"):
                 await self._on_actor_failure(actor_id, f"node died: {reason}")
-        # Drop object locations on that node.
+        # Drop object locations on that node; spill copies on its local
+        # disk died with it.
         for oid, entry in self.object_dir.items():
             entry["nodes"].discard(node_id)
+            if entry.get("spilled", {}).get("node_id") == node_id:
+                entry.pop("spilled", None)
         # Fail submitted jobs supervised by that node — their drivers died
         # with it, and no further state updates will ever arrive.
         for j in self.jobs.values():
@@ -595,19 +599,30 @@ class GcsServer:
             ev.set()
         return {"ok": True}
 
+    @staticmethod
+    def _loc_view(entry) -> dict:
+        out = {"nodes": list(entry["nodes"]), "size": entry["size"],
+               "known": True}
+        if entry.get("spilled"):
+            out["spilled"] = entry["spilled"]
+        return out
+
     async def h_object_location_get(self, d, conn):
         entry = self.object_dir.get(d["object_id"])
-        if not entry or not entry["nodes"]:
-            return {"nodes": [], "size": 0}
-        return {"nodes": list(entry["nodes"]), "size": entry["size"]}
+        if not entry:
+            # known=False: never registered — may simply not be produced yet
+            # (vs. known+empty = every copy is gone).
+            return {"nodes": [], "size": 0, "known": False}
+        return self._loc_view(entry)
 
     async def h_object_location_wait(self, d, conn):
-        """Block until the object has at least one location (or timeout)."""
+        """Block until the object has a location or a spill copy (or
+        timeout)."""
         oid = d["object_id"]
         timeout = d.get("timeout", 60.0)
         entry = self.object_dir.get(oid)
-        if entry and entry["nodes"]:
-            return {"nodes": list(entry["nodes"]), "size": entry["size"]}
+        if entry and (entry["nodes"] or entry.get("spilled")):
+            return self._loc_view(entry)
         ev = asyncio.Event()
         self.object_waiters[oid].append(ev)
         try:
@@ -615,7 +630,16 @@ class GcsServer:
         except asyncio.TimeoutError:
             return {"nodes": [], "size": 0, "timeout": True}
         entry = self.object_dir.get(oid, {"nodes": set(), "size": 0})
-        return {"nodes": list(entry["nodes"]), "size": entry["size"]}
+        return self._loc_view(entry)
+
+    async def h_object_spilled(self, d, conn):
+        """A raylet spilled its primary copy: record the restore URI and
+        drop the in-memory location."""
+        oid = d["object_id"]
+        entry = self.object_dir.setdefault(oid, {"nodes": set(), "size": 0})
+        entry["nodes"].discard(d["node_id"])
+        entry["spilled"] = {"node_id": d["node_id"], "uri": d["uri"]}
+        return {"ok": True}
 
     async def h_list_objects(self, d, conn):
         limit = d.get("limit", 10_000)

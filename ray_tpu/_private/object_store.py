@@ -96,16 +96,24 @@ class ObjectStore:
             raise OSError(f"failed to open object store segment {name!r}")
         self._base = self._lib.rt_store_base(self._handle)
         self._closed = False
+        self._unmapped = False
         self._lock = threading.Lock()
         if create:
             atexit.register(self.destroy)
 
     # -- lifecycle ------------------------------------------------------
 
-    def close(self):
+    def close(self, unmap: bool = True):
+        """Close the handle. With unmap=False the shared mapping (and the
+        handle) stay valid for the process lifetime — required when
+        zero-copy views (numpy arrays over store memory) may still be
+        alive; munmap under them is a segfault. Late release() calls are
+        still honored in that mode so shared refcounts don't leak."""
         with self._lock:
             if not self._closed:
-                self._lib.rt_store_close(self._handle)
+                if unmap:
+                    self._lib.rt_store_close(self._handle)
+                    self._unmapped = True
                 self._closed = True
 
     def destroy(self):

@@ -35,8 +35,9 @@ from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.config import get_config
-from ray_tpu._private.ids import NodeID
+from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStore
+from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, connect, spawn
 
 
@@ -107,6 +108,13 @@ class Raylet:
         # runtime_env hash -> (error, ts): envs whose setup failed recently;
         # tasks targeting them fail fast instead of crash-looping workers.
         self._bad_runtime_envs: Dict[Optional[str], tuple] = {}
+        # Primary-copy pinning + spill bookkeeping (LocalObjectManager:
+        # primary copies are pinned in plasma and spilled — never silently
+        # evicted; raylet/local_object_manager.h:41).
+        self._primary_pins: Dict[bytes, int] = {}  # oid -> size (pin order)
+        self._spilled: Dict[bytes, str] = {}  # oid -> restore uri
+        self._storage = None  # lazy external storage
+        self._spill_lock = asyncio.Lock()
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
 
         r = self.rpc.register
@@ -117,6 +125,9 @@ class Raylet:
         r("pull_object", self.h_pull_object)
         r("fetch_chunk", self.h_fetch_chunk)
         r("wait_object_local", self.h_wait_object_local)
+        r("object_created", self.h_object_created)
+        r("spill_objects", self.h_spill_objects)
+        r("restore_spilled", self.h_restore_spilled)
         r("get_info", self.h_get_info)
         r("prestart_workers", self.h_prestart_workers)
 
@@ -145,6 +156,7 @@ class Raylet:
         self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
+        self._bg.append(asyncio.ensure_future(self._spill_loop()))
         return port
 
     async def stop(self):
@@ -874,12 +886,36 @@ class Raylet:
 
     # -- object transfer -------------------------------------------------
     async def _ensure_local(self, oid_bytes: bytes, timeout: float = 60.0):
-        """Pull an object into the local store (PullManager analog)."""
+        """Pull an object into the local store (PullManager analog); spilled
+        objects are restored by their spill node first
+        (AsyncRestoreSpilledObject, local_object_manager.h:122)."""
         if self.store.contains_raw(oid_bytes):
             return
         resp = await self.gcs.call(
             "object_location_wait", {"object_id": oid_bytes, "timeout": timeout}
         )
+        spilled = resp.get("spilled")
+        if not resp["nodes"] and spilled:
+            spill_node = spilled["node_id"]
+            if spill_node == self.node_id.binary():
+                r = await self.h_restore_spilled({"object_id": oid_bytes}, None)
+                if not r.get("ok"):
+                    raise KeyError(
+                        f"restore of spilled object {oid_bytes.hex()} failed: "
+                        f"{r.get('error')}"
+                    )
+                return
+            peer = await self._peer(spill_node)
+            if peer is None:
+                raise KeyError(
+                    f"spill node for {oid_bytes.hex()} is unreachable"
+                )
+            r = await peer.call("restore_spilled", {"object_id": oid_bytes})
+            if not r.get("ok"):
+                raise KeyError(f"remote restore failed: {r.get('error')}")
+            resp = await self.gcs.call(
+                "object_location_get", {"object_id": oid_bytes}
+            )
         nodes = [n for n in resp["nodes"] if n != self.node_id.binary()]
         if resp.get("timeout") or (not nodes and not self.store.contains_raw(oid_bytes)):
             if self.store.contains_raw(oid_bytes):
@@ -920,10 +956,9 @@ class Raylet:
         total = meta["size"]
         if self.store.contains(oid):
             return
-        try:
-            buf = self.store.create(oid, total)
-        except ValueError:
-            return  # concurrent pull
+        buf = await self._create_with_spill(oid, total)
+        if buf is None:
+            return  # concurrent pull is materializing it
         try:
             off = 0
             chunk = cfg.object_transfer_chunk_size
@@ -974,6 +1009,169 @@ class Raylet:
         """Driver asks: make this object available in the local store."""
         await self._ensure_local(d["object_id"], d.get("timeout", 60.0))
         return {"ok": True}
+
+    # -- spilling (LocalObjectManager analog) ----------------------------
+    def _get_storage(self):
+        if self._storage is None:
+            from ray_tpu._private.external_storage import create_storage
+
+            self._storage = create_storage(
+                self.node_id.hex(), get_config().spill_dir or None
+            )
+        return self._storage
+
+    async def h_object_created(self, d, conn):
+        """A local client sealed a primary copy: pin it (so LRU eviction
+        cannot drop the only copy) and register its location."""
+        oid = d["object_id"]
+        if oid not in self._primary_pins:
+            view = self.store.get(ObjectID(oid))
+            if view is None:
+                return {"ok": False, "error": "object not found at pin time"}
+            del view  # the store-side refcount holds the pin, not the view
+            self._primary_pins[oid] = d.get("size", 0)
+        self._spilled.pop(oid, None)
+        await self.gcs.call(
+            "object_location_add",
+            {"object_id": oid, "node_id": self.node_id.binary(),
+             "size": d.get("size", 0)},
+        )
+        return {"ok": True}
+
+    def _utilization(self) -> float:
+        s = self.store.stats()
+        return s["used_bytes"] / max(1, s["heap_size"])
+
+    async def _create_with_spill(self, obj: ObjectID, size: int):
+        """store.create with spill-and-retry under pressure. Returns the
+        writable buffer, or None if the object already exists (concurrent
+        writer). Raises ObjectStoreFullError when room cannot be made."""
+        for attempt in range(6):
+            try:
+                return self.store.create(obj, size)
+            except ObjectStoreFullError:
+                n = await self._spill_until(
+                    get_config().object_spilling_low_water
+                )
+                # A concurrent spill (shared _spill_lock) may have freed
+                # room between our failed create and this pass — always
+                # retry; back off only when nothing moved.
+                if not n and attempt >= 2:
+                    await asyncio.sleep(0.25)
+            except ValueError:
+                return None
+        raise ObjectStoreFullError(f"no room for {size} bytes after spilling")
+
+    async def _wait_sealed(self, oid: bytes, timeout: float = 30.0) -> bool:
+        """Wait until a concurrently-written object is sealed (readable)."""
+        deadline = time.monotonic() + timeout
+        obj = ObjectID(oid)
+        while time.monotonic() < deadline:
+            view = self.store.get(obj)
+            if view is not None:
+                del view
+                self.store.release(obj)
+                return True
+            if not self.store.contains_raw(oid):
+                return False  # aborted/evicted mid-write
+            await asyncio.sleep(0.02)
+        return False
+
+    async def _spill_until(self, target_utilization: float) -> int:
+        """Spill pinned primaries (oldest first) until below the target."""
+        async with self._spill_lock:
+            spilled = 0
+            storage = self._get_storage()
+            loop = asyncio.get_event_loop()
+            for oid in list(self._primary_pins):
+                if self._utilization() <= target_utilization:
+                    break
+                obj = ObjectID(oid)
+                view = self.store.get(obj)
+                if view is None:
+                    self._primary_pins.pop(oid, None)
+                    continue
+                try:
+                    uri = await loop.run_in_executor(
+                        None, storage.spill, oid, view
+                    )
+                finally:
+                    del view
+                    self.store.release(obj)  # drop the read pin we just took
+                self.store.release(obj)  # drop the primary pin
+                self._primary_pins.pop(oid, None)
+                if not self.store.delete(obj):
+                    # A local client holds a live view: re-pin and keep it.
+                    v = self.store.get(obj)
+                    if v is not None:
+                        del v
+                        self._primary_pins[oid] = 0
+                    storage.delete([uri])
+                    continue
+                self._spilled[oid] = uri
+                spilled += 1
+                await self.gcs.call(
+                    "object_spilled",
+                    {"object_id": oid, "node_id": self.node_id.binary(),
+                     "uri": uri},
+                )
+            return spilled
+
+    async def h_spill_objects(self, d, conn):
+        """A client's put hit ObjectStoreFull: make room."""
+        cfg = get_config()
+        n = await self._spill_until(cfg.object_spilling_low_water)
+        return {"ok": True, "spilled": n}
+
+    async def h_restore_spilled(self, d, conn):
+        """Restore a spilled object into the local store and re-register."""
+        oid = d["object_id"]
+        if self.store.contains_raw(oid):
+            return {"ok": True}
+        uri = self._spilled.get(oid)
+        if uri is None:
+            return {"ok": False, "error": "object was not spilled here"}
+        storage = self._get_storage()
+        data = await asyncio.get_event_loop().run_in_executor(
+            None, storage.restore, uri
+        )
+        obj = ObjectID(oid)
+        try:
+            buf = await self._create_with_spill(obj, len(data))
+        except ObjectStoreFullError:
+            return {"ok": False,
+                    "error": "store full; nothing left to spill"}
+        if buf is None:
+            # A concurrent restore is writing: only report ok once it has
+            # sealed, or the requester may pull an unreadable object.
+            ok = await self._wait_sealed(oid)
+            return {"ok": ok} if ok else {
+                "ok": False, "error": "concurrent restore did not complete"
+            }
+        buf[: len(data)] = data
+        del buf
+        self.store.seal(obj)
+        # Keep the get-pin as the primary pin.
+        self._primary_pins[oid] = len(data)
+        self._spilled.pop(oid, None)
+        await self.gcs.call(
+            "object_location_add",
+            {"object_id": oid, "node_id": self.node_id.binary(),
+             "size": len(data), "restored": True},
+        )
+        return {"ok": True}
+
+    async def _spill_loop(self):
+        """Background pressure valve (SpillObjectsOfSize trigger)."""
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(0.25)
+            try:
+                if self._utilization() > cfg.object_spilling_threshold:
+                    await self._spill_until(cfg.object_spilling_low_water)
+            except Exception:
+                if self._stopping:
+                    return
 
     async def h_get_info(self, d, conn):
         return {

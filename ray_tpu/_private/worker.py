@@ -193,7 +193,10 @@ class _Pin:
     def release(self):
         if self.store is not None:
             try:
-                self.store.release(self.oid)
+                # Safe until the mapping is actually gone; refcounts live in
+                # shared memory, so skipping would leak them cluster-wide.
+                if not self.store._unmapped:
+                    self.store.release(self.oid)
             except Exception:
                 pass
             self.store = None
@@ -240,11 +243,19 @@ class CoreClient:
         self._actor_locks: Dict[bytes, asyncio.Lock] = {}
         self._actor_events: Dict[bytes, threading.Event] = {}
         self._pins: Dict[bytes, _Pin] = {}
+        self._value_finalizers: list = []  # value-lifetime pins (see _read_store)
         self._in_store: set = set()  # oids known to live in shared store
         self._push_handlers = {}
         self._connected = False
         self.default_runtime_env = None  # job-level env from init()
         self._runtime_env_cache: Dict[str, Optional[dict]] = {}
+        # Owner-side lineage: store-kind return oid -> creating task spec,
+        # for reconstruction when every copy is lost (TaskManager lineage +
+        # ObjectRecoveryManager, object_recovery_manager.h:41).
+        from collections import OrderedDict as _OD
+
+        self.lineage: "_OD[bytes, dict]" = _OD()
+        self.lineage_max_entries = 10_000
 
     # -- bootstrap -------------------------------------------------------
     def connect(self):
@@ -268,6 +279,12 @@ class CoreClient:
             handler(payload)
 
     def disconnect(self):
+        # Decide unmap safety BEFORE releasing session pins: a session pin
+        # means some non-weakrefable container of zero-copy views was
+        # fetched, and we cannot know whether its arrays are still alive.
+        self._live_views_at_disconnect = bool(self._pins) or any(
+            f.alive for f in self._value_finalizers
+        )
         for pin in self._pins.values():
             pin.release()
         self._pins.clear()
@@ -284,7 +301,10 @@ class CoreClient:
             asyncio.run_coroutine_threadsafe(_close(), self.loop).result(timeout=5)
         except Exception:
             pass
-        self.store.close()
+        # Leave the shared mapping in place if any fetched value might still
+        # alias store memory — unmapping under a live numpy view is a
+        # segfault. The mapping is reclaimed at process exit.
+        self.store.close(unmap=not self._live_views_at_disconnect)
         self._connected = False
 
     def _run(self, coro, timeout=None):
@@ -378,19 +398,38 @@ class CoreClient:
             self._put_to_store(ObjectID(oid), value)
         # else: remote object; the directory resolves it
 
-    def _put_to_store(self, oid: ObjectID, value) -> int:
-        so = ser.serialize(value)
-        if self.store.put_serialized(oid, so):
+    def put_serialized_with_spill(self, oid: ObjectID, so) -> bool:
+        """Write to the shared store, asking the raylet to spill under
+        pressure; registers + pins the primary copy via the raylet
+        (object_created), never silently evictable."""
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        wrote = False
+        attempts = 8
+        for attempt in range(attempts):
+            try:
+                wrote = self.store.put_serialized(oid, so)
+                break
+            except ObjectStoreFullError:
+                if attempt == attempts - 1:
+                    raise
+                r = self._run(self.raylet.call("spill_objects", {}), timeout=120)
+                if not r.get("spilled"):
+                    # Nothing spillable right now — concurrent writers may
+                    # finish (and become spillable) shortly.
+                    time.sleep(0.25)
+        if wrote:
             self._run(
-                self.gcs.call(
-                    "object_location_add",
-                    {
-                        "object_id": oid.binary(),
-                        "node_id": self.node_id,
-                        "size": so.total_size,
-                    },
+                self.raylet.call(
+                    "object_created",
+                    {"object_id": oid.binary(), "size": so.total_size},
                 )
             )
+        return wrote
+
+    def _put_to_store(self, oid: ObjectID, value) -> int:
+        so = ser.serialize(value)
+        self.put_serialized_with_spill(oid, so)
         self._in_store.add(oid.binary())
         return so.total_size
 
@@ -432,35 +471,90 @@ class CoreClient:
             return self.memory_store[oid]
         if self.store.contains_raw(oid):
             return self._read_store(ObjectID(oid))
-        # Remote: ask our raylet to pull it locally.
-        remaining = 60.0 if deadline is None else max(0.1, deadline - time.monotonic())
-        try:
-            self._run(
-                self.raylet.call(
-                    "wait_object_local", {"object_id": oid, "timeout": remaining},
-                    timeout=remaining + 5,
-                )
+        # Remote: ask our raylet to pull it locally. Probes are short so a
+        # vanished object is detected well before the caller's deadline;
+        # with lineage the creating task re-executes
+        # (ObjectRecoveryManager::RecoverObject), otherwise the object is
+        # declared lost after a grace probe.
+        recon_left = get_config().task_max_retries
+        last_err: Optional[Exception] = None
+        while True:
+            remaining = (
+                60.0 if deadline is None else max(0.1, deadline - time.monotonic())
             )
-        except Exception as e:  # noqa: BLE001
-            if deadline is not None and time.monotonic() >= deadline:
-                raise GetTimeoutError(f"get() timed out waiting for {ref}")
-            raise ObjectLostError(
-                f"object {ref.hex()} could not be retrieved: {e}"
-            ) from None
-        return self._read_store(ObjectID(oid))
+            probe = min(5.0, remaining * 0.4)
+            try:
+                self._run(
+                    self.raylet.call(
+                        "wait_object_local",
+                        {"object_id": oid, "timeout": probe},
+                        timeout=probe + 5,
+                    )
+                )
+                return self._read_store(ObjectID(oid))
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(f"get() timed out waiting for {ref}")
+                spec = self.lineage.get(oid)
+                if spec is not None:
+                    # Re-execute the creating task (bounded attempts).
+                    if recon_left <= 0:
+                        break
+                    recon_left -= 1
+                    result = self._run(
+                        self.raylet.call("submit_task", dict(spec), timeout=None),
+                        timeout=None if deadline is None else remaining,
+                    )
+                    if result.get("status") != "ok":
+                        break
+                    continue
+                # No lineage: "known with zero copies" means every replica
+                # (memory + spill) is gone — lost. Unknown means possibly
+                # not yet produced: keep waiting (blocking get semantics).
+                try:
+                    loc = self._run(
+                        self.gcs.call(
+                            "object_location_get", {"object_id": oid}
+                        ),
+                        timeout=10,
+                    )
+                except Exception:
+                    continue
+                if (
+                    loc.get("known")
+                    and not loc.get("nodes")
+                    and not loc.get("spilled")
+                ):
+                    break  # registered once, all copies lost
+                continue
+        raise ObjectLostError(
+            f"object {ref.hex()} could not be retrieved: {last_err}"
+        ) from None
 
     def _read_store(self, oid: ObjectID):
         view = self.store.get(oid)
         if view is None:
             raise ObjectLostError(f"object {oid.hex()} missing from local store")
         value = ser.deserialize(view)
-        # Pin until the session ends or the value is re-fetched; eviction
-        # must not unmap memory under live zero-copy arrays.
-        old = self._pins.get(oid.binary())
-        if old is not None:
-            self.store.release(oid)  # only keep one pin per object
-        else:
-            self._pins[oid.binary()] = _Pin(self.store, oid)
+        # The store-side refcount from get() is the pin protecting the
+        # zero-copy buffers under `value`. Tie its release to the value's
+        # lifetime where possible so dropped results become spillable;
+        # otherwise hold a session pin (released at disconnect).
+        pin = _Pin(self.store, oid)
+        try:
+            fin = weakref.finalize(value, pin.release)
+            self._value_finalizers.append(fin)
+            if len(self._value_finalizers) > 256:
+                self._value_finalizers = [
+                    f for f in self._value_finalizers if f.alive
+                ]
+        except TypeError:  # not weakref-able (tuple/dict/primitive)
+            old = self._pins.get(oid.binary())
+            if old is not None:
+                pin.release()  # keep a single session pin per object
+            else:
+                self._pins[oid.binary()] = pin
         self._in_store.add(oid.binary())
         return value
 
@@ -479,11 +573,12 @@ class CoreClient:
                     or self.store.contains_raw(oid)
                 )
                 if not done and ref._future is None:
-                    # Check the cluster directory for remote completion.
+                    # Check the cluster directory for remote completion; a
+                    # spilled-only object is ready (restorable on get).
                     loc = self._run(
                         self.gcs.call("object_location_get", {"object_id": oid})
                     )
-                    done = bool(loc["nodes"])
+                    done = bool(loc["nodes"]) or bool(loc.get("spilled"))
                 (ready if done else still).append(ref)
             pending = still
             if len(ready) >= num_returns or not pending:
@@ -591,6 +686,9 @@ class CoreClient:
                     futures[i].set_result(value)
                 else:  # in the shared store
                     self._in_store.add(oid)
+                    self.lineage[oid] = spec
+                    while len(self.lineage) > self.lineage_max_entries:
+                        self.lineage.popitem(last=False)
                     futures[i].set_result(_IN_STORE)
         elif status == "error":
             err = _rebuild_task_error(result)

@@ -193,17 +193,7 @@ class WorkerProcess:
                 returns.append({"kind": "inline", "data": so.to_bytes()})
             else:
                 oid = object_id_for_task(task_id, i)
-                if self.client.store.put_serialized(oid, so):
-                    self.client._run(
-                        self.client.gcs.call(
-                            "object_location_add",
-                            {
-                                "object_id": oid.binary(),
-                                "node_id": self.node_id,
-                                "size": so.total_size,
-                            },
-                        )
-                    )
+                self.client.put_serialized_with_spill(oid, so)
                 returns.append({"kind": "store", "size": so.total_size})
         return {"status": "ok", "returns": returns}
 
