@@ -261,6 +261,8 @@ class GcsServer:
             info["resources_available"] = d["available"]
             if "total" in d:
                 info["resources_total"] = d["total"]
+            if "demand_bundles" in d:
+                info["demand_bundles"] = d["demand_bundles"]
             info["last_heartbeat"] = time.monotonic()
         return {"ok": True}
 

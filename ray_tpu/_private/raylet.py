@@ -112,6 +112,13 @@ class Raylet:
         # primary copies are pinned in plasma and spilled — never silently
         # evicted; raylet/local_object_manager.h:41).
         self._primary_pins: Dict[bytes, int] = {}  # oid -> size (pin order)
+        self._last_infeasible_check = 0.0
+        # task_id -> resources for every queued-undispatched task; stable
+        # across a dispatch pass (items in the pass-local requeue list are
+        # still here), so heartbeats report true demand.
+        self._queued_specs: Dict[bytes, Dict[str, float]] = {}
+        self._infeasible_warned: set = set()
+        self._queued_since: Dict[bytes, float] = {}
         self._spilled: Dict[bytes, str] = {}  # oid -> restore uri
         self._storage = None  # lazy external storage
         self._spill_lock = asyncio.Lock()
@@ -151,7 +158,8 @@ class Raylet:
             },
         )
         for ch in ("create_actor", "kill_actor_worker", "reserve_bundle",
-                   "cancel_bundle", "node_dead", "run_job", "stop_job"):
+                   "cancel_bundle", "node_dead", "node_added", "run_job",
+                   "stop_job"):
             await self.gcs.call("subscribe", {"channel": ch})
         self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
@@ -231,6 +239,11 @@ class Raylet:
                     os.killpg(proc.pid, signal.SIGTERM)
                 except (ProcessLookupError, PermissionError):
                     proc.terminate()
+        elif channel == "node_added":
+            # A new node may satisfy queued-infeasible tasks: re-check now.
+            self.node_cache.pop(payload.get("node_id"), None)
+            self._last_infeasible_check = 0.0
+            self._dispatch_event.set()
         elif channel == "node_dead":
             nid = payload["node_id"]
             conn = self.peer_conns.pop(nid, None)
@@ -555,9 +568,19 @@ class Raylet:
             for k, v in resources.items()
         )
 
-    def _queued_demand_add(self, resources: Dict[str, float], sign: float):
+    def _queued_demand_add(self, resources: Dict[str, float], sign: float,
+                           spec: Optional[dict] = None):
         for k, v in resources.items():
             self.queued_demand[k] = self.queued_demand.get(k, 0) + sign * v
+        # Mirror the queue in a pass-stable map so the heartbeat's demand
+        # snapshot never observes the transient mid-dispatch empty queue.
+        if spec is not None:
+            if sign > 0:
+                self._queued_specs[spec["task_id"]] = resources
+            else:
+                self._queued_specs.pop(spec["task_id"], None)
+                self._queued_since.pop(spec["task_id"], None)
+                self._infeasible_warned.discard(spec["task_id"])
 
     def _acquire(self, resources: Dict[str, float]):
         for k, v in resources.items():
@@ -626,11 +649,10 @@ class Raylet:
                 best, best_soft = n["node_id"], nsoft
         return best
 
-    async def _pick_remote_node(self, resources) -> Optional[dict]:
+    def _pick_remote_node_from(self, nodes, resources) -> Optional[dict]:
         """Best remote node by lowest utilization (hybrid policy tail)."""
-        resp = await self.gcs.call("get_nodes", {})
         best, best_util = None, None
-        for n in resp["nodes"]:
+        for n in nodes:
             if n["state"] != "ALIVE" or n["node_id"] == self.node_id.binary():
                 continue
             avail, total = n["resources_available"], n["resources_total"]
@@ -643,6 +665,10 @@ class Raylet:
             if best_util is None or util < best_util:
                 best, best_util = n, util
         return best
+
+    async def _pick_remote_node(self, resources) -> Optional[dict]:
+        resp = await self.gcs.call("get_nodes", {})
+        return self._pick_remote_node_from(resp["nodes"], resources)
 
     async def h_submit_task(self, d, conn):
         """Queue a task; the response resolves when the task completes.
@@ -697,17 +723,37 @@ class Raylet:
                 node = await self._pick_remote_node(resources)
                 if node is not None:
                     return await self._forward_task(spec, node["node_id"])
-                if not self._feasible_locally(resources):
-                    return {
-                        "status": "error",
-                        "error": f"no node can satisfy resources {resources}",
-                    }
+                # No node fits today: stay queued — the dispatch loop
+                # re-evaluates remote placement as nodes join (the
+                # reference keeps infeasible tasks pending for the
+                # autoscaler to satisfy).
 
         self.task_queue.append((spec, fut))
-        self._queued_demand_add(resources, +1)
+        self._queued_demand_add(resources, +1, spec)
         self._record_task_event(spec, "PENDING_SCHEDULING")
         self._dispatch_event.set()
         return await fut
+
+    async def _forward_and_resolve(self, spec, fut, node_id: bytes):
+        """Forward a queued task; on transport failure put it back in the
+        queue (the task was promised to wait for capacity, not to fail on
+        a flaky handoff)."""
+        try:
+            result = await self._forward_task(spec, node_id)
+        except Exception as e:  # noqa: BLE001 — peer died mid-call
+            result = {"status": "error",
+                      "error": f"target node unavailable: {e}"}
+        if (
+            result.get("status") == "error"
+            and "target node unavailable" in str(result.get("error", ""))
+        ):
+            if not fut.done():
+                self.task_queue.append((spec, fut))
+                self._queued_demand_add(spec.get("resources", {}), +1, spec)
+                self._dispatch_event.set()
+            return
+        if not fut.done():
+            fut.set_result(result)
 
     async def _forward_task(self, spec, node_id: bytes):
         conn = await self._peer(node_id)
@@ -751,19 +797,64 @@ class Raylet:
             await self._dispatch_event.wait()
             self._dispatch_event.clear()
             requeue = []
+            pass_nodes = None  # one get_nodes snapshot per pass (throttled)
             while self.task_queue:
                 spec, fut = self.task_queue.popleft()
                 if fut.done():
-                    self._queued_demand_add(spec.get("resources", {}), -1)
+                    self._queued_demand_add(spec.get("resources", {}), -1, spec)
                     continue
                 resources = spec.get("resources", {})
                 if spec.get("pg_bundle") is not None and self._bundle_for(spec) is None:
-                    self._queued_demand_add(resources, -1)
+                    self._queued_demand_add(resources, -1, spec)
                     if not fut.done():
                         fut.set_result(
                             {"status": "error",
                              "error": "placement group bundle was removed"}
                         )
+                    continue
+                if not self._feasible_locally(resources) and not spec.get("forwarded"):
+                    # Infeasible here: hand off once a feasible node joins
+                    # (autoscaled nodes register with the GCS). One cluster
+                    # snapshot per 0.5s pass serves ALL infeasible tasks —
+                    # a poison task must not starve placeable ones.
+                    now = time.monotonic()
+                    if pass_nodes is None and now - self._last_infeasible_check >= 0.5:
+                        self._last_infeasible_check = now
+                        try:
+                            pass_nodes = (await self.gcs.call("get_nodes", {}))["nodes"]
+                        except Exception:
+                            pass_nodes = []
+                    node = (
+                        self._pick_remote_node_from(pass_nodes, resources)
+                        if pass_nodes is not None
+                        else None
+                    )
+                    if node is not None:
+                        node["resources_available"] = {
+                            k: node["resources_available"].get(k, 0) - v
+                            for k, v in resources.items()
+                        } | {
+                            k: v
+                            for k, v in node["resources_available"].items()
+                            if k not in resources
+                        }
+                        self._queued_demand_add(resources, -1, spec)
+                        spawn(
+                            self._forward_and_resolve(spec, fut, node["node_id"])
+                        )
+                        continue
+                    tid = spec["task_id"]
+                    first = self._queued_since.setdefault(tid, now)
+                    if now - first > 30.0 and tid not in self._infeasible_warned:
+                        self._infeasible_warned.add(tid)
+                        print(
+                            f"[ray_tpu] WARNING: task {spec.get('name') or tid.hex()[:8]} "
+                            f"has been infeasible for 30s (needs {resources}); "
+                            "no node in the cluster can satisfy it — waiting "
+                            "for the autoscaler or a new node.",
+                            file=sys.stderr, flush=True,
+                        )
+                    requeue.append((spec, fut))
                     continue
                 deps = spec.get("deps") or []
                 missing = [d for d in deps if not self.store.contains_raw(d)]
@@ -773,7 +864,7 @@ class Raylet:
                 renv_hash = spec.get("runtime_env_hash")
                 bad = self._bad_runtime_envs.get(renv_hash)
                 if bad is not None and time.monotonic() - bad[1] < 60.0:
-                    self._queued_demand_add(resources, -1)
+                    self._queued_demand_add(resources, -1, spec)
                     if not fut.done():
                         fut.set_result(
                             {"status": "error",
@@ -823,7 +914,7 @@ class Raylet:
                 if not self._try_acquire_for(spec):
                     requeue.append((spec, fut))
                     continue
-                self._queued_demand_add(resources, -1)
+                self._queued_demand_add(resources, -1, spec)
                 worker.idle = False
                 worker.current_task = spec["task_id"]
                 self.inflight[spec["task_id"]] = {
@@ -857,7 +948,7 @@ class Raylet:
         try:
             await asyncio.gather(*[self._ensure_local(oid) for oid in missing])
         except Exception as e:  # noqa: BLE001
-            self._queued_demand_add(spec.get("resources", {}), -1)
+            self._queued_demand_add(spec.get("resources", {}), -1, spec)
             if not fut.done():
                 fut.set_result({"status": "error", "error": f"dependency fetch failed: {e}"})
             return
@@ -1198,11 +1289,18 @@ class Raylet:
         while True:
             await asyncio.sleep(cfg.health_check_period_s / 2)
             try:
+                # Demand bundles of queued-but-undispatched tasks feed the
+                # autoscaler's binpacking (LoadMetrics / resource_demand_
+                # scheduler in the reference). _queued_specs is stable
+                # across a dispatch pass (unlike task_queue, whose items
+                # sit in a pass-local requeue list during awaits).
+                demand = list(self._queued_specs.values())[:64]
                 await self.gcs.call(
                     "resource_update",
                     {
                         "node_id": self.node_id.binary(),
                         "available": self.resources_available,
+                        "demand_bundles": demand,
                     },
                 )
                 if self._task_events:

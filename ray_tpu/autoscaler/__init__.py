@@ -1,0 +1,5 @@
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeMultiNodeProvider,
+    NodeProvider,
+)

@@ -1,0 +1,230 @@
+"""The autoscaler control loop.
+
+Analog of the reference's ``StandardAutoscaler``
+(autoscaler/_private/autoscaler.py:171) driven by the head-node Monitor
+(_private/monitor.py:126), with the demand binpacking of
+resource_demand_scheduler.py: unmet task demand bundles are packed onto
+node types to decide scale-up; idle provider nodes past the timeout are
+drained and terminated for scale-down.
+
+TPU specifics: a node type with ``slice_hosts`` N scales in whole slices —
+N hosts are created (and terminated) together, because a partial TPU slice
+cannot run SPMD programs.
+
+Config shape (mirrors the reference's YAML ``available_node_types``):
+
+    {
+      "node_types": {
+        "cpu-worker": {"resources": {"CPU": 4}, "min_workers": 0,
+                        "max_workers": 10},
+        "v5e-slice":  {"resources": {"TPU": 4}, "slice_hosts": 4,
+                        "max_workers": 2},   # max 2 slices = 8 hosts
+      },
+      "idle_timeout_s": 60,
+    }
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.node import EventLoopThread
+from ray_tpu._private.protocol import connect
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+def _fits(bundle: Dict[str, float], free: Dict[str, float]) -> bool:
+    return all(free.get(k, 0) + 1e-9 >= v for k, v in bundle.items())
+
+
+def _claim(bundle: Dict[str, float], free: Dict[str, float]):
+    for k, v in bundle.items():
+        free[k] = free.get(k, 0) - v
+
+
+class StandardAutoscaler:
+    def __init__(
+        self,
+        config: Dict,
+        provider: NodeProvider,
+        gcs_address: str,
+        io: Optional[EventLoopThread] = None,
+    ):
+        self.config = config
+        self.provider = provider
+        self.node_types: Dict[str, dict] = config.get("node_types", {})
+        self.idle_timeout_s = config.get("idle_timeout_s", 60.0)
+        self._own_io = io is None
+        self.io = io or EventLoopThread("rt-autoscaler")
+        host, port = gcs_address.rsplit(":", 1)
+        self.gcs = self.io.run(connect(host, int(port)))
+        self._idle_since: Dict[str, float] = {}  # provider id -> ts
+        # Launched but not yet registered: count toward limits so one burst
+        # of updates doesn't over-launch.
+        self._starting: Dict[str, List[str]] = {t: [] for t in self.node_types}
+        self._warned_unplaceable: set = set()
+
+    def close(self):
+        try:
+            self.io.run(self.gcs.close(), timeout=5)
+        except Exception:
+            pass
+        if self._own_io:
+            self.io.stop()
+
+    # -- state ------------------------------------------------------------
+    def _cluster_nodes(self) -> List[dict]:
+        return self.io.run(self.gcs.call("get_nodes", {}))["nodes"]
+
+    def _provider_view(self):
+        """provider id -> {type, node_id(hex or None)}; prunes _starting."""
+        view = {}
+        for pid in self.provider.non_terminated_nodes():
+            tags = self.provider.node_tags(pid)
+            view[pid] = {
+                "type": tags.get("rt-node-type"),
+                "node_id": tags.get("rt-node-id"),
+            }
+        for t, pids in self._starting.items():
+            self._starting[t] = [p for p in pids if p in view]
+        return view
+
+    def _count_by_type(self, view) -> Dict[str, int]:
+        counts = {t: 0 for t in self.node_types}
+        for info in view.values():
+            if info["type"] in counts:
+                counts[info["type"]] += 1
+        return counts
+
+    # -- the decision step ------------------------------------------------
+    def update(self) -> Dict[str, int]:
+        """One reconcile pass. Returns {node_type: hosts_launched}."""
+        nodes = self._cluster_nodes()
+        alive = [n for n in nodes if n["state"] == "ALIVE"]
+        view = self._provider_view()
+        launched: Dict[str, int] = {}
+
+        # ---- scale up: binpack unmet demand --------------------------
+        free_per_node = [dict(n.get("resources_available", {})) for n in alive]
+        unmet: List[Dict[str, float]] = []
+        for n in alive:
+            for bundle in n.get("demand_bundles", []) or []:
+                placed = False
+                for free in free_per_node:
+                    if _fits(bundle, free):
+                        _claim(bundle, free)
+                        placed = True
+                        break
+                if not placed:
+                    unmet.append(bundle)
+
+        if unmet:
+            counts = self._count_by_type(view)
+            # Pending capacity from still-starting nodes absorbs demand.
+            pending_free = []
+            for t, pids in self._starting.items():
+                spec = self.node_types.get(t, {})
+                for pid in pids:
+                    if view.get(pid, {}).get("node_id") is None:
+                        pending_free.append(dict(spec.get("resources", {})))
+            to_launch: Dict[str, int] = {}
+            for bundle in unmet:
+                placed = False
+                for free in pending_free:
+                    if _fits(bundle, free):
+                        _claim(bundle, free)
+                        placed = True
+                        break
+                if placed:
+                    continue
+                for t, spec in self.node_types.items():
+                    res = spec.get("resources", {})
+                    if not _fits(bundle, dict(res)):
+                        continue
+                    slice_hosts = spec.get("slice_hosts", 1)
+                    in_use = counts.get(t, 0) + to_launch.get(t, 0) * slice_hosts
+                    max_hosts = spec.get("max_workers", 2**31) * slice_hosts
+                    if in_use + slice_hosts > max_hosts:
+                        continue
+                    to_launch[t] = to_launch.get(t, 0) + 1
+                    free = dict(res)
+                    _claim(bundle, free)
+                    pending_free.append(free)
+                    for _ in range(slice_hosts - 1):
+                        pending_free.append(dict(res))
+                    placed = True
+                    break
+                if not placed:
+                    key = tuple(sorted(bundle.items()))
+                    if key not in self._warned_unplaceable:
+                        self._warned_unplaceable.add(key)
+                        import sys
+
+                        print(
+                            f"[ray_tpu autoscaler] WARNING: demand {bundle} "
+                            "fits no configured node type (or all types are "
+                            "at max_workers); the task will stay pending.",
+                            file=sys.stderr, flush=True,
+                        )
+            for t, groups in to_launch.items():
+                spec = self.node_types[t]
+                n_hosts = groups * spec.get("slice_hosts", 1)
+                pids = self.provider.create_node(t, spec, n_hosts)
+                self._starting.setdefault(t, []).extend(pids)
+                launched[t] = launched.get(t, 0) + n_hosts
+
+        # ---- enforce min_workers -------------------------------------
+        counts = self._count_by_type(self._provider_view())
+        for t, spec in self.node_types.items():
+            slice_hosts = spec.get("slice_hosts", 1)
+            min_hosts = spec.get("min_workers", 0) * slice_hosts
+            if counts.get(t, 0) < min_hosts:
+                need = min_hosts - counts.get(t, 0)
+                pids = self.provider.create_node(t, spec, need)
+                self._starting.setdefault(t, []).extend(pids)
+                launched[t] = launched.get(t, 0) + need
+
+        # ---- scale down: idle past timeout ---------------------------
+        by_node_id = {n["node_id"].hex() if isinstance(n["node_id"], bytes)
+                      else n["node_id"]: n for n in alive}
+        now = time.monotonic()
+        view = self._provider_view()
+        counts = self._count_by_type(view)
+        for pid, info in view.items():
+            node = by_node_id.get(info.get("node_id") or "")
+            if node is None:
+                continue  # still starting
+            idle = (
+                not node.get("demand_bundles")
+                and node.get("resources_available") == node.get("resources_total")
+            )
+            if not idle:
+                self._idle_since.pop(pid, None)
+                continue
+            first = self._idle_since.setdefault(pid, now)
+            spec = self.node_types.get(info["type"] or "", {})
+            slice_hosts = spec.get("slice_hosts", 1)
+            min_hosts = spec.get("min_workers", 0) * slice_hosts
+            if (
+                now - first > self.idle_timeout_s
+                and counts.get(info["type"], 0) - 1 >= min_hosts
+            ):
+                self._drain_and_terminate(pid, info)
+                counts[info["type"]] = counts.get(info["type"], 0) - 1
+        return launched
+
+    def _drain_and_terminate(self, pid: str, info: dict):
+        node_id = info.get("node_id")
+        if node_id:
+            try:
+                self.io.run(
+                    self.gcs.call(
+                        "drain_node", {"node_id": bytes.fromhex(node_id)}
+                    ),
+                    timeout=10,
+                )
+            except Exception:
+                pass
+        self.provider.terminate_node(pid)
+        self._idle_since.pop(pid, None)

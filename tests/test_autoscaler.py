@@ -1,0 +1,151 @@
+"""Autoscaler tests with the fake (in-process) node provider.
+
+Reference analogs: python/ray/tests/test_autoscaler_fake_multinode.py and
+the resource-demand binpacking tests of test_resource_demand_scheduler.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.autoscaler import FakeMultiNodeProvider, StandardAutoscaler
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def scaling_cluster():
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # head
+    cluster.connect()
+    provider = FakeMultiNodeProvider(cluster.io, "127.0.0.1", cluster.gcs_port)
+    yield cluster, provider
+    cluster.shutdown()
+
+
+def _wait(fn, timeout=30.0, poll=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(poll)
+    raise TimeoutError("condition not met")
+
+
+def test_scale_up_on_demand(scaling_cluster):
+    cluster, provider = scaling_cluster
+    autoscaler = StandardAutoscaler(
+        {
+            "node_types": {
+                "worker": {"resources": {"CPU": 2}, "max_workers": 4},
+            },
+            "idle_timeout_s": 9999,
+        },
+        provider,
+        f"127.0.0.1:{cluster.gcs_port}",
+        io=cluster.io,
+    )
+
+    @rt.remote(num_cpus=2)
+    def heavy():
+        time.sleep(0.5)
+        return 1
+
+    # Head has 1 CPU: these 2-CPU tasks are infeasible until workers join.
+    refs = [heavy.remote() for _ in range(4)]
+    time.sleep(1.2)  # demand bundles reach the GCS via heartbeat
+
+    launched = autoscaler.update()
+    assert launched.get("worker", 0) >= 1
+    assert rt.get(refs, timeout=60) == [1, 1, 1, 1]
+
+    # Second pass with no pending demand launches nothing.
+    time.sleep(1.2)
+    assert autoscaler.update() == {}
+    assert len(provider.non_terminated_nodes()) <= 4
+
+
+def test_scale_up_respects_max_workers(scaling_cluster):
+    cluster, provider = scaling_cluster
+    autoscaler = StandardAutoscaler(
+        {"node_types": {"worker": {"resources": {"CPU": 2}, "max_workers": 1}},
+         "idle_timeout_s": 9999},
+        provider,
+        f"127.0.0.1:{cluster.gcs_port}",
+        io=cluster.io,
+    )
+
+    @rt.remote(num_cpus=2)
+    def heavy():
+        time.sleep(0.2)
+        return 1
+
+    refs = [heavy.remote() for _ in range(6)]
+    time.sleep(1.2)
+    autoscaler.update()
+    time.sleep(1.2)
+    autoscaler.update()
+    assert len(provider.non_terminated_nodes()) == 1
+    rt.get(refs, timeout=120)
+
+
+def test_min_workers_and_idle_scale_down(scaling_cluster):
+    cluster, provider = scaling_cluster
+    autoscaler = StandardAutoscaler(
+        {"node_types": {"worker": {"resources": {"CPU": 2}, "min_workers": 1,
+                                    "max_workers": 3}},
+         "idle_timeout_s": 0.5},
+        provider,
+        f"127.0.0.1:{cluster.gcs_port}",
+        io=cluster.io,
+    )
+    # min_workers=1 launches a worker with no demand at all.
+    launched = autoscaler.update()
+    assert launched.get("worker") == 1
+
+    @rt.remote(num_cpus=2)
+    def heavy():
+        time.sleep(0.3)
+        return 1
+
+    refs = [heavy.remote() for _ in range(4)]
+    time.sleep(1.2)
+    autoscaler.update()
+    n_peak = len(provider.non_terminated_nodes())
+    assert n_peak >= 1
+    rt.get(refs, timeout=60)
+
+    # After the work drains, idle nodes terminate down to min_workers.
+    def scaled_down():
+        time.sleep(0.6)
+        autoscaler.update()
+        return len(provider.non_terminated_nodes()) == 1
+
+    _wait(scaled_down, timeout=30)
+
+
+def test_tpu_slice_scales_whole_slices(scaling_cluster):
+    """A slice node type launches slice_hosts hosts atomically."""
+    cluster, provider = scaling_cluster
+    autoscaler = StandardAutoscaler(
+        {"node_types": {
+            "v5e-slice": {"resources": {"TPU": 4, "CPU": 1},
+                           "slice_hosts": 4, "max_workers": 2}},
+         "idle_timeout_s": 9999},
+        provider,
+        f"127.0.0.1:{cluster.gcs_port}",
+        io=cluster.io,
+    )
+
+    @rt.remote(num_tpus=4, num_cpus=0)
+    def tpu_task():
+        return 1
+
+    ref = tpu_task.remote()
+    time.sleep(1.2)
+    launched = autoscaler.update()
+    # One unmet TPU bundle still scales a whole 4-host slice.
+    assert launched.get("v5e-slice") == 4
+    assert len(provider.non_terminated_nodes()) == 4
+    assert rt.get(ref, timeout=60) == 1
