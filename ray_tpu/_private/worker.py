@@ -174,6 +174,12 @@ class ActorMethod:
         )
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG composition (reference: dag/class_node.py)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor methods cannot be called directly; use "
@@ -794,6 +800,16 @@ class CoreClient:
                 f"actor {actor_id.hex()} is dead: {info.get('death_cause')}"
             )
         return info
+
+    def actor_raw_call(self, actor_id, method: str, payload,
+                       timeout: float = 30.0):
+        """Low-level RPC to the worker hosting an actor (compiled-DAG
+        control: dag_start/dag_stop)."""
+        if isinstance(actor_id, (bytes, bytearray)):
+            actor_id = ActorID(actor_id)
+        info = self._actor_info(actor_id)
+        conn = self._actor_conn(info)
+        return self._run(conn.call(method, payload, timeout=None), timeout=timeout)
 
     def _actor_conn(self, info) -> Connection:
         key = (info["address"], info["port"])

@@ -58,7 +58,10 @@ class WorkerProcess:
         self.store_name = os.environ["RT_STORE_NAME"]
         self.rpc = RpcServer("127.0.0.1", 0)
         self.rpc.register("actor_call", self.h_actor_call)
+        self.rpc.register("dag_start", self.h_dag_start)
+        self.rpc.register("dag_stop", self.h_dag_stop)
         self.rpc.register("ping", self.h_ping)
+        self._dag_loops: list = []  # (thread, stop_event)
         self.client: Optional[CoreClient] = None
         self.raylet_conn = None
         self.actor: Optional[ActorState] = None
@@ -276,9 +279,18 @@ class WorkerProcess:
         def do_call():
             method = getattr(actor.instance, d["method"])
             args, kwargs = self.client.deserialize_args(d["args"])
-            if inspect.iscoroutinefunction(method):
-                return asyncio.run(method(*args, **kwargs))
-            return method(*args, **kwargs)
+
+            def invoke():
+                if inspect.iscoroutinefunction(method):
+                    return asyncio.run(method(*args, **kwargs))
+                return method(*args, **kwargs)
+
+            if actor.max_concurrency == 1:
+                # Shares the state lock with compiled-DAG loops so stages
+                # and regular calls never mutate actor state concurrently.
+                with actor.lock:
+                    return invoke()
+            return invoke()
 
         try:
             value = await self.loop.run_in_executor(self.executor, do_call)
@@ -293,6 +305,123 @@ class WorkerProcess:
         except BaseException as e:  # noqa: BLE001
             self._record_task_event(d["task_id"], d["method"], "FAILED")
             return make_task_error(e)
+
+    # -- compiled DAG resident loop (do_exec_compiled_task analog,
+    # dag/compiled_dag_node.py:34) ---------------------------------------
+    async def h_dag_start(self, d, conn):
+        actor = self.actor
+        if actor is None or actor.actor_id != d["actor_id"]:
+            return {"ok": False, "error": "actor not hosted by this worker"}
+        try:
+            stages = self._bind_dag_stages(d["stages"], actor.instance)
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        stop = threading.Event()
+        loop_id = os.urandom(8).hex()
+        # Serialize stages with regular actor calls on single-threaded
+        # actors: both paths take the actor's state lock.
+        lock = actor.lock if actor.max_concurrency == 1 else None
+        t = threading.Thread(
+            target=self._dag_loop, args=(stages, stop, lock), daemon=True,
+            name="rt-dag-loop",
+        )
+        t.start()
+        self._dag_loops.append((loop_id, t, stop))
+        return {"ok": True, "loop_id": loop_id}
+
+    async def h_dag_stop(self, d, conn):
+        target = d.get("loop_id")
+        for loop_id, _, stop in self._dag_loops:
+            if target is None or loop_id == target:
+                stop.set()
+        self._dag_loops = [
+            (lid, t, s) for lid, t, s in self._dag_loops if t.is_alive()
+        ]
+        return {"ok": True}
+
+    @staticmethod
+    def _bind_dag_stages(stage_specs, instance):
+        import pickle
+
+        from ray_tpu.experimental.channel import Channel
+
+        stages = []
+        for spec in stage_specs:
+            args = []
+            for a in spec["args"]:
+                if a["kind"] == "chan":
+                    args.append(Channel(name=a["name"]))
+                else:
+                    args.append(("const", pickle.loads(a["value"])))
+            kwargs = {}
+            for k, v in spec["kwargs"].items():
+                if v["kind"] == "chan":
+                    kwargs[k] = Channel(name=v["name"])
+                else:
+                    kwargs[k] = ("const", pickle.loads(v["value"]))
+            stages.append(
+                {
+                    "method": getattr(instance, spec["method"]),
+                    "args": args,
+                    "kwargs": kwargs,
+                    "outs": [Channel(name=n) for n in spec["out_channels"]],
+                }
+            )
+        return stages
+
+    @staticmethod
+    def _dag_loop(stages, stop: threading.Event, state_lock=None):
+        from ray_tpu.dag.compiled_dag import _StageError
+        from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+        def read_arg(a):
+            if isinstance(a, Channel):
+                while True:
+                    try:
+                        return a.read(timeout=0.5)
+                    except TimeoutError:
+                        if stop.is_set():
+                            raise ChannelClosed(a.name) from None
+            return a[1]  # ("const", value)
+
+        try:
+            while not stop.is_set():
+                for stage in stages:
+                    args = [read_arg(a) for a in stage["args"]]
+                    kwargs = {k: read_arg(v) for k, v in stage["kwargs"].items()}
+                    err = next(
+                        (x for x in [*args, *kwargs.values()]
+                         if isinstance(x, _StageError)),
+                        None,
+                    )
+                    if err is not None:
+                        value = err  # propagate without executing
+                    else:
+                        try:
+                            if state_lock is not None:
+                                with state_lock:
+                                    value = stage["method"](*args, **kwargs)
+                            else:
+                                value = stage["method"](*args, **kwargs)
+                        except BaseException as e:  # noqa: BLE001
+                            value = _StageError(e)
+                    for out in stage["outs"]:
+                        while True:
+                            try:
+                                out.write(value, timeout=0.5)
+                                break
+                            except TimeoutError:
+                                if stop.is_set():
+                                    raise ChannelClosed(out.name) from None
+        except ChannelClosed:
+            pass
+        finally:
+            for stage in stages:
+                for a in [*stage["args"], *stage["kwargs"].values()]:
+                    if isinstance(a, Channel):
+                        a.detach()
+                for out in stage["outs"]:
+                    out.detach()
 
     async def h_ping(self, d, conn):
         return {"pong": True, "actor": self.actor is not None}

@@ -1,0 +1,8 @@
+from ray_tpu.dag.dag_node import (  # noqa: F401
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled_dag import CompiledDAG  # noqa: F401

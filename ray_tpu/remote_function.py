@@ -69,6 +69,12 @@ class RemoteFunction:
         merged = {**self._options, **new_options}
         return RemoteFunction(self._function, **merged)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG composition (reference: dag/function_node.py)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function {self._function.__name__!r} cannot be called "

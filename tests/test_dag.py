@@ -1,0 +1,159 @@
+"""DAG + compiled DAG tests.
+
+Reference analogs: python/ray/dag/tests and
+python/ray/tests/test_accelerated_dag.py (channels, resident exec loops,
+error propagation).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+
+def test_channel_roundtrip():
+    ch = Channel(create=True, max_size=1_000_000)
+    try:
+        ch.write({"x": 1})
+        assert ch.read() == {"x": 1}
+        ch.write([1, 2, 3])
+        assert ch.read() == [1, 2, 3]
+        with pytest.raises(ValueError):
+            ch.write(b"x" * 2_000_000)
+        ch.close()
+        with pytest.raises(ChannelClosed):
+            ch.write(1)
+    finally:
+        ch.destroy()
+
+
+def test_eager_task_dag(rt_start):
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), double.bind(inp))
+    assert dag.execute(5) == 20
+    assert dag.execute(7) == 28
+
+
+def test_eager_actor_dag(rt_start):
+    @rt.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    acc = Acc.remote()
+    with InputNode() as inp:
+        dag = acc.add.bind(inp)
+    assert dag.execute(3) == 3
+    assert dag.execute(4) == 7  # stateful across executes
+
+
+def test_compiled_chain(rt_start):
+    @rt.remote
+    class Stage:
+        def __init__(self, mul):
+            self.mul = mul
+
+        def fwd(self, x):
+            return x * self.mul
+
+    s1, s2 = Stage.remote(2), Stage.remote(10)
+    with InputNode() as inp:
+        dag = s2.fwd.bind(s1.fwd.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(10):
+            assert compiled.execute(i) == i * 20
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_fan_out_fan_in(rt_start):
+    @rt.remote
+    class Worker:
+        def sq(self, x):
+            return x * x
+
+        def neg(self, x):
+            return -x
+
+    a, b = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.sq.bind(inp), b.neg.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(4) == [16, -4]
+        assert compiled.execute(5) == [25, -5]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_error_propagates(rt_start):
+    @rt.remote
+    class Boomer:
+        def go(self, x):
+            if x == 13:
+                raise ValueError("unlucky")
+            return x
+
+        def fwd(self, x):
+            return x
+
+    actor = Boomer.remote()
+    with InputNode() as inp:
+        dag = actor.fwd.bind(actor.go.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1) == 1
+        with pytest.raises(rt.exceptions.TaskError):
+            compiled.execute(13)
+        # The pipeline survives an error and keeps executing.
+        assert compiled.execute(2) == 2
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_throughput_faster_than_actor_calls(rt_start):
+    """The point of compilation: repeat execution beats per-call RPC."""
+
+    @rt.remote
+    class Echo:
+        def fwd(self, x):
+            return x
+
+    actor = Echo.remote()
+    rt.get(actor.fwd.remote(0))  # warm
+
+    n = 200
+    t0 = time.perf_counter()
+    for i in range(n):
+        rt.get(actor.fwd.remote(i))
+    eager_s = time.perf_counter() - t0
+
+    with InputNode() as inp:
+        dag = actor.fwd.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        compiled.execute(0)  # warm the loop
+        t0 = time.perf_counter()
+        for i in range(n):
+            assert compiled.execute(i) == i
+        compiled_s = time.perf_counter() - t0
+    finally:
+        compiled.teardown()
+    # Shared-memory handoff must beat the RPC path comfortably.
+    assert compiled_s < eager_s, (compiled_s, eager_s)
