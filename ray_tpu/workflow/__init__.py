@@ -1,0 +1,206 @@
+"""Durable workflows: DAG execution with storage-backed step checkpoints.
+
+Analog of the reference's ``ray.workflow`` (workflow/api.py:123 run,
+workflow_executor.py, workflow_state_from_dag.py): a task DAG executes
+step by step, each step's result is checkpointed to durable storage, and
+``resume()`` re-runs a failed/interrupted workflow skipping every step
+whose checkpoint exists.
+
+Storage layout (one directory per workflow under the storage root):
+    <root>/<workflow_id>/status.json
+    <root>/<workflow_id>/input.pkl
+    <root>/<workflow_id>/steps/<step_key>.pkl
+
+Step keys are stable across runs: the function's qualname plus its
+position in the deterministic topological order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import DAGNode, FunctionNode, InputNode
+
+_DEFAULT_ROOT = os.path.join(
+    os.path.expanduser("~"), ".ray_tpu", "workflows"
+)
+
+
+def _root(storage: Optional[str]) -> str:
+    return storage or os.environ.get("RT_WORKFLOW_STORAGE") or _DEFAULT_ROOT
+
+
+def _wf_dir(workflow_id: str, storage: Optional[str]) -> str:
+    return os.path.join(_root(storage), workflow_id)
+
+
+def _step_key(node: DAGNode, index: int) -> str:
+    if isinstance(node, FunctionNode):
+        name = getattr(node._remote_fn, "__qualname__", "fn")
+    else:
+        name = type(node).__name__
+    return f"{index:04d}-{name.replace('/', '_').replace('<', '').replace('>', '')}"
+
+
+def _write_status(d: str, **fields):
+    path = os.path.join(d, "status.json")
+    status = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            status = json.load(f)
+    status.update(fields)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(status, f)
+    os.replace(tmp, path)
+
+
+def _read_status(d: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(d, "status.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class WorkflowError(Exception):
+    pass
+
+
+def _execute(dag: DAGNode, wf_dir: str, input_value, max_step_retries: int):
+    import ray_tpu as rt
+
+    steps_dir = os.path.join(wf_dir, "steps")
+    os.makedirs(steps_dir, exist_ok=True)
+    topo = dag._topo()
+    resolved: Dict[int, Any] = {}
+    for index, node in enumerate(topo):
+        if isinstance(node, InputNode):
+            resolved[node._id] = input_value
+            continue
+        if not isinstance(node, FunctionNode):
+            raise WorkflowError(
+                "workflows support task (function) DAGs; actor nodes hold "
+                "process state that cannot be checkpoint-resumed"
+            )
+        key = _step_key(node, index)
+        ckpt = os.path.join(steps_dir, key + ".pkl")
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                resolved[node._id] = pickle.load(f)
+            continue
+        args, kwargs = node._resolve_args(resolved)
+        last_exc = None
+        for _ in range(max_step_retries + 1):
+            try:
+                value = rt.get(node._remote_fn.remote(*args, **kwargs))
+                break
+            except Exception as e:  # noqa: BLE001
+                last_exc = e
+        else:
+            raise WorkflowError(f"step {key} failed: {last_exc}") from last_exc
+        tmp = ckpt + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f, protocol=5)
+        os.replace(tmp, ckpt)  # atomic: a step is either durable or absent
+        _write_status(wf_dir, last_step=key, updated_at=time.time())
+        resolved[node._id] = value
+    return resolved[dag._id]
+
+
+def run(
+    dag: DAGNode,
+    *args,
+    workflow_id: Optional[str] = None,
+    storage: Optional[str] = None,
+    max_step_retries: int = 3,
+):
+    """Execute a DAG durably; returns the final result.
+
+    Reference: workflow.run (workflow/api.py:123).
+    """
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    wf_dir = _wf_dir(workflow_id, storage)
+    os.makedirs(wf_dir, exist_ok=True)
+    input_value = args[0] if args else None
+    with open(os.path.join(wf_dir, "input.pkl"), "wb") as f:
+        pickle.dump(input_value, f, protocol=5)
+    with open(os.path.join(wf_dir, "dag.pkl"), "wb") as f:
+        import cloudpickle
+
+        cloudpickle.dump(dag, f)
+    _write_status(wf_dir, workflow_id=workflow_id, state="RUNNING",
+                  created_at=time.time())
+    try:
+        result = _execute(dag, wf_dir, input_value, max_step_retries)
+    except BaseException as e:
+        _write_status(wf_dir, state="FAILED", error=str(e))
+        raise
+    with open(os.path.join(wf_dir, "output.pkl"), "wb") as f:
+        pickle.dump(result, f, protocol=5)
+    _write_status(wf_dir, state="SUCCEEDED", finished_at=time.time())
+    return result
+
+
+def resume(workflow_id: str, storage: Optional[str] = None,
+           max_step_retries: int = 3):
+    """Re-run a workflow, skipping checkpointed steps
+    (workflow.resume in the reference)."""
+    wf_dir = _wf_dir(workflow_id, storage)
+    status = _read_status(wf_dir)
+    if status is None:
+        raise WorkflowError(f"no such workflow: {workflow_id}")
+    if status.get("state") == "SUCCEEDED":
+        with open(os.path.join(wf_dir, "output.pkl"), "rb") as f:
+            return pickle.load(f)
+    import cloudpickle
+
+    with open(os.path.join(wf_dir, "dag.pkl"), "rb") as f:
+        dag = cloudpickle.load(f)
+    with open(os.path.join(wf_dir, "input.pkl"), "rb") as f:
+        input_value = pickle.load(f)
+    _write_status(wf_dir, state="RUNNING")
+    try:
+        result = _execute(dag, wf_dir, input_value, max_step_retries)
+    except BaseException as e:
+        _write_status(wf_dir, state="FAILED", error=str(e))
+        raise
+    with open(os.path.join(wf_dir, "output.pkl"), "wb") as f:
+        pickle.dump(result, f, protocol=5)
+    _write_status(wf_dir, state="SUCCEEDED", finished_at=time.time())
+    return result
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None) -> Optional[str]:
+    status = _read_status(_wf_dir(workflow_id, storage))
+    return status.get("state") if status else None
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None):
+    wf_dir = _wf_dir(workflow_id, storage)
+    path = os.path.join(wf_dir, "output.pkl")
+    if not os.path.exists(path):
+        raise WorkflowError(f"workflow {workflow_id} has no output yet")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def list_all(storage: Optional[str] = None) -> List[dict]:
+    root = _root(storage)
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for wid in sorted(os.listdir(root)):
+        status = _read_status(os.path.join(root, wid))
+        if status:
+            out.append(status)
+    return out
+
+
+def delete(workflow_id: str, storage: Optional[str] = None):
+    shutil.rmtree(_wf_dir(workflow_id, storage), ignore_errors=True)

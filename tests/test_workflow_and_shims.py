@@ -1,0 +1,125 @@
+"""Workflow durability + multiprocessing/joblib/iter shim tests.
+
+Reference analogs: python/ray/workflow/tests, util/multiprocessing tests,
+util/joblib tests, util/iter tests.
+"""
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.dag import InputNode
+
+
+calls = {"n": 0}
+
+
+def test_workflow_run_and_resume(rt_start, tmp_path):
+    from ray_tpu import workflow
+
+    storage = str(tmp_path / "wf")
+
+    @rt.remote
+    def ingest(x):
+        return list(range(x))
+
+    @rt.remote
+    def total(xs):
+        return sum(xs)
+
+    @rt.remote
+    def must_fail_once(t, flag_path=str(tmp_path / "flag")):
+        import os
+
+        if not os.path.exists(flag_path):
+            open(flag_path, "w").close()
+            raise RuntimeError("transient")
+        return t * 10
+
+    with InputNode() as inp:
+        dag = must_fail_once.bind(total.bind(ingest.bind(inp)))
+
+    # First run: the last step fails once, then retries succeed.
+    out = workflow.run(dag, 5, workflow_id="wf-1", storage=storage)
+    assert out == 100  # sum(range(5)) * 10
+
+    assert workflow.get_status("wf-1", storage=storage) == "SUCCEEDED"
+    assert workflow.get_output("wf-1", storage=storage) == 100
+    # Resume of a finished workflow returns the stored output.
+    assert workflow.resume("wf-1", storage=storage) == 100
+    assert any(w["workflow_id"] == "wf-1" for w in workflow.list_all(storage))
+    workflow.delete("wf-1", storage=storage)
+    assert workflow.get_status("wf-1", storage=storage) is None
+
+
+def test_workflow_resume_skips_completed_steps(rt_start, tmp_path):
+    from ray_tpu import workflow
+
+    storage = str(tmp_path / "wf2")
+    marker = tmp_path / "count"
+    marker.write_text("0")
+
+    @rt.remote
+    def counted(x, path=str(marker)):
+        n = int(open(path).read()) + 1
+        open(path, "w").write(str(n))
+        return x + 1
+
+    @rt.remote
+    def boom(x, arm_path=str(tmp_path / "armed")):
+        import os
+
+        if os.path.exists(arm_path):
+            return x * 2
+        raise RuntimeError("not armed yet")
+
+    with InputNode() as inp:
+        dag = boom.bind(counted.bind(inp))
+
+    with pytest.raises(workflow.WorkflowError):
+        workflow.run(dag, 1, workflow_id="wf-2", storage=storage,
+                     max_step_retries=0)
+    assert workflow.get_status("wf-2", storage=storage) == "FAILED"
+    assert marker.read_text() == "1"  # first step ran once and checkpointed
+
+    (tmp_path / "armed").write_text("")  # arm the second step
+    out = workflow.resume("wf-2", storage=storage, max_step_retries=0)
+    assert out == 4
+    # The checkpointed first step did NOT re-run.
+    assert marker.read_text() == "1"
+
+
+def _sq(x):
+    return x * x
+
+
+def test_multiprocessing_pool(rt_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert pool.apply(_sq, (7,)) == 49
+        ar = pool.apply_async(_sq, (8,))
+        assert ar.get(timeout=30) == 64
+        assert pool.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert sorted(pool.imap_unordered(_sq, [1, 2, 3])) == [1, 4, 9]
+
+
+def test_joblib_backend(rt_start):
+    import joblib
+
+    from ray_tpu.util.joblib import register_rt
+
+    register_rt()
+    with joblib.parallel_backend("rt"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(_sq)(i) for i in range(8)
+        )
+    assert out == [i * i for i in range(8)]
+
+
+def test_parallel_iterator(rt_start):
+    from ray_tpu.util import iter as rt_iter
+
+    it = rt_iter.from_range(10, num_shards=3)
+    out = it.for_each(lambda x: x * 2).filter(lambda x: x % 4 == 0).gather_sync()
+    assert sorted(out) == [0, 4, 8, 12, 16]
