@@ -166,7 +166,7 @@ def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
 
     def body(x, lp):
         # x: [B, L, D]
-        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, use_pallas=False)
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         b, l, d = h.shape
         q = (h @ lp["wq"]).reshape(b, l, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"]).reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
@@ -176,7 +176,7 @@ def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
         attn = _attention(cfg, q, k, v, mesh, positions)
         x = x + (attn.reshape(b, l, -1) @ lp["wo"]).astype(x.dtype)
 
-        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, use_pallas=False)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.num_experts == 0:
             gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
             up = (h @ lp["w_up"]).astype(jnp.float32)
@@ -219,7 +219,7 @@ def forward(
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     body = _layer_fn(cfg, mesh, cos, sin, positions)
     x, auxes = jax.lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, use_pallas=False)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"]
     return logits, auxes.sum()
 

@@ -53,15 +53,30 @@ def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
     o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _rmsnorm_pallas(x, w, eps, block_rows: int = 256, interpret: bool = False):
+def _rmsnorm_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dwp_ref, *, eps: float):
+    """Row-local dx plus a per-block partial dw (summed by the caller).
+
+    The normalizer is recomputed from x (rematerialized, as the fwd kernel
+    saves nothing), so the backward reads the same inputs as the forward.
+    """
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = x * inv
+    gw = g * w
+    dx = inv * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    dwp_ref[:] = jnp.sum(g * xhat, axis=0)
+
+
+def _rmsnorm_pallas_fwd2(x2, w, eps, block_rows, interpret):
     from jax.experimental import pallas as pl
 
-    orig_shape = x.shape
-    d = x.shape[-1]
-    rows = int(np_prod(orig_shape[:-1]))
-    x2 = x.reshape(rows, d)
+    rows, d = x2.shape
     block_rows = min(block_rows, rows)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_rmsnorm_kernel, eps=eps),
         grid=(pl.cdiv(rows, block_rows),),
         in_specs=[
@@ -69,9 +84,67 @@ def _rmsnorm_pallas(x, w, eps, block_rows: int = 256, interpret: bool = False):
             pl.BlockSpec((d,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x2.dtype),
         interpret=interpret,
     )(x2, w)
+
+
+def _rmsnorm_pallas_bwd2(x2, w, g2, eps, block_rows, interpret):
+    from jax.experimental import pallas as pl
+
+    rows, d = x2.shape
+    block_rows = min(block_rows, rows)
+    nblocks = -(-rows // block_rows)
+    # Zero-pad a partial tail block: padded rows give g*xhat = 0, so the
+    # per-block dw partial sums defined zeros instead of out-of-bounds
+    # garbage (real-TPU OOB block contents are undefined).
+    rows_pad = nblocks * block_rows
+    if rows_pad != rows:
+        x2 = jnp.pad(x2, ((0, rows_pad - rows), (0, 0)))
+        g2 = jnp.pad(g2, ((0, rows_pad - rows), (0, 0)))
+    dx, dw_partial = pl.pallas_call(
+        functools.partial(_rmsnorm_bwd_kernel, eps=eps),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((None, d), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, d), x2.dtype),
+            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w, g2)
+    return dx[:rows], dw_partial.sum(axis=0).astype(w.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rmsnorm_pallas_core(x2, w, eps, block_rows, interpret):
+    return _rmsnorm_pallas_fwd2(x2, w, eps, block_rows, interpret)
+
+
+def _pallas_core_fwd(x2, w, eps, block_rows, interpret):
+    return _rmsnorm_pallas_fwd2(x2, w, eps, block_rows, interpret), (x2, w)
+
+
+def _pallas_core_bwd(eps, block_rows, interpret, res, g):
+    x2, w = res
+    return _rmsnorm_pallas_bwd2(x2, w, g, eps, block_rows, interpret)
+
+
+_rmsnorm_pallas_core.defvjp(_pallas_core_fwd, _pallas_core_bwd)
+
+
+def _rmsnorm_pallas(x, w, eps, block_rows: int = 256, interpret: bool = False):
+    orig_shape = x.shape
+    d = x.shape[-1]
+    rows = int(np_prod(orig_shape[:-1]))
+    out = _rmsnorm_pallas_core(x.reshape(rows, d), w, eps, block_rows, interpret)
     return out.reshape(orig_shape)
 
 
