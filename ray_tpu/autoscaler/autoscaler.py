@@ -63,7 +63,12 @@ class StandardAutoscaler:
         # Launched but not yet registered: count toward limits so one burst
         # of updates doesn't over-launch.
         self._starting: Dict[str, List[str]] = {t: [] for t in self.node_types}
+        # Slice membership for slice_hosts>1 types: type -> list of pid
+        # groups created together. Scale-down is slice-atomic: a group is
+        # terminated only when every host in it has idled past the timeout.
+        self._slice_groups: Dict[str, List[List[str]]] = {}
         self._warned_unplaceable: set = set()
+        self._warned_untracked_slice: set = set()
 
     def close(self):
         try:
@@ -169,9 +174,11 @@ class StandardAutoscaler:
                         )
             for t, groups in to_launch.items():
                 spec = self.node_types[t]
-                n_hosts = groups * spec.get("slice_hosts", 1)
+                slice_hosts = spec.get("slice_hosts", 1)
+                n_hosts = groups * slice_hosts
                 pids = self.provider.create_node(t, spec, n_hosts)
                 self._starting.setdefault(t, []).extend(pids)
+                self._record_slices(t, slice_hosts, pids)
                 launched[t] = launched.get(t, 0) + n_hosts
 
         # ---- enforce min_workers -------------------------------------
@@ -180,9 +187,12 @@ class StandardAutoscaler:
             slice_hosts = spec.get("slice_hosts", 1)
             min_hosts = spec.get("min_workers", 0) * slice_hosts
             if counts.get(t, 0) < min_hosts:
+                # Round up to whole slices: a partial slice is useless.
                 need = min_hosts - counts.get(t, 0)
+                need = -(-need // slice_hosts) * slice_hosts
                 pids = self.provider.create_node(t, spec, need)
                 self._starting.setdefault(t, []).extend(pids)
+                self._record_slices(t, slice_hosts, pids)
                 launched[t] = launched.get(t, 0) + need
 
         # ---- scale down: idle past timeout ---------------------------
