@@ -86,13 +86,18 @@ def main():
 
     for _ in range(warmup):
         params, opt_state, loss = jstep(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    # On remote-tunneled TPU platforms block_until_ready can return before
+    # execution finishes; a device_get of the scalar loss is a true sync.
+    jax.device_get(loss)
+    t0 = time.perf_counter()
+    jax.device_get(loss)
+    round_trip = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = jstep(params, opt_state, tokens)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    jax.device_get(loss)
+    dt = max(time.perf_counter() - t0 - round_trip, 1e-9)
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * steps / dt

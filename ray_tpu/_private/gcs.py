@@ -631,6 +631,17 @@ class GcsServer:
             await asyncio.wait_for(ev.wait(), timeout)
         except asyncio.TimeoutError:
             return {"nodes": [], "size": 0, "timeout": True}
+        finally:
+            # Clients probing a never-produced object every few seconds
+            # would otherwise grow the waiter list without bound.
+            waiters = self.object_waiters.get(oid)
+            if waiters is not None:
+                try:
+                    waiters.remove(ev)
+                except ValueError:
+                    pass
+                if not waiters:
+                    self.object_waiters.pop(oid, None)
         entry = self.object_dir.get(oid, {"nodes": set(), "size": 0})
         return self._loc_view(entry)
 

@@ -469,6 +469,17 @@ class Raylet:
             # The driver's ray_tpu.init() picks this up so the job's own
             # tasks/actors inherit the job runtime env.
             env["RT_JOB_RUNTIME_ENV"] = _json.dumps(renv)
+        if submission_id in self._job_stops:
+            # A stop arrived while the runtime env was materializing (the
+            # proc was not in self._jobs yet): honor it instead of running
+            # the driver to completion and reporting SUCCEEDED.
+            self._job_stops.discard(submission_id)
+            await self.gcs.call(
+                "job_update",
+                {"submission_id": submission_id, "state": "STOPPED",
+                 "message": "stopped before start"},
+            )
+            return
         try:
             proc = subprocess.Popen(
                 payload["entrypoint"],
@@ -487,29 +498,45 @@ class Raylet:
             )
             return
         self._jobs[submission_id] = proc
+        if submission_id in self._job_stops:
+            # Stop raced the Popen window: kill the fresh process group now;
+            # _stream_job reports STOPPED when it reaps the signal exit.
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                proc.terminate()
         await self.gcs.call(
             "job_update", {"submission_id": submission_id, "state": "RUNNING"}
         )
         spawn(self._stream_job(submission_id, proc))
 
     async def _stream_job(self, submission_id: str, proc: subprocess.Popen):
+        import codecs
+
         loop = asyncio.get_event_loop()
         fd = proc.stdout.fileno()
+        # Incremental decoder: a multibyte UTF-8 character split across a
+        # read boundary carries over instead of becoming U+FFFD garbage.
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
         while True:
             # Raw fd read: returns as soon as ANY bytes arrive, so sparse
             # driver output streams live instead of waiting for a full
             # 64 KB buffered-read quantum.
             chunk = await loop.run_in_executor(None, os.read, fd, 65536)
-            if not chunk:
+            text = decoder.decode(chunk, final=not chunk)
+            if not chunk and not text:
                 break
             try:
                 await self.gcs.call(
                     "job_log_append",
-                    {"submission_id": submission_id,
-                     "data": chunk.decode(errors="replace")},
+                    {"submission_id": submission_id, "data": text},
                 )
             except Exception:
                 pass
+            if not chunk:
+                break
         rc = await loop.run_in_executor(None, proc.wait)
         self._jobs.pop(submission_id, None)
         stop_requested = submission_id in self._job_stops

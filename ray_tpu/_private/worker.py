@@ -502,22 +502,12 @@ class CoreClient:
                 last_err = e
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(f"get() timed out waiting for {ref}")
-                spec = self.lineage.get(oid)
-                if spec is not None:
-                    # Re-execute the creating task (bounded attempts).
-                    if recon_left <= 0:
-                        break
-                    recon_left -= 1
-                    result = self._run(
-                        self.raylet.call("submit_task", dict(spec), timeout=None),
-                        timeout=None if deadline is None else remaining,
-                    )
-                    if result.get("status") != "ok":
-                        break
-                    continue
-                # No lineage: "known with zero copies" means every replica
-                # (memory + spill) is gone — lost. Unknown means possibly
-                # not yet produced: keep waiting (blocking get semantics).
+                # A probe timeout can just mean a slow transfer. Consult the
+                # object directory first: re-executing the (side-effectful)
+                # creating task while a copy still exists would duplicate it.
+                # "Known with zero copies" means every replica (memory +
+                # spill) is gone — lost. Unknown means possibly not yet
+                # produced: keep waiting (blocking get semantics).
                 try:
                     loc = self._run(
                         self.gcs.call(
@@ -527,12 +517,26 @@ class CoreClient:
                     )
                 except Exception:
                     continue
-                if (
+                lost = (
                     loc.get("known")
                     and not loc.get("nodes")
                     and not loc.get("spilled")
-                ):
-                    break  # registered once, all copies lost
+                )
+                if not lost:
+                    continue  # copy exists or not yet produced: keep pulling
+                spec = self.lineage.get(oid)
+                if spec is None:
+                    break  # registered once, all copies lost, no lineage
+                # Re-execute the creating task (bounded attempts).
+                if recon_left <= 0:
+                    break
+                recon_left -= 1
+                result = self._run(
+                    self.raylet.call("submit_task", dict(spec), timeout=None),
+                    timeout=None if deadline is None else remaining,
+                )
+                if result.get("status") != "ok":
+                    break
                 continue
         raise ObjectLostError(
             f"object {ref.hex()} could not be retrieved: {last_err}"

@@ -201,28 +201,93 @@ class StandardAutoscaler:
         now = time.monotonic()
         view = self._provider_view()
         counts = self._count_by_type(view)
-        for pid, info in view.items():
+
+        def idle_expired(pid, info):
+            """True once the host has been idle past the timeout."""
             node = by_node_id.get(info.get("node_id") or "")
             if node is None:
-                continue  # still starting
+                return False  # still starting
             idle = (
                 not node.get("demand_bundles")
                 and node.get("resources_available") == node.get("resources_total")
             )
             if not idle:
                 self._idle_since.pop(pid, None)
-                continue
+                return False
             first = self._idle_since.setdefault(pid, now)
+            return now - first > self.idle_timeout_s
+
+        # Single-host node types terminate host by host.
+        for pid, info in view.items():
             spec = self.node_types.get(info["type"] or "", {})
-            slice_hosts = spec.get("slice_hosts", 1)
-            min_hosts = spec.get("min_workers", 0) * slice_hosts
+            if spec.get("slice_hosts", 1) > 1:
+                continue
             if (
-                now - first > self.idle_timeout_s
-                and counts.get(info["type"], 0) - 1 >= min_hosts
+                idle_expired(pid, info)
+                and counts.get(info["type"], 0) - 1 >= spec.get("min_workers", 0)
             ):
                 self._drain_and_terminate(pid, info)
                 counts[info["type"]] = counts.get(info["type"], 0) - 1
+
+        # Slice types terminate whole slices, and only when EVERY host of
+        # the slice has idled past the timeout: a partial slice cannot run
+        # SPMD programs, so per-host scale-down would strand capacity.
+        for t, spec in self.node_types.items():
+            slice_hosts = spec.get("slice_hosts", 1)
+            if slice_hosts <= 1:
+                continue
+            min_hosts = spec.get("min_workers", 0) * slice_hosts
+            for group in self._live_slice_groups(t, slice_hosts, view):
+                if not all(idle_expired(pid, view[pid]) for pid in group):
+                    continue
+                if counts.get(t, 0) - len(group) < min_hosts:
+                    continue
+                for pid in group:
+                    self._drain_and_terminate(pid, view[pid])
+                counts[t] = counts.get(t, 0) - len(group)
+                self._slice_groups[t].remove(group)
         return launched
+
+    # -- slice bookkeeping -------------------------------------------------
+    def _record_slices(self, t: str, slice_hosts: int, pids: List[str]):
+        """Remember which provider hosts were created together as slices."""
+        if slice_hosts <= 1:
+            return
+        groups = self._slice_groups.setdefault(t, [])
+        for i in range(0, len(pids), slice_hosts):
+            groups.append(list(pids[i:i + slice_hosts]))
+
+    def _live_slice_groups(self, t: str, slice_hosts: int, view) -> List[List[str]]:
+        """Recorded slice groups pruned to live hosts; adopts untracked ones.
+
+        Hosts of a slice type with no recorded group (e.g. they predate this
+        autoscaler process) are chunked into slices in sorted order so they
+        can still be scaled down atomically rather than leaking forever.
+        """
+        live = {pid for pid, info in view.items() if info["type"] == t}
+        groups: List[List[str]] = []
+        tracked: set = set()
+        for g in self._slice_groups.get(t, []):
+            g2 = [p for p in g if p in live]
+            if g2:
+                groups.append(g2)
+                tracked.update(g2)
+        untracked = sorted(live - tracked)
+        if untracked:
+            if t not in self._warned_untracked_slice:
+                self._warned_untracked_slice.add(t)
+                import sys
+
+                print(
+                    f"[ray_tpu autoscaler] WARNING: {len(untracked)} hosts of "
+                    f"slice type {t!r} have no recorded slice group; adopting "
+                    "them in sorted order for slice-atomic scale-down.",
+                    file=sys.stderr, flush=True,
+                )
+            for i in range(0, len(untracked), slice_hosts):
+                groups.append(untracked[i:i + slice_hosts])
+        self._slice_groups[t] = groups
+        return list(groups)
 
     def _drain_and_terminate(self, pid: str, info: dict):
         node_id = info.get("node_id")

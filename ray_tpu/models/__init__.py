@@ -11,6 +11,7 @@ from ray_tpu.models.transformer import (
     TransformerConfig,
     init_params,
     forward,
+    forward_pipelined,
     loss_fn,
     param_logical_axes,
 )
@@ -20,6 +21,7 @@ __all__ = [
     "TransformerConfig",
     "init_params",
     "forward",
+    "forward_pipelined",
     "loss_fn",
     "param_logical_axes",
     "configs",

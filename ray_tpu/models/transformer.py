@@ -46,6 +46,9 @@ class TransformerConfig:
     # attention implementation: "flash" | "ring" | "ulysses"
     attn_impl: str = "flash"
     remat: bool = True
+    # Pipeline parallelism: microbatches per step when the mesh has pp>1
+    # (0 = auto: 2*stages when the batch divides, else stages, else 1).
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -224,10 +227,67 @@ def forward(
     return logits, auxes.sum()
 
 
+def forward_pipelined(
+    params: Dict,
+    tokens: jax.Array,  # [batch, seq] int32
+    cfg: TransformerConfig,
+    mesh,
+    num_microbatches: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pipeline-parallel forward: the layer stack shards over "pp" stages.
+
+    Each pp rank holds n_layers/S contiguous layers; microbatches stream
+    through the GPipe schedule of parallel.pipeline.pipeline_stages (all
+    stages inside one compiled program, activations rotated with ppermute).
+    Embedding and the LM head are replicated — they run on every rank, but
+    only the layer stack (the bulk of the FLOPs) is pipelined.
+    """
+    from ray_tpu.parallel.pipeline import pipeline_stages
+
+    S = mesh.shape["pp"]
+    b, l = tokens.shape
+    M = num_microbatches or cfg.pp_microbatches
+    if not M:
+        M = 2 * S if b % (2 * S) == 0 else (S if b % S == 0 else 1)
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by {M} pp microbatches")
+    if cfg.n_layers % S != 0:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={S}")
+    if cfg.num_experts:
+        raise ValueError(
+            "pipeline parallelism currently supports dense layers only "
+            "(the MoE aux loss does not thread through the pp schedule)"
+        )
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    body = _layer_fn(cfg, mesh, cos, sin, None)
+
+    def stage_fn(stage_layers, act):
+        # stage_layers: leaves [n_layers/S, ...] — this rank's stage.
+        act, _ = jax.lax.scan(body, act, stage_layers)
+        return act
+
+    xm = x.reshape(M, b // M, l, x.shape[-1])
+    ym = pipeline_stages(stage_fn, params["layers"], xm, mesh, axis_name="pp")
+    x = ym.reshape(b, l, x.shape[-1])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    return logits, jnp.zeros((), dtype=jnp.float32)
+
+
 def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None,
             aux_weight: float = 0.01):
-    """Next-token LM loss. tokens: [B, L]; predicts tokens[:, 1:]."""
-    logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
+    """Next-token LM loss. tokens: [B, L]; predicts tokens[:, 1:].
+
+    With a pp>1 mesh the forward runs the GPipe microbatch pipeline; the
+    backward differentiates straight through it (static-bound scan), which
+    is what makes MeshConfig(pp=...) a real training capability.
+    """
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        logits, aux = forward_pipelined(params, tokens[:, :-1], cfg, mesh)
+    else:
+        logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
     labels = tokens[:, 1:]
     loss = softmax_cross_entropy(logits, labels).mean()
     return loss + aux_weight * aux

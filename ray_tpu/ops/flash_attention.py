@@ -74,7 +74,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     """One (batch*head, q-block) program: loop over kv blocks.
 
     q_ref: [block_q, d]; k_ref/v_ref: [Lk_pad, d]; o_ref: [block_q, d];
-    lse_ref: [block_q] (f32 logsumexp of each row's scores).
+    lse_ref: [block_q, 1] (f32 logsumexp of each row's scores — the
+    trailing singleton keeps the row stats 2D, which Mosaic's
+    last-two-dims tiling rule requires of every block).
     """
     from jax.experimental import pallas as pl
 
@@ -86,8 +88,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     q = q_ref[:].astype(jnp.float32) * sm_scale
 
     o = jnp.zeros((block_q, d), dtype=jnp.float32)
-    m = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
-    l = jnp.zeros((block_q,), dtype=jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((block_q, 1), dtype=jnp.float32)
 
     def body(kv_idx, carry):
         o, m, l = carry
@@ -96,11 +98,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         s = _score_mask(s, q_idx * block_q, kv_idx * block_k, block_q,
                         block_k, causal, lq, lk, lq_pad, lk_pad)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1)
-        o_new = o * corr[:, None] + jnp.dot(
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        o_new = o * corr + jnp.dot(
             p, v_blk, preferred_element_type=jnp.float32
         )
         return o_new, m_new, l_new
@@ -115,7 +117,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         num_iter = num_kv
     o, m, l = jax.lax.fori_loop(0, num_iter, body, (o, m, l))
     l_safe = jnp.maximum(l, 1e-20)
-    o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[:] = (o / l_safe).astype(o_ref.dtype)
     lse_ref[:] = m + jnp.log(l_safe)
 
 
@@ -135,8 +137,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[:].astype(jnp.float32) * sm_scale
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]
-    delta = delta_ref[:]
+    lse = lse_ref[:]        # [block_q, 1]
+    delta = delta_ref[:]    # [block_q, 1]
 
     def body(kv_idx, dq):
         k_blk = k_ref[pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
@@ -144,9 +146,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         s = _score_mask(s, q_idx * block_q, kv_idx * block_k, block_q,
                         block_k, causal, lq, lk, lq_pad, lk_pad)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
 
     if causal:
@@ -183,17 +185,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[pl.ds(q_i * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[pl.ds(q_i * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[pl.ds(q_i * block_q, block_q)]
-        delta_blk = delta_ref[pl.ds(q_i * block_q, block_q)]
+        lse_blk = lse_ref[pl.ds(q_i * block_q, block_q), :]      # [block_q, 1]
+        delta_blk = delta_ref[pl.ds(q_i * block_q, block_q), :]  # [block_q, 1]
         s = sm_scale * jnp.dot(
             q_blk, k.T, preferred_element_type=jnp.float32
         )
         s = _score_mask(s, q_i * block_q, kv_idx * block_k, block_q, block_k,
                         causal, lq, lk, lq_pad, lk_pad)
-        p = jnp.exp(s - lse_blk[:, None])
+        p = jnp.exp(s - lse_blk)
         dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None])
+        ds = p * (dp - delta_blk)
         dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -244,15 +246,15 @@ def _fwd_pallas(qt, kt, vt, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq_pad, d), qt.dtype),
-            jax.ShapeDtypeStruct((bh, lq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq_pad, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :lq], lse[:, :lq]
+    return out[:, :lq], lse[:, :lq, 0]
 
 
 def _bwd_pallas(qt, kt, vt, out, lse, g, causal, block_q, block_k, interpret):
@@ -275,8 +277,9 @@ def _bwd_pallas(qt, kt, vt, out, lse, g, causal, block_q, block_k, interpret):
     vp = _pad_to(vt, lk_pad, 1)
     gp = _pad_to(g, lq_pad, 1)
     # Padded rows carry lse=0, delta=0 so masked scores give p=exp(-1e30)=0.
-    lsep = _pad_to(lse, lq_pad, 1)
-    deltap = _pad_to(delta, lq_pad, 1)
+    # Trailing singleton keeps row stats 2D in-kernel (Mosaic tiling rule).
+    lsep = _pad_to(lse, lq_pad, 1)[..., None]
+    deltap = _pad_to(delta, lq_pad, 1)[..., None]
 
     dq = pl.pallas_call(
         functools.partial(
@@ -294,8 +297,8 @@ def _bwd_pallas(qt, kt, vt, out, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, lk_pad, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, lk_pad, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), qt.dtype),
@@ -318,8 +321,8 @@ def _bwd_pallas(qt, kt, vt, out, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, lq_pad, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, lq_pad), lambda i, j: (i, 0)),
-            pl.BlockSpec((None, lq_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, lq_pad, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, lq_pad, 1), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),

@@ -53,12 +53,17 @@ def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
     o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _rmsnorm_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dwp_ref, *, eps: float):
-    """Row-local dx plus a per-block partial dw (summed by the caller).
+def _rmsnorm_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, *, eps: float):
+    """Row-local dx plus dw accumulated across the sequential TPU grid.
 
     The normalizer is recomputed from x (rematerialized, as the fwd kernel
     saves nothing), so the backward reads the same inputs as the forward.
+    dw_ref is one (8, d) block every grid step revisits: row 0 accumulates,
+    rows 1-7 pad the block up to the fp32 sublane tile.
     """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
     x = x_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
@@ -68,7 +73,15 @@ def _rmsnorm_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dwp_ref, *, eps: float):
     gw = g * w
     dx = inv * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
     dx_ref[:] = dx.astype(dx_ref.dtype)
-    dwp_ref[:] = jnp.sum(g * xhat, axis=0)
+    part = jnp.pad(jnp.sum(g * xhat, axis=0, keepdims=True), ((0, 7), (0, 0)))
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[:] = part
+
+    @pl.when(i != 0)
+    def _acc():
+        dw_ref[:] = dw_ref[:] + part
 
 
 def _rmsnorm_pallas_fwd2(x2, w, eps, block_rows, interpret):
@@ -81,12 +94,12 @@ def _rmsnorm_pallas_fwd2(x2, w, eps, block_rows, interpret):
         grid=(pl.cdiv(rows, block_rows),),
         in_specs=[
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, d), x2.dtype),
         interpret=interpret,
-    )(x2, w)
+    )(x2, w.reshape(1, d))
 
 
 def _rmsnorm_pallas_bwd2(x2, w, g2, eps, block_rows, interpret):
@@ -96,31 +109,31 @@ def _rmsnorm_pallas_bwd2(x2, w, g2, eps, block_rows, interpret):
     block_rows = min(block_rows, rows)
     nblocks = -(-rows // block_rows)
     # Zero-pad a partial tail block: padded rows give g*xhat = 0, so the
-    # per-block dw partial sums defined zeros instead of out-of-bounds
-    # garbage (real-TPU OOB block contents are undefined).
+    # dw accumulator adds defined zeros instead of out-of-bounds garbage
+    # (real-TPU OOB block contents are undefined).
     rows_pad = nblocks * block_rows
     if rows_pad != rows:
         x2 = jnp.pad(x2, ((0, rows_pad - rows), (0, 0)))
         g2 = jnp.pad(g2, ((0, rows_pad - rows), (0, 0)))
-    dx, dw_partial = pl.pallas_call(
+    dx, dw_acc = pl.pallas_call(
         functools.partial(_rmsnorm_bwd_kernel, eps=eps),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((None, d), lambda i: (i, 0)),
+            pl.BlockSpec((8, d), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows_pad, d), x2.dtype),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+            jax.ShapeDtypeStruct((8, d), jnp.float32),
         ],
         interpret=interpret,
-    )(x2, w, g2)
-    return dx[:rows], dw_partial.sum(axis=0).astype(w.dtype)
+    )(x2, w.reshape(1, d), g2)
+    return dx[:rows], dw_acc.sum(axis=0).astype(w.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

@@ -35,14 +35,18 @@ def pipeline_stages(
 
     Args:
       stage_fn: (stage_params, activation) -> activation. One stage's
-        compute (e.g. a group of transformer layers).
-      params_stacked: pytree whose leaves have a leading stage axis of size
-        S, sharded over `axis_name`.
+        compute. `stage_params` is the DEVICE-LOCAL shard of
+        `params_stacked`: leaves keep a leading axis of layers-per-stage
+        (stack_len / S), so a stage holding several transformer layers
+        scans over them inside stage_fn.
+      params_stacked: pytree whose leaves have a leading stack axis
+        divisible by S, sharded over `axis_name`.
       x_microbatches: [M, microbatch, ...] input microbatches (replicated
         over the pp axis).
       mesh: mesh with the `axis_name` axis of size S.
 
-    Returns [M, microbatch, ...] outputs of the final stage.
+    Returns [M, microbatch, ...] outputs of the final stage. Differentiable
+    (the tick loop has static bounds, so it lowers to scan).
     """
     S = mesh.shape[axis_name]
     M = x_microbatches.shape[0]
@@ -52,8 +56,9 @@ def pipeline_stages(
         x_spec = P()
 
     def local_fn(params_local, xs):
-        # params_local: leaves [1, ...] (this device's stage); xs: [M, mb, ...]
-        stage_params = jax.tree.map(lambda p: p[0], params_local)
+        # params_local: leaves [stack/S, ...] (this device's stage layers);
+        # xs: [M, mb, ...]
+        stage_params = params_local
         stage_idx = jax.lax.axis_index(axis_name)
         total_ticks = S + M - 1
 

@@ -149,3 +149,46 @@ def test_tpu_slice_scales_whole_slices(scaling_cluster):
     assert launched.get("v5e-slice") == 4
     assert len(provider.non_terminated_nodes()) == 4
     assert rt.get(ref, timeout=60) == 1
+
+def test_tpu_slice_scale_down_is_atomic(scaling_cluster):
+    """Idle slices terminate whole-slice or not at all: if even one host of
+    a slice is busy, the autoscaler must not strand a partial slice."""
+    cluster, provider = scaling_cluster
+    autoscaler = StandardAutoscaler(
+        {"node_types": {
+            "v5e-slice": {"resources": {"TPU": 4, "CPU": 1},
+                           "slice_hosts": 2, "max_workers": 2}},
+         "idle_timeout_s": 0.5},
+        provider,
+        f"127.0.0.1:{cluster.gcs_port}",
+        io=cluster.io,
+    )
+
+    @rt.remote(num_tpus=4, num_cpus=0)
+    def tpu_task(t):
+        time.sleep(t)
+        return 1
+
+    ref = tpu_task.remote(0.1)
+    time.sleep(1.2)
+    assert autoscaler.update().get("v5e-slice") == 2
+    assert rt.get(ref, timeout=60) == 1
+
+    # Keep ONE host of the slice busy: the whole slice must survive.
+    busy = tpu_task.remote(6.0)
+    time.sleep(1.2)
+    for _ in range(4):
+        time.sleep(0.7)
+        autoscaler.update()
+        assert len(provider.non_terminated_nodes()) == 2, (
+            "partial slice terminated while one host was busy"
+        )
+    assert rt.get(busy, timeout=60) == 1
+
+    # Fully idle: the slice terminates together (0 -> whole slice gone).
+    def slice_gone():
+        time.sleep(0.7)
+        autoscaler.update()
+        return len(provider.non_terminated_nodes()) == 0
+
+    _wait(slice_gone, timeout=30)
