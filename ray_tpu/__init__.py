@@ -142,11 +142,21 @@ def get(
     *,
     timeout: Optional[float] = None,
 ):
-    """Fetch object values (reference: ray.get, _private/worker.py:2570)."""
+    """Fetch object values (reference: ray.get, _private/worker.py:2570).
+
+    Accepts ObjectRefs and objects exposing one via `.ref` (e.g.
+    serve.DeploymentResponse), matching ray.get's handling of responses.
+    """
     client = _worker.get_client()
+    if not isinstance(refs, ObjectRef) and hasattr(refs, "ref"):
+        refs = refs.ref
     if isinstance(refs, ObjectRef):
         return client.get([refs], timeout)[0]
-    return client.get(list(refs), timeout)
+    return client.get(
+        [r.ref if not isinstance(r, ObjectRef) and hasattr(r, "ref") else r
+         for r in refs],
+        timeout,
+    )
 
 
 def put(value: Any) -> ObjectRef:

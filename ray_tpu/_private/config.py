@@ -62,6 +62,16 @@ class Config:
     # worker_register_timeout_seconds).
     worker_register_timeout_s: float = 120.0
 
+    # -- memory monitor / OOM policy -------------------------------------
+    # Node memory fraction above which the raylet kills the newest
+    # retriable task's worker instead of letting the OS OOM-kill the node
+    # (reference: memory_usage_threshold, ray_config_def.h:77 — 0.95).
+    memory_usage_threshold: float = 0.95
+    # Monitor poll period (reference: memory_monitor_refresh_ms — 250ms).
+    memory_monitor_interval_s: float = 0.25
+    # 0 disables the monitor (reference disables via refresh_ms=0).
+    memory_monitor_enabled: bool = True
+
     # -- fault tolerance ------------------------------------------------
     # Default task retries (reference: max_retries default 3,
     # python/ray/remote_function.py).

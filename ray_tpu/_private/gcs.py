@@ -39,10 +39,30 @@ from ray_tpu._private.config import get_config
 from ray_tpu._private.protocol import RpcServer, ServerConnection
 
 
+#: Handlers that mutate durable tables; each marks the snapshot dirty.
+_WRITE_METHODS = {
+    "kv_put", "kv_del",
+    "register_actor", "actor_ready", "kill_actor", "worker_dead",
+    "register_job", "submit_job", "job_update", "job_log_append", "stop_job",
+    "create_placement_group", "remove_placement_group",
+    "object_location_add", "object_location_remove", "object_spilled",
+    "objects_freed",
+}
+
+
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self.rpc = RpcServer(host, port)
         self.host = host
+        # Fault tolerance: durable tables snapshot to persist_path (debounced
+        # + atomic rename) and restore on restart; live state (nodes,
+        # connections, waiters) is rebuilt as raylets reconnect within a
+        # heartbeat. The role of the reference's Redis store client
+        # (gcs/store_client/redis_store_client.h:33), file-backed.
+        self.persist_path = persist_path
+        self._persist_dirty = False
+        self._persist_task: Optional[asyncio.Task] = None
         # tables
         self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespace -> k -> v
         self.nodes: Dict[bytes, dict] = {}  # node_id -> info
@@ -62,6 +82,7 @@ class GcsServer:
         self.pending_pgs: Set[bytes] = set()
         self.pg_counter = 0
         self._started = asyncio.Event()
+        self._stopping = False
         self._health_task: Optional[asyncio.Task] = None
 
         r = self.rpc.register
@@ -99,6 +120,7 @@ class GcsServer:
         r("object_location_wait", self.h_object_location_wait)
         r("object_location_remove", self.h_object_location_remove)
         r("object_spilled", self.h_object_spilled)
+        r("objects_freed", self.h_objects_freed)
         r("list_objects", self.h_list_objects)
         # placement groups
         r("create_placement_group", self.h_create_pg)
@@ -118,6 +140,83 @@ class GcsServer:
 
         self.rpc.on_disconnect = self._on_disconnect
 
+        if self.persist_path:
+            import os as _os
+
+            if _os.path.exists(self.persist_path):
+                self._restore(self.persist_path)
+            for name in _WRITE_METHODS:
+                self.rpc.handlers[name] = self._wrap_durable(
+                    self.rpc.handlers[name]
+                )
+
+    # -- persistence ----------------------------------------------------
+    def _wrap_durable(self, handler):
+        async def wrapped(d, conn):
+            out = await handler(d, conn)
+            self._mark_dirty()
+            return out
+
+        return wrapped
+
+    def _mark_dirty(self):
+        if not self.persist_path:
+            return
+        self._persist_dirty = True
+        if self._persist_task is None or self._persist_task.done():
+            self._persist_task = asyncio.ensure_future(self._persist_soon())
+
+    def _snapshot_bytes(self) -> bytes:
+        import pickle
+
+        return pickle.dumps(
+            {
+                "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
+                "jobs": self.jobs,
+                "actors": self.actors,
+                "named_actors": self.named_actors,
+                "placement_groups": self.placement_groups,
+                "object_dir": self.object_dir,
+                "pg_counter": self.pg_counter,
+            }
+        )
+
+    @staticmethod
+    def _write_snapshot(path: str, data: bytes):
+        import os
+
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    async def _persist_soon(self):
+        while self._persist_dirty:
+            self._persist_dirty = False
+            await asyncio.sleep(0.05)  # debounce mutation bursts
+            # Pickle on the loop (tables are mutated by handlers on this
+            # loop, so a thread would race them) but write in an executor —
+            # the disk I/O is the slow part and must not head-of-line-block
+            # heartbeats and scheduling.
+            data = self._snapshot_bytes()
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._write_snapshot, self.persist_path, data
+            )
+
+    def _restore(self, path: str):
+        import pickle
+
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        for ns, kvs in snap.get("kv", {}).items():
+            self.kv[ns].update(kvs)
+        self.jobs.update(snap.get("jobs", {}))
+        self.actors.update(snap.get("actors", {}))
+        self.named_actors.update(snap.get("named_actors", {}))
+        self.placement_groups.update(snap.get("placement_groups", {}))
+        self.object_dir.update(snap.get("object_dir", {}))
+        self.pg_counter = snap.get("pg_counter", self.pg_counter)
+
     # ------------------------------------------------------------------
     async def start(self) -> int:
         port = await self.rpc.start()
@@ -126,8 +225,25 @@ class GcsServer:
         return port
 
     async def stop(self):
+        # Stop flag first: the connection teardown below triggers
+        # _on_disconnect for every peer, which would otherwise mark nodes
+        # (and their actors) dead and persist that into the snapshot a
+        # restarted GCS restores from.
+        self._stopping = True
         if self._health_task:
             self._health_task.cancel()
+        persist_pending = (
+            self._persist_task is not None and not self._persist_task.done()
+        )
+        if persist_pending:
+            self._persist_task.cancel()
+        if self.persist_path and (self._persist_dirty or persist_pending):
+            # Flush acknowledged-but-debounced mutations synchronously: a
+            # clean shutdown must not lose the last 50ms of writes (the
+            # loop clears the dirty flag before its debounce sleep, so a
+            # cancelled-in-flight task also means unflushed writes).
+            self._persist_dirty = False
+            self._write_snapshot(self.persist_path, self._snapshot_bytes())
         await self.rpc.stop()
 
     async def publish(self, channel: str, payload: Any):
@@ -141,6 +257,8 @@ class GcsServer:
             self.subscribers[channel].discard(c)
 
     async def _on_disconnect(self, conn: ServerConnection):
+        if self._stopping:
+            return  # our own teardown, not a peer death
         for subs in self.subscribers.values():
             subs.discard(conn)
         node_id = conn.meta.get("node_id")
@@ -207,6 +325,7 @@ class GcsServer:
                 j["end_time"] = time.time()
                 j["message"] = f"supervising node died: {reason}"
         await self.publish("node_dead", {"node_id": node_id, "reason": reason})
+        self._mark_dirty()
 
     # -- kv -------------------------------------------------------------
     async def h_kv_put(self, d, conn):
@@ -670,6 +789,34 @@ class GcsServer:
         entry = self.object_dir.get(d["object_id"])
         if entry:
             entry["nodes"].discard(d["node_id"])
+        return {"ok": True}
+
+    async def h_objects_freed(self, d, conn):
+        """Owner freed these objects: drop the directory entries and tell
+        every node still holding a copy (or a spill file) to reclaim it.
+        The eviction-notification role of the reference's pubsub object
+        channels (protobuf/pubsub.proto:30-48), owner-initiated."""
+        for oid in d["object_ids"]:
+            entry = self.object_dir.pop(oid, None)
+            targets: set = set()
+            if entry:
+                targets |= set(entry["nodes"])
+                sp = entry.get("spilled")
+                if sp:
+                    targets.add(sp["node_id"])
+            for nid in targets:
+                node_conn = self.node_conns.get(nid)
+                if node_conn is not None and node_conn is not conn:
+                    try:
+                        await node_conn.push(
+                            "free_objects", {"object_ids": [oid]}
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+            # Wake location waiters: they observe the empty entry instead
+            # of hanging until timeout.
+            for ev in self.object_waiters.pop(oid, []):
+                ev.set()
         return {"ok": True}
 
     # -- placement groups -------------------------------------------------

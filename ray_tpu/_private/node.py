@@ -86,7 +86,14 @@ class Node:
         self._owns_loop = loop_thread is None
         self.gcs_server: Optional[GcsServer] = None
         if head:
-            self.gcs_server = GcsServer()
+            import os as _os
+
+            # GCS fault tolerance: set RT_GCS_PERSIST_PATH to snapshot the
+            # durable GCS tables (kv/jobs/actors/PGs/object dir) to disk so a
+            # restarted GCS rejoins live raylets with its state intact.
+            self.gcs_server = GcsServer(
+                persist_path=_os.environ.get("RT_GCS_PERSIST_PATH") or None
+            )
             self.gcs_port = self.io.run(self.gcs_server.start())
             self.gcs_host = "127.0.0.1"
         else:

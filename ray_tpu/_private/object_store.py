@@ -20,7 +20,11 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libray_tpu_store.so")
+# RT_STORE_LIB overrides the library (e.g. the ASAN build from
+# `make -C ray_tpu/native asan` for sanitizer stress runs).
+_LIB_PATH = os.environ.get("RT_STORE_LIB") or os.path.join(
+    _NATIVE_DIR, "libray_tpu_store.so"
+)
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -31,8 +35,11 @@ def _load_lib() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(
-            os.path.join(_NATIVE_DIR, "object_store.cc")
+        default_lib = _LIB_PATH == os.path.join(_NATIVE_DIR, "libray_tpu_store.so")
+        if default_lib and (
+            not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(_LIB_PATH)
+            < os.path.getmtime(os.path.join(_NATIVE_DIR, "object_store.cc"))
         ):
             subprocess.run(
                 ["make", "-s", "-C", _NATIVE_DIR],

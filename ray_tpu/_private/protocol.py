@@ -238,7 +238,22 @@ class RpcServer:
     async def stop(self):
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+        # Close accepted connections as well: peers must observe the death
+        # (ConnectionLost) to enter their reconnect paths — a closed
+        # listener alone leaves established sockets half-alive. Must happen
+        # BEFORE wait_closed(): since 3.12 it waits for handler coroutines,
+        # which only exit when their sockets close.
+        for conn in list(self.connections):
+            conn.closed = True
+            try:
+                conn.writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
 
 
 async def connect(host: str, port: int, push_handler=None, timeout: float = 10.0) -> Connection:

@@ -135,36 +135,62 @@ class Raylet:
         r("object_created", self.h_object_created)
         r("spill_objects", self.h_spill_objects)
         r("restore_spilled", self.h_restore_spilled)
+        r("free_objects", self.h_free_objects)
         r("get_info", self.h_get_info)
         r("prestart_workers", self.h_prestart_workers)
 
     # ------------------------------------------------------------------
-    async def start(self) -> int:
-        port = await self.rpc.start()
-        self.port = port
-        self.gcs = await connect(
-            self.gcs_host, self.gcs_port, push_handler=self._on_gcs_push
-        )
-        await self.gcs.call(
+    _GCS_CHANNELS = ("create_actor", "kill_actor_worker", "reserve_bundle",
+                     "cancel_bundle", "node_dead", "node_added", "run_job",
+                     "stop_job", "free_objects")
+
+    async def _register_with_gcs(self, gcs):
+        await gcs.call(
             "register_node",
             {
                 "node_id": self.node_id.binary(),
                 "address": self.host,
-                "port": port,
+                "port": self.port,
                 "object_store_name": self.store_name,
                 "resources": self.resources_total,
                 "labels": self.labels,
                 "is_head": self.is_head,
             },
         )
-        for ch in ("create_actor", "kill_actor_worker", "reserve_bundle",
-                   "cancel_bundle", "node_dead", "node_added", "run_job",
-                   "stop_job"):
-            await self.gcs.call("subscribe", {"channel": ch})
+        for ch in self._GCS_CHANNELS:
+            await gcs.call("subscribe", {"channel": ch})
+
+    async def _reconnect_gcs(self):
+        """The GCS died: redial until it (or its restarted successor) is
+        back, then re-register this node and its subscriptions. This is the
+        raylet half of GCS fault tolerance — live cluster state is rebuilt
+        from re-registration, durable tables from the GCS snapshot
+        (gcs_redis_failure_detector analog with roles reversed: raylets
+        outlive the GCS instead of suiciding)."""
+        while not self._stopping:
+            try:
+                gcs = await connect(
+                    self.gcs_host, self.gcs_port,
+                    push_handler=self._on_gcs_push, timeout=2.0,
+                )
+                await self._register_with_gcs(gcs)
+                self.gcs = gcs
+                return
+            except Exception:  # noqa: BLE001
+                await asyncio.sleep(0.5)
+
+    async def start(self) -> int:
+        port = await self.rpc.start()
+        self.port = port
+        self.gcs = await connect(
+            self.gcs_host, self.gcs_port, push_handler=self._on_gcs_push
+        )
+        await self._register_with_gcs(self.gcs)
         self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         self._bg.append(asyncio.ensure_future(self._spill_loop()))
+        self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         return port
 
     async def stop(self):
@@ -188,6 +214,28 @@ class Raylet:
         if self.gcs:
             await self.gcs.close()
         self.store.destroy()
+
+    async def kill(self):
+        """Abrupt death for fault injection: SIGKILL the workers, drop every
+        connection, no draining, no GCS goodbye — the in-process equivalent
+        of `kill -9` on a raylet (chaos tests; RayletKiller analog)."""
+        self._stopping = True
+        for t in self._bg:
+            t.cancel()
+        for w in self.workers.values():
+            try:
+                w.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        await self.rpc.stop()
+        if self.gcs:
+            await self.gcs.close()
+        for c in self.peer_conns.values():
+            try:
+                await c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self.peer_conns.clear()
 
     # -- GCS pushes ------------------------------------------------------
     def _on_gcs_push(self, channel: str, payload: Any):
@@ -239,6 +287,9 @@ class Raylet:
                     os.killpg(proc.pid, signal.SIGTERM)
                 except (ProcessLookupError, PermissionError):
                     proc.terminate()
+        elif channel == "free_objects":
+            for oid in payload["object_ids"]:
+                self._free_local(oid)
         elif channel == "node_added":
             # A new node may satisfy queued-infeasible tasks: re-check now.
             self.node_cache.pop(payload.get("node_id"), None)
@@ -372,6 +423,104 @@ class Raylet:
                         reason=f"worker process exited ({w.proc.returncode})",
                     )
                     self._dispatch_event.set()
+
+    # -- memory monitor / OOM policy --------------------------------------
+    def _memory_usage_fraction(self) -> float:
+        """Node memory usage (tests override this).
+
+        Prefers the memory cgroup when limited — in a container the cgroup
+        OOM killer fires long before host MemAvailable moves, so reading
+        /proc/meminfo alone would never trip the policy (the reference's
+        MemoryMonitor reads cgroup usage the same way)."""
+        try:
+            # cgroup v2, then v1; a limit of "max"/huge means unlimited.
+            for cur_p, max_p in (
+                ("/sys/fs/cgroup/memory.current", "/sys/fs/cgroup/memory.max"),
+                ("/sys/fs/cgroup/memory/memory.usage_in_bytes",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"),
+            ):
+                try:
+                    with open(max_p) as f:
+                        raw = f.read().strip()
+                    if raw == "max":
+                        continue
+                    limit = int(raw)
+                    if limit <= 0 or limit > 1 << 60:
+                        continue
+                    with open(cur_p) as f:
+                        current = int(f.read().strip())
+                    return current / limit
+                except (FileNotFoundError, ValueError):
+                    continue
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    info[key] = int(rest.strip().split()[0])
+            total = info.get("MemTotal", 0)
+            if total <= 0 or "MemAvailable" not in info:
+                return 0.0  # can't measure: never report pressure
+            return 1.0 - info["MemAvailable"] / total
+        except Exception:  # noqa: BLE001 — non-Linux or restricted /proc
+            return 0.0
+
+    def _pick_oom_victim(self):
+        """Newest retriable task first, newest task as fallback — the
+        reference's retriable-FIFO killing policy
+        (raylet/worker_killing_policy.cc)."""
+        candidates = []
+        for entry in self.inflight.values():
+            w = entry.get("worker")
+            if w is None or w.proc is None:
+                continue
+            candidates.append(
+                (bool(entry["spec"].get("retriable", True)),
+                 entry.get("start", 0.0), w, entry)
+            )
+        if not candidates:
+            return None
+        retriable = [c for c in candidates if c[0]]
+        pool = retriable or candidates
+        pool.sort(key=lambda c: c[1])
+        _, _, w, entry = pool[-1]
+        return w, entry
+
+    async def _memory_monitor_loop(self):
+        """Kill a task's worker before the OS OOM-killer takes the raylet
+        (reference: MemoryMonitor + worker_killing_policy.cc; threshold
+        memory_usage_threshold, ray_config_def.h:77)."""
+        cfg = get_config()
+        if not cfg.memory_monitor_enabled or cfg.memory_monitor_interval_s <= 0:
+            return
+        while True:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            try:
+                frac = self._memory_usage_fraction()
+                if frac < cfg.memory_usage_threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                w, entry = victim
+                spec = entry["spec"]
+                print(
+                    f"[ray_tpu] memory monitor: node at "
+                    f"{frac:.0%} >= {cfg.memory_usage_threshold:.0%}; "
+                    f"killing worker of task "
+                    f"{spec.get('name') or spec['task_id'].hex()[:8]} "
+                    f"(newest retriable) — it will be retried.",
+                    file=sys.stderr, flush=True,
+                )
+                self._record_task_event(
+                    spec, "OOM_KILLED", worker_id=w.worker_id,
+                    memory_fraction=frac,
+                )
+                try:
+                    w.proc.kill()  # reap loop fails the task as retriable
+                except Exception:  # noqa: BLE001
+                    pass
+            except Exception:  # noqa: BLE001
+                pass
 
     async def _create_actor_worker(self, payload):
         """Spawn a dedicated worker for an actor and hand it the create spec.
@@ -749,7 +898,15 @@ class Raylet:
             if not self._feasible_locally(resources) or not self._available_for_new_work(resources):
                 node = await self._pick_remote_node(resources)
                 if node is not None:
-                    return await self._forward_task(spec, node["node_id"])
+                    result = await self._forward_task(spec, node["node_id"])
+                    if not (
+                        result.get("status") == "error"
+                        and "target node unavailable"
+                        in str(result.get("error", ""))
+                    ):
+                        return result
+                    # The chosen peer died mid-handoff: fall through and
+                    # queue locally — retries/rescheduling own it from here.
                 # No node fits today: stay queued — the dispatch loop
                 # re-evaluates remote placement as nodes join (the
                 # reference keeps infeasible tasks pending for the
@@ -789,7 +946,13 @@ class Raylet:
         spec = dict(spec)
         spec["scheduling"] = None  # already routed
         spec["forwarded"] = True
-        return await conn.call("submit_task", spec, timeout=None)
+        try:
+            return await conn.call("submit_task", spec, timeout=None)
+        except Exception as e:  # noqa: BLE001 — peer died mid-call
+            return {
+                "status": "error",
+                "error": f"target node unavailable: {e}",
+            }
 
     async def _peer(self, node_id: bytes) -> Optional[Connection]:
         # Single-flight per node: concurrent forwards must share one
@@ -900,6 +1063,13 @@ class Raylet:
                     continue
                 worker = self._idle_worker(renv_hash)
                 if worker is None:
+                    if not self._available_locally(resources):
+                        # Every matching resource is already acquired by
+                        # running tasks — a fresh worker could not take this
+                        # task either. Spawning here is the storm that burns
+                        # CPU on worker startup instead of task execution.
+                        requeue.append((spec, fut))
+                        continue
                     # Spawn only as many workers as there is queued work,
                     # counting ones still starting up (WorkerPool prestart
                     # logic, worker_pool.h:347) — never a spawn storm.
@@ -912,7 +1082,18 @@ class Raylet:
                         if w.actor_id is None and w.conn is None
                         and w.runtime_env_hash == renv_hash
                     )
+                    # Bound prestart by how many tasks of this footprint can
+                    # actually run at once — with 4 free CPUs and CPU:1
+                    # tasks, 4 workers saturate the node; the 5th..16th only
+                    # burn startup CPU the running tasks need.
+                    cap = None
+                    for k, v in resources.items():
+                        if v > 0:
+                            c = int(self.resources_available.get(k, 0) // v)
+                            cap = c if cap is None else min(cap, c)
                     wanted = 1 + len(self.task_queue) + len(requeue)
+                    if cap is not None:
+                        wanted = min(wanted, max(cap, 1))
                     if n_live >= cfg.max_workers_per_node and n_starting == 0:
                         # Pool full of other-env workers: replace an idle one
                         # so a new env hash can't starve (the reference kills
@@ -948,6 +1129,7 @@ class Raylet:
                     "spec": spec,
                     "fut": fut,
                     "worker": worker,
+                    "start": time.monotonic(),
                 }
                 self._record_task_event(
                     spec, "RUNNING", worker_id=worker.worker_id
@@ -956,8 +1138,15 @@ class Raylet:
             for item in requeue:
                 self.task_queue.append(item)
             if requeue:
-                await asyncio.sleep(0.02)
-                self._dispatch_event.set()
+                # Blocked on resources/workers: rescan the moment anything
+                # completes (h_task_done sets the event) instead of a fixed
+                # sleep — the sleep gated every wave of a large batch to
+                # 20ms and capped batched throughput at ~200 tasks/s. The
+                # timeout keeps infeasible tasks re-checking for new nodes.
+                try:
+                    await asyncio.wait_for(self._dispatch_event.wait(), 0.1)
+                except asyncio.TimeoutError:
+                    self._dispatch_event.set()
 
     def _idle_worker(self, renv_hash: Optional[str] = None) -> Optional[WorkerHandle]:
         for w in self.workers.values():
@@ -981,6 +1170,40 @@ class Raylet:
             return
         self.task_queue.append((spec, fut))
         self._dispatch_event.set()
+
+    def _free_local(self, oid: bytes):
+        """Drop this node's copies of a freed object: primary pin, store
+        entry (best-effort: readers still mapping it defer to LRU eviction,
+        which reclaims refcount-0 objects with zero IO), and spill file."""
+        if oid in self._primary_pins:
+            try:
+                self.store.release(ObjectID(oid))
+            except Exception:  # noqa: BLE001
+                pass
+            self._primary_pins.pop(oid, None)
+        try:
+            self.store.delete(ObjectID(oid))
+        except Exception:  # noqa: BLE001
+            pass
+        uri = self._spilled.pop(oid, None)
+        if uri:
+            try:
+                self._get_storage().delete([uri])
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def h_free_objects(self, d, conn):
+        """Owner-driven free (the last ObjectRef died): reclaim local
+        copies, then let the GCS fan the free out to every other node
+        holding a copy or a spill file."""
+        oids = list(d["object_ids"])
+        for oid in oids:
+            self._free_local(oid)
+        try:
+            await self.gcs.call("objects_freed", {"object_ids": oids})
+        except Exception:  # noqa: BLE001
+            pass
+        return {"ok": True, "count": len(oids)}
 
     async def h_task_done(self, d, conn):
         """Worker reports task completion (the PushTask reply path)."""
@@ -1342,6 +1565,8 @@ class Raylet:
             except Exception:
                 if self._stopping:
                     return
+                if self.gcs is not None and self.gcs._closed:
+                    await self._reconnect_gcs()
 
 
 def main():  # pragma: no cover - run as subprocess

@@ -262,6 +262,20 @@ class CoreClient:
 
         self.lineage: "_OD[bytes, dict]" = _OD()
         self.lineage_max_entries = 10_000
+        # Owner-side reference GC (ReferenceCounter analog,
+        # reference_count.h:61, simplified): when the last local ObjectRef
+        # to an object THIS process owns dies — and no in-flight task
+        # borrows it as an argument — the owner frees the cluster copies.
+        # Borrowers (processes that deserialized the ref) never free.
+        self._owned_store_oids: set = set()
+        self._task_borrows: Dict[bytes, int] = {}
+        self._free_dropped: set = set()   # dropped refs awaiting borrow==0
+        self._free_queue: List[bytes] = []
+        self._free_lock = threading.Lock()
+        self._free_flusher = None
+        # GCS-restart survival (client half): see _gcs_call.
+        self._subscribed_channels: set = set()
+        self._gcs_redial_lock = None
 
     # -- bootstrap -------------------------------------------------------
     def connect(self):
@@ -272,6 +286,50 @@ class CoreClient:
     async def _connect(self):
         self.gcs = await connect(*self.gcs_addr, push_handler=self._on_push)
         self.raylet = await connect(*self.raylet_addr)
+
+    async def _gcs_call(self, method, payload=None, timeout=None):
+        """GCS call that survives a GCS restart: on a dead connection,
+        redial once, replay channel subscriptions, and retry the call.
+
+        Known limitation: if the GCS applied+persisted a non-idempotent
+        write (register_actor, kv_put overwrite=False) and died before
+        replying, the retry double-applies and may surface an
+        'already exists' error for an operation that succeeded — the same
+        at-least-once window every RPC-retry system has without
+        idempotency tokens.
+        """
+        if method == "subscribe":
+            self._subscribed_channels.add(payload["channel"])
+        try:
+            return await self.gcs.call(method, payload, timeout=timeout)
+        except ConnectionLost:
+            await self._redial_gcs()
+            return await self.gcs.call(method, payload, timeout=timeout)
+
+    async def _redial_gcs(self):
+        lock = self._gcs_redial_lock
+        if lock is None:
+            lock = self._gcs_redial_lock = asyncio.Lock()
+        async with lock:
+            if self.gcs is not None and not self.gcs._closed:
+                return  # another caller already redialed
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    gcs = await connect(
+                        *self.gcs_addr, push_handler=self._on_push, timeout=2.0
+                    )
+                    break
+                except Exception:  # noqa: BLE001
+                    if time.monotonic() > deadline:
+                        raise ConnectionLost("GCS unreachable after restart")
+                    await asyncio.sleep(0.5)
+            for ch in list(self._subscribed_channels):
+                try:
+                    await gcs.call("subscribe", {"channel": ch})
+                except Exception:  # noqa: BLE001
+                    pass
+            self.gcs = gcs
 
     def _on_push(self, channel: str, payload):
         if channel.startswith("actor_update:"):
@@ -285,6 +343,15 @@ class CoreClient:
             handler(payload)
 
     def disconnect(self):
+        # Quiesce the free flusher before teardown ("task destroyed but
+        # pending" noise otherwise).
+        self._connected = False
+        flusher = self._free_flusher
+        if flusher is not None and not flusher.done():
+            try:
+                self.loop.call_soon_threadsafe(flusher.cancel)
+            except RuntimeError:
+                pass
         # Decide unmap safety BEFORE releasing session pins: a session pin
         # means some non-weakrefable container of zero-copy views was
         # fetched, and we cannot know whether its arrays are still alive.
@@ -317,23 +384,104 @@ class CoreClient:
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
+    # -- reference GC -----------------------------------------------------
+    def _track_owned_ref(self, ref: ObjectRef):
+        """Fire the free protocol when this owned ref is garbage-collected."""
+        weakref.finalize(ref, self._on_ref_dropped, ref.id.binary())
+
+    def _on_ref_dropped(self, oid: bytes):
+        # Runs from GC — any thread, possibly at interpreter shutdown.
+        if not self._connected:
+            return
+        with self._free_lock:
+            if self._task_borrows.get(oid, 0) > 0:
+                self._free_dropped.add(oid)
+                return
+            self._free_queue.append(oid)
+        try:
+            self.loop.call_soon_threadsafe(self._ensure_free_flush)
+        except RuntimeError:
+            pass  # loop is shutting down; store is reclaimed with the node
+
+    def _ensure_free_flush(self):
+        if self._free_flusher is None or self._free_flusher.done():
+            self._free_flusher = asyncio.ensure_future(self._flush_free())
+
+    async def _flush_free(self):
+        # Loop until the queue is drained: a ref dropped while the raylet
+        # call below is in flight sees this task as not-done and schedules
+        # nothing, so exiting with a non-empty queue would strand it.
+        while True:
+            await asyncio.sleep(0.05)  # debounce: batch bursts of GC'd refs
+            with self._free_lock:
+                oids, self._free_queue = self._free_queue, []
+            if not oids:
+                return
+            to_free = [o for o in oids if o in self._owned_store_oids]
+            for o in oids:
+                self._owned_store_oids.discard(o)
+                self.lineage.pop(o, None)
+                self.memory_store.pop(o, None)
+                self._in_store.discard(o)
+            if not self._connected:
+                return
+            if to_free:
+                try:
+                    await self.raylet.call(
+                        "free_objects", {"object_ids": to_free}, timeout=30
+                    )
+                except Exception:  # noqa: BLE001 — eviction backstops
+                    pass
+
+    def _borrow_deps(self, spec: dict, deps: List[bytes]):
+        """Pin deps for the task's lifetime so an argument whose driver ref
+        dies mid-flight is not freed under the running task."""
+        if not deps:
+            return
+        spec["deps_borrowed"] = list(deps)
+        with self._free_lock:
+            for dep in deps:
+                self._task_borrows[dep] = self._task_borrows.get(dep, 0) + 1
+
+    def _release_borrows(self, spec: dict):
+        deps = spec.pop("deps_borrowed", None)
+        if not deps:
+            return
+        enqueued = False
+        with self._free_lock:
+            for dep in deps:
+                n = self._task_borrows.get(dep, 0) - 1
+                if n > 0:
+                    self._task_borrows[dep] = n
+                    continue
+                self._task_borrows.pop(dep, None)
+                if dep in self._free_dropped:
+                    self._free_dropped.discard(dep)
+                    self._free_queue.append(dep)
+                    enqueued = True
+        if enqueued:
+            try:
+                self.loop.call_soon_threadsafe(self._ensure_free_flush)
+            except RuntimeError:
+                pass
+
     # -- kv --------------------------------------------------------------
     def kv_put(self, key: bytes, value: bytes, ns: str = "", overwrite=True) -> bool:
         r = self._run(
-            self.gcs.call(
+            self._gcs_call(
                 "kv_put", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
             )
         )
         return r["added"]
 
     def kv_get(self, key: bytes, ns: str = "") -> Optional[bytes]:
-        return self._run(self.gcs.call("kv_get", {"ns": ns, "key": key}))["value"]
+        return self._run(self._gcs_call("kv_get", {"ns": ns, "key": key}))["value"]
 
     def kv_del(self, key: bytes, ns: str = "") -> bool:
-        return self._run(self.gcs.call("kv_del", {"ns": ns, "key": key}))["deleted"]
+        return self._run(self._gcs_call("kv_del", {"ns": ns, "key": key}))["deleted"]
 
     def kv_keys(self, prefix: bytes = b"", ns: str = "") -> List[bytes]:
-        return self._run(self.gcs.call("kv_keys", {"ns": ns, "prefix": prefix}))["keys"]
+        return self._run(self._gcs_call("kv_keys", {"ns": ns, "prefix": prefix}))["keys"]
 
     # -- serialization helpers -------------------------------------------
     def serialize_args(self, args, kwargs) -> Tuple[bytes, List[bytes]]:
@@ -445,6 +593,8 @@ class CoreClient:
         self._put_to_store(oid, value)
         ref = ObjectRef(oid)
         self.known_refs[oid.binary()] = ref
+        self._owned_store_oids.add(oid.binary())
+        self._track_owned_ref(ref)
         return ref
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float]):
@@ -510,7 +660,7 @@ class CoreClient:
                 # produced: keep waiting (blocking get semantics).
                 try:
                     loc = self._run(
-                        self.gcs.call(
+                        self._gcs_call(
                             "object_location_get", {"object_id": oid}
                         ),
                         timeout=10,
@@ -586,7 +736,7 @@ class CoreClient:
                     # Check the cluster directory for remote completion; a
                     # spilled-only object is ready (restorable on get).
                     loc = self._run(
-                        self.gcs.call("object_location_get", {"object_id": oid})
+                        self._gcs_call("object_location_get", {"object_id": oid})
                     )
                     done = bool(loc["nodes"]) or bool(loc.get("spilled"))
                 (ready if done else still).append(ref)
@@ -650,6 +800,9 @@ class CoreClient:
             "runtime_env_hash": resolved_env["hash"] if resolved_env else None,
         }
         retries = cfg.task_max_retries if max_retries is None else max_retries
+        # The raylet's OOM policy prefers killing retriable tasks
+        # (worker_killing_policy.cc retriable-FIFO).
+        spec["retriable"] = retries > 0
         refs = []
         futures = []
         for i in range(num_returns):
@@ -657,8 +810,10 @@ class CoreClient:
             fut = concurrent.futures.Future()
             ref = ObjectRef(oid, fut)
             self.known_refs[oid.binary()] = ref
+            self._track_owned_ref(ref)
             refs.append(ref)
             futures.append(fut)
+        self._borrow_deps(spec, deps)
         asyncio.run_coroutine_threadsafe(
             self._submit_with_retries(spec, futures, retries), self.loop
         )
@@ -680,6 +835,7 @@ class CoreClient:
             return
 
     def _complete_task(self, spec, result, futures):
+        self._release_borrows(spec)
         status = result.get("status")
         if status == "ok":
             for i, entry in enumerate(result["returns"]):
@@ -696,10 +852,17 @@ class CoreClient:
                     futures[i].set_result(value)
                 else:  # in the shared store
                     self._in_store.add(oid)
+                    self._owned_store_oids.add(oid)
                     self.lineage[oid] = spec
                     while len(self.lineage) > self.lineage_max_entries:
                         self.lineage.popitem(last=False)
                     futures[i].set_result(_IN_STORE)
+                    if oid not in self.known_refs:
+                        # The caller dropped the ref before completion: the
+                        # finalizer already fired, so free the result now.
+                        with self._free_lock:
+                            self._free_queue.append(oid)
+                        self._ensure_free_flush()
         elif status == "error":
             err = _rebuild_task_error(result)
             for f in futures:
@@ -740,7 +903,7 @@ class CoreClient:
             "runtime_env": resolved_env,
         }
         resp = self._run(
-            self.gcs.call(
+            self._gcs_call(
                 "register_actor",
                 {
                     "actor_id": actor_id.binary(),
@@ -759,7 +922,7 @@ class CoreClient:
         if not resp.get("ok"):
             raise ValueError(resp.get("error", "actor registration failed"))
         self._run(
-            self.gcs.call("subscribe", {"channel": "actor_update:" + actor_id.hex()})
+            self._gcs_call("subscribe", {"channel": "actor_update:" + actor_id.hex()})
         )
         method_names = [
             m
@@ -777,7 +940,7 @@ class CoreClient:
         aid = actor_id.binary()
         info = self._actor_cache.get(aid)
         if info is None or info["state"] not in ("ALIVE", "DEAD"):
-            info = self._run(self.gcs.call("get_actor", {"actor_id": aid}))["actor"]
+            info = self._run(self._gcs_call("get_actor", {"actor_id": aid}))["actor"]
             if info is not None:
                 self._actor_cache[aid] = info
         if info is None:
@@ -787,9 +950,9 @@ class CoreClient:
             ev = self._actor_events.setdefault(aid, threading.Event())
             ev.clear()
             self._run(
-                self.gcs.call("subscribe", {"channel": "actor_update:" + actor_id.hex()})
+                self._gcs_call("subscribe", {"channel": "actor_update:" + actor_id.hex()})
             )
-            info = self._run(self.gcs.call("get_actor", {"actor_id": aid}))["actor"]
+            info = self._run(self._gcs_call("get_actor", {"actor_id": aid}))["actor"]
             self._actor_cache[aid] = info
             if info["state"] not in ("PENDING", "RESTARTING"):
                 break
@@ -849,9 +1012,11 @@ class CoreClient:
             fut = concurrent.futures.Future()
             ref = ObjectRef(oid, fut)
             self.known_refs[oid.binary()] = ref
+            self._track_owned_ref(ref)
             refs.append(ref)
             futures.append(fut)
         spec = {"task_id": task_id.binary()}
+        self._borrow_deps(spec, deps)
         asyncio.run_coroutine_threadsafe(
             self._actor_call_with_retries(
                 actor_id, request, spec, futures, max_task_retries
@@ -902,6 +1067,7 @@ class CoreClient:
                     attempt += 1
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
                     continue
+                self._release_borrows(spec)
                 err = ActorUnavailableError(
                     f"actor {actor_id.hex()} connection lost"
                 )
@@ -910,11 +1076,13 @@ class CoreClient:
                         f.set_exception(err)
                 return
             except (ActorDiedError, ActorUnavailableError) as e:
+                self._release_borrows(spec)
                 for f in futures:
                     if not f.done():
                         f.set_exception(e)
                 return
             except BaseException as e:  # noqa: BLE001
+                self._release_borrows(spec)
                 for f in futures:
                     if not f.done():
                         f.set_exception(e)
@@ -924,7 +1092,7 @@ class CoreClient:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._run(
-            self.gcs.call(
+            self._gcs_call(
                 "kill_actor",
                 {"actor_id": actor_id.binary(), "no_restart": no_restart},
             )
@@ -932,13 +1100,13 @@ class CoreClient:
 
     def get_actor_by_name(self, name: str, namespace: str = "") -> ActorHandle:
         info = self._run(
-            self.gcs.call("get_named_actor", {"name": name, "namespace": namespace})
+            self._gcs_call("get_named_actor", {"name": name, "namespace": namespace})
         )["actor"]
         if info is None or info["state"] == "DEAD":
             raise ValueError(f"no live actor named {name!r}")
         aid = ActorID(info["actor_id"])
         self._actor_cache[aid.binary()] = info
-        self._run(self.gcs.call("subscribe", {"channel": "actor_update:" + aid.hex()}))
+        self._run(self._gcs_call("subscribe", {"channel": "actor_update:" + aid.hex()}))
         # Method names are discovered lazily server-side; fetch from KV.
         meta = self.kv_get(b"actor_methods:" + aid.binary(), ns="actor")
         methods = cloudpickle.loads(meta) if meta else []
@@ -946,7 +1114,7 @@ class CoreClient:
 
     # -- cluster introspection --------------------------------------------
     def nodes(self) -> List[dict]:
-        return self._run(self.gcs.call("get_nodes", {}))["nodes"]
+        return self._run(self._gcs_call("get_nodes", {}))["nodes"]
 
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}

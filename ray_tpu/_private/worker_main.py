@@ -148,7 +148,7 @@ class WorkerProcess:
             if self._task_events:
                 events, self._task_events = self._task_events, []
                 try:
-                    await self.client.gcs.call("add_task_events", {"events": events})
+                    await self.client._gcs_call("add_task_events", {"events": events})
                 except Exception:
                     pass
 
@@ -219,7 +219,7 @@ class WorkerProcess:
             ]
             import cloudpickle
 
-            await self.client.gcs.call(
+            await self.client._gcs_call(
                 "kv_put",
                 {
                     "ns": "actor",
@@ -228,7 +228,7 @@ class WorkerProcess:
                     "overwrite": True,
                 },
             )
-            await self.client.gcs.call(
+            await self.client._gcs_call(
                 "actor_ready",
                 {
                     "actor_id": payload["actor_id"],
@@ -238,7 +238,7 @@ class WorkerProcess:
                 },
             )
         except BaseException as e:  # noqa: BLE001
-            await self.client.gcs.call(
+            await self.client._gcs_call(
                 "actor_ready",
                 {
                     "actor_id": payload["actor_id"],

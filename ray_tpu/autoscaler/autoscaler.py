@@ -238,7 +238,11 @@ class StandardAutoscaler:
                 continue
             min_hosts = spec.get("min_workers", 0) * slice_hosts
             for group in self._live_slice_groups(t, slice_hosts, view):
-                if not all(idle_expired(pid, view[pid]) for pid in group):
+                # Evaluate EVERY host (no short-circuit): idle_expired also
+                # clears a busy host's stale idle timer, and skipping that
+                # reset would let a pre-busy timer expire the slice.
+                statuses = [idle_expired(pid, view[pid]) for pid in group]
+                if not all(statuses):
                     continue
                 if counts.get(t, 0) - len(group) < min_hosts:
                     continue

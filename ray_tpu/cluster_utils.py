@@ -19,13 +19,27 @@ from ray_tpu._private.raylet import Raylet
 
 
 class Cluster:
-    def __init__(self):
+    def __init__(self, gcs_persist_path: Optional[str] = None):
         self.io = EventLoopThread("rt-cluster")
-        self.gcs = GcsServer()
+        self.gcs_persist_path = gcs_persist_path
+        self.gcs = GcsServer(persist_path=gcs_persist_path)
         self.gcs_port = self.io.run(self.gcs.start())
         self.raylets = []
         self.head = None
         self._client = None
+
+    def kill_gcs(self):
+        """Hard-stop the GCS process (fault injection)."""
+        self.io.run(self.gcs.stop(), timeout=5)
+
+    def restart_gcs(self):
+        """Start a fresh GCS on the same port; with a persist path it
+        restores its durable tables and live raylets re-register within a
+        heartbeat (GCS fault tolerance, redis_store_client.h:33 analog)."""
+        self.gcs = GcsServer(
+            port=self.gcs_port, persist_path=self.gcs_persist_path
+        )
+        assert self.io.run(self.gcs.start()) == self.gcs_port
 
     def add_node(
         self,
@@ -81,13 +95,12 @@ class Cluster:
         self.io.run(self.gcs._mark_node_dead(raylet.node_id.binary(), "removed"))
 
     def kill_raylet(self, raylet: Raylet):
-        """Simulate node failure without graceful teardown (chaos testing,
-        reference: test_utils.py RayletKiller :1446)."""
-        for w in raylet.workers.values():
-            try:
-                w.proc.kill()
-            except Exception:
-                pass
+        """Node failure without graceful teardown: the raylet's services
+        stop abruptly, its workers are SIGKILLed, and the GCS discovers the
+        death through the dropped connection (chaos testing, reference:
+        test_utils.py RayletKiller :1446)."""
+        self.io.run(raylet.kill(), timeout=10)
+        self.raylets.remove(raylet)
         self.io.run(self.gcs._mark_node_dead(raylet.node_id.binary(), "killed"))
 
     def shutdown(self):

@@ -247,45 +247,105 @@ class GroupedData:
 
 
 # ---------------------------------------------------------------------------
-# all-to-all implementations
+# all-to-all implementations: push-based distributed shuffle
+#
+# Reference analog: push_based_shuffle_task_scheduler.py:382 — map tasks
+# partition each block into N pieces (multi-return), reduce tasks merge
+# piece i from every map. The driver only moves REFS; rows never pass
+# through it, so shuffles scale with the cluster, not the driver.
 # ---------------------------------------------------------------------------
 
 
+def _shuffle_map_block(block, n: int, mode: str, key, seed, salt: int):
+    """Partition one block's rows into n pieces (runs as a remote task)."""
+    rows = B.block_to_rows(block)
+    parts: List[List] = [[] for _ in range(n)]
+    if mode == "random":
+        rng = _random.Random(None if seed is None else seed + salt)
+        for r in rows:
+            parts[rng.randrange(n)].append(r)
+    elif mode == "round_robin":
+        for i, r in enumerate(rows):
+            parts[i % n].append(r)
+    else:  # range partition by sorted boundary list in `key`=(col, bounds)
+        col, bounds = key
+        import bisect
+
+        for r in rows:
+            parts[bisect.bisect_right(bounds, r[col])].append(r)
+    out = tuple(B.block_from_rows(p) for p in parts)
+    return out if n > 1 else out[0]
+
+
+def _shuffle_reduce(mode: str, key, seed, salt: int, *pieces):
+    """Merge piece blocks from every map task (runs as a remote task)."""
+    rows: List = []
+    for b in pieces:
+        rows.extend(B.block_to_rows(b))
+    if mode == "random":
+        _random.Random(None if seed is None else seed + 7919 * (salt + 1)).shuffle(rows)
+    elif mode == "range":
+        col, descending = key
+        rows.sort(key=lambda r: r[col], reverse=descending)
+    return B.block_from_rows(rows)
+
+
+def _push_shuffle(refs: List, n_out: int, mode: str, map_key, reduce_key,
+                  seed=None) -> List:
+    if not refs:
+        return refs
+    n_out = max(n_out, 1)
+    map_fn = rt.remote(_shuffle_map_block)
+    reduce_fn = rt.remote(_shuffle_reduce)
+    pieces: List[List] = []  # [map][partition] -> ref
+    for i, ref in enumerate(refs):
+        out = map_fn.options(num_returns=n_out).remote(
+            ref, n_out, mode, map_key, seed, i
+        )
+        pieces.append([out] if n_out == 1 else list(out))
+    return [
+        reduce_fn.remote(mode, reduce_key, seed, j,
+                         *[pieces[i][j] for i in range(len(refs))])
+        for j in range(n_out)
+    ]
+
+
 def _repartition_refs(refs: List, num_blocks: int) -> List:
-    rows = []
-    for ref in refs:
-        rows.extend(B.block_to_rows(rt.get(ref)))
-    per = (len(rows) + num_blocks - 1) // max(num_blocks, 1)
-    out = []
-    for i in range(num_blocks):
-        chunk = rows[i * per : (i + 1) * per]
-        out.append(rt.put(B.block_from_rows(chunk)))
-    return out
+    return _push_shuffle(refs, num_blocks, "round_robin", None, None)
 
 
 def _shuffle_refs(refs: List, seed: Optional[int]) -> List:
-    rows = []
-    for ref in refs:
-        rows.extend(B.block_to_rows(rt.get(ref)))
-    rng = _random.Random(seed)
-    rng.shuffle(rows)
-    n = max(len(refs), 1)
-    per = (len(rows) + n - 1) // n
-    return [
-        rt.put(B.block_from_rows(rows[i * per : (i + 1) * per])) for i in range(n)
-    ]
+    return _push_shuffle(refs, len(refs), "random", None, None, seed=seed)
 
 
 def _sort_refs(refs: List, key: str, descending: bool) -> List:
-    rows = []
-    for ref in refs:
-        rows.extend(B.block_to_rows(rt.get(ref)))
-    rows.sort(key=lambda r: r[key], reverse=descending)
+    """Distributed range-partitioned sort: sample boundaries, range-shuffle,
+    sort each partition (the reference's sort exchange, _internal/sort.py)."""
     n = max(len(refs), 1)
-    per = (len(rows) + n - 1) // n
-    return [
-        rt.put(B.block_from_rows(rows[i * per : (i + 1) * per])) for i in range(n)
-    ]
+    # Sample keys from every block to pick n-1 partition boundaries
+    # (all sample tasks in flight at once; one batched get).
+    sample_fn = rt.remote(_sample_keys)
+    sample_refs = [sample_fn.remote(ref, key, 16) for ref in refs]
+    samples: List = [s for chunk in rt.get(sample_refs) for s in chunk]
+    samples.sort()
+    bounds = [
+        samples[(i + 1) * len(samples) // n]
+        for i in range(n - 1)
+    ] if samples else []
+    out = _push_shuffle(
+        refs, n, "range", (key, bounds), (key, descending)
+    )
+    if descending:
+        out = list(reversed(out))
+    return out
+
+
+def _sample_keys(block, key: str, k: int):
+    rows = B.block_to_rows(block)
+    if len(rows) <= k:
+        return [r[key] for r in rows]
+    step = len(rows) / k
+    return [rows[int(i * step)][key] for i in range(k)]
 
 
 def _np_item(x):

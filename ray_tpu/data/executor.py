@@ -1,17 +1,25 @@
-"""Streaming executor: pulls blocks through operator stages with bounded
-in-flight work.
+"""Streaming executor: blocks flow through operator chains with bounded
+in-flight work and no per-stage barrier.
 
 Analog of the reference's StreamingExecutor
 (data/_internal/execution/streaming_executor.py:57; scheduling loop :242)
 over PhysicalOperators (execution/interfaces/physical_operator.py:136) with
-backpressure (execution/backpressure_policy/): each map stage keeps at most
-`max_in_flight` block tasks outstanding; completed output refs flow to the
-next stage immediately (no stage barrier).
+backpressure (execution/backpressure_policy/):
+
+  * consecutive map stages are CHAINED per block — block i's stage-2 task
+    is submitted the moment its stage-1 task is, with the stage-1 output
+    ref as a dependency, so stage 2 starts on block i while block j is
+    still in stage 1 (true streaming, no stage barrier);
+  * at most `max_in_flight` blocks ride the chain at once — completed
+    chains admit new blocks (bounded memory: with spilling this is the
+    out-of-core path);
+  * AllToAllStages (shuffle/sort/repartition) are inherent barriers.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional
 
@@ -48,31 +56,41 @@ class StreamingExecutor:
     def execute(self, input_refs: List) -> List:
         """Run the stage pipeline over input block refs; returns output refs."""
         refs = list(input_refs)
-        pending_stages = list(self.stages)
-        for stage in pending_stages:
+        # Split into runs of map stages separated by all-to-all barriers.
+        run: List[MapStage] = []
+        for stage in self.stages:
             if isinstance(stage, AllToAllStage):
+                if run:
+                    refs = self._run_map_chain(run, refs)
+                    run = []
                 refs = stage.fn(refs)
             else:
-                refs = self._run_map_stage(stage, refs)
+                run.append(stage)
+        if run:
+            refs = self._run_map_chain(run, refs)
         return refs
 
-    def _run_map_stage(self, stage: MapStage, input_refs: List) -> List:
-        """Bounded-concurrency map over blocks (backpressure policy)."""
-        remote_fn = rt.remote(_apply_block_fn)
-        if stage.resources:
-            remote_fn = remote_fn.options(resources=stage.resources)
-        out: List = []
+    def _run_map_chain(self, stages: List[MapStage], input_refs: List) -> List:
+        """Pipeline a run of map stages: per-block task chains, bounded
+        number of blocks in flight (the backpressure window)."""
+        remote_fns = []
+        for st in stages:
+            f = rt.remote(_apply_block_fn)
+            if st.resources:
+                f = f.options(resources=st.resources)
+            remote_fns.append((f, st.fn))
+        cap = max(min(st.max_in_flight for st in stages), 1)
+        queue = deque(input_refs)
         in_flight: List = []
-        queue = list(input_refs)
+        out: List = []
         while queue or in_flight:
-            while queue and len(in_flight) < max(stage.max_in_flight, 1):
-                block_ref = queue.pop(0)
-                in_flight.append(remote_fn.remote(stage.fn, block_ref))
-            ready, in_flight = rt.wait(
-                in_flight, num_returns=1, timeout=60.0
-            )
+            while queue and len(in_flight) < cap:
+                ref = queue.popleft()
+                for f, fn in remote_fns:
+                    ref = f.remote(fn, ref)
+                in_flight.append(ref)
+            ready, in_flight = rt.wait(in_flight, num_returns=1, timeout=60.0)
             out.extend(ready)
             if not ready and in_flight:
-                # Nothing completed within the window; keep waiting.
                 time.sleep(0.01)
         return out

@@ -66,16 +66,16 @@ class RuntimeEnv(dict):
         env_vars: Optional[Dict[str, str]] = None,
         working_dir: Optional[str] = None,
         py_modules: Optional[List[str]] = None,
+        pip: Optional[Any] = None,
         **kwargs,
     ):
         super().__init__()
-        for k in ("pip", "conda"):
-            if kwargs.pop(k, None) is not None:
-                raise ValueError(
-                    f"runtime_env[{k!r}] is not supported on this TPU build: "
-                    "dependencies must be baked into the host image "
-                    "(per-task installs would stall whole TPU slices)"
-                )
+        if kwargs.pop("conda", None) is not None:
+            raise ValueError(
+                "runtime_env['conda'] is not supported on this TPU build: "
+                "use 'pip' (per-env-hash venvs) or bake dependencies into "
+                "the host image"
+            )
         unknown = set(kwargs) - set(_plugins)
         if unknown:
             raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
@@ -90,6 +90,16 @@ class RuntimeEnv(dict):
             self["working_dir"] = working_dir
         if py_modules:
             self["py_modules"] = list(py_modules)
+        if pip:
+            # Reference shapes (runtime_env/pip.py): list of requirement
+            # strings / pip args, or {"packages": [...]}.
+            if isinstance(pip, dict):
+                pip = list(pip.get("packages") or ())
+            if not isinstance(pip, (list, tuple)) or not all(
+                isinstance(p, str) for p in pip
+            ):
+                raise TypeError("pip must be a list of requirement strings")
+            self["pip"] = list(pip)
         for k, v in kwargs.items():
             self[k] = v
 
@@ -211,6 +221,8 @@ def prepare_runtime_env(renv: Optional[dict], client) -> Optional[dict]:
             m if m.startswith("gcs://") else _upload_dir(client, m)
             for m in renv["py_modules"]
         ]
+    if renv.get("pip"):
+        resolved["pip"] = sorted(renv["pip"])
     for name, plugin in _plugins.items():
         if renv.get(name) is not None:
             resolved[name] = plugin.prepare(renv[name], client)
@@ -234,12 +246,89 @@ def _materialize(client, uri: str) -> str:
     return extract_package(blob, uri)
 
 
+def _venv_root() -> str:
+    return os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "venvs"
+    )
+
+
+def _pip_site_packages(venv_dir: str) -> str:
+    py = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    return os.path.join(venv_dir, "lib", py, "site-packages")
+
+
+def _materialize_pip_env(pip_args: List[str], env_hash: str) -> str:
+    """Per-env-hash venv with the requested pip installs; idempotent per
+    host, concurrency-safe via atomic mkdir + ready marker.
+
+    Reference analog: _private/runtime_env/pip.py (per-env virtualenv with
+    --system-site-packages so the base image's jax/numpy stay visible).
+    Returns the venv's site-packages path to prepend to sys.path.
+    """
+    import subprocess
+    import time as _time
+
+    venv_dir = os.path.join(_venv_root(), env_hash)
+    ready = os.path.join(venv_dir, ".rt_ready")
+    site = _pip_site_packages(venv_dir)
+    if os.path.exists(ready):
+        return site
+    claim = venv_dir + ".building"
+    try:
+        os.makedirs(claim)  # atomic claim
+        building = True
+    except FileExistsError:
+        building = False
+    if not building:
+        # Another worker is installing: wait for the marker.
+        deadline = _time.monotonic() + 600
+        while _time.monotonic() < deadline:
+            if os.path.exists(ready):
+                return site
+            _time.sleep(0.5)
+        raise RuntimeError(
+            f"timed out waiting for pip env {env_hash} to build"
+        )
+    try:
+        import venv as _venv
+
+        os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+        _venv.EnvBuilder(
+            system_site_packages=True, with_pip=True, clear=True
+        ).create(venv_dir)
+        pip_bin = os.path.join(venv_dir, "bin", "pip")
+        r = subprocess.run(
+            [pip_bin, "install", "--disable-pip-version-check", *pip_args],
+            capture_output=True, text=True, timeout=600,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"pip install {' '.join(pip_args)} failed:\n{r.stderr[-2000:]}"
+            )
+        with open(ready, "w") as f:
+            f.write("ok")
+        return site
+    except BaseException:
+        import shutil as _shutil
+
+        _shutil.rmtree(venv_dir, ignore_errors=True)
+        raise
+    finally:
+        import shutil as _shutil
+
+        _shutil.rmtree(claim, ignore_errors=True)
+
+
 def apply_runtime_env(resolved: Optional[dict], client) -> None:
     """Worker side: materialize the env before running user code."""
     if not resolved:
         return
     for k, v in (resolved.get("env_vars") or {}).items():
         os.environ[k] = v
+    if resolved.get("pip"):
+        site = _materialize_pip_env(resolved["pip"], resolved["hash"])
+        if site not in sys.path:
+            sys.path.insert(0, site)
     for uri in resolved.get("py_module_uris") or ():
         path = _materialize(client, uri)
         if path not in sys.path:

@@ -19,7 +19,9 @@ from ray_tpu.serve.deployment import (
     Deployment,
     deployment,
 )
-from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import ProxyActor
 
 _proxy = None

@@ -102,6 +102,10 @@ class ServeController:
                 replica = ReplicaActor.options(
                     num_cpus=opts.pop("num_cpus", 0.1),
                     resources=opts.pop("resources", None),
+                    # Concurrent request execution inside the replica: the
+                    # substrate @serve.batch coalesces across (capped so a
+                    # misconfigured deployment can't demand 100 threads).
+                    max_concurrency=min(dep.max_ongoing_requests, 32),
                 ).remote(
                     dep.func_or_class,
                     app["init_args"],

@@ -2,8 +2,13 @@
 
 Analog of the reference's DeploymentHandle (serve/handle.py:830) + Router
 (serve/_private/router.py:924, assign_request :1040) with the
-PowerOfTwoChoicesReplicaScheduler (:295): pick two random replicas, probe
-their queue lengths, send to the shorter queue.
+PowerOfTwoChoicesReplicaScheduler (:295). Unlike round 1, replica choice
+uses HANDLE-LOCAL in-flight counts (sample two replicas, pick the one this
+handle has fewer outstanding requests on) — zero probe RPCs on the request
+path, which is also how the reference's router tracks queue length client-
+side between probes. Requests can be tagged with a multiplexed model id;
+those route by stable hash so a model's requests land on the replica that
+already has it loaded.
 """
 
 from __future__ import annotations
@@ -11,23 +16,55 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Any, List, Optional
+import zlib
+from typing import Any, Dict, List, Optional
 
 import ray_tpu as rt
 
 
+class DeploymentResponse:
+    """Awaitable-ish response wrapper: `.result()` blocks; `.ref` is the
+    underlying ObjectRef (reference: serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref, on_done=None):
+        self.ref = ref
+        if on_done is not None and ref._future is not None:
+            ref._future.add_done_callback(lambda _f: on_done())
+
+    def result(self, timeout: Optional[float] = 60.0):
+        return rt.get(self.ref, timeout=timeout)
+
+
 class DeploymentHandle:
-    def __init__(self, app_name: str, method: str = "__call__"):
+    def __init__(self, app_name: str, method: str = "__call__",
+                 multiplexed_model_id: str = "", stream: bool = False,
+                 _shared=None):
         self.app_name = app_name
         self.method = method
-        self._replicas: List = []
-        self._version = -1
-        self._last_refresh = 0.0
-        self._lock = threading.Lock()
+        self.multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
+        # Router state shared across .options() copies of this handle.
+        if _shared is None:
+            _shared = {
+                "replicas": [],
+                "version": -1,
+                "last_refresh": 0.0,
+                "inflight": {},  # actor_id -> handle-local outstanding
+                "lock": threading.Lock(),
+            }
+        self._shared = _shared
 
-    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
-        h = DeploymentHandle(self.app_name, method_name)
-        return h
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.app_name,
+            method_name if method_name is not None else self.method,
+            (multiplexed_model_id if multiplexed_model_id is not None
+             else self.multiplexed_model_id),
+            stream if stream is not None else self._stream,
+            _shared=self._shared,
+        )
 
     def _controller(self):
         from ray_tpu.serve.controller import CONTROLLER_NAME
@@ -35,44 +72,114 @@ class DeploymentHandle:
         return rt.get_actor(CONTROLLER_NAME)
 
     def _refresh(self, force: bool = False):
+        s = self._shared
         now = time.monotonic()
-        with self._lock:
-            if not force and self._replicas and now - self._last_refresh < 1.0:
+        with s["lock"]:
+            if not force and s["replicas"] and now - s["last_refresh"] < 1.0:
                 return
         info = rt.get(self._controller().get_replicas.remote(self.app_name),
                       timeout=30)
-        with self._lock:
-            self._version = info["version"]
-            self._replicas = info["replicas"]
-            self._last_refresh = now
+        with s["lock"]:
+            s["version"] = info["version"]
+            s["replicas"] = info["replicas"]
+            s["last_refresh"] = now
+            live = {r._actor_id.binary() for r in s["replicas"]}
+            s["inflight"] = {
+                k: v for k, v in s["inflight"].items() if k in live
+            }
 
     def _pick_replica(self):
-        """Power-of-two-choices (reference: router.py:295)."""
+        """Power-of-two by handle-local in-flight count (router.py:295) —
+        no probe RPCs on the request path. Multiplexed requests hash the
+        model id to a stable replica so its weights stay resident."""
         self._refresh()
-        with self._lock:
-            replicas = list(self._replicas)
+        s = self._shared
+        with s["lock"]:
+            replicas = list(s["replicas"])
         if not replicas:
             self._refresh(force=True)
-            with self._lock:
-                replicas = list(self._replicas)
+            with s["lock"]:
+                replicas = list(s["replicas"])
             if not replicas:
                 raise RuntimeError(
                     f"no running replicas for app {self.app_name!r}"
                 )
+        if self.multiplexed_model_id:
+            idx = zlib.crc32(self.multiplexed_model_id.encode()) % len(replicas)
+            return replicas[idx]
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
-        try:
-            qa, qb = rt.get([a.queue_len.remote(), b.queue_len.remote()],
-                            timeout=5)
-        except Exception:
-            return a
-        return a if qa <= qb else b
+        with s["lock"]:
+            ia = s["inflight"].get(a._actor_id.binary(), 0)
+            ib = s["inflight"].get(b._actor_id.binary(), 0)
+        return a if ia <= ib else b
+
+    def _track(self, replica):
+        s = self._shared
+        key = replica._actor_id.binary()
+        with s["lock"]:
+            s["inflight"][key] = s["inflight"].get(key, 0) + 1
+
+        def done():
+            with s["lock"]:
+                n = s["inflight"].get(key, 0) - 1
+                if n <= 0:
+                    s["inflight"].pop(key, None)
+                else:
+                    s["inflight"][key] = n
+
+        return done
 
     def remote(self, *args, **kwargs):
-        """Async call: returns an ObjectRef resolving to the response."""
+        """Dispatch a request; returns a DeploymentResponse (streaming
+        handles return an iterator over chunks instead)."""
+        if self._stream:
+            return self._stream_call(args, kwargs)
         replica = self._pick_replica()
-        return replica.handle_request.remote(self.method, args, kwargs)
+        done = self._track(replica)
+        ref = replica.handle_request.remote(
+            self.method, args, kwargs, self.multiplexed_model_id
+        )
+        return DeploymentResponse(ref, on_done=done)
+
+    def _stream_call(self, args, kwargs):
+        """Generator deployment: yields chunks as the replica produces
+        them (reference: handle_request_streaming, replica.py:478)."""
+        replica = self._pick_replica()
+        sid = rt.get(
+            replica.start_stream.remote(
+                self.method, args, kwargs, self.multiplexed_model_id
+            ),
+            timeout=60,
+        )
+
+        def gen():
+            start = 0
+            while True:
+                out = rt.get(
+                    replica.next_chunks.remote(sid, start), timeout=60
+                )
+                for c in out["chunks"]:
+                    yield c
+                start += len(out["chunks"])
+                if out["error"]:
+                    raise RuntimeError(
+                        f"stream failed in replica: {out['error']}"
+                    )
+                if out["done"]:
+                    return
+
+        return gen()
+
+    def __reduce__(self):
+        # Router state (locks, in-flight counts) is process-local: a handle
+        # shipped to another process (deployment composition) starts fresh.
+        return (
+            DeploymentHandle,
+            (self.app_name, self.method, self.multiplexed_model_id,
+             self._stream),
+        )
 
     def __call__(self, *args, **kwargs):
         raise TypeError("use handle.remote(...) for deployment calls")

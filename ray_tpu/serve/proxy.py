@@ -1,9 +1,14 @@
 """HTTP proxy: the ingress data plane.
 
 Analog of the reference's ProxyActor/HTTPProxy (serve/_private/proxy.py:1115
-/ :759, uvicorn+starlette) built on aiohttp: JSON requests POSTed to
-/{app_name} are routed through a DeploymentHandle (power-of-two balancing)
-and the JSON response returned.
+/ :759, uvicorn+starlette) built on aiohttp. JSON requests POSTed to
+/{app_name} route through a DeploymentHandle; the response resolves
+WITHOUT holding a thread per in-flight request (the round-1 weakness): the
+actor-call completion future is awaited on the event loop. Streaming
+deployments (`?stream=1` or `Accept: text/event-stream`) are served as
+Server-Sent Events; the `serve_multiplexed_model_id` header tags requests
+for model multiplexing (reference: serve/_private/proxy.py header of the
+same name).
 """
 
 from __future__ import annotations
@@ -18,9 +23,11 @@ import ray_tpu as rt
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         import asyncio
+        import json
 
         from aiohttp import web
 
+        from ray_tpu._private.worker import _IN_STORE
         from ray_tpu.serve.handle import DeploymentHandle
 
         self.host = host
@@ -28,27 +35,96 @@ class ProxyActor:
         self._handles: Dict[str, DeploymentHandle] = {}
         self._ready = threading.Event()
 
-        async def handle_request(request: web.Request):
-            app_name = request.match_info["app"]
+        def get_handle(app_name: str) -> DeploymentHandle:
             handle = self._handles.get(app_name)
             if handle is None:
                 handle = DeploymentHandle(app_name)
                 self._handles[app_name] = handle
+            return handle
+
+        async def resolve(loop, response):
+            """Await a DeploymentResponse without burning a thread: the
+            completion future resolves on the client loop; only store-kind
+            results (rare for JSON responses) fall back to an executor."""
+            ref = response.ref
+            if ref._future is not None:
+                value = await asyncio.wrap_future(ref._future)
+                if value is not _IN_STORE:
+                    return value
+            return await loop.run_in_executor(
+                None, lambda: rt.get(ref, timeout=60)
+            )
+
+        async def handle_request(request: web.Request):
+            app_name = request.match_info["app"]
+            model_id = request.headers.get("serve_multiplexed_model_id", "")
+            want_stream = (
+                request.query.get("stream") == "1"
+                or "text/event-stream" in request.headers.get("Accept", "")
+            )
             try:
                 payload = await request.json()
             except Exception:
                 payload = None
             loop = asyncio.get_event_loop()
+            handle = get_handle(app_name)
+            if model_id:
+                handle = handle.options(multiplexed_model_id=model_id)
 
-            def call():
+            def dispatch(h):
                 if isinstance(payload, dict):
-                    return rt.get(handle.remote(**payload), timeout=60)
+                    return h.remote(**payload)
                 if payload is None:
-                    return rt.get(handle.remote(), timeout=60)
-                return rt.get(handle.remote(payload), timeout=60)
+                    return h.remote()
+                return h.remote(payload)
 
             try:
-                result = await loop.run_in_executor(None, call)
+                if want_stream:
+                    sse = web.StreamResponse(
+                        headers={
+                            "Content-Type": "text/event-stream",
+                            "Cache-Control": "no-cache",
+                        }
+                    )
+                    await sse.prepare(request)
+                    # After prepare() no second response can be returned:
+                    # mid-stream failures become a terminal SSE error event.
+                    try:
+                        chunk_iter = await loop.run_in_executor(
+                            None, dispatch, handle.options(stream=True)
+                        )
+
+                        def pull(it):
+                            try:
+                                return next(it), False
+                            except StopIteration:
+                                return None, True
+
+                        it = iter(chunk_iter)
+                        while True:
+                            chunk, done = await loop.run_in_executor(
+                                None, pull, it
+                            )
+                            if done:
+                                break
+                            await sse.write(
+                                f"data: {json.dumps(chunk)}\n\n".encode()
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        await sse.write(
+                            b"event: error\ndata: "
+                            + json.dumps(
+                                f"{type(e).__name__}: {e}"
+                            ).encode()
+                            + b"\n\n"
+                        )
+                    await sse.write_eof()
+                    return sse
+                # Dispatch is quick (replica pick + actor-call submit);
+                # the potentially-long wait is the await below, which
+                # holds no thread.
+                response = await loop.run_in_executor(None, dispatch, handle)
+                result = await resolve(loop, response)
                 return web.json_response({"result": result})
             except Exception as e:  # noqa: BLE001
                 return web.json_response(

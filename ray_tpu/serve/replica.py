@@ -1,17 +1,33 @@
 """Replica actors: host the user callable.
 
 Analog of the reference's ReplicaActor (serve/_private/replica.py:240;
-UserCallableWrapper :667): wraps the deployment's class/function, tracks
-ongoing requests (the queue-length signal the router and autoscaler
-consume), and executes calls.
+UserCallableWrapper :667; streaming handler :478): wraps the deployment's
+class/function, tracks ongoing requests (the queue-length signal the
+router and autoscaler consume), executes calls — concurrently on executor
+threads when the deployment allows it — and streams generator responses
+chunk-by-chunk to pollers.
 """
 
 from __future__ import annotations
 
 import inspect
+import itertools
+import threading
+import time
 from typing import Any, Dict, Optional
 
 import ray_tpu as rt
+
+
+class _StreamBuf:
+    """Chunks produced by a generator request, consumed by long-polls."""
+
+    def __init__(self):
+        self.chunks: list = []
+        self.done = False
+        self.error: Optional[str] = None
+        self.cond = threading.Condition()
+        self.last_read = time.monotonic()
 
 
 @rt.remote
@@ -28,30 +44,118 @@ class ReplicaActor:
                 self.callable.reconfigure(user_config)
         self.ongoing = 0
         self.total_served = 0
+        self._streams: Dict[int, _StreamBuf] = {}
+        self._stream_ids = itertools.count(1)
+        self._lock = threading.Lock()
 
-    def handle_request(self, method: str, args, kwargs):
+    def _target(self, method: str):
+        if self._is_function:
+            return self.callable
+        return getattr(self.callable, method or "__call__")
+
+    def handle_request(self, method: str, args, kwargs, model_id: str = ""):
         """Execute one request (reference: replica.py handle_request)."""
-        self.ongoing += 1
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
+        with self._lock:
+            self.ongoing += 1
         try:
-            if self._is_function:
-                target = self.callable
-            else:
-                target = getattr(self.callable, method or "__call__")
+            _set_request_model_id(model_id)
+            target = self._target(method)
             if inspect.iscoroutinefunction(target):
                 import asyncio
 
                 return asyncio.run(target(*args, **kwargs))
             return target(*args, **kwargs)
         finally:
-            self.ongoing -= 1
-            self.total_served += 1
+            _set_request_model_id("")
+            with self._lock:
+                self.ongoing -= 1
+                self.total_served += 1
+
+    # -- streaming (reference: handle_request_streaming, replica.py:478) --
+    def start_stream(self, method: str, args, kwargs,
+                     model_id: str = "") -> int:
+        """Begin a generator request; returns a stream id to poll."""
+        sid = next(self._stream_ids)
+        buf = _StreamBuf()
+        with self._lock:
+            self._streams[sid] = buf
+            self.ongoing += 1
+
+        def run():
+            from ray_tpu.serve.multiplex import _set_request_model_id
+
+            try:
+                _set_request_model_id(model_id)
+                gen = self._target(method)(*args, **kwargs)
+                for chunk in gen:
+                    with buf.cond:
+                        buf.chunks.append(chunk)
+                        buf.cond.notify_all()
+            except BaseException as e:  # noqa: BLE001 — crosses the wire
+                with buf.cond:
+                    buf.error = f"{type(e).__name__}: {e}"
+            finally:
+                _set_request_model_id("")
+                with buf.cond:
+                    buf.done = True
+                    buf.cond.notify_all()
+                with self._lock:
+                    self.ongoing -= 1
+                    self.total_served += 1
+
+        threading.Thread(target=run, daemon=True).start()
+        return sid
+
+    def next_chunks(self, stream_id: int, start: int,
+                    max_wait_s: float = 2.0) -> Dict:
+        """Long-poll chunks [start:]; returns {chunks, done, error}."""
+        buf = self._streams.get(stream_id)
+        if buf is None:
+            return {"chunks": [], "done": True,
+                    "error": f"unknown stream {stream_id}"}
+        with buf.cond:
+            if len(buf.chunks) <= start and not buf.done:
+                buf.cond.wait(timeout=max_wait_s)
+            out = buf.chunks[start:]
+            done = buf.done and start + len(out) >= len(buf.chunks)
+            err = buf.error
+            buf.last_read = time.monotonic()
+        if done:
+            with self._lock:
+                self._streams.pop(stream_id, None)
+        else:
+            self._gc_streams()
+        return {"chunks": out, "done": done, "error": err}
+
+    def _gc_streams(self, idle_s: float = 300.0):
+        now = time.monotonic()
+        with self._lock:
+            stale = [
+                sid for sid, b in self._streams.items()
+                if b.done and now - b.last_read > idle_s
+            ]
+            for sid in stale:
+                self._streams.pop(sid, None)
 
     def queue_len(self) -> int:
         """Queue-length probe (reference: power-of-two router probes)."""
         return self.ongoing
 
     def stats(self) -> Dict:
-        return {"ongoing": self.ongoing, "total_served": self.total_served}
+        out = {"ongoing": self.ongoing, "total_served": self.total_served}
+        # Batch-size observability for @serve.batch methods.
+        if not self._is_function:
+            sizes = {}
+            for k, v in self.callable.__dict__.items():
+                if k.startswith("__serve_batch_queue_"):
+                    sizes[k.removeprefix("__serve_batch_queue_")] = list(
+                        v.batch_sizes
+                    )
+            if sizes:
+                out["batch_sizes"] = sizes
+        return out
 
     def reconfigure(self, user_config):
         if hasattr(self.callable, "reconfigure"):

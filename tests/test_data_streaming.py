@@ -1,0 +1,87 @@
+"""Streaming-executor + distributed-shuffle tests (VERDICT r1 item 7).
+
+Reference analogs: data streaming executor backpressure tests and
+push-based shuffle (push_based_shuffle_task_scheduler.py:382).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import data as rtd
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_out_of_core_pipeline_exceeds_store():
+    """A map pipeline whose working set exceeds the object store must
+    stream through with bounded in-flight blocks (+ spill/ref-GC)."""
+    rt.init(num_cpus=2, object_store_memory=48 * 1024 * 1024)
+    try:
+        # 16 blocks x ~8MB = 128MB >> 48MB store.
+        ds = rtd.from_items(
+            [{"i": i} for i in range(16)], parallelism=16
+        ).map_batches(
+            lambda b: {"x": np.ones((len(b["i"]), 1_000_000))}
+        ).map_batches(
+            lambda b: {"s": np.asarray([np.asarray(x).sum() for x in b["x"]])}
+        )
+        out = ds.take_all()
+        assert len(out) == 16
+        assert all(r["s"] == 1_000_000.0 for r in out)
+    finally:
+        rt.shutdown()
+
+
+def test_two_node_distributed_shuffle():
+    """random_shuffle moves rows via map/reduce TASKS (driver touches only
+    refs); with two nodes the work spreads across both."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        ds = rtd.from_items([{"i": i} for i in range(500)], parallelism=8)
+        shuffled = ds.random_shuffle(seed=7).materialize()
+        vals = [r["i"] for r in shuffled.take_all()]
+        assert sorted(vals) == list(range(500))
+        assert vals != list(range(500)), "shuffle produced identity order"
+        # Determinism with a seed.
+        again = [
+            r["i"]
+            for r in ds.random_shuffle(seed=7).materialize().take_all()
+        ]
+        assert again == vals
+    finally:
+        cluster.shutdown()
+
+
+def test_distributed_sort_range_partitioned():
+    rt.init(num_cpus=2)
+    try:
+        import random
+
+        items = [{"k": random.Random(3).random() * i} for i in range(200)]
+        random.Random(5).shuffle(items)
+        ds = rtd.from_items(items, parallelism=6).sort("k")
+        out = [r["k"] for r in ds.take_all()]
+        assert out == sorted(out)
+        desc = [
+            r["k"]
+            for r in rtd.from_items(items, parallelism=6)
+            .sort("k", descending=True)
+            .take_all()
+        ]
+        assert desc == sorted(desc, reverse=True)
+    finally:
+        rt.shutdown()
+
+
+def test_repartition_distributed():
+    rt.init(num_cpus=2)
+    try:
+        ds = rtd.from_items([{"i": i} for i in range(100)], parallelism=2)
+        rp = ds.repartition(8).materialize()
+        assert rp.num_blocks() == 8
+        assert sorted(r["i"] for r in rp.take_all()) == list(range(100))
+    finally:
+        rt.shutdown()

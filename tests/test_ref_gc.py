@@ -1,0 +1,102 @@
+"""Owner-side reference GC: dropping the last ObjectRef frees cluster copies.
+
+Reference analog: the ReferenceCounter-driven plasma free
+(core_worker/reference_count.h:61) — when an owned object's ref count hits
+zero the owner deletes the primary copy instead of letting it rot until
+eviction/spilling.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu._private import worker as worker_mod
+
+
+@pytest.fixture
+def rt_start():
+    rt.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+    yield
+    rt.shutdown()
+
+
+def _wait_for(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_del_put_ref_frees_store(rt_start):
+    client = worker_mod.get_client()
+    ref = rt.put(np.ones(1_000_000))  # 8 MB
+    oid = ref.id.binary()
+    assert client.store.contains_raw(oid)
+    del ref
+    gc.collect()
+    assert _wait_for(lambda: not client.store.contains_raw(oid)), (
+        "store copy not freed after the last ref died"
+    )
+
+
+def test_del_task_return_frees_store(rt_start):
+    client = worker_mod.get_client()
+
+    @rt.remote
+    def produce():
+        return np.ones(1_000_000)
+
+    ref = produce.remote()
+    rt.get(ref)  # materialize in the store
+    oid = ref.id.binary()
+    assert client.store.contains_raw(oid)
+    del ref
+    gc.collect()
+    assert _wait_for(lambda: not client.store.contains_raw(oid))
+
+
+def test_repeated_big_puts_never_fill_store(rt_start):
+    """The bench_core regression: 20 x 64MB puts through a 256MB store must
+    recycle freed space, not spill or die with ObjectStoreFullError."""
+    for i in range(20):
+        ref = rt.put(np.full(8_000_000, i, dtype=np.float64))  # 64 MB
+        out = rt.get(ref)
+        assert out[0] == i
+        del out, ref
+        gc.collect()
+
+
+def test_borrowed_arg_not_freed_under_running_task(rt_start):
+    """Dropping the driver's ref right after submit must not free the
+    argument out from under the running task."""
+
+    @rt.remote
+    def consume(arr):
+        time.sleep(1.0)  # outlive the driver-side del + flush debounce
+        return float(arr.sum())
+
+    ref = rt.put(np.ones(1_000_000))
+    out_ref = consume.remote(ref)
+    del ref
+    gc.collect()
+    assert rt.get(out_ref, timeout=60) == 1_000_000.0
+
+
+def test_freed_object_get_fails(rt_start):
+    client = worker_mod.get_client()
+    ref = rt.put(np.ones(100_000))
+    oid = ref.id.binary()
+    # A true borrower copy: NOT the owner's instance from known_refs.
+    borrowed = worker_mod.ObjectRef(worker_mod.ObjectID(oid))
+    del ref
+    gc.collect()
+    assert _wait_for(lambda: not client.store.contains_raw(oid))
+    client._in_store.discard(oid)  # the borrower resolves via the cluster
+    with pytest.raises((rt.exceptions.ObjectLostError,
+                        rt.exceptions.GetTimeoutError)):
+        rt.get(borrowed, timeout=5)

@@ -15,8 +15,9 @@ from ray_tpu.runtime_env import RuntimeEnv
 
 
 def test_runtime_env_validation():
-    with pytest.raises(ValueError):
-        RuntimeEnv(pip=["requests"])
+    assert RuntimeEnv(pip=["requests"])["pip"] == ["requests"]
+    with pytest.raises(TypeError):
+        RuntimeEnv(pip=[1, 2])
     with pytest.raises(ValueError):
         RuntimeEnv(conda="env.yml")
     with pytest.raises(ValueError):
@@ -203,3 +204,43 @@ def test_job_submission_working_dir(rt_start, tmp_path):
         assert "job saw m4rk3r" in client.get_job_logs(sid)
     finally:
         client.close()
+
+def test_pip_env_installs_dependency_driver_lacks(rt_start, tmp_path):
+    """runtime_env={"pip": [...]} builds a per-env-hash venv and the task
+    imports a package the driver process does not have (reference:
+    _private/runtime_env/pip.py). Uses a local sdist so the zero-egress
+    test image needs no index."""
+    pkg = tmp_path / "rt_pip_dep"
+    (pkg / "rt_pip_dep").mkdir(parents=True)
+    (pkg / "rt_pip_dep" / "__init__.py").write_text("MAGIC = 'from-venv'\n")
+    (pkg / "pyproject.toml").write_text(
+        '[build-system]\n'
+        'requires = ["setuptools"]\n'
+        'build-backend = "setuptools.build_meta"\n'
+        '[project]\n'
+        'name = "rt-pip-dep"\n'
+        'version = "0.0.1"\n'
+        '[tool.setuptools.packages.find]\n'
+        'include = ["rt_pip_dep"]\n'
+    )
+
+    with pytest.raises(ImportError):
+        import rt_pip_dep  # noqa: F401 — the driver must NOT have it
+
+    @rt.remote(
+        runtime_env={
+            "pip": ["--no-index", "--no-build-isolation", str(pkg)]
+        },
+        max_retries=0,
+    )
+    def use_dep():
+        import rt_pip_dep
+
+        return rt_pip_dep.MAGIC
+
+    assert rt.get(use_dep.remote(), timeout=300) == "from-venv"
+
+
+def test_conda_still_rejected(rt_start):
+    with pytest.raises(ValueError, match="conda"):
+        RuntimeEnv(conda={"dependencies": ["pip"]})

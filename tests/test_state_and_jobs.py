@@ -47,7 +47,9 @@ def test_state_api_lists(rt_start):
     assert rt.get(h.get.remote()) == 1
     import numpy as np
 
-    rt.put(np.ones(300_000))  # big enough for the shared store
+    # Hold the ref: owner-side reference GC frees dropped objects now.
+    big_ref = rt.put(np.ones(300_000))  # big enough for the shared store
+    assert big_ref is not None
 
     nodes = state_api.list_nodes()
     assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
