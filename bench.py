@@ -57,12 +57,15 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:
-        # ~0.8B params: fits chip HBM with AdamW f32 state + bf16 grads.
+        # ~0.8B params: fits chip HBM with AdamW state + bf16 grads.
+        # dots_nobatch remat saves the non-batch matmul outputs — ~12%
+        # faster than full recompute and still fits the 16GB chip.
         cfg = replace(
             configs.get_config("llama2-1b"),
             n_layers=12,
             max_seq=2048,
             remat=True,
+            remat_policy="dots_nobatch",
         )
         batch, seq, steps, warmup = 4, 2048, 10, 2
     else:

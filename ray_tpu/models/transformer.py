@@ -45,7 +45,19 @@ class TransformerConfig:
     experts_per_token: int = 2
     # attention implementation: "flash" | "ring" | "ulysses"
     attn_impl: str = "flash"
+    # Flash-attention Pallas block sizes. bk=512 benches ~7% faster than
+    # 256 on v5e (fewer kv-loop iterations per MXU-resident q block);
+    # larger blocks blow the ~16MB VMEM scoped budget.
+    attn_block_q: int = 256
+    attn_block_k: int = 512
     remat: bool = True
+    # Rematerialization policy under remat=True: "full" recomputes the
+    # whole layer (min memory, the safe default); "dots_nobatch" saves
+    # non-batch matmul outputs
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) — ~12%
+    # faster than full on the 0.8B bench at the cost of activation memory;
+    # "dots" saves every matmul. Opt in per config/run.
+    remat_policy: str = "full"
     # Pipeline parallelism: microbatches per step when the mesh has pp>1
     # (0 = auto: 2*stages when the batch divides, else stages, else 1).
     pp_microbatches: int = 0
@@ -161,7 +173,8 @@ def _attention(cfg: TransformerConfig, q, k, v, mesh, positions):
         spec = P(("dp", "fsdp"), "sp", "tp", None)
         return ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True,
                                  query_spec=spec)
-    return flash_attention(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True,
+                           block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
 
 
 def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
@@ -206,7 +219,22 @@ def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
         return x, aux
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots_nobatch":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.dots_saveable
+            )
+        elif cfg.remat_policy == "full":
+            body = jax.checkpoint(body)  # recompute everything (min memory)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}: "
+                "expected 'full', 'dots', or 'dots_nobatch'"
+            )
     return body
 
 
