@@ -44,13 +44,24 @@ def init(
     Reference analog: ray.init (python/ray/_private/worker.py:1228). With no
     address this bootstraps a head node in-process (GCS + raylet services on
     a background event loop; worker processes are real subprocesses).
-    With address="host:port" it connects to an existing GCS.
+    With address="host:port" it connects to an existing GCS as a new node;
+    with address="rt://host:port" it attaches as a REMOTE driver (the
+    reference's Ray Client, ray://): no local node, no shared memory —
+    puts/gets proxy through the head raylet over TCP.
     """
     global _node, _client
     if _worker.is_initialized():
         if ignore_reinit_error:
             return
         raise RuntimeError("ray_tpu.init() called twice")
+
+    if address is not None and address.startswith("rt://"):
+        _client = _remote_attach(address.removeprefix("rt://"))
+        if runtime_env:
+            _client.default_runtime_env = runtime_env
+        _worker.set_client(_client, "driver", None)
+        atexit.register(shutdown)
+        return
 
     if local_mode:
         from ray_tpu._private.local_mode import LocalClient
@@ -99,6 +110,50 @@ def init(
     atexit.register(shutdown)
 
 
+def _remote_attach(address: str):
+    """Attach as a remote (rt://) driver: connect to the GCS, find the head
+    raylet, and build a storeless CoreClient proxying through it."""
+    import asyncio as _asyncio
+
+    from ray_tpu._private.ids import JobID as _JobID
+    from ray_tpu._private.node import EventLoopThread
+    from ray_tpu._private.protocol import connect as _connect
+    from ray_tpu._private.worker import CoreClient
+
+    host, port = address.rsplit(":", 1)
+    io = EventLoopThread("rt-client")
+
+    async def _find_head():
+        gcs = await _connect(host, int(port))
+        try:
+            nodes = (await gcs.call("get_nodes", {}))["nodes"]
+        finally:
+            await gcs.close()
+        heads = [n for n in nodes if n["state"] == "ALIVE" and n.get("is_head")]
+        alive = heads or [n for n in nodes if n["state"] == "ALIVE"]
+        if not alive:
+            raise ConnectionError(f"no live nodes behind rt://{address}")
+        return alive[0]
+
+    try:
+        head = io.run(_find_head())
+        client = CoreClient(
+            io.loop,
+            (host, int(port)),
+            (head["address"], head["port"]),
+            None,  # no local store: remote mode
+            head["node_id"],
+            _JobID.from_random(),
+            mode="driver",
+        )
+        client.connect()
+    except BaseException:
+        io.stop()  # failed attach must not leak the loop thread
+        raise
+    client._owns_io = io  # torn down in disconnect via shutdown()
+    return client
+
+
 def shutdown():
     """Tear down the cluster started by init() (reference: ray.shutdown)."""
     global _node, _client
@@ -107,6 +162,12 @@ def shutdown():
             _client.disconnect()
         except Exception:
             pass
+        io = getattr(_client, "_owns_io", None)
+        if io is not None:  # remote (rt://) driver owns its loop thread
+            try:
+                io.stop()
+            except Exception:
+                pass
         _client = None
     if _node is not None:
         try:

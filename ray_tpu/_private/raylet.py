@@ -123,6 +123,8 @@ class Raylet:
         self._storage = None  # lazy external storage
         self._spill_lock = asyncio.Lock()
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
+        # Open chunked remote-client puts: oid -> (buffer, abort deadline).
+        self._client_creates: Dict[bytes, tuple] = {}
 
         r = self.rpc.register
         r("register_worker", self.h_register_worker)
@@ -136,6 +138,11 @@ class Raylet:
         r("spill_objects", self.h_spill_objects)
         r("restore_spilled", self.h_restore_spilled)
         r("free_objects", self.h_free_objects)
+        r("client_put", self.h_client_put)
+        r("client_create", self.h_client_create)
+        r("client_put_chunk", self.h_client_put_chunk)
+        r("client_seal", self.h_client_seal)
+        r("client_get_info", self.h_client_get_info)
         r("get_info", self.h_get_info)
         r("prestart_workers", self.h_prestart_workers)
 
@@ -402,6 +409,16 @@ class Raylet:
         """Detect dead worker processes; fail their tasks/actors."""
         while True:
             await asyncio.sleep(0.2)
+            # Abort chunked remote-client puts whose client vanished.
+            now = time.monotonic()
+            for oid, (buf, deadline) in list(self._client_creates.items()):
+                if now > deadline:
+                    self._client_creates.pop(oid, None)
+                    del buf
+                    try:
+                        self.store.abort(ObjectID(oid))
+                    except Exception:  # noqa: BLE001
+                        pass
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None:
                     self._forget_worker(w)
@@ -1345,6 +1362,82 @@ class Raylet:
             del view
             self.store.release(oid)
         return {"data": data}
+
+    # -- remote (rt://) clients -------------------------------------------
+    # The reference's Ray Client (util/client/worker.py:81) proxies a
+    # driver with no node-local runtime. Here a remote driver holds only
+    # TCP connections: puts ship serialized bytes into this raylet's
+    # store; gets read size here then stream chunks via fetch_chunk.
+
+    async def h_client_put(self, d, conn):
+        oid = ObjectID(d["object_id"])
+        data = d["data"]
+        if not self.store.contains_raw(d["object_id"]):
+            buf = await self._create_with_spill(oid, len(data))
+            if buf is not None:
+                buf[:] = data
+                self.store.seal(oid)
+                self.store.release(oid)
+            else:
+                # Concurrent writer owns the buffer: wait until it seals so
+                # the ok below really means "readable" (mirrors
+                # h_restore_spilled's handling of the same race).
+                if not await self._wait_sealed(d["object_id"]):
+                    return {"ok": False, "error": "concurrent put never sealed"}
+        r = await self.h_object_created(
+            {"object_id": d["object_id"], "size": len(data)}, conn
+        )
+        return {"ok": bool(r.get("ok", True))}
+
+    async def h_client_create(self, d, conn):
+        """Begin a chunked remote put: allocate the buffer, hold it until
+        client_seal (reaped if the client vanishes)."""
+        oid = ObjectID(d["object_id"])
+        if self.store.contains_raw(d["object_id"]):
+            return {"ok": True, "exists": True}
+        buf = await self._create_with_spill(oid, d["size"])
+        if buf is None:
+            if not await self._wait_sealed(d["object_id"]):
+                return {"ok": False, "error": "concurrent put never sealed"}
+            return {"ok": True, "exists": True}
+        self._client_creates[d["object_id"]] = (buf, time.monotonic() + 600)
+        return {"ok": True, "exists": False}
+
+    async def h_client_put_chunk(self, d, conn):
+        entry = self._client_creates.get(d["object_id"])
+        if entry is None:
+            return {"ok": False, "error": "no open create for object"}
+        buf, _ = entry
+        off = d["offset"]
+        buf[off:off + len(d["data"])] = d["data"]
+        return {"ok": True}
+
+    async def h_client_seal(self, d, conn):
+        entry = self._client_creates.pop(d["object_id"], None)
+        if entry is None:
+            return {"ok": False, "error": "no open create for object"}
+        oid = ObjectID(d["object_id"])
+        self.store.seal(oid)
+        self.store.release(oid)
+        r = await self.h_object_created(
+            {"object_id": d["object_id"], "size": d["size"]}, conn
+        )
+        return {"ok": bool(r.get("ok", True))}
+
+    async def h_client_get_info(self, d, conn):
+        """Ensure the object is local and return its size (the client then
+        streams it out with fetch_chunk)."""
+        oid = d["object_id"]
+        await self._ensure_local(oid, timeout=d.get("timeout", 60.0))
+        view = self.store.get(ObjectID(oid))
+        if view is None:
+            return {"ok": False, "error": "object not available"}
+        try:
+            size = len(view)
+        finally:
+            del view
+            self.store.release(ObjectID(oid))
+        return {"ok": True, "size": size}
 
     async def h_wait_object_local(self, d, conn):
         """Driver asks: make this object available in the local store."""

@@ -230,7 +230,10 @@ class CoreClient:
         self.job_id = job_id
         self.mode = mode
         self.client_id = _os.urandom(16)
-        self.store = ObjectStore(store_name)
+        # store_name=None => remote (rt://) driver: no node-local shared
+        # memory; puts/gets proxy through the raylet over TCP (the
+        # reference's Ray Client role, util/client/worker.py:81).
+        self.store = ObjectStore(store_name) if store_name else None
         # LRU-bounded cache of inline results (the in-process memory store,
         # memory_store.h:43). Values remain recoverable from a live ref's
         # completion future after eviction, so the bound is safe.
@@ -377,7 +380,8 @@ class CoreClient:
         # Leave the shared mapping in place if any fetched value might still
         # alias store memory — unmapping under a live numpy view is a
         # segfault. The mapping is reclaimed at process exit.
-        self.store.close(unmap=not self._live_views_at_disconnect)
+        if self.store is not None:
+            self.store.close(unmap=not self._live_views_at_disconnect)
         self._connected = False
 
     def _run(self, coro, timeout=None):
@@ -538,7 +542,9 @@ class CoreClient:
     def promote_ref(self, ref: ObjectRef):
         """Ensure a ref's value is resolvable from the shared store."""
         oid = ref.id.binary()
-        if oid in self._in_store or self.store.contains_raw(oid):
+        if oid in self._in_store or (
+            self.store is not None and self.store.contains_raw(oid)
+        ):
             return
         value = None
         have_value = False
@@ -557,6 +563,9 @@ class CoreClient:
         pressure; registers + pins the primary copy via the raylet
         (object_created), never silently evictable."""
         from ray_tpu.exceptions import ObjectStoreFullError
+
+        if self.store is None:  # remote driver: ship bytes to the raylet
+            return self._client_put_remote(oid, so)
 
         wrote = False
         attempts = 8
@@ -625,7 +634,7 @@ class CoreClient:
                 return completed
         if oid in self.memory_store:
             return self.memory_store[oid]
-        if self.store.contains_raw(oid):
+        if self.store is not None and self.store.contains_raw(oid):
             return self._read_store(ObjectID(oid))
         # Remote: ask our raylet to pull it locally. Probes are short so a
         # vanished object is detected well before the caller's deadline;
@@ -692,7 +701,94 @@ class CoreClient:
             f"object {ref.hex()} could not be retrieved: {last_err}"
         ) from None
 
+    def _client_put_remote(self, oid: ObjectID, so) -> bool:
+        """Ship a put to the raylet's store over TCP. Small objects go in
+        one frame; large ones stream in transfer-sized chunks so neither
+        side buffers (or stalls its event loop on) one giant message."""
+        data = so.to_bytes()
+        chunk = get_config().object_transfer_chunk_size
+        if len(data) <= chunk:
+            r = self._run(
+                self.raylet.call(
+                    "client_put", {"object_id": oid.binary(), "data": data},
+                    timeout=120,
+                )
+            )
+            return bool(r.get("ok"))
+        r = self._run(
+            self.raylet.call(
+                "client_create",
+                {"object_id": oid.binary(), "size": len(data)},
+                timeout=120,
+            )
+        )
+        if not r.get("ok"):
+            raise ObjectLostError(f"remote put failed: {r.get('error')}")
+        if r.get("exists"):
+            return True
+        view = memoryview(data)
+        for off in range(0, len(data), chunk):
+            r = self._run(
+                self.raylet.call(
+                    "client_put_chunk",
+                    {"object_id": oid.binary(), "offset": off,
+                     "data": bytes(view[off:off + chunk])},
+                    timeout=120,
+                )
+            )
+            if not r.get("ok"):
+                raise ObjectLostError(f"remote put failed: {r.get('error')}")
+        r = self._run(
+            self.raylet.call(
+                "client_seal",
+                {"object_id": oid.binary(), "size": len(data)},
+                timeout=120,
+            )
+        )
+        return bool(r.get("ok"))
+
+    def _read_remote(self, oid: ObjectID):
+        """Remote (rt://) driver: stream the object out of the raylet's
+        store over TCP in transfer-sized chunks."""
+        from ray_tpu._private.protocol import RpcError
+
+        try:
+            info = self._run(
+                self.raylet.call(
+                    "client_get_info", {"object_id": oid.binary()},
+                    timeout=120,
+                )
+            )
+            if not info.get("ok"):
+                raise ObjectLostError(
+                    f"object {oid.hex()}: {info.get('error')}"
+                )
+            size = info["size"]
+            chunk = get_config().object_transfer_chunk_size
+            parts = []
+            off = 0
+            while off < size:
+                n = min(chunk, size - off)
+                r = self._run(
+                    self.raylet.call(
+                        "fetch_chunk",
+                        {"object_id": oid.binary(), "offset": off, "size": n},
+                        timeout=120,
+                    )
+                )
+                parts.append(r["data"])
+                off += n
+        except RpcError as e:
+            raise ObjectLostError(
+                f"remote fetch of {oid.hex()} failed: {e}"
+            ) from None
+        value = ser.deserialize(memoryview(b"".join(parts)))
+        self._in_store.add(oid.binary())
+        return value
+
     def _read_store(self, oid: ObjectID):
+        if self.store is None:
+            return self._read_remote(oid)
         view = self.store.get(oid)
         if view is None:
             raise ObjectLostError(f"object {oid.hex()} missing from local store")
@@ -730,7 +826,8 @@ class CoreClient:
                 done = (
                     (ref._future is not None and ref._future.done())
                     or oid in self.memory_store
-                    or self.store.contains_raw(oid)
+                    or (self.store is not None
+                        and self.store.contains_raw(oid))
                 )
                 if not done and ref._future is None:
                     # Check the cluster directory for remote completion; a

@@ -11,6 +11,7 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
@@ -57,6 +58,7 @@ __all__ = [
     "ConcurrencyLimiter",
     "ASHAScheduler",
     "MedianStoppingRule",
+    "PopulationBasedTraining",
     "FIFOScheduler",
     "TrialScheduler",
     "Domain",

@@ -1,17 +1,20 @@
 """Trial schedulers.
 
 Analog of the reference's tune/schedulers: FIFO and ASHA
-(async_hyperband.py) plus median stopping (median_stopping_rule.py).
+(async_hyperband.py), median stopping (median_stopping_rule.py), and
+population based training (pbt.py).
 """
 
 from __future__ import annotations
 
 import math
+import random
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+EXPLOIT = "EXPLOIT"  # PBT: clone a better trial's state + mutate config
 
 
 class TrialScheduler:
@@ -118,3 +121,126 @@ class MedianStoppingRule(TrialScheduler):
         mine = sum(self.histories[trial_id]) / len(self.histories[trial_id])
         worse = mine > median if self.mode == "min" else mine < median
         return STOP if worse else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """Population based training (reference: tune/schedulers/pbt.py).
+
+    Every `perturbation_interval` reported iterations a trial in the bottom
+    quantile EXPLOITS a top-quantile trial — the tuner restarts it from the
+    donor's checkpoint — and EXPLORES by mutating hyperparameters: with
+    `resample_probability` a fresh sample from `hyperparam_mutations`,
+    otherwise the value scaled by 1.2/0.8 (or a neighboring choice for
+    categorical lists).
+    """
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        if not hyperparam_mutations:
+            raise ValueError("PBT requires hyperparam_mutations")
+        if not 0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._scores: Dict[str, float] = {}
+        self._iters: Dict[str, int] = defaultdict(int)
+        self._last_perturb: Dict[str, int] = defaultdict(int)
+        self._configs: Dict[str, Dict] = {}
+        self._checkpoints: Dict[str, str] = {}
+        self.num_exploits = 0  # observability for tests/dashboards
+
+    # -- tuner integration hooks ----------------------------------------
+    def on_trial_add(self, trial_id: str, config: Dict):
+        self._configs[trial_id] = dict(config)
+
+    def record_checkpoint(self, trial_id: str, path: str):
+        self._checkpoints[trial_id] = path
+
+    def on_complete(self, trial_id: str, result: Optional[Dict] = None):
+        self._scores.pop(trial_id, None)
+        self._checkpoints.pop(trial_id, None)
+
+    # -- decisions -------------------------------------------------------
+    def _quantiles(self):
+        ranked = sorted(
+            self._scores.items(), key=lambda kv: kv[1],
+            reverse=(self.mode == "max"),
+        )
+        k = max(1, int(len(ranked) * self.quantile))
+        if len(ranked) < 2 * k:
+            return [], []
+        top = [tid for tid, _ in ranked[:k]]
+        bottom = [tid for tid, _ in ranked[-k:]]
+        return top, bottom
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        if self.metric not in result:
+            return CONTINUE
+        self._scores[trial_id] = float(result[self.metric])
+        self._iters[trial_id] += 1
+        if self._iters[trial_id] - self._last_perturb[trial_id] < self.interval:
+            return CONTINUE
+        top, bottom = self._quantiles()
+        if trial_id not in bottom:
+            return CONTINUE
+        donors = [t for t in top if t in self._checkpoints]
+        if not donors:
+            return CONTINUE
+        self._last_perturb[trial_id] = self._iters[trial_id]
+        return EXPLOIT
+
+    def make_exploit(self, trial_id: str):
+        """Pick a donor; return (donor_checkpoint_path, mutated_config)."""
+        top, _ = self._quantiles()
+        donors = [t for t in top if t in self._checkpoints]
+        if not donors:
+            return None, None
+        donor = self._rng.choice(donors)
+        new_config = self._explore(dict(self._configs.get(donor, {})))
+        self._configs[trial_id] = new_config
+        self.num_exploits += 1
+        return self._checkpoints[donor], new_config
+
+    def _explore(self, config: Dict) -> Dict:
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_p or key not in config:
+                config[key] = self._sample(spec)
+            elif isinstance(spec, (list, tuple)):
+                # Move to a neighboring choice.
+                try:
+                    idx = list(spec).index(config[key])
+                except ValueError:
+                    idx = self._rng.randrange(len(spec))
+                step = self._rng.choice((-1, 1))
+                config[key] = list(spec)[max(0, min(len(spec) - 1, idx + step))]
+            elif isinstance(config[key], (int, float)):
+                factor = self._rng.choice((0.8, 1.2))
+                val = config[key] * factor
+                config[key] = type(config[key])(val) if isinstance(
+                    config[key], int) else val
+            else:
+                config[key] = self._sample(spec)
+        return config
+
+    def _sample(self, spec):
+        if callable(spec):
+            return spec()
+        if isinstance(spec, (list, tuple)):
+            return self._rng.choice(list(spec))
+        raise TypeError(
+            f"hyperparam_mutations values must be callables or lists, "
+            f"got {type(spec).__name__}"
+        )

@@ -21,7 +21,13 @@ from typing import Any, Callable, Dict, List, Optional
 import ray_tpu as rt
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import Result, RunConfig
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    EXPLOIT,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 
 
@@ -116,6 +122,7 @@ class Trial:
     checkpoint: Optional[Checkpoint] = None
     error: Optional[str] = None
     iteration: int = 0
+    trial_dir: str = ""
 
 
 class ResultGrid:
@@ -207,6 +214,7 @@ class Tuner:
                 trial = Trial(trial_id=trial_id, config=config)
                 trial_dir = os.path.join(exp_dir, f"trial_{trial_id}")
                 os.makedirs(trial_dir, exist_ok=True)
+                trial.trial_dir = trial_dir
                 trial.actor = _TrialActor.options(
                     num_cpus=resources.get("CPU", 1.0),
                     resources={k: v for k, v in resources.items() if k != "CPU"},
@@ -214,6 +222,8 @@ class Tuner:
                 rt.get(trial.actor.run.remote(self.trainable, config, None),
                        timeout=300)
                 trial.state = "RUNNING"
+                if hasattr(scheduler, "on_trial_add"):
+                    scheduler.on_trial_add(trial_id, config)
                 trials.append(trial)
                 live.append(trial)
 
@@ -224,6 +234,7 @@ class Tuner:
             polls = rt.get([t.actor.poll.remote() for t in live], timeout=300)
             still_live = []
             for trial, st in zip(live, polls):
+                exploited = False
                 for rep in st["reports"]:
                     trial.iteration += 1
                     metrics = dict(rep["metrics"])
@@ -234,9 +245,20 @@ class Tuner:
                         trial.checkpoint = Checkpoint.from_directory(
                             rep["checkpoint_path"]
                         )
+                        if hasattr(scheduler, "record_checkpoint"):
+                            scheduler.record_checkpoint(
+                                trial.trial_id, rep["checkpoint_path"]
+                            )
                     decision = scheduler.on_result(trial.trial_id, metrics)
                     if decision == STOP and not st["done"]:
                         trial.state = "STOPPED"
+                    elif decision == EXPLOIT and not st["done"]:
+                        exploited = self._exploit(trial, scheduler, resources)
+                        if exploited:
+                            break  # fresh actor: stale reports are moot
+                if exploited:
+                    still_live.append(trial)
+                    continue
                 if st["error"]:
                     trial.state = "ERROR"
                     trial.error = st["error"]
@@ -267,6 +289,30 @@ class Tuner:
             for t in trials
         ]
         return ResultGrid(results, trials, tc.metric, tc.mode)
+
+    def _exploit(self, trial: Trial, scheduler, resources) -> bool:
+        """PBT exploit/explore: restart the trial from a donor's checkpoint
+        with a mutated config (reference: pbt.py _exploit)."""
+        ckpt_path, new_config = scheduler.make_exploit(trial.trial_id)
+        if ckpt_path is None:
+            return False
+        try:
+            rt.kill(trial.actor)
+        except Exception:
+            pass
+        trial.config = new_config
+        trial.actor = _TrialActor.options(
+            num_cpus=resources.get("CPU", 1.0),
+            resources={k: v for k, v in resources.items() if k != "CPU"},
+        ).remote(trial.trial_id, trial.trial_dir)
+        rt.get(
+            trial.actor.run.remote(
+                self.trainable, new_config,
+                Checkpoint.from_directory(ckpt_path),
+            ),
+            timeout=300,
+        )
+        return True
 
     def _snapshot(self, exp_dir: str, trials: List[Trial]):
         """Experiment state snapshot (reference:
