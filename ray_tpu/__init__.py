@@ -56,6 +56,18 @@ def init(
         raise RuntimeError("ray_tpu.init() called twice")
 
     if address is not None and address.startswith("rt://"):
+        bad = {
+            "num_cpus": num_cpus, "num_tpus": num_tpus,
+            "resources": resources,
+            "object_store_memory": object_store_memory,
+            "labels": labels, "local_mode": local_mode or None,
+        }
+        bad = [k for k, v in bad.items() if v]
+        if bad:
+            raise ValueError(
+                f"rt:// remote drivers attach without a local node; "
+                f"{bad} cannot apply (configure nodes cluster-side)"
+            )
         _client = _remote_attach(address.removeprefix("rt://"))
         if runtime_env:
             _client.default_runtime_env = runtime_env

@@ -84,6 +84,36 @@ class Config:
     # -- rpc ------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_size: int = 512 * 1024 * 1024
+    # Dial timeout for raylet->raylet peer connections (short: waiters
+    # queue behind the per-peer lock, so a blackholed peer must fail fast).
+    peer_dial_timeout_s: float = 2.0
+
+    # -- client ----------------------------------------------------------
+    # Probe period for blocking gets on remote objects (reference:
+    # fetch_warn_timeout_milliseconds family).
+    get_probe_interval_s: float = 5.0
+    # In-process memory store bound (memory_store.h analog).
+    memory_store_max_entries: int = 8192
+    # Owner-side lineage table bound (lineage eviction).
+    lineage_max_entries: int = 10_000
+    # Debounce for batching dropped-ref free RPCs.
+    free_flush_debounce_s: float = 0.05
+
+    # -- raylet loops -----------------------------------------------------
+    # Dead-worker reap / stale-client-create sweep period.
+    reap_interval_s: float = 0.2
+    # Forced dispatch rescan period while tasks wait on resources.
+    dispatch_rescan_interval_s: float = 0.1
+    # How long a failed runtime env is remembered before retrying builds.
+    bad_runtime_env_ttl_s: float = 60.0
+    # Warn when a task has been infeasible this long.
+    infeasible_warn_s: float = 30.0
+    # Abort an open chunked remote-client put after this long.
+    client_create_ttl_s: float = 600.0
+
+    # -- gcs --------------------------------------------------------------
+    # Snapshot debounce for GCS persistence (RT_GCS_PERSIST_PATH).
+    gcs_persist_debounce_s: float = 0.05
 
     # -- collective -----------------------------------------------------
     collective_rendezvous_timeout_s: float = 60.0

@@ -193,7 +193,7 @@ class GcsServer:
     async def _persist_soon(self):
         while self._persist_dirty:
             self._persist_dirty = False
-            await asyncio.sleep(0.05)  # debounce mutation bursts
+            await asyncio.sleep(get_config().gcs_persist_debounce_s)
             # Pickle on the loop (tables are mutated by handlers on this
             # loop, so a thread would race them) but write in an executor —
             # the disk I/O is the slow part and must not head-of-line-block

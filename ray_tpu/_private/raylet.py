@@ -407,8 +407,9 @@ class Raylet:
 
     async def _reap_loop(self):
         """Detect dead worker processes; fail their tasks/actors."""
+        cfg = get_config()
         while True:
-            await asyncio.sleep(0.2)
+            await asyncio.sleep(cfg.reap_interval_s)
             # Abort chunked remote-client puts whose client vanished.
             now = time.monotonic()
             for oid, (buf, deadline) in list(self._client_creates.items()):
@@ -991,7 +992,10 @@ class Raylet:
             try:
                 # Short dial timeout: waiters queue behind this lock, so a
                 # blackholed peer must fail fast, not serialize 10s stalls.
-                conn = await connect(info["address"], info["port"], timeout=2.0)
+                conn = await connect(
+                    info["address"], info["port"],
+                    timeout=get_config().peer_dial_timeout_s,
+                )
             except OSError:
                 return None
             self.peer_conns[node_id] = conn
@@ -1052,7 +1056,7 @@ class Raylet:
                         continue
                     tid = spec["task_id"]
                     first = self._queued_since.setdefault(tid, now)
-                    if now - first > 30.0 and tid not in self._infeasible_warned:
+                    if now - first > cfg.infeasible_warn_s and tid not in self._infeasible_warned:
                         self._infeasible_warned.add(tid)
                         print(
                             f"[ray_tpu] WARNING: task {spec.get('name') or tid.hex()[:8]} "
@@ -1070,7 +1074,7 @@ class Raylet:
                     continue
                 renv_hash = spec.get("runtime_env_hash")
                 bad = self._bad_runtime_envs.get(renv_hash)
-                if bad is not None and time.monotonic() - bad[1] < 60.0:
+                if bad is not None and time.monotonic() - bad[1] < cfg.bad_runtime_env_ttl_s:
                     self._queued_demand_add(resources, -1, spec)
                     if not fut.done():
                         fut.set_result(
@@ -1161,7 +1165,10 @@ class Raylet:
                 # 20ms and capped batched throughput at ~200 tasks/s. The
                 # timeout keeps infeasible tasks re-checking for new nodes.
                 try:
-                    await asyncio.wait_for(self._dispatch_event.wait(), 0.1)
+                    await asyncio.wait_for(
+                        self._dispatch_event.wait(),
+                        cfg.dispatch_rescan_interval_s,
+                    )
                 except asyncio.TimeoutError:
                     self._dispatch_event.set()
 
@@ -1400,7 +1407,9 @@ class Raylet:
             if not await self._wait_sealed(d["object_id"]):
                 return {"ok": False, "error": "concurrent put never sealed"}
             return {"ok": True, "exists": True}
-        self._client_creates[d["object_id"]] = (buf, time.monotonic() + 600)
+        self._client_creates[d["object_id"]] = (
+            buf, time.monotonic() + get_config().client_create_ttl_s
+        )
         return {"ok": True, "exists": False}
 
     async def h_client_put_chunk(self, d, conn):

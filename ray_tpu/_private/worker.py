@@ -240,7 +240,7 @@ class CoreClient:
         from collections import OrderedDict
 
         self.memory_store: "OrderedDict[bytes, Any]" = OrderedDict()
-        self.memory_store_max_entries = 8192
+        self.memory_store_max_entries = get_config().memory_store_max_entries
         self.known_refs: "weakref.WeakValueDictionary[bytes, ObjectRef]" = (
             weakref.WeakValueDictionary()
         )
@@ -264,7 +264,7 @@ class CoreClient:
         from collections import OrderedDict as _OD
 
         self.lineage: "_OD[bytes, dict]" = _OD()
-        self.lineage_max_entries = 10_000
+        self.lineage_max_entries = get_config().lineage_max_entries
         # Owner-side reference GC (ReferenceCounter analog,
         # reference_count.h:61, simplified): when the last local ObjectRef
         # to an object THIS process owns dies — and no in-flight task
@@ -416,7 +416,7 @@ class CoreClient:
         # call below is in flight sees this task as not-done and schedules
         # nothing, so exiting with a non-empty queue would strand it.
         while True:
-            await asyncio.sleep(0.05)  # debounce: batch bursts of GC'd refs
+            await asyncio.sleep(get_config().free_flush_debounce_s)
             with self._free_lock:
                 oids, self._free_queue = self._free_queue, []
             if not oids:
@@ -565,7 +565,11 @@ class CoreClient:
         from ray_tpu.exceptions import ObjectStoreFullError
 
         if self.store is None:  # remote driver: ship bytes to the raylet
-            return self._client_put_remote(oid, so)
+            if not self._client_put_remote(oid, so):
+                raise ObjectLostError(
+                    f"remote put of {oid.hex()} was not stored"
+                )
+            return True
 
         wrote = False
         attempts = 8
@@ -647,7 +651,7 @@ class CoreClient:
             remaining = (
                 60.0 if deadline is None else max(0.1, deadline - time.monotonic())
             )
-            probe = min(5.0, remaining * 0.4)
+            probe = min(get_config().get_probe_interval_s, remaining * 0.4)
             try:
                 self._run(
                     self.raylet.call(

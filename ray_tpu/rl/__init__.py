@@ -10,7 +10,10 @@ from ray_tpu.rl.core.learner import Learner
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
 from ray_tpu.rl.env_runner import EnvRunner, compute_gae
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, dqn_loss
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, ppo_loss
+from ray_tpu.rl.env_runner import TransitionEnvRunner
+from ray_tpu.rl.replay import ReplayBuffer
 
 __all__ = [
     "Learner",
@@ -19,6 +22,11 @@ __all__ = [
     "DiscretePolicyModule",
     "EnvRunner",
     "compute_gae",
+    "DQN",
+    "DQNConfig",
+    "dqn_loss",
+    "ReplayBuffer",
+    "TransitionEnvRunner",
     "PPO",
     "PPOConfig",
     "ppo_loss",

@@ -1,0 +1,215 @@
+"""DQN: deep Q-learning with replay and a target network.
+
+Analog of the reference's DQN (rllib/algorithms/dqn/) on the new-API
+shape: TransitionEnvRunner actors collect epsilon-greedy transitions into
+a ReplayBuffer, the LearnerGroup applies Huber TD updates against targets
+computed from a periodically-synced target network, and fresh weights
+broadcast back to the runners (Algorithm.training_step flow,
+algorithm.py:1582).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.core.rl_module import QNetworkModule, RLModuleSpec
+from ray_tpu.rl.env_runner import TransitionEnvRunner
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+def dqn_loss(params, module, batch):
+    """Huber TD loss against precomputed targets (target-network Q-values
+    are computed driver-side so the learner stays a pure
+    params+batch -> grads function)."""
+    q = module.forward(params, batch["obs"])["q_values"]
+    q_sa = jnp.take_along_axis(
+        q, batch["actions"][:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    td = q_sa - batch["targets"]
+    huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2, jnp.abs(td) - 0.5)
+    loss = huber.mean()
+    return loss, {
+        "total_loss": loss,
+        "q_mean": q_sa.mean(),
+        "td_abs_mean": jnp.abs(td).mean(),
+    }
+
+
+@dataclass
+class DQNConfig:
+    """Builder-style config (reference: DQNConfig)."""
+
+    env_creator: Optional[Callable] = None
+    obs_dim: int = 4
+    num_actions: int = 2
+    hidden: tuple = (64, 64)
+    num_env_runners: int = 2
+    rollout_length: int = 100
+    num_learners: int = 1
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    updates_per_iteration: int = 32
+    target_update_freq: int = 2  # iterations between target syncs
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_iters: int = 20
+    seed: int = 0
+
+    def environment(self, env_creator=None, obs_dim=None, num_actions=None):
+        if env_creator is not None:
+            self.env_creator = env_creator
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def env_runners(self, num_env_runners=None, rollout_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, lr=None, gamma=None, train_batch_size=None,
+                 updates_per_iteration=None, target_update_freq=None,
+                 buffer_capacity=None, learning_starts=None,
+                 num_learners=None):
+        for name, val in (
+            ("lr", lr), ("gamma", gamma),
+            ("train_batch_size", train_batch_size),
+            ("updates_per_iteration", updates_per_iteration),
+            ("target_update_freq", target_update_freq),
+            ("buffer_capacity", buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("num_learners", num_learners),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def exploration(self, epsilon_start=None, epsilon_end=None,
+                    epsilon_decay_iters=None):
+        for name, val in (
+            ("epsilon_start", epsilon_start),
+            ("epsilon_end", epsilon_end),
+            ("epsilon_decay_iters", epsilon_decay_iters),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """The algorithm object (reference: Algorithm; train() = one iteration)."""
+
+    def __init__(self, config: DQNConfig):
+        assert config.env_creator is not None, "config.environment(...) first"
+        self.config = config
+        spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
+        module_factory = lambda: QNetworkModule(spec)  # noqa: E731
+        self.module = module_factory()
+
+        self.learner_group = LearnerGroup(
+            module_factory,
+            dqn_loss,
+            num_learners=config.num_learners,
+            seed=config.seed,
+            lr=config.lr,
+        )
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, config.obs_dim, seed=config.seed
+        )
+        self.env_runners = [
+            TransitionEnvRunner.options(num_cpus=0.5).remote(
+                config.env_creator,
+                module_factory,
+                seed=config.seed + 1 + i,
+                rollout_length=config.rollout_length,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self.target_params = self.learner_group.get_weights()
+        self._target_q = jax.jit(
+            lambda p, obs: self.module.forward(p, obs)["q_values"]
+        )
+        self._iteration = 0
+        self._broadcast_weights()
+
+    def _broadcast_weights(self, weights=None):
+        if weights is None:
+            weights = self.learner_group.get_weights()
+        rt.get([r.set_weights.remote(weights) for r in self.env_runners],
+               timeout=300)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._iteration / max(cfg.epsilon_decay_iters, 1))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        eps = self._epsilon()
+        # 1. parallel epsilon-greedy collection into the replay buffer
+        rollouts = rt.get(
+            [r.sample.remote(eps) for r in self.env_runners], timeout=600
+        )
+        for b in rollouts:
+            self.buffer.add_batch(b)
+        metrics: Dict[str, float] = {}
+        # 2. TD updates once the buffer warms up
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                next_q = np.asarray(
+                    self._target_q(self.target_params, mb["next_obs"])
+                )
+                targets = mb["rewards"] + cfg.gamma * (
+                    1.0 - mb["dones"]
+                ) * next_q.max(axis=-1)
+                batch = {
+                    "obs": mb["obs"],
+                    "actions": mb["actions"],
+                    "targets": targets.astype(np.float32),
+                }
+                metrics = self.learner_group.update_from_batch(batch)
+            # 3. periodic target-network sync + runner weight broadcast
+            # (one weights fetch serves both).
+            weights = self.learner_group.get_weights()
+            if self._iteration % cfg.target_update_freq == 0:
+                self.target_params = weights
+            self._broadcast_weights(weights)
+        self._iteration += 1
+        stats = rt.get(
+            [r.episode_stats.remote() for r in self.env_runners], timeout=300
+        )
+        returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        self.learner_group.shutdown()
+        for r in self.env_runners:
+            try:
+                rt.kill(r)
+            except Exception:
+                pass

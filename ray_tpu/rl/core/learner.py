@@ -24,10 +24,11 @@ class Learner:
         optimizer: Optional[optax.GradientTransformation] = None,
         seed: int = 0,
         grad_clip: Optional[float] = 0.5,
+        lr: float = 3e-4,
     ):
         self.module = module
         self.loss_fn = loss_fn
-        tx = optimizer or optax.adam(3e-4)
+        tx = optimizer or optax.adam(lr)
         if grad_clip:
             tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
         self.optimizer = tx

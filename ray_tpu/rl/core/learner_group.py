@@ -19,10 +19,11 @@ import ray_tpu as rt
 
 @rt.remote
 class _LearnerActor:
-    def __init__(self, module_factory, loss_fn, seed, rank, world_size):
+    def __init__(self, module_factory, loss_fn, seed, rank, world_size,
+                 lr=3e-4):
         from ray_tpu.rl.core.learner import Learner
 
-        self.learner = Learner(module_factory(), loss_fn, seed=seed)
+        self.learner = Learner(module_factory(), loss_fn, seed=seed, lr=lr)
         self.rank = rank
         self.world_size = world_size
 
@@ -65,6 +66,7 @@ class LearnerGroup:
         num_learners: int = 1,
         resources_per_learner: Optional[Dict[str, float]] = None,
         seed: int = 0,
+        lr: float = 3e-4,
     ):
         self.num_learners = max(1, num_learners)
         res = resources_per_learner or {"CPU": 1}
@@ -72,7 +74,7 @@ class LearnerGroup:
             _LearnerActor.options(
                 num_cpus=res.get("CPU", 1),
                 resources={k: v for k, v in res.items() if k != "CPU"},
-            ).remote(module_factory, loss_fn, seed, i, self.num_learners)
+            ).remote(module_factory, loss_fn, seed, i, self.num_learners, lr)
             for i in range(self.num_learners)
         ]
         if self.num_learners > 1:

@@ -55,3 +55,33 @@ class DiscretePolicyModule:
         logp = jax.nn.log_softmax(out["action_logits"])
         chosen_logp = jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
         return action, chosen_logp, out["value"]
+
+
+class QNetworkModule:
+    """Q-network for value-based algorithms (DQN family).
+
+    Reference analog: the DQN RLModules under rllib/algorithms/dqn/ —
+    an MLP mapping observations to per-action Q values, with
+    epsilon-greedy sampling for collection.
+    """
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict:
+        sizes = [self.spec.obs_dim, *self.spec.hidden]
+        return {"q": init_mlp(rng, sizes + [self.spec.num_actions])}
+
+    def forward(self, params: Dict, obs: jax.Array) -> Dict[str, jax.Array]:
+        return {"q_values": mlp_forward(params["q"], obs)}
+
+    def sample_action(self, params: Dict, obs: jax.Array, rng: jax.Array,
+                      epsilon: float = 0.0):
+        q = self.forward(params, obs)["q_values"]
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(rng)
+        random_a = jax.random.randint(
+            k1, greedy.shape, 0, self.spec.num_actions
+        )
+        explore = jax.random.uniform(k2, greedy.shape) < epsilon
+        return jnp.where(explore, random_a, greedy)

@@ -15,8 +15,10 @@ import numpy as np
 import ray_tpu as rt
 
 
-@rt.remote
-class EnvRunner:
+class _EnvRunnerBase:
+    """Shared env-runner scaffolding: env/module setup, weight sync, lazy
+    jitted sampler, episode bookkeeping. Subclasses implement sample()."""
+
     def __init__(self, env_creator, module_factory, seed: int = 0,
                  rollout_length: int = 200):
         import jax
@@ -35,19 +37,45 @@ class EnvRunner:
         self.params = weights
         return True
 
-    def sample(self) -> Dict[str, np.ndarray]:
-        """One rollout of fixed length (truncated episodes carry value
-        bootstrap info via `last_value`)."""
+    def _begin_rollout(self):
         import jax
 
         assert self.params is not None, "set_weights first"
         if self._sample is None:
             self._sample = jax.jit(self.module.sample_action)
-
         if self._obs is None:
             self._obs, _ = self.env.reset()
             self._episode_return = 0.0
 
+    def _advance(self, nxt, reward, terminated, truncated):
+        """Track episode returns; returns the next observation state."""
+        self._episode_return += float(reward)
+        if terminated or truncated:
+            self._episode_returns.append(self._episode_return)
+            self._obs, _ = self.env.reset()
+            self._episode_return = 0.0
+        else:
+            self._obs = nxt
+
+    def episode_stats(self) -> Dict[str, Any]:
+        return {
+            "episodes": len(self._episode_returns),
+            "mean_return": (
+                float(np.mean(self._episode_returns[-20:]))
+                if self._episode_returns
+                else 0.0
+            ),
+        }
+
+
+@rt.remote
+class EnvRunner(_EnvRunnerBase):
+    def sample(self) -> Dict[str, np.ndarray]:
+        """One rollout of fixed length (truncated episodes carry value
+        bootstrap info via `last_value`)."""
+        import jax
+
+        self._begin_rollout()
         T = self.rollout_length
         obs_buf, act_buf, logp_buf, val_buf = [], [], [], []
         rew_buf, done_buf = [], []
@@ -61,15 +89,9 @@ class EnvRunner:
             logp_buf.append(float(np.asarray(logp)[0]))
             val_buf.append(float(np.asarray(value)[0]))
             nxt, reward, terminated, truncated, _ = self.env.step(action)
-            self._episode_return += float(reward)
             rew_buf.append(float(reward))
             done_buf.append(bool(terminated))
-            if terminated or truncated:
-                self._episode_returns.append(self._episode_return)
-                self._obs, _ = self.env.reset()
-                self._episode_return = 0.0
-            else:
-                self._obs = nxt
+            self._advance(nxt, reward, terminated, truncated)
         # Bootstrap value of the final observation.
         obs = np.asarray(self._obs, dtype=np.float32)
         self.rng, key = jax.random.split(self.rng)
@@ -83,17 +105,6 @@ class EnvRunner:
             "dones": np.asarray(done_buf, dtype=np.float32),
             "last_value": float(np.asarray(last_value)[0]),
         }
-
-    def episode_stats(self) -> Dict[str, Any]:
-        stats = {
-            "episodes": len(self._episode_returns),
-            "mean_return": (
-                float(np.mean(self._episode_returns[-20:]))
-                if self._episode_returns
-                else 0.0
-            ),
-        }
-        return stats
 
 
 def compute_gae(batch: Dict[str, np.ndarray], gamma: float = 0.99,
@@ -114,3 +125,40 @@ def compute_gae(batch: Dict[str, np.ndarray], gamma: float = 0.99,
     out["advantages"] = adv
     out["returns"] = adv + values
     return out
+
+
+@rt.remote
+class TransitionEnvRunner(_EnvRunnerBase):
+    """Collects (s, a, r, s', done) transitions with epsilon-greedy
+    exploration for off-policy algorithms (DQN family).
+
+    Reference analog: SingleAgentEnvRunner in off-policy mode feeding
+    replay buffers (rllib/env/single_agent_env_runner.py:29).
+    """
+
+    def sample(self, epsilon: float = 0.1) -> Dict[str, np.ndarray]:
+        import jax
+
+        self._begin_rollout()
+        T = self.rollout_length
+        obs_buf, act_buf, rew_buf, next_buf, done_buf = [], [], [], [], []
+        for _ in range(T):
+            self.rng, key = jax.random.split(self.rng)
+            obs = np.asarray(self._obs, dtype=np.float32)
+            action = int(np.asarray(
+                self._sample(self.params, obs[None], key, epsilon)
+            )[0])
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            obs_buf.append(obs)
+            act_buf.append(action)
+            rew_buf.append(float(reward))
+            next_buf.append(np.asarray(nxt, dtype=np.float32))
+            done_buf.append(bool(terminated))
+            self._advance(nxt, reward, terminated, truncated)
+        return {
+            "obs": np.stack(obs_buf),
+            "actions": np.asarray(act_buf, dtype=np.int32),
+            "rewards": np.asarray(rew_buf, dtype=np.float32),
+            "next_obs": np.stack(next_buf),
+            "dones": np.asarray(done_buf, dtype=np.float32),
+        }

@@ -199,7 +199,8 @@ class PopulationBasedTraining(TrialScheduler):
         donors = [t for t in top if t in self._checkpoints]
         if not donors:
             return CONTINUE
-        self._last_perturb[trial_id] = self._iters[trial_id]
+        # _last_perturb is recorded in make_exploit: a failed exploit (the
+        # donor finished in between) must not cost a whole interval.
         return EXPLOIT
 
     def make_exploit(self, trial_id: str):
@@ -211,6 +212,7 @@ class PopulationBasedTraining(TrialScheduler):
         donor = self._rng.choice(donors)
         new_config = self._explore(dict(self._configs.get(donor, {})))
         self._configs[trial_id] = new_config
+        self._last_perturb[trial_id] = self._iters[trial_id]
         self.num_exploits += 1
         return self._checkpoints[donor], new_config
 

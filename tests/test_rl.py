@@ -100,3 +100,53 @@ def test_ppo_cartpole_improves():
         assert result["episodes_total"] > 0
     finally:
         algo.stop()
+
+def test_replay_buffer_ring_and_sampling():
+    from ray_tpu.rl import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=10, obs_dim=2, seed=0)
+    for start in (0, 6):  # second add wraps the ring
+        buf.add_batch({
+            "obs": np.full((6, 2), start, dtype=np.float32),
+            "next_obs": np.full((6, 2), start + 1, dtype=np.float32),
+            "actions": np.arange(start, start + 6, dtype=np.int32),
+            "rewards": np.ones(6, dtype=np.float32),
+            "dones": np.zeros(6, dtype=np.float32),
+        })
+    assert len(buf) == 10
+    mb = buf.sample(32)
+    assert mb["obs"].shape == (32, 2)
+    assert set(mb["actions"]) <= set(range(12))
+
+
+def test_dqn_cartpole_improves(rt_start):
+    import gymnasium as gym
+
+    from ray_tpu.rl import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment(lambda: gym.make("CartPole-v1"), obs_dim=4, num_actions=2)
+        .env_runners(num_env_runners=2, rollout_length=200)
+        .training(lr=1e-3, train_batch_size=64, updates_per_iteration=64,
+                  learning_starts=400, target_update_freq=2)
+        .exploration(epsilon_start=1.0, epsilon_end=0.05,
+                     epsilon_decay_iters=6)
+        .build()
+    )
+    try:
+        first = None
+        best = -1.0
+        for _ in range(30):
+            result = algo.train()
+            if first is None and result["episodes_total"] > 0:
+                first = result["episode_return_mean"]
+            best = max(best, result["episode_return_mean"])
+            if best >= 75.0:
+                break
+        assert result["buffer_size"] > 400
+        assert best >= 75.0, (
+            f"DQN failed to learn CartPole: first={first} best={best}"
+        )
+    finally:
+        algo.stop()
