@@ -753,42 +753,50 @@ class CoreClient:
 
     def _read_remote(self, oid: ObjectID):
         """Remote (rt://) driver: stream the object out of the raylet's
-        store over TCP in transfer-sized chunks."""
+        store over TCP in transfer-sized chunks.
+
+        The chunk stream holds no pin on the raylet side, so a concurrent
+        spill can evict the object mid-stream; each retry re-runs
+        client_get_info, whose _ensure_local restores spilled copies."""
         from ray_tpu._private.protocol import RpcError
 
-        try:
-            info = self._run(
-                self.raylet.call(
-                    "client_get_info", {"object_id": oid.binary()},
-                    timeout=120,
-                )
-            )
-            if not info.get("ok"):
-                raise ObjectLostError(
-                    f"object {oid.hex()}: {info.get('error')}"
-                )
-            size = info["size"]
-            chunk = get_config().object_transfer_chunk_size
-            parts = []
-            off = 0
-            while off < size:
-                n = min(chunk, size - off)
-                r = self._run(
+        last_err = None
+        for _attempt in range(3):
+            try:
+                info = self._run(
                     self.raylet.call(
-                        "fetch_chunk",
-                        {"object_id": oid.binary(), "offset": off, "size": n},
+                        "client_get_info", {"object_id": oid.binary()},
                         timeout=120,
                     )
                 )
-                parts.append(r["data"])
-                off += n
-        except RpcError as e:
-            raise ObjectLostError(
-                f"remote fetch of {oid.hex()} failed: {e}"
-            ) from None
-        value = ser.deserialize(memoryview(b"".join(parts)))
-        self._in_store.add(oid.binary())
-        return value
+                if not info.get("ok"):
+                    raise ObjectLostError(
+                        f"object {oid.hex()}: {info.get('error')}"
+                    )
+                size = info["size"]
+                chunk = get_config().object_transfer_chunk_size
+                parts = []
+                off = 0
+                while off < size:
+                    n = min(chunk, size - off)
+                    r = self._run(
+                        self.raylet.call(
+                            "fetch_chunk",
+                            {"object_id": oid.binary(), "offset": off,
+                             "size": n},
+                            timeout=120,
+                        )
+                    )
+                    parts.append(r["data"])
+                    off += n
+                value = ser.deserialize(memoryview(b"".join(parts)))
+                self._in_store.add(oid.binary())
+                return value
+            except RpcError as e:  # spilled/evicted mid-stream: retry
+                last_err = e
+        raise ObjectLostError(
+            f"remote fetch of {oid.hex()} failed: {last_err}"
+        ) from None
 
     def _read_store(self, oid: ObjectID):
         if self.store is None:

@@ -12,6 +12,7 @@ from ray_tpu.data.dataset import (
     read_csv,
     read_json,
     read_parquet,
+    read_text,
 )
 
 __all__ = [
@@ -20,6 +21,7 @@ __all__ = [
     "from_numpy",
     "range",
     "read_parquet",
+    "read_text",
     "read_csv",
     "read_json",
 ]

@@ -12,6 +12,7 @@ reference: data/iterator.py + train/_internal/data_config.py).
 
 from __future__ import annotations
 
+import os
 import random as _random
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
@@ -202,6 +203,56 @@ class Dataset:
             shards.append(from_items(chunk, parallelism=1))
         return shards
 
+    # -- output ----------------------------------------------------------
+    def write_parquet(self, path: str) -> List[str]:
+        """One parquet file per block under `path` (reference:
+        Dataset.write_parquet)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in enumerate(self._iter_blocks()):
+            rows = B.block_to_rows(block)
+            if not rows:
+                continue
+            table = pa.Table.from_pylist(rows)
+            fp = os.path.join(path, f"part-{i:05d}.parquet")
+            pq.write_table(table, fp)
+            out.append(fp)
+        return out
+
+    def write_csv(self, path: str) -> List[str]:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in enumerate(self._iter_blocks()):
+            rows = B.block_to_rows(block)
+            if not rows:
+                continue
+            fp = os.path.join(path, f"part-{i:05d}.csv")
+            pacsv.write_csv(pa.Table.from_pylist(rows), fp)
+            out.append(fp)
+        return out
+
+    def write_json(self, path: str) -> List[str]:
+        import json as _json
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in enumerate(self._iter_blocks()):
+            rows = B.block_to_rows(block)
+            if not rows:
+                continue
+            fp = os.path.join(path, f"part-{i:05d}.jsonl")
+            with open(fp, "w") as f:
+                for r in rows:
+                    f.write(_json.dumps(r, default=_json_fallback) + "\n")
+            out.append(fp)
+        return out
+
     def __repr__(self):
         return (
             f"Dataset(blocks={len(self._input_refs)}, "
@@ -356,6 +407,18 @@ def _np_item(x):
     return x
 
 
+def _json_fallback(x):
+    """json.dumps default= hook: arrays become lists; anything else raises
+    (returning the object unchanged would recurse forever)."""
+    import numpy as np
+
+    if isinstance(x, np.generic):
+        return x.item()
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    raise TypeError(f"not JSON serializable: {type(x).__name__}")
+
+
 # ---------------------------------------------------------------------------
 # creation APIs
 # ---------------------------------------------------------------------------
@@ -414,3 +477,10 @@ def read_json(path: str, parallelism: int = 4) -> Dataset:
 
     table = pajson.read_json(path)
     return Dataset([rt.put(table)]).repartition(parallelism)
+
+
+def read_text(path: str, parallelism: int = 4) -> Dataset:
+    """One row per line: {"text": line} (reference: data read_text)."""
+    with open(path) as f:
+        rows = [{"text": line.rstrip("\n")} for line in f]
+    return from_items(rows, parallelism)

@@ -115,6 +115,7 @@ class PPO:
             ppo_loss,
             num_learners=config.num_learners,
             seed=config.seed,
+            lr=config.lr,
         )
         self.env_runners = [
             EnvRunner.options(num_cpus=0.5).remote(

@@ -279,6 +279,28 @@ def cmd_job(args):
     job_cli(args, _resolve_address(args))
 
 
+def cmd_serve(args):
+    """`rt serve deploy <config>`: declarative deploys (reference:
+    `serve deploy`, serve/scripts.py:256)."""
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    rt.init(address=_resolve_address(args), num_cpus=0,
+            ignore_reinit_error=True)
+    if args.serve_command == "deploy":
+        if not args.config:
+            raise SystemExit("rt serve deploy requires a config file path")
+        handles = serve.run_from_config(args.config)
+        print(f"deployed: {', '.join(handles) or '(nothing)'}")
+    elif args.serve_command == "status":
+        import json as _json
+
+        print(_json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_command == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="rt", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -324,6 +346,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("memory", help="object store usage by object")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("serve", help="declarative Serve deploys")
+    sp.add_argument("serve_command", choices=["deploy", "status", "shutdown"])
+    sp.add_argument("config", nargs="?", help="JSON/YAML app config")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("job", help="submit and manage jobs")
     sp.add_argument("job_command",

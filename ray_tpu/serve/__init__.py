@@ -23,6 +23,7 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import ProxyActor
+from ray_tpu.serve.schema import run_from_config
 
 _proxy = None
 
@@ -90,4 +91,5 @@ __all__ = [
     "status",
     "shutdown",
     "start_http_proxy",
+    "run_from_config",
 ]

@@ -8,7 +8,11 @@ get_world_rank ...).
 """
 
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig, allreduce_gradients
-from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.checkpoint import (
+    AsyncCheckpointer,
+    Checkpoint,
+    CheckpointManager,
+)
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -33,6 +37,7 @@ __all__ = [
     "BackendConfig",
     "JaxConfig",
     "allreduce_gradients",
+    "AsyncCheckpointer",
     "Checkpoint",
     "CheckpointManager",
     "CheckpointConfig",

@@ -151,3 +151,36 @@ def _json_safe(d: Dict) -> Dict:
         except TypeError:
             out[k] = str(v)
     return out
+
+
+class AsyncCheckpointer:
+    """Asynchronous pytree checkpointing: save() returns once the arrays
+    are snapshotted to host memory and serialization continues in
+    background threads, so the train step keeps the TPU busy during the
+    write. wait() is the completion barrier — call it before REPORTING a
+    checkpoint so a resume can never observe a partial write.
+
+    Reference analog: the async upload path of train/_internal/storage.py
+    (StorageContext persists checkpoints off the training thread); on TPU
+    pods each host writes only its own shards (orbax ocdbt layout).
+    """
+
+    def __init__(self):
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+
+    def save(self, path: str, tree: Any) -> "Checkpoint":
+        path = os.path.abspath(path)
+        self._ckptr.save(os.path.join(path, "pytree"), tree, force=True)
+        return Checkpoint(path)
+
+    def wait(self):
+        """Block until every outstanding save has been committed."""
+        self._ckptr.wait_until_finished()
+
+    def close(self):
+        try:
+            self._ckptr.close()
+        except Exception:  # noqa: BLE001
+            pass

