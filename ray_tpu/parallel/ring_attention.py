@@ -93,17 +93,33 @@ def ring_attention(
             o, m, l, k_cur, v_cur = carry
             # kv block currently held arrived from ring position my_idx - i.
             k_idx = (my_idx - i) % axis_size
+
+            def attend(carry):
+                o, m, l = carry
+                if causal:
+                    bias = _causal_bias(
+                        my_idx, k_idx, block_q, block_k, jnp.float32
+                    )
+                else:
+                    bias = None
+                s = _block_attn(
+                    q_blk.astype(jnp.float32),
+                    k_cur.astype(jnp.float32),
+                    v_cur.astype(jnp.float32),
+                    bias,
+                )
+                return _online_update((o, m, l), s, v_cur.astype(jnp.float32))
+
             if causal:
-                bias = _causal_bias(my_idx, k_idx, block_q, block_k, jnp.float32)
+                # Blocks entirely in the future (k_idx > my_idx) are fully
+                # masked: skip their matmuls outright — on a causal ring
+                # each device computes only ~half the steps instead of
+                # materializing -inf scores for the rest.
+                o, m, l = jax.lax.cond(
+                    k_idx <= my_idx, attend, lambda c: c, (o, m, l)
+                )
             else:
-                bias = None
-            s = _block_attn(
-                q_blk.astype(jnp.float32),
-                k_cur.astype(jnp.float32),
-                v_cur.astype(jnp.float32),
-                bias,
-            )
-            o, m, l = _online_update((o, m, l), s, v_cur.astype(jnp.float32))
+                o, m, l = attend((o, m, l))
             # Rotate kv to the right neighbor; overlapped with next step's
             # compute by XLA latency hiding.
             perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
