@@ -125,6 +125,11 @@ class Raylet:
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
         # Open chunked remote-client puts: oid -> (buffer, abort deadline).
         self._client_creates: Dict[bytes, tuple] = {}
+        # Runtime metric counters (reported as deltas on the heartbeat).
+        self._metric_tasks_dispatched = 0
+        self._metric_tasks_failed = 0
+        self._metric_objects_spilled = 0
+        self._metric_reported: Dict[str, int] = {}
 
         r = self.rpc.register
         r("register_worker", self.h_register_worker)
@@ -432,6 +437,7 @@ class Raylet:
                                  "error": f"worker exited with code {w.proc.returncode}"}
                             )
                         if entry:
+                            self._metric_tasks_failed += 1
                             self._release_task_resources(entry["spec"])
                             self._record_task_event(
                                 entry["spec"], "FAILED", worker_id=w.worker_id
@@ -1152,6 +1158,7 @@ class Raylet:
                     "worker": worker,
                     "start": time.monotonic(),
                 }
+                self._metric_tasks_dispatched += 1
                 self._record_task_event(
                     spec, "RUNNING", worker_id=worker.worker_id
                 )
@@ -1239,6 +1246,8 @@ class Raylet:
         w.current_task = None
         w.last_idle_time = time.monotonic()
         self._release_task_resources(entry["spec"])
+        if d["result"].get("status") != "ok":
+            self._metric_tasks_failed += 1
         self._record_task_event(
             entry["spec"],
             "FINISHED" if d["result"].get("status") == "ok" else "FAILED",
@@ -1552,6 +1561,7 @@ class Raylet:
                     storage.delete([uri])
                     continue
                 self._spilled[oid] = uri
+                self._metric_objects_spilled += 1
                 spilled += 1
                 await self.gcs.call(
                     "object_spilled",
@@ -1636,11 +1646,56 @@ class Raylet:
         }
 
     # -- sync ------------------------------------------------------------
+    def _runtime_metric_deltas(self):
+        """Per-component runtime metrics (stats/metric_defs.h:46-61 analog:
+        task/worker/store counters), reported as deltas so the GCS
+        aggregate matches its Counter semantics."""
+        stats = self.store.stats()
+        node = self.node_id.hex()[:12]
+        counters = {
+            "rt_raylet_tasks_dispatched_total": self._metric_tasks_dispatched,
+            "rt_raylet_tasks_failed_total": self._metric_tasks_failed,
+            "rt_raylet_objects_spilled_total": self._metric_objects_spilled,
+        }
+        records = []
+        commits = {}
+        for name, value in counters.items():
+            prev = self._metric_reported.get(name, 0)
+            if value != prev:
+                records.append(
+                    {"name": name, "type": "counter",
+                     "description": "raylet runtime counter",
+                     "data": [[[["node", node]], value - prev]]}
+                )
+                commits[name] = value
+        for name, value in (
+            ("rt_raylet_store_used_bytes", stats.get("used_bytes", 0)),
+            ("rt_raylet_store_objects", stats.get("num_objects", 0)),
+            ("rt_raylet_workers", len(self.workers)),
+            ("rt_raylet_tasks_queued", len(self._queued_specs)),
+        ):
+            records.append(
+                {"name": name, "type": "gauge",
+                 "description": "raylet runtime gauge",
+                 "data": [[[["node", node]], value]]}
+            )
+        return records, commits
+
     async def _heartbeat_loop(self):
         cfg = get_config()
         while True:
             await asyncio.sleep(cfg.health_check_period_s / 2)
             try:
+                try:
+                    records, commits = self._runtime_metric_deltas()
+                    await self.gcs.call(
+                        "metrics_report", {"records": records}
+                    )
+                    # Commit counter baselines only after a successful
+                    # send — a GCS outage must not eat the deltas.
+                    self._metric_reported.update(commits)
+                except Exception:  # noqa: BLE001 — observability is best-effort
+                    pass
                 # Demand bundles of queued-but-undispatched tasks feed the
                 # autoscaler's binpacking (LoadMetrics / resource_demand_
                 # scheduler in the reference). _queued_specs is stable
