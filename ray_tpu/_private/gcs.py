@@ -77,6 +77,7 @@ class GcsServer:
         # Aggregated user metrics: name -> {type, description, boundaries?,
         #   series: {tags_tuple -> value | histogram-state}}
         self.metrics: Dict[str, dict] = {}
+        self._metrics_seq: Dict[bytes, int] = {}  # reporter -> last seq
         self.subscribers: Dict[str, Set[ServerConnection]] = defaultdict(set)
         self.pending_actors: Set[bytes] = set()
         self.pending_pgs: Set[bytes] = set()
@@ -129,6 +130,7 @@ class GcsServer:
         r("list_placement_groups", self.h_list_pgs)
         # pubsub
         r("subscribe", self.h_subscribe)
+        r("publish", self.h_publish)
         # task events / state API
         r("add_task_events", self.h_add_task_events)
         r("list_task_events", self.h_list_task_events)
@@ -1001,6 +1003,26 @@ class GcsServer:
         return {"pgs": list(self.placement_groups.values())}
 
     # -- pubsub ----------------------------------------------------------
+    #: Channels clients may publish to. System channels (actor_update:*,
+    #: node_dead, ...) are GCS-originated only — a spoofed actor_update
+    #: would poison every subscriber's actor cache.
+    _CLIENT_PUBLISH_PREFIXES = ("serve_routes:", "user:")
+
+    async def h_publish(self, d, conn):
+        """Client-originated publish: fan a payload out to every subscriber
+        of a namespaced channel (Publisher analog, pubsub/publisher.h:307 —
+        used by e.g. the Serve controller to invalidate handle routing
+        tables)."""
+        channel = d["channel"]
+        if not channel.startswith(self._CLIENT_PUBLISH_PREFIXES):
+            return {
+                "ok": False,
+                "error": f"clients may not publish to {channel!r}; allowed "
+                         f"prefixes: {list(self._CLIENT_PUBLISH_PREFIXES)}",
+            }
+        await self.publish(channel, d.get("payload"))
+        return {"ok": True}
+
     async def h_subscribe(self, d, conn):
         self.subscribers[d["channel"]].add(conn)
         return {"ok": True}
@@ -1021,8 +1043,16 @@ class GcsServer:
         """Merge a client's metric deltas into the cluster aggregate.
 
         Counters accumulate deltas; gauges are last-writer-wins per tag
-        set; histogram bucket counts/sums accumulate.
+        set; histogram bucket counts/sums accumulate. Reports carrying a
+        (reporter, seq) pair are deduplicated so an at-least-once retry
+        (reply lost after the report applied) cannot double-count.
         """
+        reporter, seq = d.get("reporter"), d.get("seq")
+        if reporter is not None and seq is not None:
+            last = self._metrics_seq.get(reporter)
+            if last is not None and seq <= last:
+                return {"ok": True, "duplicate": True}
+            self._metrics_seq[reporter] = seq
         for rec in d["records"]:
             m = self.metrics.setdefault(
                 rec["name"],

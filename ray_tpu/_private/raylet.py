@@ -126,6 +126,7 @@ class Raylet:
         # Open chunked remote-client puts: oid -> (buffer, abort deadline).
         self._client_creates: Dict[bytes, tuple] = {}
         # Runtime metric counters (reported as deltas on the heartbeat).
+        self._metrics_seq = 0
         self._metric_tasks_dispatched = 0
         self._metric_tasks_failed = 0
         self._metric_objects_spilled = 0
@@ -1688,11 +1689,16 @@ class Raylet:
             try:
                 try:
                     records, commits = self._runtime_metric_deltas()
+                    self._metrics_seq += 1
                     await self.gcs.call(
-                        "metrics_report", {"records": records}
+                        "metrics_report",
+                        {"records": records,
+                         "reporter": self.node_id.binary(),
+                         "seq": self._metrics_seq},
                     )
                     # Commit counter baselines only after a successful
-                    # send — a GCS outage must not eat the deltas.
+                    # send; the (reporter, seq) pair makes a retried
+                    # report idempotent if only the reply was lost.
                     self._metric_reported.update(commits)
                 except Exception:  # noqa: BLE001 — observability is best-effort
                     pass

@@ -341,9 +341,20 @@ class CoreClient:
             ev = self._actor_events.get(aid)
             if ev:
                 ev.set()
-        handler = self._push_handlers.get(channel)
-        if handler:
+        for handler in self._push_handlers.get(channel, ()):
             handler(payload)
+
+    def subscribe_push(self, channel: str, handler):
+        """Register a push handler + GCS subscription for a channel
+        (client half of the pubsub long-poll replacement). Multiple
+        handlers per channel fan out — a second subscriber must not evict
+        the first."""
+        self._push_handlers.setdefault(channel, []).append(handler)
+        self._run(self._gcs_call("subscribe", {"channel": channel}))
+
+    def publish(self, channel: str, payload=None):
+        self._run(self._gcs_call("publish",
+                                 {"channel": channel, "payload": payload}))
 
     def disconnect(self):
         # Quiesce the free flusher before teardown ("task destroyed but

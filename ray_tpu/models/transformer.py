@@ -273,12 +273,28 @@ def forward_pipelined(
     from ray_tpu.parallel.pipeline import pipeline_stages
 
     S = mesh.shape["pp"]
+    dp_extent = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
     b, l = tokens.shape
     M = num_microbatches or cfg.pp_microbatches
     if not M:
-        M = 2 * S if b % (2 * S) == 0 else (S if b % S == 0 else 1)
+        # Auto: prefer 2*S microbatches, but each microbatch's batch dim
+        # must still split over dp/fsdp.
+        for cand in (2 * S, S, 1):
+            if b % cand == 0 and (b // cand) % dp_extent == 0:
+                M = cand
+                break
+        else:
+            raise ValueError(
+                f"batch {b} cannot form pp microbatches divisible by the "
+                f"dp extent {dp_extent}; pick batch = k * {S} * {dp_extent}"
+            )
     if b % M != 0:
         raise ValueError(f"batch {b} not divisible by {M} pp microbatches")
+    if (b // M) % dp_extent != 0:
+        raise ValueError(
+            f"microbatch size {b // M} not divisible by dp extent "
+            f"{dp_extent} (batch {b}, {M} microbatches)"
+        )
     if cfg.n_layers % S != 0:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={S}")
     if cfg.num_experts:
@@ -297,7 +313,18 @@ def forward_pipelined(
         return act
 
     xm = x.reshape(M, b // M, l, x.shape[-1])
-    ym = pipeline_stages(stage_fn, params["layers"], xm, mesh, axis_name="pp")
+    # pp composes with data parallelism: each microbatch's batch dim
+    # splits over dp/fsdp inside the pipeline shard_map, so a dp×pp mesh
+    # runs dp-many replicas of every pipeline stage.
+    from jax.sharding import PartitionSpec as P
+
+    dp_axes = tuple(
+        a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1
+    )
+    x_spec = P(None, dp_axes) if dp_axes else P()
+    ym = pipeline_stages(
+        stage_fn, params["layers"], xm, mesh, axis_name="pp", x_spec=x_spec
+    )
     x = ym.reshape(b, l, x.shape[-1])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"]

@@ -44,7 +44,9 @@ class ServeController:
                 "init_args": init_args,
                 "init_kwargs": init_kwargs,
                 "replicas": [],
-                "version": 0,
+                # Monotonic across redeploys so handles can compare
+                # versions to detect ANY change, including replacement.
+                "version": (old["version"] + 1) if old else 0,
                 "target": deployment.num_replicas,
                 "last_scale_up": 0.0,
                 "last_scale_down": time.monotonic(),
@@ -116,13 +118,30 @@ class ServeController:
             with self._lock:
                 app["replicas"].extend(new)
                 app["version"] += 1
+            self._publish_routes(name)
         elif current > target:
             with self._lock:
                 excess = app["replicas"][target:]
                 app["replicas"] = app["replicas"][:target]
                 app["version"] += 1
+            self._publish_routes(name)
             for r in excess:
                 _kill_quietly(r)
+
+    def _publish_routes(self, name: str):
+        """Push a routing-table invalidation to subscribed handles — the
+        LongPollHost role (serve/_private/long_poll.py:175): handles learn
+        of replica set changes immediately instead of on their poll TTL."""
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            with self._lock:
+                version = self.apps[name]["version"]
+            worker_mod.get_client().publish(
+                f"serve_routes:{name}", {"version": version}
+            )
+        except Exception:  # noqa: BLE001 — handles fall back to polling
+            pass
 
     def _reconcile_loop(self):
         while not self._stop:

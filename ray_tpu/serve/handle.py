@@ -51,6 +51,7 @@ class DeploymentHandle:
                 "last_refresh": 0.0,
                 "inflight": {},  # actor_id -> handle-local outstanding
                 "lock": threading.Lock(),
+                "subscribed": False,
             }
         self._shared = _shared
 
@@ -71,18 +72,48 @@ class DeploymentHandle:
 
         return rt.get_actor(CONTROLLER_NAME)
 
+    def _subscribe_invalidation(self):
+        """Push invalidation from the controller (LongPollHost analog):
+        a routes push zeroes last_refresh so the NEXT request refetches,
+        instead of waiting out the poll TTL. Polling stays as fallback."""
+        s = self._shared
+        with s["lock"]:
+            if s["subscribed"]:
+                return
+            s["subscribed"] = True
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            def on_push(_payload, _s=s):
+                with _s["lock"]:
+                    _s["last_refresh"] = 0.0
+
+            worker_mod.get_client().subscribe_push(
+                f"serve_routes:{self.app_name}", on_push
+            )
+        except Exception:  # noqa: BLE001 — polling still works
+            pass
+
     def _refresh(self, force: bool = False):
+        self._subscribe_invalidation()
         s = self._shared
         now = time.monotonic()
         with s["lock"]:
-            if not force and s["replicas"] and now - s["last_refresh"] < 1.0:
+            lr0 = s["last_refresh"]
+            if not force and s["replicas"] and now - lr0 < 1.0:
                 return
         info = rt.get(self._controller().get_replicas.remote(self.app_name),
                       timeout=30)
         with s["lock"]:
-            s["version"] = info["version"]
-            s["replicas"] = info["replicas"]
-            s["last_refresh"] = now
+            if info["version"] >= s["version"]:
+                s["version"] = info["version"]
+                s["replicas"] = info["replicas"]
+            if s["last_refresh"] == lr0:
+                s["last_refresh"] = time.monotonic()
+            # else: a push invalidation zeroed last_refresh while our RPC
+            # was in flight — leave it zeroed so the next request refetches
+            # the post-change table instead of trusting this possibly-stale
+            # response for a full TTL.
             live = {r._actor_id.binary() for r in s["replicas"]}
             s["inflight"] = {
                 k: v for k, v in s["inflight"].items() if k in live

@@ -242,3 +242,34 @@ def test_run_from_config_declarative_deploy(rt_serve, tmp_path):
         assert st["echo"]["target_replicas"] >= 2 or st  # deployed w/ override
     finally:
         sys.path.remove(str(tmp_path))
+
+def test_route_push_invalidation_beats_poll_ttl(rt_serve):
+    """Replica-set changes push to handles (LongPollHost analog): with the
+    poll TTL suppressed, a scale-up still becomes visible via the push."""
+    @serve.deployment(num_replicas=1)
+    class App:
+        def __call__(self):
+            return "ok"
+
+    handle = serve.run(App.bind(), name="pushy")
+    assert rt.get(handle.remote(), timeout=60) == "ok"  # subscribe happens
+
+    s = handle._shared
+    with s["lock"]:
+        # Suppress polling: only a push can zero this back out.
+        s["last_refresh"] = time.monotonic() + 10_000
+        v0 = s["version"]
+
+    serve.run(App.options(num_replicas=3).bind(), name="pushy")
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        rt.get(handle.remote(), timeout=60)  # requests drive refresh
+        with s["lock"]:
+            if s["version"] > v0 and len(s["replicas"]) == 3:
+                break
+        time.sleep(0.2)
+    with s["lock"]:
+        assert s["version"] > v0 and len(s["replicas"]) == 3, (
+            "push invalidation never refreshed the routing table"
+        )
