@@ -16,6 +16,7 @@ from ray_tpu.models.transformer import (
     param_logical_axes,
 )
 from ray_tpu.models import configs
+from ray_tpu.models.generate import decode_step, generate, init_kv_cache, prefill
 
 __all__ = [
     "TransformerConfig",
@@ -25,4 +26,8 @@ __all__ = [
     "loss_fn",
     "param_logical_axes",
     "configs",
+    "generate",
+    "prefill",
+    "decode_step",
+    "init_kv_cache",
 ]
