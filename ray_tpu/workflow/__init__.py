@@ -42,9 +42,20 @@ def _wf_dir(workflow_id: str, storage: Optional[str]) -> str:
 def _step_key(node: DAGNode, index: int) -> str:
     if isinstance(node, FunctionNode):
         name = getattr(node._remote_fn, "__qualname__", "fn")
+    elif isinstance(node, EventNode):
+        name = f"event-{node.event_name}"
     else:
         name = type(node).__name__
     return f"{index:04d}-{name.replace('/', '_').replace('<', '').replace('>', '')}"
+
+
+def _checkpoint(path: str, value: Any):
+    """Durably persist a step/event result: write-then-rename, so the
+    checkpoint is either complete or absent."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f, protocol=5)
+    os.replace(tmp, path)
 
 
 def _write_status(d: str, **fields):
@@ -68,6 +79,50 @@ def _read_status(d: str) -> Optional[dict]:
         return None
 
 
+class EventNode(DAGNode):
+    """A workflow step that resolves when an external signal arrives.
+
+    Reference analog: workflow events (workflow.wait_for_event): the node
+    blocks the workflow until `workflow.signal(workflow_id, name, ...)`
+    delivers a payload; the payload checkpoints like any step, so a
+    resumed workflow does not wait again for an event it already received.
+    """
+
+    def __init__(self, name: str, timeout_s: Optional[float] = None,
+                 poll_s: float = 0.2):
+        super().__init__((), {})
+        self.event_name = name
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+
+    def _execute_node(self, resolved):
+        raise WorkflowError(
+            f"workflow.event({self.event_name!r}) only resolves under "
+            "workflow.run(...), which provides the durable signal store"
+        )
+
+
+def event(name: str, timeout_s: Optional[float] = None) -> EventNode:
+    """Declare an event dependency in a workflow DAG."""
+    return EventNode(name, timeout_s=timeout_s)
+
+
+def signal(workflow_id: str, name: str, payload: Any = None,
+           storage: Optional[str] = None):
+    """Deliver an event payload to a (possibly waiting) workflow. Durable:
+    signaling before the workflow reaches the event is fine."""
+    import tempfile
+
+    d = os.path.join(_wf_dir(workflow_id, storage), "events")
+    os.makedirs(d, exist_ok=True)
+    # Unique tmp per signaler: concurrent signals must never interleave
+    # writes into one tmp inode before the atomic rename.
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    with os.fdopen(fd, "wb") as f:
+        pickle.dump(payload, f, protocol=5)
+    os.replace(tmp, os.path.join(d, name + ".pkl"))
+
+
 class WorkflowError(Exception):
     pass
 
@@ -82,6 +137,32 @@ def _execute(dag: DAGNode, wf_dir: str, input_value, max_step_retries: int):
     for index, node in enumerate(topo):
         if isinstance(node, InputNode):
             resolved[node._id] = input_value
+            continue
+        if isinstance(node, EventNode):
+            key = _step_key(node, index)
+            ckpt = os.path.join(steps_dir, key + ".pkl")
+            if os.path.exists(ckpt):
+                with open(ckpt, "rb") as f:
+                    resolved[node._id] = pickle.load(f)
+                continue
+            ev_path = os.path.join(wf_dir, "events", node.event_name + ".pkl")
+            _write_status(wf_dir, state="WAITING", waiting_on=node.event_name)
+            deadline = (
+                None if node.timeout_s is None
+                else time.monotonic() + node.timeout_s
+            )
+            while not os.path.exists(ev_path):
+                if deadline is not None and time.monotonic() > deadline:
+                    raise WorkflowError(
+                        f"timed out waiting for event {node.event_name!r}"
+                    )
+                time.sleep(node.poll_s)
+            with open(ev_path, "rb") as f:
+                payload = pickle.load(f)
+            _checkpoint(ckpt, payload)
+            _write_status(wf_dir, state="RUNNING", last_step=key,
+                          waiting_on=None, updated_at=time.time())
+            resolved[node._id] = payload
             continue
         if not isinstance(node, FunctionNode):
             raise WorkflowError(
@@ -104,10 +185,7 @@ def _execute(dag: DAGNode, wf_dir: str, input_value, max_step_retries: int):
                 last_exc = e
         else:
             raise WorkflowError(f"step {key} failed: {last_exc}") from last_exc
-        tmp = ckpt + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(value, f, protocol=5)
-        os.replace(tmp, ckpt)  # atomic: a step is either durable or absent
+        _checkpoint(ckpt, value)  # atomic: a step is durable or absent
         _write_status(wf_dir, last_step=key, updated_at=time.time())
         resolved[node._id] = value
     return resolved[dag._id]

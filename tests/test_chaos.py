@@ -158,3 +158,35 @@ def test_gcs_restart_preserves_state_and_serves(tmp_path):
         assert rt.get(add.remote(2, 3), timeout=60) == 5
     finally:
         cluster.shutdown()
+
+def test_gcs_restart_during_task_storm(tmp_path):
+    """The GCS dies and restarts WHILE tasks are flowing: in-flight work
+    completes (tasks ride raylet connections, not the GCS) and new work
+    submits after the raylet re-registers."""
+    persist = str(tmp_path / "gcs.bin")
+    cluster = Cluster(gcs_persist_path=persist)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        @rt.remote(max_retries=3)
+        def work(i):
+            time.sleep(0.1)
+            return i
+
+        refs = [work.remote(i) for i in range(20)]
+        time.sleep(0.4)  # storm in flight
+        cluster.kill_gcs()
+        time.sleep(0.5)
+        cluster.restart_gcs()
+
+        assert rt.get(refs, timeout=120) == list(range(20))
+
+        # Fresh submissions work once the raylet re-registers.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if any(n["state"] == "ALIVE" for n in cluster.gcs.nodes.values()):
+                break
+            time.sleep(0.25)
+        assert rt.get(work.remote(99), timeout=60) == 99
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,120 @@
+"""LLM serving showcase: the generation stack behind a Serve deployment —
+batched prefill+decode, per-model multiplexing, streaming tokens.
+
+This is the TPU serving story end to end: serve.batch coalesces
+concurrent prompts into one batched generate() call (one set of MXU
+passes), multiplexing keeps several checkpoints LRU-resident per replica,
+and token streaming rides the generator protocol.
+"""
+
+import threading
+import time
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt_serve():
+    rt.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    rt.shutdown()
+
+
+def test_batched_llm_generation(rt_serve):
+    @serve.deployment(max_ongoing_requests=8)
+    class LLM:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import configs, init_params
+
+            self.cfg = replace(configs.tiny, dtype=np.float32)
+            self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def generate_batch(self, prompts):
+            import jax.numpy as jnp
+
+            from ray_tpu.models import generate
+
+            # Same-length prompts stack into ONE batched generate call.
+            batch = jnp.asarray(np.stack(prompts), dtype=jnp.int32)
+            out = generate(self.params, batch, self.cfg, max_new_tokens=4)
+            return [np.asarray(row).tolist() for row in out]
+
+        def __call__(self, prompt):
+            return self.generate_batch(np.asarray(prompt, dtype=np.int32))
+
+    handle = serve.run(LLM.bind(), name="llm")
+    prompts = [[1 + i, 7, 42, 3] for i in range(8)]
+    results = [None] * 8
+
+    def call(i):
+        results[i] = rt.get(handle.remote(prompts[i]), timeout=120)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert all(r is not None and len(r) == 4 for r in results)
+
+    # Same prompt => same greedy tokens regardless of batch composition.
+    again = rt.get(handle.remote(prompts[0]), timeout=120)
+    assert again == results[0]
+
+    # Batching actually coalesced concurrent prompts.
+    handle._refresh(force=True)
+    replica = handle._shared["replicas"][0]
+    stats = rt.get(replica.stats.remote(), timeout=30)
+    assert max(stats["batch_sizes"]["generate_batch"]) > 1
+
+
+def test_streaming_token_generation(rt_serve):
+    @serve.deployment
+    class StreamLLM:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import configs, init_params
+
+            self.cfg = replace(configs.tiny, dtype=np.float32)
+            self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+
+        def __call__(self, prompt, n=5):
+            import jax.numpy as jnp
+
+            from ray_tpu.models.generate import (
+                decode_step, init_kv_cache, prefill,
+            )
+
+            tokens = jnp.asarray([prompt], dtype=jnp.int32)
+            cache = init_kv_cache(self.cfg, 1, tokens.shape[1] + n)
+            logits, cache = prefill(self.params, tokens, cache, self.cfg)
+            for _ in range(n):
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                yield int(nxt[0])
+                logits, cache = decode_step(self.params, nxt, cache, self.cfg)
+
+    handle = serve.run(StreamLLM.bind(), name="sllm")
+    toks = list(handle.options(stream=True).remote([5, 9, 2], n=5))
+    assert len(toks) == 5 and all(isinstance(t, int) for t in toks)
+
+    # The stream matches batch generation of the same prompt (greedy).
+    from ray_tpu.models import configs, generate, init_params
+    import jax
+    import jax.numpy as jnp
+
+    cfg = replace(configs.tiny, dtype=np.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ref = generate(
+        params, jnp.asarray([[5, 9, 2]], dtype=jnp.int32), cfg,
+        max_new_tokens=5,
+    )
+    assert toks == np.asarray(ref[0]).tolist()

@@ -123,3 +123,64 @@ def test_parallel_iterator(rt_start):
     it = rt_iter.from_range(10, num_shards=3)
     out = it.for_each(lambda x: x * 2).filter(lambda x: x % 4 == 0).gather_sync()
     assert sorted(out) == [0, 4, 8, 12, 16]
+
+def test_workflow_events_signal_and_resume(rt_start, tmp_path):
+    """workflow.event blocks until workflow.signal delivers a payload; the
+    payload checkpoints, so a resume does not re-wait (reference: workflow
+    events / wait_for_event)."""
+    import threading
+    import time
+
+    from ray_tpu import workflow
+
+    @rt.remote
+    def combine(a, b):
+        return {"approved": a, "value": b}
+
+    ev = workflow.event("approval")
+    dag = combine.bind(ev, 42)
+
+    wf_id = "wf-events-1"
+    out = {}
+
+    def run():
+        out["result"] = workflow.run(
+            dag, workflow_id=wf_id, storage=str(tmp_path)
+        )
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # The workflow must be WAITING on the event, not finished.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if workflow.get_status(wf_id, storage=str(tmp_path)) == "WAITING":
+            break
+        time.sleep(0.1)
+    assert t.is_alive(), "workflow finished without the event"
+
+    workflow.signal(wf_id, "approval", {"by": "alice"}, storage=str(tmp_path))
+    t.join(timeout=60)
+    assert out["result"] == {"approved": {"by": "alice"}, "value": 42}
+
+    # Signal-before-run also works (durable delivery).
+    wf2 = "wf-events-2"
+    workflow.signal(wf2, "approval", "pre", storage=str(tmp_path))
+    res = workflow.run(
+        combine.bind(workflow.event("approval"), 1),
+        workflow_id=wf2, storage=str(tmp_path),
+    )
+    assert res == {"approved": "pre", "value": 1}
+
+
+def test_workflow_event_timeout(rt_start, tmp_path):
+    from ray_tpu import workflow
+
+    @rt.remote
+    def use(x):
+        return x
+
+    with pytest.raises(workflow.WorkflowError, match="timed out"):
+        workflow.run(
+            use.bind(workflow.event("never", timeout_s=0.5)),
+            workflow_id="wf-timeout", storage=str(tmp_path),
+        )
