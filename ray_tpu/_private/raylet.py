@@ -123,6 +123,11 @@ class Raylet:
         self._storage = None  # lazy external storage
         self._spill_lock = asyncio.Lock()
         self._object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
+        # Pull admission control (PullManager analog, pull_manager.h:52):
+        # bound concurrent inbound transfers so a burst of dependency
+        # fetches can't thrash the store/network; single-flight per object.
+        self._pull_slots = asyncio.Semaphore(8)
+        self._active_pulls: Dict[bytes, asyncio.Future] = {}
         # Open chunked remote-client puts: oid -> (buffer, abort deadline).
         self._client_creates: Dict[bytes, tuple] = {}
         # Runtime metric counters (reported as deltas on the heartbeat).
@@ -1261,9 +1266,40 @@ class Raylet:
 
     # -- object transfer -------------------------------------------------
     async def _ensure_local(self, oid_bytes: bytes, timeout: float = 60.0):
-        """Pull an object into the local store (PullManager analog); spilled
+        """Pull an object into the local store (PullManager analog):
+        single-flight per object, bounded concurrent transfers; spilled
         objects are restored by their spill node first
         (AsyncRestoreSpilledObject, local_object_manager.h:122)."""
+        if self.store.contains_raw(oid_bytes):
+            return
+        # Single-flight per object: loop (not a one-shot check) so waiters
+        # that wake concurrently never register duplicate pulls over each
+        # other; a failed pull propagates so waiters retry deliberately.
+        while True:
+            existing = self._active_pulls.get(oid_bytes)
+            if existing is None:
+                break
+            try:
+                await asyncio.shield(existing)
+            except Exception:  # noqa: BLE001 — leader failed; we may retry
+                pass
+            if self.store.contains_raw(oid_bytes):
+                return
+        fut = asyncio.get_event_loop().create_future()
+        fut.add_done_callback(lambda f: f.exception())  # consumed by waiters
+        self._active_pulls[oid_bytes] = fut
+        try:
+            await self._ensure_local_inner(oid_bytes, timeout)
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        finally:
+            self._active_pulls.pop(oid_bytes, None)
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _ensure_local_inner(self, oid_bytes: bytes, timeout: float = 60.0):
         if self.store.contains_raw(oid_bytes):
             return
         resp = await self.gcs.call(
@@ -1304,7 +1340,11 @@ class Raylet:
             if peer is None:
                 continue
             try:
-                await self._pull_from(peer, oid_bytes, resp["size"])
+                async with self._pull_slots:
+                    # Admission control bounds the TRANSFER only — holding
+                    # a slot across object_location_wait would let 8
+                    # unproduced dependencies starve ready pulls for 60s.
+                    await self._pull_from(peer, oid_bytes, resp["size"])
                 await self.gcs.call(
                     "object_location_add",
                     {

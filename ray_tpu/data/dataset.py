@@ -102,6 +102,79 @@ class Dataset:
                 break
         return from_items(rows)
 
+    def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
+        """Row -> value for a new column (reference: Dataset.add_column)."""
+        def block_fn(block):
+            return B.block_from_rows(
+                [{**r, name: fn(r)} for r in B.block_to_rows(block)]
+            )
+
+        return self._with_stage(MapStage(block_fn, name="add_column"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+
+        def block_fn(block):
+            return B.block_from_rows(
+                [{k: v for k, v in r.items() if k not in drop}
+                 for r in B.block_to_rows(block)]
+            )
+
+        return self._with_stage(MapStage(block_fn, name="drop_columns"))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        keep = list(cols)
+
+        def block_fn(block):
+            return B.block_from_rows(
+                [{k: r[k] for k in keep} for r in B.block_to_rows(block)]
+            )
+
+        return self._with_stage(MapStage(block_fn, name="select_columns"))
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample). The
+        seed salts per block (the repo's _shuffle_map_block convention) so
+        blocks draw independent sequences, not one repeated mask."""
+        def block_fn(block, index):
+            rows = B.block_to_rows(block)
+            rng = _random.Random(None if seed is None else seed + index)
+            return B.block_from_rows(
+                [r for r in rows if rng.random() < fraction]
+            )
+
+        return self._with_stage(
+            MapStage(block_fn, name="random_sample", with_index=True)
+        )
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-wise zip of two equal-length datasets (reference:
+        Dataset.zip). Clashing right-side column names are suffixed with
+        "_1" (left value kept under the original name). When per-block row
+        counts align, blocks zip pairwise in remote tasks; otherwise one
+        remote task merges (rows never pass through the driver)."""
+        left = self.materialize()
+        right = other.materialize()
+        lrefs, rrefs = left._input_refs, right._input_refs
+        count_fn = rt.remote(_block_count)
+        lc = rt.get([count_fn.remote(r) for r in lrefs])
+        rc = rt.get([count_fn.remote(r) for r in rrefs])
+        if sum(lc) != sum(rc):
+            raise ValueError(
+                f"zip requires equal lengths, got {sum(lc)} vs {sum(rc)}"
+            )
+        zip_fn = rt.remote(_zip_blocks)
+        if lc == rc:
+            return Dataset(
+                [zip_fn.remote(a, b) for a, b in zip(lrefs, rrefs)]
+            )
+        # Misaligned blocks: one worker-side merge (driver touches refs).
+        merged = rt.remote(_zip_all).options(num_returns=1).remote(
+            len(lrefs), *lrefs, *rrefs
+        )
+        return Dataset([merged])
+
     # -- aggregation -----------------------------------------------------
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -389,6 +462,35 @@ def _sort_refs(refs: List, key: str, descending: bool) -> List:
     if descending:
         out = list(reversed(out))
     return out
+
+
+def _block_count(block) -> int:
+    return B.block_num_rows(block)
+
+
+def _merge_zip_rows(a_rows, b_rows):
+    rows = []
+    for a, b in zip(a_rows, b_rows):
+        merged = dict(a)
+        for k, v in b.items():
+            merged[k if k not in a else k + "_1"] = v
+        rows.append(merged)
+    return rows
+
+
+def _zip_blocks(a_block, b_block):
+    return B.block_from_rows(
+        _merge_zip_rows(B.block_to_rows(a_block), B.block_to_rows(b_block))
+    )
+
+
+def _zip_all(n_left, *blocks):
+    a_rows, b_rows = [], []
+    for blk in blocks[:n_left]:
+        a_rows.extend(B.block_to_rows(blk))
+    for blk in blocks[n_left:]:
+        b_rows.extend(B.block_to_rows(blk))
+    return B.block_from_rows(_merge_zip_rows(a_rows, b_rows))
 
 
 def _sample_keys(block, key: str, k: int):

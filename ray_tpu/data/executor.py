@@ -30,10 +30,13 @@ import ray_tpu as rt
 class MapStage:
     """A per-block transform executed as remote tasks."""
 
-    fn: Callable  # Block -> Block
+    fn: Callable  # Block -> Block  (or (Block, index) with with_index)
     name: str = "map"
     max_in_flight: int = 4
     resources: Optional[dict] = None
+    # fn receives the block's position as a second arg (e.g. per-block
+    # seed salting for sampling).
+    with_index: bool = False
 
 
 @dataclass
@@ -46,6 +49,10 @@ class AllToAllStage:
 
 def _apply_block_fn(fn, block):
     return fn(block)
+
+
+def _apply_block_fn_indexed(fn, block, index):
+    return fn(block, index)
 
 
 class StreamingExecutor:
@@ -75,19 +82,24 @@ class StreamingExecutor:
         number of blocks in flight (the backpressure window)."""
         remote_fns = []
         for st in stages:
-            f = rt.remote(_apply_block_fn)
+            f = rt.remote(
+                _apply_block_fn_indexed if st.with_index else _apply_block_fn
+            )
             if st.resources:
                 f = f.options(resources=st.resources)
-            remote_fns.append((f, st.fn))
+            remote_fns.append((f, st.fn, st.with_index))
         cap = max(min(st.max_in_flight for st in stages), 1)
-        queue = deque(input_refs)
+        queue = deque(enumerate(input_refs))
         in_flight: List = []
         out: List = []
         while queue or in_flight:
             while queue and len(in_flight) < cap:
-                ref = queue.popleft()
-                for f, fn in remote_fns:
-                    ref = f.remote(fn, ref)
+                idx, ref = queue.popleft()
+                for f, fn, with_index in remote_fns:
+                    if with_index:
+                        ref = f.remote(fn, ref, idx)
+                    else:
+                        ref = f.remote(fn, ref)
                 in_flight.append(ref)
             ready, in_flight = rt.wait(in_flight, num_returns=1, timeout=60.0)
             out.extend(ready)

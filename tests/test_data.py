@@ -182,3 +182,24 @@ def test_read_text(rt_start, tmp_path):
     p.write_text("alpha\nbeta\ngamma\n")
     ds = rtd.read_text(str(p))
     assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]
+
+def test_column_ops_and_sampling(rt_start):
+    import ray_tpu.data as rtd
+
+    ds = rtd.from_items([{"a": i, "b": i * 2} for i in range(50)],
+                        parallelism=4)
+    out = (
+        ds.add_column("c", lambda r: r["a"] + r["b"])
+        .drop_columns(["b"])
+        .select_columns(["c"])
+        .take(3)
+    )
+    assert out == [{"c": 0}, {"c": 3}, {"c": 6}]
+
+    sampled = ds.random_sample(0.5, seed=1).count()
+    assert 5 <= sampled <= 45
+
+    zipped = rtd.from_items([{"x": i} for i in range(5)]).zip(
+        rtd.from_items([{"y": i * 10} for i in range(5)])
+    )
+    assert zipped.take(2) == [{"x": 0, "y": 0}, {"x": 1, "y": 10}]
