@@ -1281,6 +1281,10 @@ class Raylet:
                 break
             try:
                 await asyncio.shield(existing)
+            except asyncio.CancelledError:
+                if not existing.done():
+                    raise  # WE were cancelled; the leader is still going
+                # The LEADER was cancelled: fall through and retry.
             except Exception:  # noqa: BLE001 — leader failed; we may retry
                 pass
             if self.store.contains_raw(oid_bytes):

@@ -90,19 +90,23 @@ class StreamingExecutor:
             remote_fns.append((f, st.fn, st.with_index))
         cap = max(min(st.max_in_flight for st in stages), 1)
         queue = deque(enumerate(input_refs))
-        in_flight: List = []
-        out: List = []
-        while queue or in_flight:
-            while queue and len(in_flight) < cap:
+        pending: dict = {}  # chained ref -> original block index
+        out: List = [None] * len(input_refs)
+        while queue or pending:
+            while queue and len(pending) < cap:
                 idx, ref = queue.popleft()
                 for f, fn, with_index in remote_fns:
                     if with_index:
                         ref = f.remote(fn, ref, idx)
                     else:
                         ref = f.remote(fn, ref)
-                in_flight.append(ref)
-            ready, in_flight = rt.wait(in_flight, num_returns=1, timeout=60.0)
-            out.extend(ready)
-            if not ready and in_flight:
+                pending[ref] = idx
+            ready, _ = rt.wait(list(pending), num_returns=1, timeout=60.0)
+            for r in ready:
+                # Results land at their ORIGINAL positions: consumers (zip,
+                # ordered iteration) rely on block order surviving the
+                # completion-order wait.
+                out[pending.pop(r)] = r
+            if not ready and pending:
                 time.sleep(0.01)
         return out

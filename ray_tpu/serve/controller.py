@@ -27,6 +27,7 @@ class ServeController:
     def __init__(self):
         # app name -> {deployment, replicas: [handles], version}
         self.apps: Dict[str, Dict] = {}
+        self._health_fails: Dict[bytes, int] = {}
         self._lock = threading.Lock()
         self._stop = False
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
@@ -150,10 +151,65 @@ class ServeController:
                 with self._lock:
                     names = list(self.apps)
                 for name in names:
+                    self._check_replica_health(name)
                     self._autoscale(name)
                     self._reconcile_once(name)
             except Exception:
                 pass
+
+    def _check_replica_health(self, name: str):
+        """Drop dead replicas so reconcile replaces them — the
+        DeploymentState failure-recovery role (deployment_state.py:1211).
+        Probes run in PARALLEL (one slow app must not stall the reconcile
+        loop) and a replica is declared dead only after 3 consecutive
+        failed probes, so a replica that is briefly saturated (all
+        concurrency slots busy) or still loading a model is not killed."""
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            replicas = list(app["replicas"])
+        if not replicas:
+            return
+        refs = [r.health_check.remote() for r in replicas]
+        # One collective wait bounds the whole pass at ~10s regardless of
+        # how many replicas are hung.
+        ready, _not_ready = rt.wait(refs, num_returns=len(refs), timeout=10.0)
+        ready_set = set(ready)
+        dead = []
+        for r, ref in zip(replicas, refs):
+            key = r._actor_id.binary()
+            healthy = False
+            if ref in ready_set:
+                try:
+                    rt.get(ref, timeout=5)
+                    healthy = True
+                except Exception:  # noqa: BLE001 — call errored: unhealthy
+                    pass
+            if healthy:
+                self._health_fails.pop(key, None)
+                continue
+            fails = self._health_fails.get(key, 0) + 1
+            self._health_fails[key] = fails
+            if fails >= 3:
+                dead.append(r)
+        if not dead:
+            return
+        for r in dead:
+            self._health_fails.pop(r._actor_id.binary(), None)
+        dead_ids = {d._actor_id.binary() for d in dead}
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            app["replicas"] = [
+                r for r in app["replicas"]
+                if r._actor_id.binary() not in dead_ids
+            ]
+            app["version"] += 1
+        self._publish_routes(name)
+        for r in dead:
+            _kill_quietly(r)
 
     def _autoscale(self, name: str):
         """Queue-length autoscaling (reference: autoscaling_policy.py)."""

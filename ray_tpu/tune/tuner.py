@@ -123,6 +123,7 @@ class Trial:
     error: Optional[str] = None
     iteration: int = 0
     trial_dir: str = ""
+    failures: int = 0
 
 
 class ResultGrid:
@@ -215,12 +216,7 @@ class Tuner:
                 trial_dir = os.path.join(exp_dir, f"trial_{trial_id}")
                 os.makedirs(trial_dir, exist_ok=True)
                 trial.trial_dir = trial_dir
-                trial.actor = _TrialActor.options(
-                    num_cpus=resources.get("CPU", 1.0),
-                    resources={k: v for k, v in resources.items() if k != "CPU"},
-                ).remote(trial_id, trial_dir)
-                rt.get(trial.actor.run.remote(self.trainable, config, None),
-                       timeout=300)
+                self._launch_actor(trial, config, None, resources)
                 trial.state = "RUNNING"
                 if hasattr(scheduler, "on_trial_add"):
                     scheduler.on_trial_add(trial_id, config)
@@ -230,10 +226,43 @@ class Tuner:
             if not live and exhausted:
                 break
 
-            # Poll live trials.
-            polls = rt.get([t.actor.poll.remote() for t in live], timeout=300)
+            # Poll live trials (per-trial isolation: one crashed actor
+            # must not take down the controller loop).
+            polls = []
+            for t in live:
+                try:
+                    polls.append(rt.get(t.actor.poll.remote(), timeout=300))
+                except Exception as e:  # noqa: BLE001 — actor/worker died
+                    polls.append({"crashed": str(e)})
             still_live = []
             for trial, st in zip(live, polls):
+                if "crashed" in st:
+                    # Trial-level fault tolerance (FailureConfig.max_failures,
+                    # reference air/config.py:377): restart the trial actor
+                    # from its newest checkpoint. A FAILED restart keeps the
+                    # trial live so the next poll retries it (counting
+                    # against the same budget) — it must never abort fit().
+                    trial.failures += 1
+                    budget = self.run_config.failure_config.max_failures
+                    if budget < 0 or trial.failures <= budget:
+                        try:
+                            self._restart_trial(trial, resources)
+                        except Exception:  # noqa: BLE001 — retried next poll
+                            pass
+                        still_live.append(trial)
+                    else:
+                        trial.state = "ERROR"
+                        trial.error = (
+                            f"trial crashed {trial.failures}x "
+                            f"(max_failures={budget}): {st['crashed']}"
+                        )
+                        try:
+                            rt.kill(trial.actor)  # may be hung, not dead
+                        except Exception:  # noqa: BLE001
+                            pass
+                        scheduler.on_complete(trial.trial_id, trial.last_metrics)
+                        searcher.on_trial_complete(trial.trial_id, trial.last_metrics)
+                    continue
                 exploited = False
                 for rep in st["reports"]:
                     trial.iteration += 1
@@ -290,6 +319,25 @@ class Tuner:
         ]
         return ResultGrid(results, trials, tc.metric, tc.mode)
 
+    def _launch_actor(self, trial: Trial, config, checkpoint, resources):
+        """The single trial-actor launch path (initial, exploit, restart)."""
+        trial.actor = _TrialActor.options(
+            num_cpus=resources.get("CPU", 1.0),
+            resources={k: v for k, v in resources.items() if k != "CPU"},
+        ).remote(trial.trial_id, trial.trial_dir)
+        rt.get(
+            trial.actor.run.remote(self.trainable, config, checkpoint),
+            timeout=300,
+        )
+
+    def _restart_trial(self, trial: Trial, resources):
+        """Replace a crashed trial actor, resuming from its checkpoint."""
+        try:
+            rt.kill(trial.actor)
+        except Exception:  # noqa: BLE001
+            pass
+        self._launch_actor(trial, trial.config, trial.checkpoint, resources)
+
     def _exploit(self, trial: Trial, scheduler, resources) -> bool:
         """PBT exploit/explore: restart the trial from a donor's checkpoint
         with a mutated config (reference: pbt.py _exploit)."""
@@ -301,16 +349,9 @@ class Tuner:
         except Exception:
             pass
         trial.config = new_config
-        trial.actor = _TrialActor.options(
-            num_cpus=resources.get("CPU", 1.0),
-            resources={k: v for k, v in resources.items() if k != "CPU"},
-        ).remote(trial.trial_id, trial.trial_dir)
-        rt.get(
-            trial.actor.run.remote(
-                self.trainable, new_config,
-                Checkpoint.from_directory(ckpt_path),
-            ),
-            timeout=300,
+        self._launch_actor(
+            trial, new_config, Checkpoint.from_directory(ckpt_path),
+            resources,
         )
         return True
 

@@ -273,3 +273,42 @@ def test_route_push_invalidation_beats_poll_ttl(rt_serve):
         assert s["version"] > v0 and len(s["replicas"]) == 3, (
             "push invalidation never refreshed the routing table"
         )
+
+def test_dead_replica_replaced_and_service_heals(rt_serve):
+    """SIGKILL a replica's worker process: the controller detects the dead
+    replica, replaces it, and the handle routes around it (reference:
+    DeploymentState failure recovery, deployment_state.py:1211)."""
+    import os
+    import signal
+
+    from ray_tpu._private import worker as worker_mod
+
+    @serve.deployment(num_replicas=2)
+    class App:
+        def __call__(self):
+            return os.getpid()
+
+    handle = serve.run(App.bind(), name="healme")
+    pids = set()
+    for _ in range(8):
+        pids.add(rt.get(handle.remote(), timeout=60))
+    assert len(pids) >= 1
+
+    # Kill one replica's worker process outright.
+    victim_pid = next(iter(pids))
+    os.kill(victim_pid, signal.SIGKILL)
+
+    # The service keeps answering (handle may briefly hit the dead replica
+    # and retry on the next call), and a replacement replica appears.
+    deadline = time.monotonic() + 60
+    new_pids = set()
+    while time.monotonic() < deadline:
+        try:
+            new_pids.add(rt.get(handle.remote(), timeout=30))
+        except Exception:
+            pass  # transient while routing catches up
+        if len(new_pids - {victim_pid}) >= 2:
+            break
+        time.sleep(0.3)
+    alive = new_pids - {victim_pid}
+    assert len(alive) >= 2, f"replacement replica never served: {new_pids}"

@@ -37,7 +37,7 @@ def test_batched_llm_generation(rt_serve):
             self.cfg = replace(configs.tiny, dtype=np.float32)
             self.params = init_params(jax.random.PRNGKey(0), self.cfg)
 
-        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.75)
         def generate_batch(self, prompts):
             import jax.numpy as jnp
 

@@ -205,3 +205,71 @@ def test_pbt_exploits_and_improves(tmp_path):
     # so every trial must finish far above the pure lr=0.1 ceiling (3.0).
     finals = sorted(r.metrics["score"] for r in grid)
     assert finals[0] > 4.0, f"bottom trial never improved: {finals}"
+
+def _crashy_trainable(config):
+    """Checkpoints progress; crashes the whole worker process at step 3 on
+    the first life (the checkpoint lets a restarted trial resume)."""
+    import json as _json
+    import os as _os
+    import tempfile
+
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    step = 0
+    ckpt = tune.get_checkpoint()
+    if ckpt is not None:
+        with open(_os.path.join(ckpt.path, "s.json")) as f:
+            step = _json.load(f)["step"]
+    first_life = ckpt is None
+    for step in range(step, 8):
+        d = tempfile.mkdtemp()
+        with open(_os.path.join(d, "s.json"), "w") as f:
+            _json.dump({"step": step}, f)
+        tune.report({"step": step}, checkpoint=Checkpoint.from_directory(d))
+        time.sleep(0.05)
+        if first_life and step == 3:
+            _os._exit(1)  # hard crash: the actor process dies
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 2}], indirect=True)
+def test_trial_crash_restarts_from_checkpoint(tmp_path):
+    from ray_tpu.train.config import FailureConfig, RunConfig
+
+    tuner = Tuner(
+        _crashy_trainable,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="step", mode="max"),
+        run_config=RunConfig(
+            name="crashy", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.error is None
+    # The trial finished all 8 steps across two lives, resuming >= step 3.
+    assert best.metrics["step"] == 7
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 2}], indirect=True)
+def test_trial_crash_exhausts_budget(tmp_path):
+    from ray_tpu.train.config import FailureConfig, RunConfig
+
+    def always_crash(config):
+        import os as _os
+
+        tune.report({"step": 0})
+        time.sleep(0.1)
+        _os._exit(1)
+
+    tuner = Tuner(
+        always_crash,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="step", mode="max"),
+        run_config=RunConfig(
+            name="crashy2", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.errors, "exhausted failure budget must surface an error"
