@@ -1,0 +1,29 @@
+"""Distributed data pipeline: read -> transform -> shuffle -> train ingest.
+
+Run: python examples/data_pipeline.py
+"""
+
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu import data as rtd
+
+
+def main():
+    rt.init(num_cpus=4)
+    ds = (
+        rtd.range(10_000, parallelism=8)
+        .map(lambda r: {"x": r["id"] / 10_000.0})
+        .add_column("y", lambda r: 2.0 * r["x"] + 1.0)
+        .random_shuffle(seed=0)
+    )
+    for i, batch in enumerate(ds.iter_batches(batch_size=1024)):
+        x = np.asarray(batch["x"], dtype=np.float32)
+        print(f"batch {i}: {len(x)} rows, mean x={x.mean():.3f}")
+        if i >= 3:
+            break
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Serve an LLM with dynamic batching + token streaming over HTTP.
+
+Run: python examples/serve_llm.py
+Then:  curl -X POST localhost:8000/llm -d '{"prompt": [1, 7, 42]}'
+       curl -N -X POST 'localhost:8000/llm?stream=1' -d '{"prompt": [1, 7, 42]}'
+"""
+
+import time
+from dataclasses import replace
+
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@serve.deployment(max_ongoing_requests=16)
+class LLM:
+    def __init__(self):
+        import jax
+
+        from ray_tpu.models import configs, init_params
+
+        self.cfg = replace(configs.tiny, dtype=np.float32)
+        self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+    def generate_batch(self, prompts):
+        import jax.numpy as jnp
+
+        from ray_tpu.models import generate
+
+        batch = jnp.asarray(np.stack(prompts), dtype=jnp.int32)
+        out = generate(self.params, batch, self.cfg, max_new_tokens=16)
+        return [np.asarray(r).tolist() for r in out]
+
+    def __call__(self, prompt):
+        return self.generate_batch(np.asarray(prompt, dtype=np.int32))
+
+
+def main():
+    rt.init(num_cpus=4)
+    serve.run(LLM.bind(), name="llm")
+    addr = serve.start_http_proxy(port=8000)
+    print(f"serving at {addr}/llm — ctrl-c to stop")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        serve.shutdown()
+        rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

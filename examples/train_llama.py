@@ -1,0 +1,49 @@
+"""Train a Llama-family model with JaxTrainer over a sharded mesh.
+
+Run (CPU mesh):  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+                     python examples/train_llama.py
+On a TPU host the same script uses the real chips.
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import configs, init_params, loss_fn, param_logical_axes
+from ray_tpu.parallel import MeshConfig, build_mesh, shard_params
+
+
+def main():
+    n = len(jax.devices())
+    mesh = build_mesh(MeshConfig.for_devices(n, tp=2 if n % 2 == 0 else 1))
+    cfg = replace(
+        configs.tiny if jax.devices()[0].platform == "cpu"
+        else configs.get_config("llama2-1b"),
+        remat=True,
+        remat_policy="dots_nobatch",
+    )
+    params = shard_params(
+        init_params(jax.random.PRNGKey(0), cfg), param_logical_axes(cfg), mesh
+    )
+    opt = optax.adamw(3e-4)
+    state = jax.jit(opt.init)(params)
+
+    def step(p, s, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(p, tokens, cfg, mesh)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, min(cfg.max_seq, 128) + 1), 0,
+        cfg.vocab_size,
+    )
+    for i in range(10):
+        params, state, loss = jstep(params, state, tokens)
+        print(f"step {i}: loss {float(jax.device_get(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Hyperparameter search with Population Based Training.
+
+Run: python examples/tune_pbt.py
+"""
+
+import ray_tpu as rt
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import PopulationBasedTraining, TuneConfig, Tuner
+
+
+def objective(config):
+    import json, os, tempfile, time
+
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    score, step = 0.0, 0
+    ckpt = tune.get_checkpoint()
+    if ckpt:
+        st = json.load(open(os.path.join(ckpt.path, "s.json")))
+        score, step = st["score"], st["step"]
+    for step in range(step, 40):
+        score += config["lr"]
+        d = tempfile.mkdtemp()
+        json.dump({"score": score, "step": step},
+                  open(os.path.join(d, "s.json"), "w"))
+        tune.report({"score": score},
+                    checkpoint=Checkpoint.from_directory(d))
+        time.sleep(0.05)
+
+
+def main():
+    rt.init(num_cpus=4)
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.1, 1.0, 10.0]},
+    )
+    grid = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.1, 1.0, 10.0, 10.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt),
+        run_config=RunConfig(name="pbt-demo"),
+    ).fit()
+    print("best:", grid.get_best_result().metrics)
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()
