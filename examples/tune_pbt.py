@@ -19,7 +19,8 @@ def objective(config):
     if ckpt:
         st = json.load(open(os.path.join(ckpt.path, "s.json")))
         score, step = st["score"], st["step"]
-    for step in range(step, 40):
+    # The checkpoint was written after completing `step` — resume AFTER it.
+    for step in range(step + 1 if ckpt else step, 40):
         score += config["lr"]
         d = tempfile.mkdtemp()
         json.dump({"score": score, "step": step},

@@ -11,8 +11,35 @@ from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
 from ray_tpu.rl.env_runner import EnvRunner, compute_gae
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, dqn_loss
+from ray_tpu.rl.algorithms.impala import (
+    IMPALA,
+    IMPALAConfig,
+    impala_loss,
+    vtrace,
+)
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, ppo_loss
+from ray_tpu.rl.connectors import (
+    ClipReward,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+)
 from ray_tpu.rl.env_runner import TransitionEnvRunner
+from ray_tpu.rl.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiRLModule,
+)
+from ray_tpu.rl.offline import (
+    BC,
+    BCConfig,
+    bc_loss,
+    dataset_to_batch,
+    episodes_to_dataset,
+)
 from ray_tpu.rl.replay import ReplayBuffer
 
 __all__ = [
@@ -30,4 +57,23 @@ __all__ = [
     "PPO",
     "PPOConfig",
     "ppo_loss",
+    "IMPALA",
+    "IMPALAConfig",
+    "impala_loss",
+    "vtrace",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiRLModule",
+    "Connector",
+    "ConnectorPipeline",
+    "FlattenObs",
+    "NormalizeObs",
+    "ClipReward",
+    "BC",
+    "BCConfig",
+    "bc_loss",
+    "episodes_to_dataset",
+    "dataset_to_batch",
 ]

@@ -52,6 +52,7 @@ class DQNConfig:
     hidden: tuple = (64, 64)
     num_env_runners: int = 2
     rollout_length: int = 100
+    connectors_factory: Optional[Callable] = None
     num_learners: int = 1
     lr: float = 1e-3
     gamma: float = 0.99
@@ -74,11 +75,14 @@ class DQNConfig:
             self.num_actions = num_actions
         return self
 
-    def env_runners(self, num_env_runners=None, rollout_length=None):
+    def env_runners(self, num_env_runners=None, rollout_length=None,
+                    connectors_factory=None):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if rollout_length is not None:
             self.rollout_length = rollout_length
+        if connectors_factory is not None:
+            self.connectors_factory = connectors_factory
         return self
 
     def training(self, lr=None, gamma=None, train_batch_size=None,
@@ -139,6 +143,10 @@ class DQN:
                 module_factory,
                 seed=config.seed + 1 + i,
                 rollout_length=config.rollout_length,
+                connectors=(
+                    config.connectors_factory()
+                    if config.connectors_factory else None
+                ),
             )
             for i in range(config.num_env_runners)
         ]

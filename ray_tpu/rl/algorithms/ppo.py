@@ -59,6 +59,7 @@ class PPOConfig:
     hidden: tuple = (64, 64)
     num_env_runners: int = 2
     rollout_length: int = 200
+    connectors_factory: Optional[Callable] = None
     num_learners: int = 1
     lr: float = 3e-4
     gamma: float = 0.99
@@ -76,11 +77,17 @@ class PPOConfig:
             self.num_actions = num_actions
         return self
 
-    def env_runners(self, num_env_runners=None, rollout_length=None):
+    def env_runners(self, num_env_runners=None, rollout_length=None,
+                    connectors_factory=None):
+        """connectors_factory: zero-arg callable returning a fresh
+        ConnectorPipeline — each runner gets its own instance (stateful
+        connectors keep per-runner statistics)."""
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if rollout_length is not None:
             self.rollout_length = rollout_length
+        if connectors_factory is not None:
+            self.connectors_factory = connectors_factory
         return self
 
     def training(self, lr=None, num_epochs=None, minibatch_size=None,
@@ -123,6 +130,11 @@ class PPO:
                 module_factory,
                 seed=config.seed + 1 + i,
                 rollout_length=config.rollout_length,
+                connectors=(
+                    config.connectors_factory()
+                    if config.connectors_factory else None
+                ),
+                gamma=config.gamma,
             )
             for i in range(config.num_env_runners)
         ]
@@ -148,15 +160,13 @@ class PPO:
             for k in ("obs", "actions", "logp", "values", "advantages", "returns")
         }
         # 2. minibatch SGD epochs on the learner group
-        n = len(batch["obs"])
-        rng = np.random.default_rng(cfg.seed + self._iteration)
-        metrics: Dict[str, float] = {}
-        for _ in range(cfg.num_epochs):
-            perm = rng.permutation(n)
-            for start in range(0, n - cfg.minibatch_size + 1, cfg.minibatch_size):
-                idx = perm[start : start + cfg.minibatch_size]
-                mb = {k: v[idx] for k, v in batch.items()}
-                metrics = self.learner_group.update_from_batch(mb)
+        from ray_tpu.rl.core.learner import minibatch_epochs
+
+        metrics: Dict[str, float] = minibatch_epochs(
+            self.learner_group.update_from_batch, batch,
+            cfg.num_epochs, cfg.minibatch_size,
+            np.random.default_rng(cfg.seed + self._iteration),
+        )
         # 3. broadcast new weights to env runners
         self._broadcast_weights()
         self._iteration += 1

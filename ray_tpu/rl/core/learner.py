@@ -71,3 +71,20 @@ class Learner:
 
     def set_weights(self, weights):
         self.params = weights
+
+
+def minibatch_epochs(update_fn, batch, num_epochs: int, minibatch_size: int,
+                     rng) -> Dict:
+    """Shuffled minibatch-SGD epochs over a flat batch dict; returns the
+    last update's metrics. The shared epoch loop for PPO, multi-agent PPO,
+    and BC (reference: the minibatch cycling in Learner.update_from_batch,
+    learner.py:1128)."""
+    n = len(next(iter(batch.values())))
+    mb = min(minibatch_size, n)
+    metrics: Dict = {}
+    for _ in range(num_epochs):
+        perm = rng.permutation(n)
+        for start in range(0, n - mb + 1, mb):
+            idx = perm[start : start + mb]
+            metrics = update_fn({k: v[idx] for k, v in batch.items()})
+    return metrics

@@ -4,6 +4,14 @@ Analog of the reference's EnvRunner/SingleAgentEnvRunner
 (rllib/env/env_runner.py, env/single_agent_env_runner.py:29): actors that
 step gymnasium envs with the current weights and return sample batches
 (obs/actions/logp/values/rewards/dones arranged for GAE).
+
+Truncation semantics (gymnasium): a truncated episode ends but its final
+state still has value. The on-policy runner folds that value into the
+last reward — reward += gamma * V(s_final) — and marks the step done,
+which is algebraically identical to bootstrapping for both GAE and
+V-trace while keeping the batch schema flat. The off-policy runner
+instead ships the true next_obs with dones = terminated-only, which is
+already exact for Q targets.
 """
 
 from __future__ import annotations
@@ -15,27 +23,74 @@ import numpy as np
 import ray_tpu as rt
 
 
+class EpisodeTracker:
+    """Episode return bookkeeping shared by all runner flavors."""
+
+    def __init__(self):
+        self.current = 0.0
+        self.returns: list = []
+
+    def add(self, reward: float):
+        self.current += float(reward)
+
+    def end_episode(self):
+        self.returns.append(self.current)
+        self.current = 0.0
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "episodes": len(self.returns),
+            "mean_return": (
+                float(np.mean(self.returns[-20:])) if self.returns else 0.0
+            ),
+        }
+
+
 class _EnvRunnerBase:
     """Shared env-runner scaffolding: env/module setup, weight sync, lazy
-    jitted sampler, episode bookkeeping. Subclasses implement sample()."""
+    jitted sampler, connector pipeline, episode bookkeeping. Subclasses
+    implement sample()."""
 
     def __init__(self, env_creator, module_factory, seed: int = 0,
-                 rollout_length: int = 200):
+                 rollout_length: int = 200, connectors=None,
+                 gamma: float = 0.99):
         import jax
 
         self.env = env_creator()
         self.module = module_factory()
         self.rollout_length = rollout_length
+        self.gamma = gamma
         self.rng = jax.random.PRNGKey(seed)
         self.params = None
-        self._obs = None
-        self._episode_return = 0.0
-        self._episode_returns: list = []
+        self.connectors = connectors  # ConnectorPipeline or None
+        self._obs = None        # raw current observation
+        self._obs_conn = None   # its connected form (computed exactly once)
+        self._tracker = EpisodeTracker()
         self._sample = None  # jitted sampler
+
+    def _connect(self, obs) -> np.ndarray:
+        """Env-to-module connector pass (identity when unconfigured)."""
+        if self.connectors is None:
+            return np.asarray(obs, dtype=np.float32)
+        return self.connectors(obs)
+
+    def _reward(self, reward: float) -> float:
+        if self.connectors is None:
+            return float(reward)
+        return self.connectors.transform_reward(float(reward))
+
+    def get_connector_state(self):
+        return None if self.connectors is None else self.connectors.get_state()
 
     def set_weights(self, weights):
         self.params = weights
         return True
+
+    def _set_obs(self, raw):
+        """Install a new current observation, connecting it exactly once
+        (stateful connectors like NormalizeObs must see each state once)."""
+        self._obs = raw
+        self._obs_conn = self._connect(raw)
 
     def _begin_rollout(self):
         import jax
@@ -44,35 +99,34 @@ class _EnvRunnerBase:
         if self._sample is None:
             self._sample = jax.jit(self.module.sample_action)
         if self._obs is None:
-            self._obs, _ = self.env.reset()
-            self._episode_return = 0.0
+            obs, _ = self.env.reset()
+            self._set_obs(obs)
 
-    def _advance(self, nxt, reward, terminated, truncated):
-        """Track episode returns; returns the next observation state."""
-        self._episode_return += float(reward)
+    def _advance(self, nxt, reward, terminated, truncated) -> np.ndarray:
+        """Track episode returns and install the next observation. Returns
+        the connected form of the true successor state (on episode end,
+        that's `nxt` connected once; the env is then reset)."""
+        self._tracker.add(reward)
         if terminated or truncated:
-            self._episode_returns.append(self._episode_return)
-            self._obs, _ = self.env.reset()
-            self._episode_return = 0.0
+            nxt_conn = self._connect(nxt)
+            self._tracker.end_episode()
+            obs, _ = self.env.reset()
+            self._set_obs(obs)
         else:
-            self._obs = nxt
+            self._set_obs(nxt)
+            nxt_conn = self._obs_conn
+        return nxt_conn
 
     def episode_stats(self) -> Dict[str, Any]:
-        return {
-            "episodes": len(self._episode_returns),
-            "mean_return": (
-                float(np.mean(self._episode_returns[-20:]))
-                if self._episode_returns
-                else 0.0
-            ),
-        }
+        return self._tracker.stats()
 
 
 @rt.remote
 class EnvRunner(_EnvRunnerBase):
     def sample(self) -> Dict[str, np.ndarray]:
-        """One rollout of fixed length (truncated episodes carry value
-        bootstrap info via `last_value`)."""
+        """One fixed-length rollout. Mid-rollout truncations bootstrap by
+        folding gamma * V(s_final) into the reward (see module docstring);
+        the rollout-end cut bootstraps via `last_value`/`last_obs`."""
         import jax
 
         self._begin_rollout()
@@ -81,7 +135,7 @@ class EnvRunner(_EnvRunnerBase):
         rew_buf, done_buf = [], []
         for _ in range(T):
             self.rng, key = jax.random.split(self.rng)
-            obs = np.asarray(self._obs, dtype=np.float32)
+            obs = self._obs_conn
             action, logp, value = self._sample(self.params, obs[None], key)
             action = int(np.asarray(action)[0])
             obs_buf.append(obs)
@@ -89,11 +143,22 @@ class EnvRunner(_EnvRunnerBase):
             logp_buf.append(float(np.asarray(logp)[0]))
             val_buf.append(float(np.asarray(value)[0]))
             nxt, reward, terminated, truncated, _ = self.env.step(action)
-            rew_buf.append(float(reward))
-            done_buf.append(bool(terminated))
-            self._advance(nxt, reward, terminated, truncated)
-        # Bootstrap value of the final observation.
-        obs = np.asarray(self._obs, dtype=np.float32)
+            rew = self._reward(reward)
+            nxt_conn = self._advance(nxt, reward, terminated, truncated)
+            if truncated and not terminated:
+                # The episode was cut by a time limit, not by reaching a
+                # terminal state: bootstrap its tail value into the reward.
+                self.rng, key = jax.random.split(self.rng)
+                _, _, v_final = self._sample(
+                    self.params, nxt_conn[None], key
+                )
+                rew += self.gamma * float(np.asarray(v_final)[0])
+            rew_buf.append(rew)
+            done_buf.append(bool(terminated or truncated))
+        # Bootstrap value of the final observation. last_obs also ships so
+        # off-policy consumers (V-trace) can re-bootstrap under the
+        # *learner's* current params rather than the behavior policy's.
+        obs = self._obs_conn
         self.rng, key = jax.random.split(self.rng)
         _, _, last_value = self._sample(self.params, obs[None], key)
         return {
@@ -104,6 +169,7 @@ class EnvRunner(_EnvRunnerBase):
             "rewards": np.asarray(rew_buf, dtype=np.float32),
             "dones": np.asarray(done_buf, dtype=np.float32),
             "last_value": float(np.asarray(last_value)[0]),
+            "last_obs": obs,
         }
 
 
@@ -133,7 +199,10 @@ class TransitionEnvRunner(_EnvRunnerBase):
     exploration for off-policy algorithms (DQN family).
 
     Reference analog: SingleAgentEnvRunner in off-policy mode feeding
-    replay buffers (rllib/env/single_agent_env_runner.py:29).
+    replay buffers (rllib/env/single_agent_env_runner.py:29). Truncation
+    needs no special handling here: next_obs is the true successor and
+    dones records terminated-only, so Q targets bootstrap correctly
+    through time limits.
     """
 
     def sample(self, epsilon: float = 0.1) -> Dict[str, np.ndarray]:
@@ -144,17 +213,19 @@ class TransitionEnvRunner(_EnvRunnerBase):
         obs_buf, act_buf, rew_buf, next_buf, done_buf = [], [], [], [], []
         for _ in range(T):
             self.rng, key = jax.random.split(self.rng)
-            obs = np.asarray(self._obs, dtype=np.float32)
+            obs = self._obs_conn
             action = int(np.asarray(
                 self._sample(self.params, obs[None], key, epsilon)
             )[0])
             nxt, reward, terminated, truncated, _ = self.env.step(action)
             obs_buf.append(obs)
             act_buf.append(action)
-            rew_buf.append(float(reward))
-            next_buf.append(np.asarray(nxt, dtype=np.float32))
+            rew_buf.append(self._reward(reward))
             done_buf.append(bool(terminated))
-            self._advance(nxt, reward, terminated, truncated)
+            # next_obs passes the same connector pipeline as obs (Q targets
+            # would otherwise mix distributions); _advance connects each
+            # successor state exactly once.
+            next_buf.append(self._advance(nxt, reward, terminated, truncated))
         return {
             "obs": np.stack(obs_buf),
             "actions": np.asarray(act_buf, dtype=np.int32),

@@ -1,0 +1,127 @@
+"""Offline RL: experience IO through ray_tpu.data + behavior cloning.
+
+Analog of the reference's offline RL stack (rllib/offline/: JsonWriter /
+JsonReader / the offline data input pipeline, and the BC/MARWIL algorithm
+family under rllib/algorithms/bc/): collected episodes persist as a
+distributed dataset, and offline algorithms train policies straight from
+that dataset without touching an environment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu import data as rt_data
+from ray_tpu.rl.core.learner import Learner
+from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
+
+
+def episodes_to_dataset(rollouts: List[Dict[str, np.ndarray]]):
+    """Flatten sampled rollout batches into a row-per-transition Dataset
+    (reference: JsonWriter writing SampleBatches, rllib/offline/json_writer.py).
+
+    Each row carries obs/action plus whatever per-step fields the rollout
+    had (logp, rewards, dones, ...) so downstream offline algorithms can
+    pick what they need.
+    """
+    rows = []
+    for b in rollouts:
+        T = len(b["actions"])
+        step_keys = [
+            k for k, v in b.items()
+            if isinstance(v, np.ndarray) and v.shape[:1] == (T,)
+        ]
+        for t in range(T):
+            rows.append({k: b[k][t] for k in step_keys})
+    return rt_data.from_items(rows)
+
+
+def dataset_to_batch(ds, keys=("obs", "actions")) -> Dict[str, np.ndarray]:
+    """Materialize a transition Dataset into stacked numpy arrays
+    (reference: JsonReader producing SampleBatches)."""
+    rows = ds.take_all() if hasattr(ds, "take_all") else ds.take(ds.count())
+    return {k: np.stack([np.asarray(r[k]) for r in rows]) for k in keys}
+
+
+def bc_loss(params, module, batch):
+    """Negative log-likelihood of the dataset actions (behavior cloning;
+    reference: rllib/algorithms/bc/)."""
+    out = module.forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(out["action_logits"])
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    loss = -logp.mean()
+    accuracy = (
+        jnp.argmax(out["action_logits"], axis=-1) == batch["actions"]
+    ).mean()
+    return loss, {"total_loss": loss, "accuracy": accuracy}
+
+
+@dataclass
+class BCConfig:
+    """Builder-style config for behavior cloning from a Dataset."""
+
+    obs_dim: int = 4
+    num_actions: int = 2
+    hidden: tuple = (64, 64)
+    lr: float = 1e-3
+    minibatch_size: int = 128
+    seed: int = 0
+
+    def module(self, obs_dim=None, num_actions=None, hidden=None):
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        if hidden is not None:
+            self.hidden = hidden
+        return self
+
+    def training(self, lr=None, minibatch_size=None):
+        if lr is not None:
+            self.lr = lr
+        if minibatch_size is not None:
+            self.minibatch_size = minibatch_size
+        return self
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Behavior cloning over an offline transition dataset."""
+
+    def __init__(self, config: BCConfig):
+        self.config = config
+        spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
+        self.module = DiscretePolicyModule(spec)
+        self.learner = Learner(
+            self.module, bc_loss, seed=config.seed, lr=config.lr
+        )
+        self._rng = np.random.default_rng(config.seed)
+
+    def train_on_dataset(self, ds, num_epochs: int = 1) -> Dict[str, float]:
+        """Minibatch SGD epochs over the full dataset; returns the last
+        update's metrics."""
+        batch = dataset_to_batch(ds)
+        return self.train_on_batch(batch, num_epochs)
+
+    def train_on_batch(self, batch: Dict[str, np.ndarray],
+                       num_epochs: int = 1) -> Dict[str, float]:
+        from ray_tpu.rl.core.learner import minibatch_epochs
+
+        return minibatch_epochs(
+            self.learner.update_from_batch,
+            {k: v for k, v in batch.items() if k in ("obs", "actions")},
+            num_epochs, self.config.minibatch_size, self._rng,
+        )
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        out = self.module.forward(self.learner.params, obs)
+        return np.asarray(jnp.argmax(out["action_logits"], axis=-1))

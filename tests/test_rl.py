@@ -101,6 +101,74 @@ def test_ppo_cartpole_improves():
     finally:
         algo.stop()
 
+def test_vtrace_reduces_to_td_lambda_on_policy():
+    """With rho = c = 1 (on-policy, ratios un-clipped), V-trace targets
+    equal the lambda=1 discounted-return bootstrap (per the IMPALA paper's
+    on-policy special case)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import vtrace
+
+    T = 5
+    rng = np.random.default_rng(0)
+    logp = jnp.asarray(rng.normal(size=T).astype(np.float32))
+    rewards = jnp.asarray(rng.normal(size=T).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=T).astype(np.float32))
+    dones = jnp.zeros(T, dtype=jnp.float32)
+    bootstrap = jnp.float32(0.7)
+    gamma = 0.9
+    vs, _ = vtrace(logp, logp, rewards, values, bootstrap, dones, gamma=gamma)
+    # On-policy, no terminals: vs_t = sum_k gamma^k r_{t+k} + gamma^{T-t} * bootstrap.
+    expected = np.zeros(T, dtype=np.float64)
+    acc = float(bootstrap)
+    for t in reversed(range(T)):
+        acc = float(rewards[t]) + gamma * acc
+        expected[t] = acc
+    np.testing.assert_allclose(np.asarray(vs), expected, rtol=1e-5)
+
+
+def test_vtrace_terminal_cuts_bootstrap():
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import vtrace
+
+    logp = jnp.zeros(2, dtype=jnp.float32)
+    rewards = jnp.asarray([1.0, 2.0], dtype=jnp.float32)
+    values = jnp.zeros(2, dtype=jnp.float32)
+    dones = jnp.asarray([0.0, 1.0], dtype=jnp.float32)
+    vs, _ = vtrace(logp, logp, rewards, values, jnp.float32(100.0), dones,
+                   gamma=1.0)
+    # Terminal at t=1: the 100.0 bootstrap must not leak in.
+    np.testing.assert_allclose(np.asarray(vs), [3.0, 2.0], rtol=1e-6)
+
+
+def test_impala_cartpole_improves(rt_start):
+    import gymnasium as gym
+
+    from ray_tpu.rl import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment(lambda: gym.make("CartPole-v1"), obs_dim=4, num_actions=2)
+        .env_runners(num_env_runners=2, rollout_length=128)
+        .training(lr=3e-3, updates_per_iteration=8, rollouts_per_update=2)
+        .build()
+    )
+    try:
+        first = algo.train()
+        best = 0.0
+        for _ in range(8):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 75.0:
+                break
+        assert best > first["episode_return_mean"] or best > 60.0, (
+            f"no improvement: first={first['episode_return_mean']}, best={best}"
+        )
+    finally:
+        algo.stop()
+
+
 def test_replay_buffer_ring_and_sampling():
     from ray_tpu.rl import ReplayBuffer
 

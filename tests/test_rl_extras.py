@@ -1,0 +1,214 @@
+"""Multi-agent, connector, and offline-RL tests.
+
+Reference model: rllib's multi-agent tests (shared and separate policies,
+the agent->policy mapping fn), connector unit tests, and the offline/BC
+learning tests (SURVEY.md §2.3 RLlib rollout/offline rows) — scaled for a
+1-CPU CI box with a fast-learning contextual-bandit multi-agent env.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.rl import (
+    BCConfig,
+    ConnectorPipeline,
+    ClipReward,
+    FlattenObs,
+    MultiAgentEnv,
+    MultiAgentPPOConfig,
+    NormalizeObs,
+    RLModuleSpec,
+    dataset_to_batch,
+    episodes_to_dataset,
+)
+
+
+class MatchContextEnv(MultiAgentEnv):
+    """Two-agent contextual bandit: each agent sees a one-hot context and
+    earns 1.0 for picking the hot index. Episodes run 8 steps. Learnable
+    in a handful of PPO iterations — exercises the multi-agent plumbing,
+    not the optimizer."""
+
+    agent_ids = ("a0", "a1")
+
+    def __init__(self, seed=0, horizon=8):
+        self.rng = np.random.default_rng(seed)
+        self.horizon = horizon
+        self.t = 0
+
+    def _obs(self):
+        out = {}
+        for aid in self.agent_ids:
+            ctx = np.zeros(3, dtype=np.float32)
+            ctx[self.rng.integers(0, 3)] = 1.0
+            out[aid] = ctx
+        self._current = out
+        return out
+
+    def reset(self):
+        self.t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        rewards = {
+            aid: float(action_dict[aid] == int(np.argmax(self._current[aid])))
+            for aid in self.agent_ids
+        }
+        self.t += 1
+        done = self.t >= self.horizon
+        obs = self._obs() if not done else self._current
+        terms = {aid: done for aid in self.agent_ids}
+        terms["__all__"] = done
+        truncs = {aid: False for aid in self.agent_ids}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+def _ma_config(policies, mapping_fn, seed=0):
+    return (
+        MultiAgentPPOConfig()
+        .environment(lambda: MatchContextEnv(seed=seed))
+        .multi_agent(policies=policies, policy_mapping_fn=mapping_fn)
+        .env_runners(num_env_runners=2, rollout_length=64)
+        .training(lr=1e-2, num_epochs=4, minibatch_size=64)
+    )
+
+
+def test_multi_agent_ppo_separate_policies(rt_start):
+    spec = RLModuleSpec(obs_dim=3, num_actions=3)
+    algo = _ma_config(
+        {"p0": spec, "p1": spec},
+        lambda aid: "p0" if aid == "a0" else "p1",
+    ).build()
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(6):
+            last = algo.train()
+            # Optimal = 16/episode across both agents (8 steps x 2 agents).
+            if last["episode_return_mean"] >= 13.0:
+                break
+        assert last["episode_return_mean"] > first["episode_return_mean"], (
+            f"no improvement: {first['episode_return_mean']} -> "
+            f"{last['episode_return_mean']}"
+        )
+        assert last["episodes_total"] > 0
+        # Both policies actually trained.
+        assert any(k.startswith("learner/p0/") for k in last)
+        assert any(k.startswith("learner/p1/") for k in last)
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_ppo_shared_policy(rt_start):
+    spec = RLModuleSpec(obs_dim=3, num_actions=3)
+    algo = _ma_config({"shared": spec}, lambda aid: "shared").build()
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(6):
+            last = algo.train()
+            if last["episode_return_mean"] >= 13.0:
+                break
+        assert last["episode_return_mean"] > first["episode_return_mean"]
+    finally:
+        algo.stop()
+
+
+# -- connectors ------------------------------------------------------------
+
+
+def test_flatten_and_normalize_connectors():
+    pipe = ConnectorPipeline([FlattenObs(), NormalizeObs(clip=5.0)])
+    rng = np.random.default_rng(0)
+    outs = [pipe(rng.normal(loc=7.0, scale=2.0, size=(2, 3))) for _ in range(200)]
+    assert outs[-1].shape == (6,)
+    stacked = np.stack(outs[100:])
+    # After warmup the running normalization centers the stream.
+    assert abs(stacked.mean()) < 0.5
+    assert stacked.std() < 2.0
+    # State round-trips (the runner-sync path).
+    state = pipe.get_state()
+    pipe2 = ConnectorPipeline([FlattenObs(), NormalizeObs(clip=5.0)])
+    pipe2.set_state(state)
+    x = rng.normal(loc=7.0, scale=2.0, size=(2, 3))
+    np.testing.assert_allclose(pipe(x), pipe2(x), rtol=1e-5)
+
+
+def test_clip_reward_connector():
+    pipe = ConnectorPipeline([ClipReward(bound=1.0)])
+    assert pipe.transform_reward(10.0) == 1.0
+    assert pipe.transform_reward(-3.0) == -1.0
+    assert pipe.transform_reward(0.5) == 0.5
+    # Identity on observations.
+    obs = np.array([2.0, -2.0], dtype=np.float32)
+    np.testing.assert_array_equal(pipe(obs), obs)
+
+
+def test_env_runner_applies_connectors(rt_start):
+    import gymnasium as gym
+
+    from ray_tpu.rl import DiscretePolicyModule, EnvRunner
+
+    spec = RLModuleSpec(obs_dim=4, num_actions=2)
+    runner = EnvRunner.remote(
+        lambda: gym.make("CartPole-v1"),
+        lambda: DiscretePolicyModule(spec),
+        rollout_length=64,
+        connectors=ConnectorPipeline([NormalizeObs(clip=3.0)]),
+    )
+    import jax
+
+    params = DiscretePolicyModule(spec).init(jax.random.PRNGKey(0))
+    rt.get(runner.set_weights.remote(params), timeout=120)
+    batch = rt.get(runner.sample.remote(), timeout=300)
+    # The connector's clip bound proves the transform ran.
+    assert np.abs(batch["obs"]).max() <= 3.0
+    state = rt.get(runner.get_connector_state.remote(), timeout=120)
+    assert state[0]["count"] >= 64
+
+
+# -- offline / BC ----------------------------------------------------------
+
+
+def test_episodes_to_dataset_roundtrip(rt_start):
+    rollouts = [
+        {
+            "obs": np.arange(6, dtype=np.float32).reshape(3, 2),
+            "actions": np.array([0, 1, 0], dtype=np.int32),
+            "rewards": np.array([1.0, 2.0, 3.0], dtype=np.float32),
+            "last_value": 0.0,  # non-per-step field: must be dropped
+        },
+        {
+            "obs": np.ones((2, 2), dtype=np.float32),
+            "actions": np.array([1, 1], dtype=np.int32),
+            "rewards": np.array([4.0, 5.0], dtype=np.float32),
+            "last_value": 0.0,
+        },
+    ]
+    ds = episodes_to_dataset(rollouts)
+    assert ds.count() == 5
+    batch = dataset_to_batch(ds, keys=("obs", "actions", "rewards"))
+    assert batch["obs"].shape == (5, 2)
+    assert batch["actions"].tolist() == [0, 1, 0, 1, 1]
+    assert sorted(batch["rewards"].tolist()) == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_bc_learns_expert_policy(rt_start):
+    # Expert data for the contextual bandit: action = argmax(context).
+    rng = np.random.default_rng(0)
+    obs = np.zeros((512, 3), dtype=np.float32)
+    hot = rng.integers(0, 3, size=512)
+    obs[np.arange(512), hot] = 1.0
+    rollouts = [{
+        "obs": obs,
+        "actions": hot.astype(np.int32),
+    }]
+    ds = episodes_to_dataset(rollouts)
+    bc = BCConfig().module(obs_dim=3, num_actions=3).training(lr=5e-3).build()
+    metrics = bc.train_on_dataset(ds, num_epochs=20)
+    assert metrics["accuracy"] > 0.95, metrics
+    # Cloned policy reproduces the expert on fresh contexts.
+    test_obs = np.eye(3, dtype=np.float32)
+    np.testing.assert_array_equal(bc.compute_actions(test_obs), [0, 1, 2])
