@@ -20,6 +20,8 @@ from ray_tpu.tune.search import (
     ConcurrencyLimiter,
     Domain,
     GridSearch,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -56,6 +58,8 @@ __all__ = [
     "grid_search",
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
+    "Searcher",
+    "TPESearcher",
     "ASHAScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",

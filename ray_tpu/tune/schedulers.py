@@ -36,6 +36,12 @@ class ASHAScheduler(TrialScheduler):
     Rungs at time_attr values grace_period * reduction_factor^k; a trial
     reaching a rung stops unless its metric is in the top 1/reduction_factor
     of results recorded at that rung so far.
+
+    Multiple brackets (the full async-HyperBand shape, reference
+    async_hyperband.py `brackets` arg) assign trials round-robin to
+    brackets whose grace periods grow by reduction_factor — bracket k
+    starts halving at grace_period * rf^k, trading early-stopping
+    aggressiveness against robustness to slow starters.
     """
 
     def __init__(
@@ -46,22 +52,39 @@ class ASHAScheduler(TrialScheduler):
         grace_period: int = 1,
         reduction_factor: int = 4,
         max_t: int = 100,
+        brackets: int = 1,
     ):
         assert mode in ("min", "max")
+        assert brackets >= 1
         self.metric = metric
         self.mode = mode
         self.time_attr = time_attr
         self.grace_period = grace_period
         self.reduction_factor = reduction_factor
         self.max_t = max_t
-        self.rungs: List[int] = []
-        t = grace_period
-        while t < max_t:
-            self.rungs.append(t)
-            t *= reduction_factor
-        # rung value -> recorded metrics
-        self.recorded: Dict[int, List[float]] = defaultdict(list)
+        # Per-bracket rung ladders: bracket k's first rung is
+        # grace_period * rf^k.
+        self.bracket_rungs: List[List[int]] = []
+        for k in range(brackets):
+            rungs = []
+            t = grace_period * reduction_factor ** k
+            while t < max_t:
+                rungs.append(t)
+                t *= reduction_factor
+            self.bracket_rungs.append(rungs)
+        # (bracket, rung value) -> recorded metrics
+        self.recorded: Dict[tuple, List[float]] = defaultdict(list)
         self._passed: Dict[str, set] = defaultdict(set)
+        self._bracket_of: Dict[str, int] = {}
+        self._next_bracket = 0
+
+    def _bracket(self, trial_id: str) -> int:
+        if trial_id not in self._bracket_of:
+            self._bracket_of[trial_id] = self._next_bracket
+            self._next_bracket = (self._next_bracket + 1) % len(
+                self.bracket_rungs
+            )
+        return self._bracket_of[trial_id]
 
     def on_result(self, trial_id: str, result: Dict) -> str:
         t = result.get(self.time_attr)
@@ -70,10 +93,11 @@ class ASHAScheduler(TrialScheduler):
             return CONTINUE
         if t >= self.max_t:
             return STOP
-        for rung in self.rungs:
+        bracket = self._bracket(trial_id)
+        for rung in self.bracket_rungs[bracket]:
             if t >= rung and rung not in self._passed[trial_id]:
                 self._passed[trial_id].add(rung)
-                recorded = self.recorded[rung]
+                recorded = self.recorded[(bracket, rung)]
                 recorded.append(value)
                 if len(recorded) >= self.reduction_factor:
                     ordered = sorted(recorded, reverse=(self.mode == "max"))

@@ -80,6 +80,13 @@ def grid_search(values) -> GridSearch:
     return GridSearch(list(values))
 
 
+# Sentinel a searcher returns when it has nothing to suggest *right now*
+# but is not exhausted (reference: Searcher.FINISHED vs. deferred
+# suggestions in tune/search/search_generator.py). None still means "no
+# more trials ever".
+PAUSED = "__tune_paused__"
+
+
 class Searcher:
     """Suggest configs one at a time (reference: tune/search/searcher.py)."""
 
@@ -132,6 +139,150 @@ class BasicVariantGenerator(Searcher):
         return config
 
 
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator search (Bergstra et al. 2011).
+
+    The native model-based searcher — the same algorithm the reference
+    reaches through its Optuna integration (tune/search/optuna/, whose
+    default sampler is TPE). Completed trials split into a good quantile
+    l(x) and the rest g(x); each dimension is modeled with a kernel
+    density over observed values, candidates are drawn from l and ranked
+    by the acquisition ratio l(x)/g(x).
+
+    Numeric domains (Uniform/LogUniform/RandInt) use Gaussian kernels
+    (log-space for LogUniform); Choice uses smoothed categorical counts.
+    Falls back to random sampling until `n_startup` results exist.
+    """
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "min",
+                 num_samples: int = 32, gamma: float = 0.25,
+                 n_startup: int = 8, n_candidates: int = 24,
+                 seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self.param_space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.gamma = gamma
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._suggested = 0
+        self._configs: Dict[str, Dict] = {}  # trial_id -> config
+        self._observations: List[tuple] = []  # (config, score)
+
+    def _random_config(self) -> Dict:
+        config = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, GridSearch):
+                config[k] = self.rng.choice(v.values)
+            elif isinstance(v, Domain):
+                config[k] = v.sample(self.rng)
+            elif callable(v) and not isinstance(v, type):
+                config[k] = v()
+            else:
+                config[k] = v
+        return config
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        if len(self._observations) < self.n_startup:
+            config = self._random_config()
+        else:
+            config = self._tpe_config()
+        self._configs[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        config = self._configs.pop(trial_id, None)
+        if config is None or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "max":
+            score = -score  # store as minimization
+        self._observations.append((config, score))
+
+    # -- TPE core --------------------------------------------------------
+    def _split(self):
+        ranked = sorted(self._observations, key=lambda cs: cs[1])
+        n_good = max(1, int(len(ranked) * self.gamma))
+        return [c for c, _ in ranked[:n_good]], [c for c, _ in ranked[n_good:]]
+
+    def _tpe_config(self) -> Dict:
+        good, bad = self._split()
+        best, best_score = None, -float("inf")
+        for _ in range(self.n_candidates):
+            cand = {}
+            ratio = 0.0
+            for k, dom in self.param_space.items():
+                if isinstance(dom, (Uniform, LogUniform, RandInt)):
+                    val, r = self._numeric_dim(k, dom, good, bad)
+                elif isinstance(dom, (Choice, GridSearch)):
+                    cats = dom.categories if isinstance(dom, Choice) else dom.values
+                    val, r = self._categorical_dim(k, cats, good, bad)
+                elif isinstance(dom, Domain):
+                    val, r = dom.sample(self.rng), 0.0
+                else:
+                    val, r = (dom() if callable(dom) and not isinstance(dom, type)
+                              else dom), 0.0
+                cand[k] = val
+                ratio += r
+            if ratio > best_score:
+                best, best_score = cand, ratio
+        return best
+
+    def _numeric_dim(self, key, dom, good, bad):
+        import math
+
+        log = isinstance(dom, LogUniform)
+        to_x = (lambda v: math.log(v)) if log else (lambda v: float(v))
+        lo, hi = to_x(dom.low), to_x(dom.high if not isinstance(dom, RandInt)
+                                     else dom.high - 1)
+        goods = [to_x(c[key]) for c in good if key in c]
+        bads = [to_x(c[key]) for c in bad if key in c]
+        width = max(hi - lo, 1e-12)
+        bw = max(width / max(len(goods), 1) ** 0.5, width * 0.05)
+        # Sample from l(x): pick a good point's kernel, draw, clamp.
+        center = self.rng.choice(goods) if goods else self.rng.uniform(lo, hi)
+        x = min(hi, max(lo, self.rng.gauss(center, bw)))
+
+        def kde(pts, x):
+            if not pts:
+                return 1.0 / width  # uniform prior
+            s = sum(
+                math.exp(-0.5 * ((x - p) / bw) ** 2) / (bw * 2.5066282746)
+                for p in pts
+            )
+            # Mix with the uniform prior so g(x) never hits zero.
+            return 0.9 * s / len(pts) + 0.1 / width
+
+        ratio = math.log(kde(goods, x)) - math.log(kde(bads, x))
+        val = math.exp(x) if log else x
+        if isinstance(dom, RandInt):
+            val = min(dom.high - 1, max(dom.low, int(round(val))))
+        return val, ratio
+
+    def _categorical_dim(self, key, cats, good, bad):
+        import math
+
+        def probs(configs):
+            counts = {repr(c): 1.0 for c in cats}  # +1 smoothing
+            for cfg in configs:
+                if key in cfg:
+                    counts[repr(cfg[key])] = counts.get(repr(cfg[key]), 1.0) + 1
+            total = sum(counts.values())
+            return {k: v / total for k, v in counts.items()}
+
+        pg, pb = probs(good), probs(bad)
+        # Sample category from l, score by log ratio.
+        cats_list = list(cats)
+        weights = [pg[repr(c)] for c in cats_list]
+        val = self.rng.choices(cats_list, weights=weights, k=1)[0]
+        return val, math.log(pg[repr(val)]) - math.log(pb[repr(val)])
+
+
 class ConcurrencyLimiter(Searcher):
     """Cap in-flight suggestions (reference: tune/search/ConcurrencyLimiter)."""
 
@@ -142,9 +293,9 @@ class ConcurrencyLimiter(Searcher):
 
     def suggest(self, trial_id: str) -> Optional[Dict]:
         if len(self.live) >= self.max_concurrent:
-            return None
+            return PAUSED  # at cap now; ask again after a completion
         config = self.searcher.suggest(trial_id)
-        if config is not None:
+        if config is not None and config is not PAUSED:
             self.live.add(trial_id)
         return config
 

@@ -28,7 +28,7 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     TrialScheduler,
 )
-from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.search import PAUSED, BasicVariantGenerator, Searcher
 
 
 @dataclass
@@ -209,6 +209,8 @@ class Tuner:
             while not exhausted and len(live) < max_concurrent:
                 trial_id = uuid.uuid4().hex[:8]
                 config = searcher.suggest(trial_id)
+                if config is PAUSED:
+                    break  # nothing right now (e.g. ConcurrencyLimiter cap)
                 if config is None:
                     exhausted = True
                     break
@@ -304,7 +306,7 @@ class Tuner:
                         pass
             live = still_live
             self._snapshot(exp_dir, trials)
-            if live:
+            if live or not exhausted:
                 time.sleep(0.05)
 
         results = [
