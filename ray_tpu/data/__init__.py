@@ -4,6 +4,8 @@ Public surface mirrors the reference's ray.data creation APIs:
 range / from_items / from_numpy / read_parquet / read_csv / read_json.
 """
 
+from ray_tpu.data import aggregate
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
 from ray_tpu.data.dataset import (
     Dataset,
     from_items,
@@ -24,4 +26,11 @@ __all__ = [
     "read_text",
     "read_csv",
     "read_json",
+    "aggregate",
+    "Count",
+    "Sum",
+    "Min",
+    "Max",
+    "Mean",
+    "Std",
 ]

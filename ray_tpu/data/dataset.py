@@ -26,6 +26,7 @@ class Dataset:
         self._input_refs = list(input_refs)
         self._stages = list(stages or [])
         self._materialized: Optional[List] = None
+        self._stats: List[Dict] = []
 
     # -- plan building ---------------------------------------------------
     def _with_stage(self, stage) -> "Dataset":
@@ -179,26 +180,52 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
-    def sum(self, column: str) -> float:
-        total = 0.0
-        for block in self._iter_blocks():
-            for r in B.block_to_rows(block):
-                total += r[column]
-        return total
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Distributed aggregation: one remote partial-state task per
+        block, tiny states merged on the driver (reference:
+        Dataset.aggregate over AggregateFn, data/aggregate.py)."""
+        from ray_tpu.data import aggregate as A
 
-    def mean(self, column: str) -> float:
-        total, count = 0.0, 0
-        for block in self._iter_blocks():
-            for r in B.block_to_rows(block):
-                total += r[column]
-                count += 1
-        return total / max(count, 1)
+        aggs = list(aggs)
+        fn = rt.remote(A.partial_states)
+        state_refs = [fn.remote(ref, aggs) for ref in self._executed_refs()]
+        values = A.merge_states(rt.get(state_refs), aggs)
+        return {agg.name: v for agg, v in zip(aggs, values)}
+
+    def sum(self, column: str):
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(column))[f"sum({column})"]
+
+    def mean(self, column: str):
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(column))[f"mean({column})"]
 
     def min(self, column: str):
-        return min(r[column] for r in self.iter_rows())
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(column))[f"min({column})"]
 
     def max(self, column: str):
-        return max(r[column] for r in self.iter_rows())
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(column))[f"max({column})"]
+
+    def std(self, column: str, ddof: int = 1):
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(column, ddof))[f"std({column})"]
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (reference: Dataset.unique) —
+        per-block distinct sets in remote tasks, union on the driver."""
+        fn = rt.remote(_distinct_block)
+        sets = rt.get([fn.remote(ref, column) for ref in self._executed_refs()])
+        out = set()
+        for s in sets:
+            out |= s
+        return sorted(out)
 
     # -- execution -------------------------------------------------------
     def materialize(self) -> "Dataset":
@@ -207,15 +234,38 @@ class Dataset:
             return self
         executor = StreamingExecutor(self._stages)
         refs = executor.execute(self._input_refs)
-        return Dataset(refs)
+        out = Dataset(refs)
+        out._stats = self._stats + executor.stats
+        return out
+
+    def stats(self) -> str:
+        """Per-stage execution timing of the last materialization
+        (reference: Dataset.stats / _internal/stats.py)."""
+        if not self._stats and self._stages:
+            self._executed_refs()
+        lines = [
+            f"Stage {i}: {s['stage']}: {s['blocks']} blocks, {s['wall_s']}s"
+            for i, s in enumerate(self._stats)
+        ]
+        return "\n".join(lines) if lines else "(no executed stages)"
 
     def _executed_refs(self) -> List:
         if self._materialized is None:
-            self._materialized = self.materialize()._input_refs
+            m = self.materialize()
+            self._materialized = m._input_refs
+            self._stats = m._stats
         return self._materialized
 
-    def _iter_blocks(self) -> Iterator:
-        for ref in self._executed_refs():
+    def _iter_blocks(self, prefetch_blocks: int = 0) -> Iterator:
+        """Yield blocks; with prefetch_blocks > 0 the next k blocks' pulls
+        are initiated (non-blocking rt.wait) while the current block is
+        consumed — transfer overlaps compute (reference: prefetching block
+        iterator, data/iterator.py)."""
+        refs = self._executed_refs()
+        for i, ref in enumerate(refs):
+            if prefetch_blocks > 0 and i + 1 < len(refs):
+                ahead = refs[i + 1 : i + 1 + prefetch_blocks]
+                rt.wait(ahead, num_returns=len(ahead), timeout=0)
             yield rt.get(ref)
 
     # -- consumption -----------------------------------------------------
@@ -242,16 +292,65 @@ class Dataset:
             yield from B.block_to_rows(block)
 
     def iter_batches(self, batch_size: int = 256,
-                     batch_format: str = "numpy") -> Iterator:
-        """Re-batch across block boundaries (reference: data/iterator.py)."""
+                     batch_format: str = "numpy",
+                     prefetch_blocks: int = 1,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator:
+        """Re-batch across block boundaries (reference: data/iterator.py).
+
+        local_shuffle_buffer_size enables the reference's windowed local
+        shuffle: rows accumulate in a buffer of at least that size and
+        each batch draws a random permutation from it — cheap
+        randomization without a full distributed shuffle.
+        """
+        rng = (
+            _random.Random(local_shuffle_seed)
+            if local_shuffle_buffer_size else None
+        )
+        threshold = max(local_shuffle_buffer_size or 0, batch_size)
         carry: List[Any] = []
-        for block in self._iter_blocks():
+        for block in self._iter_blocks(prefetch_blocks=prefetch_blocks):
             carry.extend(B.block_to_rows(block))
-            while len(carry) >= batch_size:
+            while len(carry) >= threshold:
+                if rng is not None:
+                    rng.shuffle(carry)
                 chunk, carry = carry[:batch_size], carry[batch_size:]
                 yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
-        if carry:
-            yield B.block_to_batch(B.block_from_rows(carry), batch_format)
+        while carry:
+            if rng is not None:
+                rng.shuffle(carry)
+            chunk, carry = carry[:batch_size], carry[batch_size:]
+            yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
+
+    def iter_jax_batches(self, batch_size: int = 256, sharding=None,
+                         prefetch_blocks: int = 1,
+                         **kwargs) -> Iterator:
+        """numpy batches placed onto JAX devices, one batch of device
+        transfer ahead of the consumer (the TPU input-pipeline shape:
+        host->HBM copy of batch i+1 overlaps the step on batch i).
+        Reference analog: iter_torch_batches (data/iterator.py) rebuilt
+        for JAX: pass sharding=NamedSharding(...) to lay each batch out
+        across a mesh."""
+        import jax
+
+        def put(batch):
+            if sharding is None:
+                return jax.tree.map(jax.device_put, batch)
+            return jax.tree.map(
+                lambda x: jax.device_put(x, sharding), batch
+            )
+
+        pending = None
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            prefetch_blocks=prefetch_blocks, **kwargs,
+        ):
+            nxt = put(batch)  # async dispatch; transfer proceeds in background
+            if pending is not None:
+                yield pending
+            pending = nxt
+        if pending is not None:
+            yield pending
 
     def schema(self):
         for block in self._iter_blocks():
@@ -263,18 +362,39 @@ class Dataset:
 
     # -- train ingest ----------------------------------------------------
     def split(self, n: int) -> List["Dataset"]:
-        """Split into n shards, one per training worker (reference:
-        Dataset.split feeding Train workers)."""
+        """Split into n equal shards, one per training worker (reference:
+        Dataset.split(equal=True) feeding Train workers).
+
+        Shards are formed from block REFS: whole blocks pass by reference
+        and only the blocks straddling a shard boundary are sliced — in
+        remote tasks. Rows never move through the driver, so the split
+        scales with the cluster.
+        """
         refs = self.materialize()._input_refs
-        rows = []
-        for ref in refs:
-            rows.extend(B.block_to_rows(rt.get(ref)))
-        shard_size = (len(rows) + n - 1) // n
-        shards = []
-        for i in range(n):
-            chunk = rows[i * shard_size : (i + 1) * shard_size]
-            shards.append(from_items(chunk, parallelism=1))
-        return shards
+        count_fn = rt.remote(_block_count)
+        counts = rt.get([count_fn.remote(r) for r in refs])
+        total = sum(counts)
+        boundaries = [total * i // n for i in range(n + 1)]
+        slice_fn = rt.remote(_slice_block)
+        shard_refs: List[List] = [[] for _ in range(n)]
+        offset = 0  # global row index of the current block's first row
+        for ref, c in zip(refs, counts):
+            if c == 0:
+                continue
+            for i in range(n):
+                lo = max(boundaries[i], offset)
+                hi = min(boundaries[i + 1], offset + c)
+                if lo >= hi:
+                    continue
+                if lo == offset and hi == offset + c:
+                    shard_refs[i].append(ref)  # whole block, no copy
+                else:
+                    shard_refs[i].append(
+                        slice_fn.remote(ref, lo - offset, hi - offset)
+                    )
+            offset += c
+        return [Dataset(sr if sr else [rt.put(B.block_from_rows([]))])
+                for sr in shard_refs]
 
     # -- output ----------------------------------------------------------
     def write_parquet(self, path: str) -> List[str]:
@@ -334,40 +454,120 @@ class Dataset:
 
 
 class GroupedData:
-    """Minimal groupby-aggregate (reference: data grouped_data.py)."""
+    """Distributed groupby (reference: data grouped_data.py).
+
+    Hash-partitions rows by key across remote reduce tasks (each key's
+    rows land in exactly one partition), then each partition groups and
+    aggregates locally — the reference's hash-shuffle groupby exchange.
+    Rows never pass through the driver.
+    """
 
     def __init__(self, ds: Dataset, key: str):
         self.ds = ds
         self.key = key
 
-    def _groups(self) -> Dict:
-        groups: Dict[Any, List] = {}
-        for r in self.ds.iter_rows():
-            groups.setdefault(r[self.key], []).append(r)
-        return groups
+    def _shuffled_partitions(self) -> List:
+        refs = self.ds.materialize()._input_refs
+        n = max(len(refs), 1)
+        map_fn = rt.remote(_hash_partition_block)
+        pieces: List[List] = []
+        for ref in refs:
+            out = map_fn.options(num_returns=n).remote(ref, n, self.key)
+            pieces.append([out] if n == 1 else list(out))
+        return [[pieces[i][j] for i in range(len(refs))] for j in range(n)]
+
+    def _reduce(self, reduce_fn, *args) -> Dataset:
+        rfn = rt.remote(reduce_fn)
+        out = [
+            rfn.remote(self.key, *args, *partition)
+            for partition in self._shuffled_partitions()
+        ]
+        return Dataset(out)
+
+    def aggregate(self, *aggs) -> Dataset:
+        """One result row per group with one column per AggregateFn."""
+        return self._reduce(_group_aggregate, list(aggs))
 
     def count(self) -> Dataset:
-        rows = [
-            {self.key: k, "count()": len(v)} for k, v in sorted(self._groups().items())
-        ]
-        return from_items(rows)
+        from ray_tpu.data.aggregate import Count
+
+        return self.aggregate(Count())
 
     def sum(self, column: str) -> Dataset:
-        rows = [
-            {self.key: k, f"sum({column})": sum(r[column] for r in v)}
-            for k, v in sorted(self._groups().items())
-        ]
-        return from_items(rows)
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(column))
 
     def mean(self, column: str) -> Dataset:
-        rows = [
-            {
-                self.key: k,
-                f"mean({column})": sum(r[column] for r in v) / len(v),
-            }
-            for k, v in sorted(self._groups().items())
-        ]
-        return from_items(rows)
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(column))
+
+    def min(self, column: str) -> Dataset:
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(column))
+
+    def max(self, column: str) -> Dataset:
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(column))
+
+    def std(self, column: str, ddof: int = 1) -> Dataset:
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(column, ddof))
+
+    def map_groups(self, fn: Callable[[List[dict]], Any]) -> Dataset:
+        """Apply a UDF to each group's row list; the UDF returns a row or
+        a list of rows (reference: GroupedData.map_groups)."""
+        return self._reduce(_group_map, fn)
+
+
+def _hash_partition_block(block, n: int, key: str):
+    """Partition one block's rows by hash(key) across n pieces."""
+    parts: List[List] = [[] for _ in range(n)]
+    for r in B.block_to_rows(block):
+        parts[hash(r[key]) % n].append(r)
+    out = tuple(B.block_from_rows(p) for p in parts)
+    return out if n > 1 else out[0]
+
+
+def _collect_groups(key: str, pieces) -> Dict[Any, List]:
+    groups: Dict[Any, List] = {}
+    for blk in pieces:
+        for r in B.block_to_rows(blk):
+            groups.setdefault(r[key], []).append(r)
+    return groups
+
+
+def _group_aggregate(key: str, aggs, *pieces):
+    rows = []
+    for k, group_rows in sorted(_collect_groups(key, pieces).items()):
+        row = {key: k}
+        for agg in aggs:
+            row[agg.name] = agg.finalize(agg.partial(group_rows))
+        rows.append(row)
+    return B.block_from_rows(rows)
+
+
+def _group_map(key: str, fn, *pieces):
+    rows = []
+    for _, group_rows in sorted(_collect_groups(key, pieces).items()):
+        out = fn(group_rows)
+        if isinstance(out, list):
+            rows.extend(out)
+        else:
+            rows.append(out)
+    return B.block_from_rows(rows)
+
+
+def _distinct_block(block, column: str) -> set:
+    return {r[column] for r in B.block_to_rows(block)}
+
+
+def _slice_block(block, start: int, end: int):
+    return B.block_slice(block, start, end)
 
 
 # ---------------------------------------------------------------------------

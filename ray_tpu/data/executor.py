@@ -59,6 +59,9 @@ class StreamingExecutor:
     def __init__(self, stages: List[Any], max_in_flight: int = 4):
         self.stages = stages
         self.max_in_flight = max_in_flight
+        # Per-stage-run execution stats (reference: Dataset.stats(),
+        # _internal/stats.py): [{"stage", "blocks", "wall_s"}].
+        self.stats: List[dict] = []
 
     def execute(self, input_refs: List) -> List:
         """Run the stage pipeline over input block refs; returns output refs."""
@@ -68,14 +71,34 @@ class StreamingExecutor:
         for stage in self.stages:
             if isinstance(stage, AllToAllStage):
                 if run:
-                    refs = self._run_map_chain(run, refs)
+                    refs = self._timed(
+                        "+".join(s.name for s in run),
+                        lambda r=run, x=refs: self._run_map_chain(r, x),
+                        len(refs),
+                    )
                     run = []
-                refs = stage.fn(refs)
+                refs = self._timed(
+                    stage.name, lambda s=stage, x=refs: s.fn(x), len(refs)
+                )
             else:
                 run.append(stage)
         if run:
-            refs = self._run_map_chain(run, refs)
+            refs = self._timed(
+                "+".join(s.name for s in run),
+                lambda r=run, x=refs: self._run_map_chain(r, x),
+                len(refs),
+            )
         return refs
+
+    def _timed(self, name: str, fn, n_blocks: int):
+        start = time.perf_counter()
+        out = fn()
+        self.stats.append({
+            "stage": name,
+            "blocks": n_blocks,
+            "wall_s": round(time.perf_counter() - start, 4),
+        })
+        return out
 
     def _run_map_chain(self, stages: List[MapStage], input_refs: List) -> List:
         """Pipeline a run of map stages: per-block task chains, bounded

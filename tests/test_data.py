@@ -84,6 +84,77 @@ def test_groupby():
     assert sums[0]["sum(v)"] == 0 + 3 + 6
 
 
+def test_std_and_generic_aggregate():
+    vals = [float(i) for i in range(20)]
+    ds = rtd.from_items([{"v": v} for v in vals]).repartition(4)
+    np.testing.assert_allclose(ds.std("v"), np.std(vals, ddof=1), rtol=1e-9)
+    out = ds.aggregate(rtd.Count(), rtd.Sum("v"), rtd.Mean("v"), rtd.Std("v"))
+    assert out["count()"] == 20
+    assert out["sum(v)"] == sum(vals)
+    np.testing.assert_allclose(out["mean(v)"], np.mean(vals))
+    np.testing.assert_allclose(out["std(v)"], np.std(vals, ddof=1), rtol=1e-9)
+
+
+def test_unique():
+    ds = rtd.from_items([{"k": i % 4} for i in range(40)]).repartition(5)
+    assert ds.unique("k") == [0, 1, 2, 3]
+
+
+def test_groupby_distributed_aggregates():
+    ds = rtd.from_items(
+        [{"k": f"g{i % 3}", "v": float(i)} for i in range(12)]
+    ).repartition(4)
+    rows = {r["k"]: r for r in ds.groupby("k").mean("v").take_all()}
+    # g0: 0,3,6,9 -> 4.5; g1: 1,4,7,10 -> 5.5; g2: 2,5,8,11 -> 6.5
+    assert rows["g0"]["mean(v)"] == 4.5
+    assert rows["g1"]["mean(v)"] == 5.5
+    assert rows["g2"]["mean(v)"] == 6.5
+    stds = {r["k"]: r["std(v)"] for r in ds.groupby("k").std("v").take_all()}
+    np.testing.assert_allclose(
+        stds["g0"], np.std([0.0, 3.0, 6.0, 9.0], ddof=1), rtol=1e-9
+    )
+
+
+def test_groupby_map_groups():
+    ds = rtd.from_items([{"k": i % 2, "v": i} for i in range(8)])
+    out = ds.groupby("k").map_groups(
+        lambda rows: {"k": rows[0]["k"], "span": max(r["v"] for r in rows)
+                      - min(r["v"] for r in rows)}
+    ).take_all()
+    assert {r["k"]: r["span"] for r in out} == {0: 6, 1: 6}
+
+
+def test_local_shuffle_and_prefetch_iter():
+    ds = rtd.range(40).repartition(4)
+    batches = list(ds.iter_batches(
+        batch_size=10, prefetch_blocks=2,
+        local_shuffle_buffer_size=20, local_shuffle_seed=0,
+    ))
+    ids = [int(i) for b in batches for i in b["id"]]
+    assert sorted(ids) == list(range(40))  # a permutation...
+    assert ids != list(range(40))          # ...that actually shuffled
+
+
+def test_iter_jax_batches_device_arrays():
+    import jax
+
+    ds = rtd.from_numpy({"x": np.arange(12, dtype=np.float32)})
+    batches = list(ds.iter_jax_batches(batch_size=5))
+    assert [len(b["x"]) for b in batches] == [5, 5, 2]
+    assert isinstance(batches[0]["x"], jax.Array)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b["x"]) for b in batches]),
+        np.arange(12, dtype=np.float32),
+    )
+
+
+def test_dataset_stats():
+    ds = rtd.range(20).map(lambda r: {"id": r["id"] * 2}).repartition(2)
+    ds.count()
+    s = ds.stats()
+    assert "map" in s and "repartition" in s, s
+
+
 def test_iter_batches_rebatching():
     ds = rtd.range(25).repartition(4)
     batches = list(ds.iter_batches(batch_size=10))
