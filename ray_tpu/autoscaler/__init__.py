@@ -3,3 +3,9 @@ from ray_tpu.autoscaler.node_provider import (  # noqa: F401
     FakeMultiNodeProvider,
     NodeProvider,
 )
+from ray_tpu.autoscaler.v2 import (  # noqa: F401
+    Instance,
+    InstanceManager,
+    Reconciler,
+    Scheduler,
+)

@@ -1,0 +1,186 @@
+"""Autoscaler v2 (instance manager) tests.
+
+Reference model: autoscaler/v2 unit tests — the instance lifecycle state
+machine, the demand scheduler, and reconciliation against a mock cloud
+provider, all without a live cluster.
+"""
+
+import pytest
+
+from ray_tpu.autoscaler import v2
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.v2 import InstanceManager, Reconciler, Scheduler
+
+
+class MockProvider(NodeProvider):
+    """In-memory cloud: created nodes appear in non_terminated_nodes
+    after `delay_ticks` calls (0 = immediately)."""
+
+    def __init__(self, fail_types=()):
+        self._nodes = {}
+        self._counter = 0
+        self.fail_types = set(fail_types)
+        self.terminated = []
+
+    def create_node(self, node_type, node_config, count):
+        if node_type in self.fail_types:
+            raise RuntimeError("cloud quota exceeded")
+        out = []
+        for _ in range(count):
+            self._counter += 1
+            cid = f"i-{self._counter:04d}"
+            self._nodes[cid] = node_type
+            out.append(cid)
+        return out
+
+    def terminate_node(self, provider_node_id):
+        self._nodes.pop(provider_node_id, None)
+        self.terminated.append(provider_node_id)
+
+    def non_terminated_nodes(self):
+        return list(self._nodes)
+
+    def node_tags(self, provider_node_id):
+        return {"rt-node-type": self._nodes.get(provider_node_id, "")}
+
+
+def test_instance_lifecycle_legal_transitions():
+    im = InstanceManager()
+    inst = im.create("cpu")
+    assert inst.status == v2.QUEUED
+    im.set_status(inst.instance_id, v2.REQUESTED)
+    im.set_status(inst.instance_id, v2.ALLOCATED)
+    im.set_status(inst.instance_id, v2.RAY_RUNNING)
+    with pytest.raises(ValueError):  # RAY_RUNNING -> ALLOCATED is illegal
+        im.set_status(inst.instance_id, v2.ALLOCATED)
+    im.set_status(inst.instance_id, v2.TERMINATING)
+    im.set_status(inst.instance_id, v2.TERMINATED)
+    with pytest.raises(ValueError):  # terminal state
+        im.set_status(inst.instance_id, v2.QUEUED)
+    assert [s for s, _ in inst.status_history] == [
+        "QUEUED", "REQUESTED", "ALLOCATED", "RAY_RUNNING",
+        "TERMINATING", "TERMINATED",
+    ]
+
+
+def test_scheduler_binpacks_and_respects_limits():
+    sched = Scheduler({
+        "cpu": {"resources": {"CPU": 4}, "max_workers": 2},
+        "v5e": {"resources": {"TPU": 4}, "slice_hosts": 4, "max_workers": 1},
+    })
+    # 6 CPU bundles of 2 -> 12 CPU -> 3 cpu nodes, capped at 2.
+    launches = sched.desired_launches(
+        [{"CPU": 2.0}] * 6, free_per_node=[], active_counts={}
+    )
+    assert launches["cpu"] == 2
+    # TPU demand launches one slice UNIT (4 hosts handled by the caller).
+    launches = sched.desired_launches(
+        [{"TPU": 4.0}], free_per_node=[], active_counts={}
+    )
+    assert launches == {"v5e": 1}
+    # Existing free capacity absorbs demand: nothing to launch.
+    launches = sched.desired_launches(
+        [{"CPU": 2.0}], free_per_node=[{"CPU": 4.0}], active_counts={"cpu": 1}
+    )
+    assert launches == {}
+
+
+def test_scheduler_min_workers_floor():
+    sched = Scheduler({"cpu": {"resources": {"CPU": 4}, "min_workers": 2,
+                               "max_workers": 5}})
+    launches = sched.desired_launches([], [], {})
+    assert launches == {"cpu": 2}
+    launches = sched.desired_launches([], [], {"cpu": 2})
+    assert launches == {}
+
+
+def _mk_reconciler(provider, node_types, ray_state, demands,
+                   idle_timeout_s=60.0):
+    im = InstanceManager()
+    rec = Reconciler(
+        im, provider, node_types,
+        ray_state_fn=lambda: ray_state,
+        demands_fn=lambda: demands,
+        idle_timeout_s=idle_timeout_s,
+    )
+    return im, rec
+
+
+def test_reconciler_full_lifecycle():
+    provider = MockProvider()
+    ray_state = {}
+    demands = [{"CPU": 2.0}]
+    im, rec = _mk_reconciler(
+        provider, {"cpu": {"resources": {"CPU": 4}, "max_workers": 4}},
+        ray_state, demands, idle_timeout_s=0.0,
+    )
+    rec.step()  # demand -> QUEUED -> REQUESTED (cloud create issued)
+    [inst] = im.instances((v2.REQUESTED,))
+    assert inst.cloud_id in provider.non_terminated_nodes()
+
+    rec.step()  # cloud lists it -> ALLOCATED
+    assert im.get(inst.instance_id).status == v2.ALLOCATED
+
+    # Raylet registers, busy: RAY_RUNNING and stays.
+    ray_state[inst.cloud_id] = {"alive": True, "idle_s": 0.0,
+                                "free": {"CPU": 2.0}}
+    demands.clear()
+    rec.step()
+    assert im.get(inst.instance_id).status == v2.RAY_RUNNING
+
+    # Node goes idle past the (zero) timeout -> terminated, slice-atomic
+    # path for a single host is the host itself.
+    ray_state[inst.cloud_id] = {"alive": True, "idle_s": 10.0,
+                                "free": {"CPU": 4.0}}
+    rec.step()
+    assert im.get(inst.instance_id).status == v2.TERMINATING
+    rec.step()  # provider no longer lists it
+    assert im.get(inst.instance_id).status == v2.TERMINATED
+    assert provider.terminated == [inst.cloud_id]
+
+
+def test_reconciler_slice_atomic_scale_down():
+    provider = MockProvider()
+    ray_state = {}
+    demands = [{"TPU": 4.0}]
+    im, rec = _mk_reconciler(
+        provider,
+        {"v5e": {"resources": {"TPU": 4}, "slice_hosts": 2, "max_workers": 2}},
+        ray_state, demands, idle_timeout_s=0.0,
+    )
+    rec.step()
+    insts = im.instances((v2.REQUESTED,))
+    assert len(insts) == 2  # one slice unit = 2 hosts
+    assert len({i.slice_group for i in insts}) == 1
+    demands.clear()
+    # Both register; only ONE is idle -> slice must survive.
+    ray_state[insts[0].cloud_id] = {"alive": True, "idle_s": 10.0, "free": {}}
+    ray_state[insts[1].cloud_id] = {"alive": True, "idle_s": 0.0, "free": {}}
+    rec.step()
+    rec.step()
+    assert all(
+        im.get(i.instance_id).status == v2.RAY_RUNNING for i in insts
+    )
+    # Both idle -> the whole slice goes together.
+    ray_state[insts[1].cloud_id]["idle_s"] = 10.0
+    rec.step()
+    assert sorted(provider.terminated) == sorted(
+        i.cloud_id for i in insts
+    )
+
+
+def test_reconciler_retries_failed_allocation():
+    provider = MockProvider(fail_types={"cpu"})
+    im, rec = _mk_reconciler(
+        provider, {"cpu": {"resources": {"CPU": 4}, "min_workers": 1,
+                           "max_workers": 2}},
+        {}, [],
+    )
+    rec.step()  # create_node raises -> instance stays QUEUED
+    assert len(im.instances((v2.QUEUED,))) == 1
+    rec.step()  # retried every tick; still failing, still exactly one
+    assert len(im.instances((v2.QUEUED,))) == 1
+    provider.fail_types.clear()
+    rec.step()  # cloud recovered
+    assert len(im.instances((v2.REQUESTED,))) == 1
+    assert rec.report()["cpu"][v2.REQUESTED] == 1
