@@ -1,0 +1,87 @@
+// End-to-end demo/test for the rt C++ user API.
+//
+// Usage: rt_demo <gcs_host> <gcs_port>
+// Prints "CPP CLIENT OK" and exits 0 when every step passes; the Python
+// test harness (tests/test_cpp_client.py) drives this against a live
+// cluster.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "rt/client.h"
+
+#define CHECK(cond, what)                                         \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      std::fprintf(stderr, "FAIL %s: %s\n", what,                 \
+                   client.last_error().c_str());                  \
+      return 1;                                                   \
+    }                                                             \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <gcs_host> <gcs_port>\n", argv[0]);
+    return 2;
+  }
+  rt::Client client;
+  CHECK(client.Connect(argv[1], std::atoi(argv[2])), "connect");
+
+  // 1. GCS KV round trip.
+  CHECK(client.KvPut("cpp", "greeting", "hello from c++"), "kv_put");
+  auto got = client.KvGet("cpp", "greeting");
+  CHECK(got.has_value() && *got == "hello from c++", "kv_get");
+  CHECK(client.KvDel("cpp", "greeting"), "kv_del");
+  CHECK(!client.KvGet("cpp", "greeting").has_value(), "kv_del_took");
+
+  // 2. Object store put/get round trip (RTX1 cross-language framing).
+  rt::Value obj = rt::Value::Map();
+  obj["kind"] = rt::Value::S("cpp-object");
+  obj["payload"] = rt::Value::Arr({rt::Value::I(1), rt::Value::I(2),
+                                   rt::Value::F(3.5)});
+  std::string oid = client.Put(obj);
+  CHECK(!oid.empty(), "put");
+  auto fetched = client.Get(oid);
+  CHECK(fetched.has_value(), "get");
+  CHECK(fetched->find("kind")->as_str() == "cpp-object", "get_roundtrip");
+  CHECK(fetched->find("payload")->as_arr()[2].as_double() == 3.5,
+        "get_payload");
+
+  // 3. Cross-language task: run Python math.hypot(3, 4) in a worker.
+  auto result = client.Submit("math:hypot",
+                              {rt::Value::F(3.0), rt::Value::F(4.0)});
+  if (!result.ok) {
+    std::fprintf(stderr, "FAIL submit: %s\n", result.error.c_str());
+    return 1;
+  }
+  if (result.value.as_double() != 5.0) {
+    std::fprintf(stderr, "FAIL submit value: %f\n",
+                 result.value.as_double());
+    return 1;
+  }
+
+  // 4. Cross-language task returning a structure.
+  auto sorted = client.Submit(
+      "builtins:sorted",
+      {rt::Value::Arr({rt::Value::I(3), rt::Value::I(1), rt::Value::I(2)})});
+  if (!sorted.ok) {
+    std::fprintf(stderr, "FAIL sorted: %s\n", sorted.error.c_str());
+    return 1;
+  }
+  const auto& arr = sorted.value.as_arr();
+  if (arr.size() != 3 || arr[0].as_int() != 1 || arr[2].as_int() != 3) {
+    std::fprintf(stderr, "FAIL sorted value\n");
+    return 1;
+  }
+
+  // 5. A failing task surfaces its Python error.
+  auto bad = client.Submit("math:sqrt", {rt::Value::S("not-a-number")});
+  if (bad.ok) {
+    std::fprintf(stderr, "FAIL error propagation: bad task succeeded\n");
+    return 1;
+  }
+
+  std::printf("CPP CLIENT OK\n");
+  return 0;
+}

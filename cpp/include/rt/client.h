@@ -1,0 +1,88 @@
+// rt C++ user API: a native client for the ray_tpu runtime.
+//
+// Reference analog: the C++ user API (cpp/include/ray/api/ in the
+// reference, ~9k LoC over the CoreWorker). This runtime's control plane
+// is length-prefixed msgpack frames over TCP (ray_tpu/_private/
+// protocol.py), so the native client speaks that protocol directly — no
+// Python in the loop:
+//
+//   * cluster attach (GCS get_nodes -> head raylet), driver job
+//     registration — the rt:// remote-driver role
+//     (ray_tpu/__init__.py _remote_attach)
+//   * GCS KV get/put/del
+//   * object put/get against the head raylet's shared-memory store
+//     (client_put / client_get_info / fetch_chunk), using the RTX1
+//     cross-language object framing (msgpack payload) so Python
+//     rt.get() reads C++ puts and vice versa
+//   * cross-language task submission: Submit("module:function", args)
+//     runs the named Python function in a pool worker and returns its
+//     RTX1-encoded result (reference: cross-language function-descriptor
+//     calls used by the Java/C++ frontends)
+//
+// Blocking, single-connection-per-peer; link against librt_client.a.
+
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "rt/msgpack.h"
+
+namespace rt {
+
+class Client {
+ public:
+  Client() = default;
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Attach to a cluster via its GCS address. Registers a driver job.
+  bool Connect(const std::string& gcs_host, int gcs_port);
+  void Disconnect();
+  const std::string& last_error() const { return error_; }
+
+  // -- GCS key-value store --------------------------------------------
+  bool KvPut(const std::string& ns, const std::string& key,
+             const std::string& value, bool overwrite = true);
+  std::optional<std::string> KvGet(const std::string& ns,
+                                   const std::string& key);
+  bool KvDel(const std::string& ns, const std::string& key);
+
+  // -- objects ---------------------------------------------------------
+  // Put a msgpack value into the cluster object store; returns the
+  // 16-byte object id ("" on failure).
+  std::string Put(const Value& value);
+  // Fetch + decode an RTX1 object by id.
+  std::optional<Value> Get(const std::string& object_id,
+                           double timeout_s = 60.0);
+
+  // -- tasks -----------------------------------------------------------
+  struct TaskResult {
+    bool ok = false;
+    std::string error;
+    Value value;
+  };
+  // Run the Python function "module:attr" in a cluster worker with
+  // msgpack-plain args; blocks for the result.
+  TaskResult Submit(const std::string& fn_name,
+                    const std::vector<Value>& args,
+                    double timeout_s = 120.0);
+
+ private:
+  Value Call(int fd, const std::string& method, const Value& payload,
+             bool* ok);
+  bool SendFrame(int fd, const Value& frame);
+  bool RecvFrame(int fd, Value* frame);
+  std::string RandomId();
+
+  int gcs_fd_ = -1;
+  int raylet_fd_ = -1;
+  int64_t next_call_id_ = 1;
+  std::string job_id_;
+  std::string error_;
+};
+
+}  // namespace rt

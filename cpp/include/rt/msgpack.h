@@ -1,0 +1,114 @@
+// Minimal msgpack value model + codec for the rt C++ client.
+//
+// Covers the subset the rt wire protocol uses (protocol.py frame maps:
+// nil, bool, int, float64, str, bin, array, map). Reference analog: the
+// C++ user API's serialization layer (cpp/include/ray/api/serializer.h in
+// the reference uses msgpack too).
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <optional>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace rt {
+
+class Value {
+ public:
+  enum class Type { kNil, kBool, kInt, kUint, kFloat, kStr, kBin, kArr, kMap };
+
+  Value() : type_(Type::kNil) {}
+
+  static Value Nil() { return Value(); }
+  static Value B(bool b) {
+    Value v;
+    v.type_ = Type::kBool;
+    v.b_ = b;
+    return v;
+  }
+  static Value I(int64_t i) {
+    Value v;
+    v.type_ = Type::kInt;
+    v.i_ = i;
+    return v;
+  }
+  static Value U(uint64_t u) {
+    Value v;
+    v.type_ = Type::kUint;
+    v.u_ = u;
+    return v;
+  }
+  static Value F(double d) {
+    Value v;
+    v.type_ = Type::kFloat;
+    v.d_ = d;
+    return v;
+  }
+  static Value S(std::string s) {
+    Value v;
+    v.type_ = Type::kStr;
+    v.s_ = std::move(s);
+    return v;
+  }
+  static Value Bin(std::string bytes) {
+    Value v;
+    v.type_ = Type::kBin;
+    v.s_ = std::move(bytes);
+    return v;
+  }
+  static Value Arr(std::vector<Value> items = {}) {
+    Value v;
+    v.type_ = Type::kArr;
+    v.arr_ = std::move(items);
+    return v;
+  }
+  static Value Map() {
+    Value v;
+    v.type_ = Type::kMap;
+    return v;
+  }
+
+  Type type() const { return type_; }
+  bool is_nil() const { return type_ == Type::kNil; }
+
+  bool as_bool() const { return b_; }
+  int64_t as_int() const {
+    if (type_ == Type::kUint) return static_cast<int64_t>(u_);
+    if (type_ == Type::kFloat) return static_cast<int64_t>(d_);
+    return i_;
+  }
+  double as_double() const {
+    if (type_ == Type::kInt) return static_cast<double>(i_);
+    if (type_ == Type::kUint) return static_cast<double>(u_);
+    return d_;
+  }
+  const std::string& as_str() const { return s_; }   // kStr or kBin
+  const std::string& as_bin() const { return s_; }
+  const std::vector<Value>& as_arr() const { return arr_; }
+  std::vector<Value>& arr() { return arr_; }
+
+  // Map access (string keys — the wire protocol's convention).
+  Value& operator[](const std::string& key);
+  const Value* find(const std::string& key) const;
+  const std::vector<std::pair<Value, Value>>& as_map() const { return map_; }
+
+  void pack(std::string* out) const;
+  // Returns false on truncated/invalid input.
+  static bool unpack(const uint8_t* data, size_t len, size_t* pos, Value* out);
+
+ private:
+  Type type_;
+  bool b_ = false;
+  int64_t i_ = 0;
+  uint64_t u_ = 0;
+  double d_ = 0;
+  std::string s_;
+  std::vector<Value> arr_;
+  std::vector<std::pair<Value, Value>> map_;
+};
+
+}  // namespace rt

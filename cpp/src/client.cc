@@ -1,0 +1,378 @@
+// rt C++ client — see include/rt/client.h.
+
+#include "rt/client.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <random>
+
+namespace rt {
+
+namespace {
+
+int DialTcp(const std::string& host, int port, std::string* err) {
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  int rc = getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res);
+  if (rc != 0) {
+    *err = "resolve " + host + ": " + gai_strerror(rc);
+    return -1;
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    *err = "connect " + host + ":" + port_s + " failed";
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+bool WriteAll(int fd, const char* data, size_t len) {
+  while (len > 0) {
+    ssize_t n = write(fd, data, len);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool ReadAll(int fd, char* data, size_t len) {
+  while (len > 0) {
+    ssize_t n = read(fd, data, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+Client::~Client() { Disconnect(); }
+
+void Client::Disconnect() {
+  if (gcs_fd_ >= 0) close(gcs_fd_);
+  if (raylet_fd_ >= 0) close(raylet_fd_);
+  gcs_fd_ = raylet_fd_ = -1;
+}
+
+std::string Client::RandomId() {
+  static std::mt19937_64 rng{std::random_device{}()};
+  std::string id(16, '\0');
+  for (int i = 0; i < 16; i += 8) {
+    uint64_t r = rng();
+    std::memcpy(&id[i], &r, 8);
+  }
+  return id;
+}
+
+bool Client::SendFrame(int fd, const Value& frame) {
+  std::string body;
+  frame.pack(&body);
+  uint32_t len = static_cast<uint32_t>(body.size());
+  char header[4];
+  std::memcpy(header, &len, 4);  // protocol uses little-endian u32
+  return WriteAll(fd, header, 4) && WriteAll(fd, body.data(), body.size());
+}
+
+bool Client::RecvFrame(int fd, Value* frame) {
+  char header[4];
+  if (!ReadAll(fd, header, 4)) return false;
+  uint32_t len;
+  std::memcpy(&len, header, 4);
+  std::string body(len, '\0');
+  if (!ReadAll(fd, &body[0], len)) return false;
+  size_t pos = 0;
+  return Value::unpack(reinterpret_cast<const uint8_t*>(body.data()),
+                       body.size(), &pos, frame);
+}
+
+Value Client::Call(int fd, const std::string& method, const Value& payload,
+                   bool* ok) {
+  *ok = false;
+  int64_t cid = next_call_id_++;
+  Value frame = Value::Map();
+  frame["k"] = Value::S("req");
+  frame["i"] = Value::I(cid);
+  frame["m"] = Value::S(method);
+  frame["d"] = payload;
+  if (!SendFrame(fd, frame)) {
+    error_ = "send failed on method " + method;
+    return Value::Nil();
+  }
+  // Blocking single-outstanding-call loop; push frames are skipped.
+  while (true) {
+    Value resp;
+    if (!RecvFrame(fd, &resp)) {
+      error_ = "connection lost awaiting " + method;
+      return Value::Nil();
+    }
+    const Value* kind = resp.find("k");
+    if (kind == nullptr || kind->as_str() != "resp") continue;
+    const Value* id = resp.find("i");
+    if (id == nullptr || id->as_int() != cid) continue;
+    const Value* err = resp.find("e");
+    if (err != nullptr && !err->is_nil()) {
+      error_ = err->as_str();
+      return Value::Nil();
+    }
+    *ok = true;
+    const Value* data = resp.find("d");
+    return data == nullptr ? Value::Nil() : *data;
+  }
+}
+
+bool Client::Connect(const std::string& gcs_host, int gcs_port) {
+  gcs_fd_ = DialTcp(gcs_host, gcs_port, &error_);
+  if (gcs_fd_ < 0) return false;
+  bool ok = false;
+  Value nodes = Call(gcs_fd_, "get_nodes", Value::Map(), &ok);
+  if (!ok) return false;
+  const Value* list = nodes.find("nodes");
+  if (list == nullptr) {
+    error_ = "get_nodes returned no node list";
+    return false;
+  }
+  // Prefer the head node (the rt:// attach rule, __init__._remote_attach).
+  const Value* chosen = nullptr;
+  for (const auto& node : list->as_arr()) {
+    const Value* state = node.find("state");
+    if (state == nullptr || state->as_str() != "ALIVE") continue;
+    const Value* head = node.find("is_head");
+    if (chosen == nullptr || (head != nullptr && head->as_bool())) {
+      chosen = &node;
+      if (head != nullptr && head->as_bool()) break;
+    }
+  }
+  if (chosen == nullptr) {
+    error_ = "no live nodes in cluster";
+    return false;
+  }
+  const Value* addr = chosen->find("address");
+  const Value* port = chosen->find("port");
+  raylet_fd_ = DialTcp(addr->as_str(), static_cast<int>(port->as_int()),
+                       &error_);
+  if (raylet_fd_ < 0) return false;
+
+  job_id_ = RandomId();
+  Value reg = Value::Map();
+  reg["job_id"] = Value::Bin(job_id_);
+  reg["pid"] = Value::I(static_cast<int64_t>(getpid()));
+  reg["entrypoint"] = Value::S("cpp-client");
+  Call(gcs_fd_, "register_job", reg, &ok);
+  return ok;
+}
+
+bool Client::KvPut(const std::string& ns, const std::string& key,
+                   const std::string& value, bool overwrite) {
+  Value d = Value::Map();
+  d["ns"] = Value::S(ns);
+  d["key"] = Value::Bin(key);
+  d["value"] = Value::Bin(value);
+  d["overwrite"] = Value::B(overwrite);
+  bool ok = false;
+  Value r = Call(gcs_fd_, "kv_put", d, &ok);
+  if (!ok) return false;
+  const Value* added = r.find("added");
+  return added != nullptr && added->as_bool();
+}
+
+std::optional<std::string> Client::KvGet(const std::string& ns,
+                                         const std::string& key) {
+  Value d = Value::Map();
+  d["ns"] = Value::S(ns);
+  d["key"] = Value::Bin(key);
+  bool ok = false;
+  Value r = Call(gcs_fd_, "kv_get", d, &ok);
+  if (!ok) return std::nullopt;
+  const Value* value = r.find("value");
+  if (value == nullptr || value->is_nil()) return std::nullopt;
+  return value->as_bin();
+}
+
+bool Client::KvDel(const std::string& ns, const std::string& key) {
+  Value d = Value::Map();
+  d["ns"] = Value::S(ns);
+  d["key"] = Value::Bin(key);
+  bool ok = false;
+  Value r = Call(gcs_fd_, "kv_del", d, &ok);
+  if (!ok) return false;
+  const Value* deleted = r.find("deleted");
+  return deleted != nullptr && deleted->as_bool();
+}
+
+namespace {
+constexpr uint32_t kXlangMagic = 0x52545831;  // "RTX1", little-endian u32
+}
+
+std::string Client::Put(const Value& value) {
+  // RTX1 framing: u32 magic + msgpack payload (serialization.py).
+  std::string payload(4, '\0');
+  std::memcpy(&payload[0], &kXlangMagic, 4);
+  value.pack(&payload);
+
+  std::string oid = RandomId();
+  Value d = Value::Map();
+  d["object_id"] = Value::Bin(oid);
+  d["data"] = Value::Bin(payload);
+  bool ok = false;
+  Value r = Call(raylet_fd_, "client_put", d, &ok);
+  if (!ok) return "";
+  const Value* okf = r.find("ok");
+  if (okf == nullptr || !okf->as_bool()) {
+    const Value* err = r.find("error");
+    error_ = err != nullptr && !err->is_nil() ? err->as_str() : "put failed";
+    return "";
+  }
+  return oid;
+}
+
+std::optional<Value> Client::Get(const std::string& object_id,
+                                 double timeout_s) {
+  Value d = Value::Map();
+  d["object_id"] = Value::Bin(object_id);
+  d["timeout"] = Value::F(timeout_s);
+  bool ok = false;
+  Value info = Call(raylet_fd_, "client_get_info", d, &ok);
+  if (!ok) return std::nullopt;
+  const Value* okf = info.find("ok");
+  if (okf == nullptr || !okf->as_bool()) {
+    const Value* err = info.find("error");
+    error_ = err != nullptr && !err->is_nil() ? err->as_str() : "get failed";
+    return std::nullopt;
+  }
+  int64_t size = info.find("size")->as_int();
+  std::string data;
+  data.reserve(static_cast<size_t>(size));
+  const int64_t kChunk = 4 * 1024 * 1024;
+  for (int64_t off = 0; off < size; off += kChunk) {
+    Value cd = Value::Map();
+    cd["object_id"] = Value::Bin(object_id);
+    cd["offset"] = Value::I(off);
+    cd["size"] = Value::I(std::min(kChunk, size - off));
+    Value chunk = Call(raylet_fd_, "fetch_chunk", cd, &ok);
+    if (!ok) return std::nullopt;
+    data += chunk.find("data")->as_bin();
+  }
+  if (data.size() < 4) {
+    error_ = "object too small to carry a magic";
+    return std::nullopt;
+  }
+  uint32_t magic;
+  std::memcpy(&magic, data.data(), 4);
+  if (magic != kXlangMagic) {
+    error_ = "object is not cross-language (RTX1) encoded";
+    return std::nullopt;
+  }
+  Value out;
+  size_t pos = 0;
+  if (!Value::unpack(reinterpret_cast<const uint8_t*>(data.data()) + 4,
+                     data.size() - 4, &pos, &out)) {
+    error_ = "corrupt msgpack payload";
+    return std::nullopt;
+  }
+  return out;
+}
+
+Client::TaskResult Client::Submit(const std::string& fn_name,
+                                  const std::vector<Value>& args,
+                                  double timeout_s) {
+  (void)timeout_s;  // the blocking call returns when the task completes
+  TaskResult result;
+  Value spec = Value::Map();
+  spec["task_id"] = Value::Bin(RandomId());
+  spec["job_id"] = Value::Bin(job_id_);
+  spec["name"] = Value::S(fn_name);
+  spec["fn_name"] = Value::S(fn_name);
+  spec["plain_args"] = Value::Arr(args);
+  spec["deps"] = Value::Arr();
+  spec["num_returns"] = Value::I(1);
+  Value res = Value::Map();
+  res["CPU"] = Value::F(1.0);
+  spec["resources"] = res;
+  spec["retriable"] = Value::B(false);
+
+  bool ok = false;
+  Value r = Call(raylet_fd_, "submit_task", spec, &ok);
+  if (!ok) {
+    result.error = error_;
+    return result;
+  }
+  const Value* status = r.find("status");
+  if (status == nullptr || status->as_str() != "ok") {
+    const Value* err = r.find("error");
+    result.error = err != nullptr && !err->is_nil()
+                       ? err->as_str()
+                       : "task failed";
+    return result;
+  }
+  const Value* returns = r.find("returns");
+  if (returns == nullptr || returns->as_arr().empty()) {
+    result.error = "task returned nothing";
+    return result;
+  }
+  const Value& entry = returns->as_arr()[0];
+  const std::string& kind = entry.find("kind")->as_str();
+  if (kind == "inline") {
+    const std::string& data = entry.find("data")->as_bin();
+    uint32_t magic = 0;
+    if (data.size() >= 4) std::memcpy(&magic, data.data(), 4);
+    if (magic != kXlangMagic) {
+      result.error = "result is not cross-language encoded";
+      return result;
+    }
+    size_t pos = 0;
+    if (!Value::unpack(reinterpret_cast<const uint8_t*>(data.data()) + 4,
+                       data.size() - 4, &pos, &result.value)) {
+      result.error = "corrupt result payload";
+      return result;
+    }
+    result.ok = true;
+    return result;
+  }
+  // Large result: stored in the cluster; fetch by the id the worker
+  // reported.
+  const Value* oid = entry.find("object_id");
+  if (oid == nullptr) {
+    result.error = "stored result missing object_id";
+    return result;
+  }
+  auto fetched = Get(oid->as_bin(), timeout_s);
+  if (!fetched.has_value()) {
+    result.error = error_;
+    return result;
+  }
+  result.value = std::move(*fetched);
+  result.ok = true;
+  return result;
+}
+
+}  // namespace rt

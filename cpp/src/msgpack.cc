@@ -1,0 +1,305 @@
+// msgpack codec — see include/rt/msgpack.h.
+
+#include "rt/msgpack.h"
+
+#include <cstring>
+
+namespace rt {
+
+Value& Value::operator[](const std::string& key) {
+  type_ = Type::kMap;
+  for (auto& kv : map_) {
+    if (kv.first.type() == Type::kStr && kv.first.as_str() == key) {
+      return kv.second;
+    }
+  }
+  map_.emplace_back(Value::S(key), Value());
+  return map_.back().second;
+}
+
+const Value* Value::find(const std::string& key) const {
+  for (const auto& kv : map_) {
+    if (kv.first.type() == Type::kStr && kv.first.as_str() == key) {
+      return &kv.second;
+    }
+  }
+  return nullptr;
+}
+
+namespace {
+
+void put_u8(std::string* out, uint8_t b) { out->push_back(static_cast<char>(b)); }
+
+void put_be(std::string* out, uint64_t v, int bytes) {
+  for (int i = bytes - 1; i >= 0; --i) {
+    out->push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+  }
+}
+
+bool read_be(const uint8_t* data, size_t len, size_t* pos, int bytes,
+             uint64_t* out) {
+  if (*pos + bytes > len) return false;
+  uint64_t v = 0;
+  for (int i = 0; i < bytes; ++i) v = (v << 8) | data[(*pos)++];
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+void Value::pack(std::string* out) const {
+  switch (type_) {
+    case Type::kNil:
+      put_u8(out, 0xc0);
+      break;
+    case Type::kBool:
+      put_u8(out, b_ ? 0xc3 : 0xc2);
+      break;
+    case Type::kInt: {
+      int64_t i = i_;
+      if (i >= 0) {
+        if (i < 128) {
+          put_u8(out, static_cast<uint8_t>(i));
+        } else if (i <= 0xffff) {
+          put_u8(out, 0xcd);
+          put_be(out, static_cast<uint64_t>(i), 2);
+        } else if (i <= 0xffffffffLL) {
+          put_u8(out, 0xce);
+          put_be(out, static_cast<uint64_t>(i), 4);
+        } else {
+          put_u8(out, 0xcf);
+          put_be(out, static_cast<uint64_t>(i), 8);
+        }
+      } else {
+        if (i >= -32) {
+          put_u8(out, static_cast<uint8_t>(0xe0 | (i + 32)));
+        } else if (i >= -32768) {
+          put_u8(out, 0xd1);
+          put_be(out, static_cast<uint16_t>(i), 2);
+        } else if (i >= -2147483648LL) {
+          put_u8(out, 0xd2);
+          put_be(out, static_cast<uint32_t>(i), 4);
+        } else {
+          put_u8(out, 0xd3);
+          put_be(out, static_cast<uint64_t>(i), 8);
+        }
+      }
+      break;
+    }
+    case Type::kUint:
+      put_u8(out, 0xcf);
+      put_be(out, u_, 8);
+      break;
+    case Type::kFloat: {
+      put_u8(out, 0xcb);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(d_), "double must be 8 bytes");
+      std::memcpy(&bits, &d_, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Type::kStr: {
+      size_t n = s_.size();
+      if (n < 32) {
+        put_u8(out, static_cast<uint8_t>(0xa0 | n));
+      } else if (n <= 0xff) {
+        put_u8(out, 0xd9);
+        put_be(out, n, 1);
+      } else if (n <= 0xffff) {
+        put_u8(out, 0xda);
+        put_be(out, n, 2);
+      } else {
+        put_u8(out, 0xdb);
+        put_be(out, n, 4);
+      }
+      out->append(s_);
+      break;
+    }
+    case Type::kBin: {
+      size_t n = s_.size();
+      if (n <= 0xff) {
+        put_u8(out, 0xc4);
+        put_be(out, n, 1);
+      } else if (n <= 0xffff) {
+        put_u8(out, 0xc5);
+        put_be(out, n, 2);
+      } else {
+        put_u8(out, 0xc6);
+        put_be(out, n, 4);
+      }
+      out->append(s_);
+      break;
+    }
+    case Type::kArr: {
+      size_t n = arr_.size();
+      if (n < 16) {
+        put_u8(out, static_cast<uint8_t>(0x90 | n));
+      } else if (n <= 0xffff) {
+        put_u8(out, 0xdc);
+        put_be(out, n, 2);
+      } else {
+        put_u8(out, 0xdd);
+        put_be(out, n, 4);
+      }
+      for (const auto& v : arr_) v.pack(out);
+      break;
+    }
+    case Type::kMap: {
+      size_t n = map_.size();
+      if (n < 16) {
+        put_u8(out, static_cast<uint8_t>(0x80 | n));
+      } else if (n <= 0xffff) {
+        put_u8(out, 0xde);
+        put_be(out, n, 2);
+      } else {
+        put_u8(out, 0xdf);
+        put_be(out, n, 4);
+      }
+      for (const auto& kv : map_) {
+        kv.first.pack(out);
+        kv.second.pack(out);
+      }
+      break;
+    }
+  }
+}
+
+bool Value::unpack(const uint8_t* data, size_t len, size_t* pos, Value* out) {
+  if (*pos >= len) return false;
+  uint8_t tag = data[(*pos)++];
+  uint64_t n = 0;
+
+  auto read_raw = [&](size_t count, std::string* s) -> bool {
+    if (*pos + count > len) return false;
+    s->assign(reinterpret_cast<const char*>(data + *pos), count);
+    *pos += count;
+    return true;
+  };
+  auto read_seq = [&](size_t count, bool map) -> bool {
+    if (map) {
+      out->type_ = Type::kMap;
+      out->map_.reserve(count);
+      for (size_t i = 0; i < count; ++i) {
+        Value k, v;
+        if (!unpack(data, len, pos, &k) || !unpack(data, len, pos, &v)) {
+          return false;
+        }
+        out->map_.emplace_back(std::move(k), std::move(v));
+      }
+    } else {
+      out->type_ = Type::kArr;
+      out->arr_.reserve(count);
+      for (size_t i = 0; i < count; ++i) {
+        Value v;
+        if (!unpack(data, len, pos, &v)) return false;
+        out->arr_.push_back(std::move(v));
+      }
+    }
+    return true;
+  };
+
+  if (tag < 0x80) {  // positive fixint
+    out->type_ = Type::kInt;
+    out->i_ = tag;
+    return true;
+  }
+  if (tag >= 0xe0) {  // negative fixint
+    out->type_ = Type::kInt;
+    out->i_ = static_cast<int8_t>(tag);
+    return true;
+  }
+  if ((tag & 0xe0) == 0xa0) {  // fixstr
+    out->type_ = Type::kStr;
+    return read_raw(tag & 0x1f, &out->s_);
+  }
+  if ((tag & 0xf0) == 0x90) return read_seq(tag & 0x0f, false);  // fixarray
+  if ((tag & 0xf0) == 0x80) return read_seq(tag & 0x0f, true);   // fixmap
+
+  switch (tag) {
+    case 0xc0:
+      out->type_ = Type::kNil;
+      return true;
+    case 0xc2:
+    case 0xc3:
+      out->type_ = Type::kBool;
+      out->b_ = (tag == 0xc3);
+      return true;
+    case 0xc4:
+    case 0xc5:
+    case 0xc6: {
+      int width = 1 << (tag - 0xc4);
+      if (!read_be(data, len, pos, width, &n)) return false;
+      out->type_ = Type::kBin;
+      return read_raw(n, &out->s_);
+    }
+    case 0xca: {  // float32
+      if (!read_be(data, len, pos, 4, &n)) return false;
+      float f;
+      uint32_t bits = static_cast<uint32_t>(n);
+      std::memcpy(&f, &bits, 4);
+      out->type_ = Type::kFloat;
+      out->d_ = f;
+      return true;
+    }
+    case 0xcb: {  // float64
+      if (!read_be(data, len, pos, 8, &n)) return false;
+      out->type_ = Type::kFloat;
+      std::memcpy(&out->d_, &n, 8);
+      return true;
+    }
+    case 0xcc:
+    case 0xcd:
+    case 0xce:
+    case 0xcf: {  // uint 8/16/32/64
+      int width = 1 << (tag - 0xcc);
+      if (!read_be(data, len, pos, width, &n)) return false;
+      if (tag == 0xcf && n > INT64_MAX) {
+        out->type_ = Type::kUint;
+        out->u_ = n;
+      } else {
+        out->type_ = Type::kInt;
+        out->i_ = static_cast<int64_t>(n);
+      }
+      return true;
+    }
+    case 0xd0:
+    case 0xd1:
+    case 0xd2:
+    case 0xd3: {  // int 8/16/32/64
+      int width = 1 << (tag - 0xd0);
+      if (!read_be(data, len, pos, width, &n)) return false;
+      out->type_ = Type::kInt;
+      switch (width) {
+        case 1: out->i_ = static_cast<int8_t>(n); break;
+        case 2: out->i_ = static_cast<int16_t>(n); break;
+        case 4: out->i_ = static_cast<int32_t>(n); break;
+        default: out->i_ = static_cast<int64_t>(n); break;
+      }
+      return true;
+    }
+    case 0xd9:
+    case 0xda:
+    case 0xdb: {  // str 8/16/32
+      int width = 1 << (tag - 0xd9);
+      if (!read_be(data, len, pos, width, &n)) return false;
+      out->type_ = Type::kStr;
+      return read_raw(n, &out->s_);
+    }
+    case 0xdc:
+    case 0xdd: {  // array 16/32
+      int width = tag == 0xdc ? 2 : 4;
+      if (!read_be(data, len, pos, width, &n)) return false;
+      return read_seq(n, false);
+    }
+    case 0xde:
+    case 0xdf: {  // map 16/32
+      int width = tag == 0xde ? 2 : 4;
+      if (!read_be(data, len, pos, width, &n)) return false;
+      return read_seq(n, true);
+    }
+    default:
+      return false;  // ext types unused by the rt protocol
+  }
+}
+
+}  // namespace rt

@@ -23,7 +23,8 @@ from typing import Any, List, Tuple
 
 import cloudpickle
 
-_MAGIC = 0x52545031  # "RTP1"
+_MAGIC = 0x52545031  # "RTP1" — pickle + out-of-band buffers
+_MAGIC_XLANG = 0x52545831  # "RTX1" — msgpack payload (cross-language)
 _ALIGN = 64
 
 
@@ -98,9 +99,19 @@ def deserialize(data: memoryview) -> Any:
     keeping the backing store pinned while the value is alive (the object
     store client pins via refcount, releasing on a weakref callback).
     """
-    magic, pickle_len, nbuf = struct.unpack_from("<III", data, 0)
+    if len(data) < 4:
+        raise ValueError("corrupt serialized object (too short)")
+    (magic,) = struct.unpack_from("<I", data, 0)
+    if magic == _MAGIC_XLANG:
+        # Cross-language (RTX1) payloads can be tiny (5 bytes for None) —
+        # decode before touching the larger RTP1 header. The memoryview
+        # slice feeds msgpack without copying the payload.
+        import msgpack
+
+        return msgpack.unpackb(data[4:], raw=False, strict_map_key=False)
     if magic != _MAGIC:
         raise ValueError("corrupt serialized object (bad magic)")
+    _, pickle_len, nbuf = struct.unpack_from("<III", data, 0)
     lens = struct.unpack_from(f"<{nbuf}Q", data, 12)
     off = 12 + 8 * nbuf
     pickled = bytes(data[off : off + pickle_len])
@@ -110,6 +121,20 @@ def deserialize(data: memoryview) -> Any:
         bufs.append(data[off : off + ln])
         off = _pad(off + ln)
     return pickle.loads(pickled, buffers=bufs)
+
+
+def serialize_xlang(value: Any) -> bytes:
+    """Serialize as a cross-language (RTX1/msgpack) object.
+
+    Any client that speaks msgpack — the C++ API in cpp/, or a remote
+    driver in another language — can read these; `deserialize` handles
+    them transparently on the Python side (the reference's cross-language
+    serialization role, java/C++ <-> python object passing)."""
+    import msgpack
+
+    return struct.pack("<I", _MAGIC_XLANG) + msgpack.packb(
+        value, use_bin_type=True
+    )
 
 
 def serialize_to_bytes(value: Any) -> bytes:

@@ -923,6 +923,11 @@ class CoreClient:
         # The raylet's OOM policy prefers killing retriable tasks
         # (worker_killing_policy.cc retriable-FIFO).
         spec["retriable"] = retries > 0
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.inject()
+        if trace_ctx:
+            spec["trace_ctx"] = trace_ctx
         refs = []
         futures = []
         for i in range(num_returns):
@@ -1126,6 +1131,11 @@ class CoreClient:
             "caller": self.client_id,
             "num_returns": num_returns,
         }
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.inject()
+        if trace_ctx:
+            request["trace_ctx"] = trace_ctx
         refs, futures = [], []
         for i in range(num_returns):
             oid = object_id_for_task(task_id, i)

@@ -28,6 +28,25 @@ from ray_tpu._private.protocol import RpcServer, connect, spawn
 from ray_tpu._private.worker import CoreClient, make_task_error
 
 
+class _RawObject:
+    """Pre-framed bytes (RTX1 cross-language objects) presented with the
+    SerializedObject store interface (total_size / write_into / to_bytes)."""
+
+    def __init__(self, raw: bytes):
+        self.raw = raw
+
+    @property
+    def total_size(self) -> int:
+        return len(self.raw)
+
+    def write_into(self, dest) -> int:
+        dest[: len(self.raw)] = self.raw
+        return len(self.raw)
+
+    def to_bytes(self) -> bytes:
+        return self.raw
+
+
 class _CallerQueue:
     """Ordered execution state for one caller (SequentialActorSubmitQueue
     receiver side, transport/sequential_actor_submit_queue.cc)."""
@@ -168,7 +187,27 @@ class WorkerProcess:
         )
 
     def _execute_task(self, spec) -> dict:
+        from ray_tpu.util import tracing
+
+        with tracing.activate(
+            spec.get("trace_ctx"), spec.get("name") or "task"
+        ):
+            return self._execute_task_body(spec)
+
+    def _execute_task_body(self, spec) -> dict:
         try:
+            if spec.get("fn_name"):
+                # Cross-language task (reference: cross_language.py /
+                # function-descriptor calls from java/cpp frontends): the
+                # function is named "module:attr", args are plain msgpack
+                # values, and the result serializes as RTX1 so the foreign
+                # caller can decode it.
+                import importlib
+
+                mod_name, _, attr = spec["fn_name"].partition(":")
+                fn = getattr(importlib.import_module(mod_name), attr)
+                value = fn(*(spec.get("plain_args") or []))
+                return self._package_returns(spec, value, xlang=True)
             fn = self.client.fn_manager.fetch(spec["fn_key"])
             args, kwargs = self.client.deserialize_args(spec["args"])
             value = fn(*args, **kwargs)
@@ -176,7 +215,7 @@ class WorkerProcess:
         except BaseException as e:  # noqa: BLE001 — shipped to the caller
             return make_task_error(e)
 
-    def _package_returns(self, spec, value) -> dict:
+    def _package_returns(self, spec, value, xlang: bool = False) -> dict:
         cfg = get_config()
         num_returns = spec.get("num_returns", 1)
         if num_returns == 1:
@@ -191,13 +230,17 @@ class WorkerProcess:
         returns = []
         task_id = TaskID(spec["task_id"])
         for i, v in enumerate(values):
-            so = ser.serialize(v)
+            so = (_RawObject(ser.serialize_xlang(v)) if xlang
+                  else ser.serialize(v))
             if so.total_size <= cfg.max_inline_object_size:
                 returns.append({"kind": "inline", "data": so.to_bytes()})
             else:
                 oid = object_id_for_task(task_id, i)
                 self.client.put_serialized_with_spill(oid, so)
-                returns.append({"kind": "store", "size": so.total_size})
+                returns.append({
+                    "kind": "store", "size": so.total_size,
+                    "object_id": oid.binary(),
+                })
         return {"status": "ok", "returns": returns}
 
     # -- actor lifecycle --------------------------------------------------
@@ -277,13 +320,16 @@ class WorkerProcess:
         self._record_task_event(d["task_id"], d["method"], "RUNNING")
 
         def do_call():
+            from ray_tpu.util import tracing
+
             method = getattr(actor.instance, d["method"])
             args, kwargs = self.client.deserialize_args(d["args"])
 
             def invoke():
-                if inspect.iscoroutinefunction(method):
-                    return asyncio.run(method(*args, **kwargs))
-                return method(*args, **kwargs)
+                with tracing.activate(d.get("trace_ctx"), d["method"]):
+                    if inspect.iscoroutinefunction(method):
+                        return asyncio.run(method(*args, **kwargs))
+                    return method(*args, **kwargs)
 
             if actor.max_concurrency == 1:
                 # Shares the state lock with compiled-DAG loops so stages

@@ -1,0 +1,116 @@
+"""Grafana dashboard generation from the metrics surface.
+
+Analog of the reference's grafana_dashboard_factory
+(dashboard/modules/metrics/grafana_dashboard_factory.py): emit a Grafana
+dashboard JSON whose panels query the Prometheus metrics this runtime
+exposes at the dashboard's /metrics endpoint — the built-in system series
+(rt_node_resource_*, rt_actors) plus one panel per registered user
+metric (Counter -> rate graph, Gauge -> graph, Histogram -> p50/p95/p99
+quantile graph over _bucket series).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+
+def _panel(panel_id: int, title: str, targets: List[Dict], y: int,
+           unit: str = "short") -> Dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": 8, "w": 12, "x": (panel_id % 2) * 12, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [
+            {"expr": t["expr"], "legendFormat": t.get("legend", ""),
+             "refId": chr(ord("A") + i)}
+            for i, t in enumerate(targets)
+        ],
+    }
+
+
+_SYSTEM_PANELS = [
+    ("Node resources available", [
+        {"expr": "rt_node_resource_available",
+         "legend": "{{node}} {{resource}}"},
+    ]),
+    ("Node resources total", [
+        {"expr": "rt_node_resource_total", "legend": "{{node}} {{resource}}"},
+    ]),
+    ("Actors by state", [
+        {"expr": "rt_actors", "legend": "{{state}}"},
+    ]),
+]
+
+
+def generate_dashboard(
+    user_metrics: Optional[List[Dict]] = None,
+    title: str = "ray_tpu cluster",
+) -> Dict:
+    """Build the dashboard dict.
+
+    user_metrics: list of Metric.info dicts ({"name", "description",
+    "type"}); defaults to every metric registered in this process
+    (util/metrics._registry).
+    """
+    if user_metrics is None:
+        from ray_tpu.util import metrics as m
+
+        with m._registry_lock:
+            user_metrics = [
+                {**metric.info, "type": type(metric).__name__.lower()}
+                for metric in m._registry
+            ]
+
+    panels: List[Dict] = []
+    pid = 1
+    y = 0
+    for name, targets in _SYSTEM_PANELS:
+        panels.append(_panel(pid, name, targets, y))
+        pid += 1
+        y += 8 * (pid % 2 == 1)
+
+    for info in user_metrics:
+        name, mtype = info["name"], info["type"]
+        if mtype == "counter":
+            targets = [{"expr": f"rate({name}[1m])", "legend": name}]
+        elif mtype == "gauge":
+            targets = [{"expr": name, "legend": name}]
+        else:  # histogram
+            targets = [
+                {"expr": f"histogram_quantile({q}, "
+                         f"rate({name}_bucket[1m]))",
+                 "legend": f"p{int(q * 100)}"}
+                for q in (0.5, 0.95, 0.99)
+            ]
+        panels.append(
+            _panel(pid, info.get("description") or name, targets, y)
+        )
+        pid += 1
+        y += 8 * (pid % 2 == 1)
+
+    return {
+        "title": title,
+        "uid": "rt-tpu-cluster",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "templating": {
+            "list": [{
+                "name": "datasource",
+                "type": "datasource",
+                "query": "prometheus",
+            }]
+        },
+        "panels": panels,
+    }
+
+
+def write_dashboard(path: str, **kwargs) -> str:
+    """Write the dashboard JSON to `path`; returns the path."""
+    with open(path, "w") as f:
+        json.dump(generate_dashboard(**kwargs), f, indent=2)
+    return path

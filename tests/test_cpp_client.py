@@ -1,0 +1,150 @@
+"""C++ user API tests: the native client against a live cluster.
+
+Reference model: the C++ user API test suite (cpp/src/ray/test/ in the
+reference) — here the rt_demo binary drives connect/KV/objects/
+cross-language tasks over the wire protocol, and Python-side tests verify
+interop in both directions (C++ put read by Python, Python xlang objects
+read back, RTX1 round trip).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+import ray_tpu as rt
+
+CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "cpp")
+DEMO = os.path.join(CPP_DIR, "build", "rt_demo")
+
+
+def _build_demo():
+    if not os.path.exists(DEMO):
+        subprocess.run(
+            ["make", "-s", "-C", CPP_DIR], check=True, timeout=300
+        )
+    return DEMO
+
+
+def test_rtx1_roundtrip_python_side():
+    from ray_tpu._private import serialization as ser
+
+    value = {"kind": "xlang", "nums": [1, 2, 3.5], "blob": b"\x00\x01"}
+    raw = ser.serialize_xlang(value)
+    assert raw[:4] == b"1XTR"  # u32 0x52545831 ("RTX1") little-endian
+    out = ser.deserialize_from_bytes(raw)
+    assert out == value
+
+
+def test_rtx1_tiny_payloads():
+    """RTX1 frames can be shorter than the RTP1 12-byte header — None is
+    5 bytes; deserialize must dispatch on the 4-byte magic first."""
+    from ray_tpu._private import serialization as ser
+
+    for value in (None, 0, 5, True, "", b""):
+        assert ser.deserialize_from_bytes(ser.serialize_xlang(value)) == value
+
+
+def test_cross_language_task_returning_none(rt_start):
+    """A fn_name task whose result msgpack-encodes under 12 bytes must
+    round-trip (regression: deserialize crashed on short RTX1 frames)."""
+    import os as _os
+
+    client = rt._worker.get_client()
+    spec = {
+        "task_id": _os.urandom(16),
+        "job_id": client.job_id.binary(),
+        "name": "builtins:print",
+        "fn_name": "builtins:print",
+        "plain_args": ["xlang"],
+        "deps": [],
+        "num_returns": 1,
+        "resources": {"CPU": 1.0},
+        "retriable": False,
+    }
+    result = client._run(client.raylet.call("submit_task", spec, timeout=120))
+    assert result["status"] == "ok"
+    from ray_tpu._private import serialization as ser
+
+    assert ser.deserialize_from_bytes(result["returns"][0]["data"]) is None
+
+
+def test_cross_language_task_from_python(rt_start):
+    """The fn_name task path works from any frontend; drive it from
+    Python by submitting a raw spec through the driver's raylet."""
+    client = rt._worker.get_client()
+    import os as _os
+
+    from ray_tpu._private.ids import TaskID
+
+    spec = {
+        "task_id": _os.urandom(16),
+        "job_id": client.job_id.binary(),
+        "name": "math:hypot",
+        "fn_name": "math:hypot",
+        "plain_args": [3.0, 4.0],
+        "deps": [],
+        "num_returns": 1,
+        "resources": {"CPU": 1.0},
+        "retriable": False,
+    }
+    result = client._run(
+        client.raylet.call("submit_task", spec, timeout=120)
+    )
+    assert result["status"] == "ok"
+    [entry] = result["returns"]
+    assert entry["kind"] == "inline"
+    from ray_tpu._private import serialization as ser
+
+    assert ser.deserialize_from_bytes(entry["data"]) == 5.0
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_cpp_demo_end_to_end(rt_start):
+    """Build and run the C++ demo binary against the live cluster: KV,
+    object put/get, cross-language submit, error propagation."""
+    demo = _build_demo()
+    node = rt._node
+    out = subprocess.run(
+        [demo, node.gcs_host, str(node.gcs_port)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "CPP CLIENT OK" in out.stdout
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_cpp_put_readable_from_python(rt_start):
+    """Interop: RTX1 objects written through the client_put path (what
+    the C++ client's Put does) read back identically through
+    client_get_info/fetch_chunk (what its Get does), and Python's
+    deserializer understands them."""
+    from ray_tpu._private import serialization as ser
+    from ray_tpu._private.ids import ObjectID
+
+    client = rt._worker.get_client()
+    oid = ObjectID.from_random()
+    raw = ser.serialize_xlang({"who": "python", "n": 7})
+    ok = client._run(
+        client.raylet.call(
+            "client_put", {"object_id": oid.binary(), "data": raw},
+            timeout=60,
+        )
+    )
+    assert ok["ok"]
+    info = client._run(
+        client.raylet.call(
+            "client_get_info", {"object_id": oid.binary()}, timeout=60
+        )
+    )
+    assert info["ok"] and info["size"] == len(raw)
+    chunk = client._run(
+        client.raylet.call(
+            "fetch_chunk",
+            {"object_id": oid.binary(), "offset": 0, "size": info["size"]},
+            timeout=60,
+        )
+    )
+    assert ser.deserialize_from_bytes(chunk["data"]) == {
+        "who": "python", "n": 7,
+    }

@@ -1,0 +1,121 @@
+"""Distributed tracing + Grafana dashboard generation tests.
+
+Reference model: tracing_helper's span-injection behavior (spans form a
+cross-process tree keyed by trace id) and the grafana_dashboard_factory
+output shape.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    yield
+    tracing.disable()
+
+
+def test_span_nesting_local():
+    tracing.enable()
+    assert tracing.current() is None
+    with tracing.span("outer"):
+        outer = tracing.current()
+        assert outer is not None
+        with tracing.span("inner"):
+            inner = tracing.current()
+            assert inner["trace_id"] == outer["trace_id"]
+            assert inner["span_id"] != outer["span_id"]
+        assert tracing.current() == outer
+    assert tracing.current() is None
+
+
+def test_inject_roots_new_trace_when_idle():
+    tracing.enable()
+    ctx = tracing.inject()
+    assert ctx["parent_span_id"] == ""
+    assert len(ctx["trace_id"]) == 32
+    tracing.disable()
+    assert tracing.inject() is None
+
+
+def test_task_spans_form_cross_process_tree(rt_start):
+    tracing.enable()
+
+    @rt.remote
+    def child():
+        return "ok"
+
+    @rt.remote
+    def parent():
+        return rt.get(child.remote())
+
+    with tracing.span("request"):
+        root_ctx = tracing.current()
+        assert rt.get(parent.remote(), timeout=120) == "ok"
+    from ray_tpu.util import profiling
+
+    profiling.flush()
+    time.sleep(0.3)
+
+    spans = tracing.get_trace(root_ctx["trace_id"])
+    # Task spans carry the function qualname; match by suffix.
+    by_name = {s["name"].rsplit(".", 1)[-1]: s for s in spans}
+    assert {"request", "parent", "child"} <= set(by_name), by_name.keys()
+    # parent task's span is a child of the driver's "request" span...
+    assert by_name["parent"]["parent_id"] == by_name["request"]["span_id"]
+    # ...and the nested task's span hangs off the parent task's span.
+    assert by_name["child"]["parent_id"] == by_name["parent"]["span_id"]
+    assert all(s["dur_s"] >= 0 for s in spans)
+
+
+def test_actor_call_spans_join_the_trace(rt_start):
+    tracing.enable()
+
+    @rt.remote
+    class A:
+        def work(self):
+            return 1
+
+    a = A.remote()
+    rt.get(a.work.remote(), timeout=120)  # untraced warmup outside span
+    with tracing.span("actor-request"):
+        ctx = tracing.current()
+        assert rt.get(a.work.remote(), timeout=120) == 1
+    from ray_tpu.util import profiling
+
+    profiling.flush()
+    time.sleep(0.3)
+    spans = tracing.get_trace(ctx["trace_id"])
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["work"]["parent_id"] == by_name["actor-request"]["span_id"]
+
+
+def test_grafana_dashboard_shape(tmp_path):
+    from ray_tpu.dashboard.grafana import generate_dashboard, write_dashboard
+
+    metrics = [
+        {"name": "app_requests", "description": "requests", "type": "counter"},
+        {"name": "app_depth", "description": "", "type": "gauge"},
+        {"name": "app_latency", "description": "latency", "type": "histogram"},
+    ]
+    dash = generate_dashboard(user_metrics=metrics)
+    assert dash["uid"] == "rt-tpu-cluster"
+    titles = [p["title"] for p in dash["panels"]]
+    assert "Actors by state" in titles
+    exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+    assert "rate(app_requests[1m])" in exprs
+    assert "app_depth" in exprs
+    assert any("histogram_quantile(0.99" in e for e in exprs)
+    # Every panel is wired to the templated prometheus datasource.
+    assert all(
+        p["datasource"]["uid"] == "${datasource}" for p in dash["panels"]
+    )
+    # File output round-trips as JSON.
+    import json
+
+    path = write_dashboard(str(tmp_path / "dash.json"), user_metrics=metrics)
+    assert json.load(open(path))["panels"]
