@@ -226,6 +226,12 @@ def prepare_runtime_env(renv: Optional[dict], client) -> Optional[dict]:
     for name, plugin in _plugins.items():
         if renv.get(name) is not None:
             resolved[name] = plugin.prepare(renv[name], client)
+            # Workers are separate processes: ship the plugin's import
+            # path so apply_runtime_env can load it there (reference:
+            # RAY_RUNTIME_ENV_PLUGINS class-path loading, plugin.py).
+            resolved.setdefault("_plugin_paths", {})[name] = (
+                f"{type(plugin).__module__}:{type(plugin).__qualname__}"
+            )
     if not resolved:
         return None
     resolved["hash"] = compute_env_hash(resolved)
@@ -339,6 +345,15 @@ def apply_runtime_env(resolved: Optional[dict], client) -> None:
         if path not in sys.path:
             sys.path.insert(0, path)
         os.chdir(path)
+    # Load any plugins this env used that aren't registered in this
+    # process (py_modules above may have just made them importable).
+    import importlib
+
+    for name, path in (resolved.get("_plugin_paths") or {}).items():
+        if name not in _plugins:
+            mod_name, _, cls_name = path.partition(":")
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            register_plugin(cls())
     for name, plugin in _plugins.items():
         if resolved.get(name) is not None:
             plugin.apply(resolved[name], client)

@@ -282,3 +282,11 @@ def list_all(storage: Optional[str] = None) -> List[dict]:
 
 def delete(workflow_id: str, storage: Optional[str] = None):
     shutil.rmtree(_wf_dir(workflow_id, storage), ignore_errors=True)
+
+
+from ray_tpu.workflow.virtual_actor import (  # noqa: E402 — needs _root
+    VirtualActorClass,
+    VirtualActorHandle,
+    readonly,
+    virtual_actor,
+)

@@ -244,3 +244,59 @@ def test_pip_env_installs_dependency_driver_lacks(rt_start, tmp_path):
 def test_conda_still_rejected(rt_start):
     with pytest.raises(ValueError, match="conda"):
         RuntimeEnv(conda={"dependencies": ["pip"]})
+
+
+def test_custom_plugin_propagates_to_workers(rt_start, tmp_path):
+    """The RuntimeEnvPlugin seam end-to-end (reference: plugin.py +
+    RAY_RUNTIME_ENV_PLUGINS class-path loading): a plugin registered on
+    the driver ships its import path with the resolved env; the worker
+    imports it from the py_modules package and applies it before user
+    code runs."""
+    moddir = tmp_path / "plugmod"
+    moddir.mkdir()
+    (moddir / "__init__.py").write_text("")
+    (moddir / "marker.py").write_text(
+        "import os\n"
+        "from ray_tpu.runtime_env.runtime_env import RuntimeEnvPlugin\n"
+        "class MarkerPlugin(RuntimeEnvPlugin):\n"
+        "    name = 'marker'\n"
+        "    def prepare(self, value, client):\n"
+        "        return value.upper()  # driver-side transform\n"
+        "    def apply(self, value, client):\n"
+        "        os.environ['RT_MARKER'] = value\n"
+    )
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from plugmod.marker import MarkerPlugin
+
+        from ray_tpu.runtime_env.runtime_env import (
+            _plugins,
+            register_plugin,
+        )
+
+        register_plugin(MarkerPlugin())
+        try:
+
+            @rt.remote
+            def read_marker():
+                import os
+
+                return os.environ.get("RT_MARKER")
+
+            result = rt.get(
+                read_marker.options(
+                    runtime_env={
+                        "py_modules": [str(tmp_path)],
+                        "marker": "hello",
+                    }
+                ).remote(),
+                timeout=120,
+            )
+            # prepare() ran on the driver (upper), apply() in the worker.
+            assert result == "HELLO"
+        finally:
+            _plugins.pop("marker", None)
+    finally:
+        sys.path.remove(str(tmp_path))
