@@ -156,6 +156,7 @@ class Raylet:
         r("client_get_info", self.h_client_get_info)
         r("get_info", self.h_get_info)
         r("prestart_workers", self.h_prestart_workers)
+        r("worker_stacks", self.h_worker_stacks)
 
     # ------------------------------------------------------------------
     _GCS_CHANNELS = ("create_actor", "kill_actor_worker", "reserve_bundle",
@@ -1429,6 +1430,31 @@ class Raylet:
     # driver with no node-local runtime. Here a remote driver holds only
     # TCP connections: puts ship serialized bytes into this raylet's
     # store; gets read size here then stream chunks via fetch_chunk.
+
+    async def h_worker_stacks(self, d, conn):
+        """Collect live thread stacks from every registered worker on this
+        node (the `rt stack` backend; reference: on-demand py-spy dumps
+        via the dashboard reporter agent)."""
+        from ray_tpu._private.protocol import connect as _connect
+
+        out = []
+        for wid, w in list(self.workers.items()):
+            if not w.port:
+                continue
+            try:
+                wconn = await _connect("127.0.0.1", w.port, timeout=5)
+                try:
+                    dump = await asyncio.wait_for(
+                        wconn.call("dump_stacks", {}), 10
+                    )
+                finally:
+                    await wconn.close()
+                out.append(dump)
+            except Exception as e:  # noqa: BLE001 — dead/busy worker
+                out.append({
+                    "worker_id": wid, "error": f"{type(e).__name__}: {e}",
+                })
+        return {"node_id": self.node_id.binary(), "workers": out}
 
     async def h_client_put(self, d, conn):
         oid = ObjectID(d["object_id"])

@@ -80,6 +80,7 @@ class WorkerProcess:
         self.rpc.register("dag_start", self.h_dag_start)
         self.rpc.register("dag_stop", self.h_dag_stop)
         self.rpc.register("ping", self.h_ping)
+        self.rpc.register("dump_stacks", self.h_dump_stacks)
         self._dag_loops: list = []  # (thread, stop_event)
         self.client: Optional[CoreClient] = None
         self.raylet_conn = None
@@ -91,6 +92,29 @@ class WorkerProcess:
         # Actor-call state events (normal-task events are recorded by the
         # raylet; actor calls bypass it, so the receiving worker reports).
         self._task_events: list = []
+
+    async def h_dump_stacks(self, d, conn):
+        """Live thread stacks of this worker (the on-demand profiling
+        role of the reference's dashboard py-spy integration,
+        dashboard/modules/reporter/profile_manager.py — in-process
+        cooperative sampling instead of an external native profiler)."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        threads = []
+        for ident, frame in frames.items():
+            threads.append({
+                "thread": names.get(ident, str(ident)),
+                "stack": "".join(traceback.format_stack(frame)),
+            })
+        return {
+            "pid": os.getpid(),
+            "worker_id": self.worker_id,
+            "actor": bool(self.actor),
+            "threads": threads,
+        }
 
     async def run(self):
         self.loop = asyncio.get_event_loop()

@@ -123,18 +123,44 @@ def decode_step(params, token, cache, cfg: TransformerConfig):
     return _forward_with_cache(params, token[:, None], cache, cfg)
 
 
+def _filter_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but the k highest logits to -inf (compiler-friendly:
+    lax.top_k + threshold compare, no gather/scatter)."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _filter_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest prefix of the probability-
+    sorted vocab whose mass reaches p; mask the rest to -inf."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Position i is kept while the mass BEFORE it is < p (so the token
+    # that crosses p stays included — standard nucleus convention).
+    keep = (cum - probs) < p
+    # Threshold logit = smallest kept sorted logit per row.
+    threshold = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
 def generate(
     params,
     prompt: jax.Array,  # [B, Lp] int32
     cfg: TransformerConfig,
     max_new_tokens: int = 32,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
 ) -> jax.Array:
-    """Greedy (temperature=0) or sampled generation; returns
-    [B, max_new_tokens] generated ids (padded with eos after stopping).
-    The whole decode loop is one compiled lax.scan.
+    """Greedy (temperature=0) or sampled generation with optional top-k /
+    nucleus (top-p) filtering; returns [B, max_new_tokens] generated ids
+    (padded with eos after stopping). The whole decode loop is one
+    compiled lax.scan.
     """
     b, lp = prompt.shape
     if max_new_tokens <= 0:
@@ -147,7 +173,12 @@ def generate(
 
     def pick(logits, key):
         if temperature and temperature > 0.0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
+            logits = logits / temperature
+            if top_k is not None:
+                logits = _filter_top_k(logits, top_k)
+            if top_p is not None and top_p < 1.0:
+                logits = _filter_top_p(logits, top_p)
+            return jax.random.categorical(key, logits, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
     rng, key0 = jax.random.split(rng)

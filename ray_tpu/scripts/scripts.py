@@ -273,6 +273,22 @@ def cmd_memory(args):
         print(f"  {o['object_id'][:16]}  {o['size']:>12} B  on [{locs}]")
 
 
+def cmd_stack(args):
+    """Live thread stacks of every worker (reference: dashboard py-spy
+    on-demand dumps)."""
+    from ray_tpu.util.state import get_worker_stacks
+
+    for w in get_worker_stacks(address=_resolve_address(args)):
+        if "error" in w:
+            print(f"== worker {w.get('worker_id', '?')}: {w['error']}")
+            continue
+        kind = "actor" if w.get("actor") else "worker"
+        print(f"== {kind} pid={w['pid']} node={w['node_id'][:8]}")
+        for t in w["threads"]:
+            print(f"-- thread {t['thread']}")
+            print(t["stack"], end="")
+
+
 def cmd_job(args):
     from ray_tpu.job import job_cli
 
@@ -342,6 +358,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", "-o")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("stack", help="dump live worker thread stacks")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("memory", help="object store usage by object")
     sp.add_argument("--address")

@@ -9,6 +9,7 @@ jobs/placement_groups/workers`` plus ``summarize_tasks``, powering the
 from ray_tpu.util.state.api import (  # noqa: F401
     StateApiClient,
     get_timeline,
+    get_worker_stacks,
     list_actors,
     list_jobs,
     list_nodes,

@@ -76,6 +76,41 @@ class StateApiClient:
             )
         return out
 
+    def worker_stacks(self) -> List[dict]:
+        """Live thread stacks of every worker on every node (the `rt
+        stack` view; reference: dashboard py-spy on-demand profiling)."""
+        import asyncio
+
+        from ray_tpu._private.protocol import connect as _connect
+
+        out: List[dict] = []
+        for n in self.call("get_nodes")["nodes"]:
+            if n["state"] != "ALIVE":
+                continue
+
+            async def _collect(addr=n["address"], port=n["port"]):
+                conn = await _connect(addr, port, timeout=5)
+                try:
+                    return await asyncio.wait_for(
+                        conn.call("worker_stacks", {}), 30
+                    )
+                finally:
+                    await conn.close()
+
+            try:
+                r = self._run_new(_collect(), timeout=40)
+            except Exception as e:  # noqa: BLE001 — node unreachable
+                out.append({"node_id": _hex(n["node_id"]),
+                            "error": f"{type(e).__name__}: {e}"})
+                continue
+            for w in r["workers"]:
+                w = dict(w)
+                w["node_id"] = _hex(n["node_id"])
+                if isinstance(w.get("worker_id"), bytes):
+                    w["worker_id"] = _hex(w["worker_id"])
+                out.append(w)
+        return out
+
     def tasks(self, limit: int = 1000) -> List[dict]:
         events = self.call("list_task_events", {"limit": 100_000})["events"]
         # Collapse the event log into latest-state-per-task
@@ -258,6 +293,11 @@ def list_workers(c):
 @_with_client
 def get_timeline(c):
     return c.timeline()
+
+
+@_with_client
+def get_worker_stacks(c):
+    return c.worker_stacks()
 
 
 @_with_client

@@ -96,3 +96,47 @@ def test_sampled_generation_respects_temperature(setup):
                  rng=jax.random.PRNGKey(8))
     assert a.shape == b.shape == (2, 8)
     assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_k_filter_masks_tail():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.generate import _filter_top_k
+
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+    out = _filter_top_k(logits, 2)
+    # Only the top-2 (5.0, 3.0) survive.
+    assert bool(jnp.isneginf(out[0, 0])) and bool(jnp.isneginf(out[0, 3]))
+    assert out[0, 1] == 5.0 and out[0, 2] == 3.0
+
+
+def test_top_p_keeps_crossing_token():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.generate import _filter_top_p
+
+    # probs ~ [0.643, 0.236, 0.087, 0.032]; p=0.5 keeps only the first
+    # token (its mass crosses 0.5); p=0.7 keeps the first two.
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]])
+    p50 = _filter_top_p(logits, 0.5)
+    assert not bool(jnp.isneginf(p50[0, 0]))
+    assert bool(jnp.isneginf(p50[0, 1]))
+    p70 = _filter_top_p(logits, 0.7)
+    assert not bool(jnp.isneginf(p70[0, 1]))
+    assert bool(jnp.isneginf(p70[0, 2]))
+
+
+def test_top_k1_sampling_equals_greedy(setup):
+    import jax
+
+    from ray_tpu.models.generate import generate
+
+    cfg, params, _ = setup
+    prompt = jax.numpy.asarray([[5, 7, 11]], dtype=jax.numpy.int32)
+    greedy = generate(params, prompt, cfg, max_new_tokens=8)
+    topk1 = generate(
+        params, prompt, cfg, max_new_tokens=8,
+        temperature=1.0, top_k=1, rng=jax.random.PRNGKey(3),
+    )
+    assert (greedy == topk1).all()

@@ -222,3 +222,33 @@ def test_user_profiling_spans_in_timeline(rt_start):
             break
         _time.sleep(0.3)
     assert {"driver-phase", "inner-phase"} <= names, names
+
+
+def test_worker_stacks(rt_start):
+    """`rt stack` backend: live thread stacks from every worker
+    (reference: on-demand py-spy dumps via the reporter agent)."""
+    import time as _time
+
+    from ray_tpu.util.state import get_worker_stacks
+
+    @rt.remote
+    class Sleeper:
+        def busy(self):
+            import time
+
+            time.sleep(5)
+            return 1
+
+    s = Sleeper.remote()
+    ref = s.busy.remote()  # in flight while we sample
+    _time.sleep(0.5)
+    stacks = get_worker_stacks()
+    workers = [w for w in stacks if "threads" in w]
+    assert workers, stacks
+    blob = "\n".join(
+        t["stack"] for w in workers for t in w["threads"]
+    )
+    # The sleeping actor method's frame is visible in some worker.
+    assert "busy" in blob
+    assert all("pid" in w for w in workers)
+    rt.get(ref, timeout=120)
