@@ -1,0 +1,111 @@
+"""Scalability envelope microbenchmarks.
+
+Analog of the reference's release/benchmarks scalability envelope
+(release/benchmarks/README.md: many queued tasks, many actors, many
+object args, many objects per get, object broadcast across nodes) scaled
+to a single CI host. Writes BENCH_SCALE.json and prints one JSON line
+per probe.
+
+Run: python bench_scale.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu as rt
+
+
+def probe(name, fn, results):
+    t0 = time.perf_counter()
+    extra = fn() or {}
+    dt = time.perf_counter() - t0
+    entry = {"probe": name, "wall_s": round(dt, 2), **extra}
+    print(json.dumps(entry), flush=True)
+    results.append(entry)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    results = []
+    rt.init(num_cpus=4, object_store_memory=1 << 30)
+
+    @rt.remote
+    def noop(x=None):
+        return 0
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return 0
+
+    # Warm the worker pool.
+    rt.get([noop.remote() for _ in range(8)])
+
+    # 1. Tasks queued on one node at once (reference envelope: 1M on a
+    # 64-core box; scaled to the 1-core CI host).
+    n_tasks = 2_000 if quick else 10_000
+    probe(
+        f"{n_tasks} queued tasks drain",
+        lambda: (
+            rt.get([noop.remote() for _ in range(n_tasks)], timeout=1200),
+            {"tasks": n_tasks},
+        )[1],
+        results,
+    )
+
+    # 2. Many live actors (reference envelope: 40k cluster-wide).
+    n_actors = 50 if quick else 200
+    def many_actors():
+        actors = [A.options(num_cpus=0.001).remote() for _ in range(n_actors)]
+        rt.get([a.ping.remote() for a in actors], timeout=1200)
+        for a in actors:
+            rt.kill(a)
+        return {"actors": n_actors}
+    probe(f"{n_actors} actors created+called", many_actors, results)
+
+    # 3. Many objects in one rt.get (reference envelope: 10k plasma
+    # objects per get).
+    n_objs = 2_000 if quick else 10_000
+    def many_objects():
+        refs = [rt.put(i) for i in range(n_objs)]
+        out = rt.get(refs, timeout=1200)
+        assert out[-1] == n_objs - 1
+        return {"objects": n_objs}
+    probe(f"{n_objs} objects in one get", many_objects, results)
+
+    # 4. Many object args to a single task (reference envelope: 10k args).
+    n_args = 500 if quick else 2_000
+    @rt.remote
+    def count_args(*args):
+        return len(args)
+    def many_args():
+        refs = [rt.put(i) for i in range(n_args)]
+        assert rt.get(count_args.remote(*refs), timeout=1200) == n_args
+        return {"args": n_args}
+    probe(f"{n_args} object args to one task", many_args, results)
+
+    # 5. Large-object broadcast to every worker (reference envelope: 1GiB
+    # broadcast to 50 nodes; here: 64MB to the worker pool).
+    blob = np.zeros(64 * 1024 * 1024 // 8)
+    @rt.remote
+    def touch(x):
+        return x.nbytes
+    def broadcast():
+        ref = rt.put(blob)
+        sizes = rt.get([touch.remote(ref) for _ in range(8)], timeout=1200)
+        assert all(s == blob.nbytes for s in sizes)
+        return {"mb": blob.nbytes >> 20, "consumers": 8}
+    probe("64MB broadcast to 8 tasks", broadcast, results)
+
+    rt.shutdown()
+    with open("BENCH_SCALE.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
