@@ -61,6 +61,12 @@ class TransformerConfig:
     # Pipeline parallelism: microbatches per step when the mesh has pp>1
     # (0 = auto: 2*stages when the batch divides, else stages, else 1).
     pp_microbatches: int = 0
+    # Chunked lm_head + cross-entropy: compute the loss in sequence
+    # chunks of this many tokens so the full [B, S, vocab] logits tensor
+    # (1.5GB at the 0.8B bench shape) is never materialized — the
+    # backward recomputes each chunk's logits (~3% extra FLOPs) in
+    # exchange for the freed HBM. 0 = off (single fused matmul).
+    ce_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -244,13 +250,18 @@ def forward(
     cfg: TransformerConfig,
     mesh=None,
     positions: Optional[jax.Array] = None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Returns (logits [B, L, vocab], aux_loss scalar)."""
+    """Returns (logits [B, L, vocab], aux_loss scalar); with
+    return_hidden, the pre-lm_head hidden states [B, L, D] instead of
+    logits (the chunked-CE loss applies lm_head itself)."""
     x = params["embed"][tokens].astype(cfg.dtype)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     body = _layer_fn(cfg, mesh, cos, sin, positions)
     x, auxes = jax.lax.scan(body, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    if return_hidden:
+        return x, auxes.sum()
     logits = x @ params["lm_head"]
     return logits, auxes.sum()
 
@@ -339,10 +350,19 @@ def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None,
     backward differentiates straight through it (static-bound scan), which
     is what makes MeshConfig(pp=...) a real training capability.
     """
+    labels = tokens[:, 1:]
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
         logits, aux = forward_pipelined(params, tokens[:, :-1], cfg, mesh)
+    elif cfg.ce_chunk:
+        from ray_tpu.ops.cross_entropy import chunked_lm_head_ce
+
+        hidden, aux = forward(params, tokens[:, :-1], cfg, mesh,
+                              return_hidden=True)
+        loss = chunked_lm_head_ce(
+            hidden, params["lm_head"], labels, cfg.ce_chunk
+        )
+        return loss + aux_weight * aux
     else:
         logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
-    labels = tokens[:, 1:]
     loss = softmax_cross_entropy(logits, labels).mean()
     return loss + aux_weight * aux

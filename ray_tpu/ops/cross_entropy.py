@@ -29,6 +29,35 @@ def _ce_forward(logits, labels):
     return lse - label_logit, lse
 
 
+def chunked_lm_head_ce(hidden: jax.Array, lm_head: jax.Array,
+                       labels: jax.Array, chunk: int) -> jax.Array:
+    """Mean next-token loss computing lm_head logits CHUNK tokens at a
+    time, so the full [B, S, vocab] tensor never exists in HBM.
+
+    hidden: [B, S, D] final hidden states; lm_head: [D, V]; labels [B, S].
+    Each chunk's matmul + softmax-CE runs under jax.checkpoint: the
+    backward recomputes that chunk's logits (one extra lm_head forward,
+    ~3% of step FLOPs at Llama shapes) instead of keeping them alive.
+    The scan over chunks keeps peak logits memory at B*chunk*V.
+    """
+    b, s, d = hidden.shape
+    if s % chunk != 0:
+        raise ValueError(f"seq {s} not divisible by ce_chunk {chunk}")
+    n = s // chunk
+    xs = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)   # [n, B, chunk, D]
+    ys = labels.reshape(b, n, chunk).swapaxes(0, 1)      # [n, B, chunk]
+
+    @jax.checkpoint
+    def body(acc, xy):
+        x, y = xy
+        logits = x @ lm_head
+        loss, _ = _ce_forward(logits, y)
+        return acc + loss.sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (xs, ys))
+    return total / (b * s)
+
+
 def _ce_fwd(logits, labels, chunk):
     loss, lse = _ce_forward(logits, labels)
     return loss, (logits, labels, lse)

@@ -255,3 +255,27 @@ def test_cross_entropy_grad():
     )(logits)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_chunked_lm_head_ce_parity():
+    """Chunked lm_head+CE (never materializes full logits) matches the
+    fused full-logits loss in value AND gradient."""
+    from dataclasses import replace
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import configs, init_params, loss_fn
+
+    cfg = replace(configs.tiny, max_seq=64, remat=False, dtype=jax.numpy.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                cfg.vocab_size)
+    l_full, g_full = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+    cfg_c = replace(cfg, ce_chunk=8)
+    l_chunk, g_chunk = jax.value_and_grad(loss_fn)(params, tokens, cfg_c)
+    np.testing.assert_allclose(float(l_full), float(l_chunk), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_chunk)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
