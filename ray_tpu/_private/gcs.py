@@ -376,15 +376,39 @@ class GcsServer:
         return {"nodes": out}
 
     async def h_resource_update(self, d, conn):
-        """Raylet pushes its resource view delta (ray_syncer analog)."""
+        """Raylet pushes its resource view (ray_syncer analog:
+        versioned deltas with gap detection; full maps as fallback).
+
+        A version gap — anything other than last+1 on a delta — means
+        this GCS missed state (restart, dropped ack): reply need_full so
+        the raylet rebases with its whole view. Version 1 with a full map
+        establishes (or re-establishes) the baseline.
+        """
         info = self.nodes.get(d["node_id"])
-        if info:
-            info["resources_available"] = d["available"]
-            if "total" in d:
-                info["resources_total"] = d["total"]
-            if "demand_bundles" in d:
-                info["demand_bundles"] = d["demand_bundles"]
-            info["last_heartbeat"] = time.monotonic()
+        if not info:
+            # Unknown node (GCS restarted before re-registration): the
+            # raylet must re-register; meanwhile ask for a full view.
+            return {"ok": False, "need_full": True}
+        ver = d.get("version")
+        full = "available" in d
+        if ver is not None and not full:
+            expected = info.get("sync_version")
+            if expected is None or ver != expected + 1:
+                return {"ok": False, "need_full": True}
+        if full:
+            info["resources_available"] = dict(d["available"])
+        else:
+            avail = info["resources_available"]
+            avail.update(d.get("delta", {}))
+            for k in d.get("removed", ()):
+                avail.pop(k, None)
+        if ver is not None:
+            info["sync_version"] = ver
+        if "total" in d:
+            info["resources_total"] = d["total"]
+        if "demand_bundles" in d:
+            info["demand_bundles"] = d["demand_bundles"]
+        info["last_heartbeat"] = time.monotonic()
         return {"ok": True}
 
     async def h_drain_node(self, d, conn):

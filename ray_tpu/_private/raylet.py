@@ -117,6 +117,10 @@ class Raylet:
         # across a dispatch pass (items in the pass-local requeue list are
         # still here), so heartbeats report true demand.
         self._queued_specs: Dict[bytes, Dict[str, float]] = {}
+        # ray_syncer-style delta sync state (_sync_resources).
+        self._sync_version = 0
+        self._synced_resources: Optional[Dict[str, float]] = None
+        self._synced_demand_sig: Optional[int] = None
         self._infeasible_warned: set = set()
         self._queued_since: Dict[bytes, float] = {}
         self._spilled: Dict[bytes, str] = {}  # oid -> restore uri
@@ -1752,6 +1756,50 @@ class Raylet:
             )
         return records, commits
 
+    async def _sync_resources(self, demand):
+        """Versioned delta sync of this node's resource view
+        (ray_syncer analog: common/ray_syncer/ray_syncer.h delta-syncs
+        per-node views instead of broadcasting full state).
+
+        Only resource entries that changed since the last acknowledged
+        sync ride the wire, under a monotonically increasing version; the
+        GCS detects gaps (its restart, a missed ack) and replies
+        need_full, which resets the baseline so the next beat carries the
+        whole view. Demand bundles ship only when they changed.
+        """
+        self._sync_version += 1
+        payload = {
+            "node_id": self.node_id.binary(),
+            "version": self._sync_version,
+        }
+        avail = dict(self.resources_available)
+        if self._synced_resources is None:
+            payload["available"] = avail
+        else:
+            delta = {
+                k: v for k, v in avail.items()
+                if self._synced_resources.get(k) != v
+            }
+            removed = [k for k in self._synced_resources if k not in avail]
+            if delta:
+                payload["delta"] = delta
+            if removed:
+                payload["removed"] = removed
+        demand_sig = hash(
+            tuple(tuple(sorted(b.items())) for b in demand)
+        )
+        if demand_sig != self._synced_demand_sig:
+            payload["demand_bundles"] = demand
+        r = await self.gcs.call("resource_update", payload)
+        if r.get("need_full"):
+            # Gap on the GCS side (restart / lost state): resend the full
+            # view on the next heartbeat.
+            self._synced_resources = None
+            self._synced_demand_sig = None
+        else:
+            self._synced_resources = avail
+            self._synced_demand_sig = demand_sig
+
     async def _heartbeat_loop(self):
         cfg = get_config()
         while True:
@@ -1778,14 +1826,7 @@ class Raylet:
                 # across a dispatch pass (unlike task_queue, whose items
                 # sit in a pass-local requeue list during awaits).
                 demand = list(self._queued_specs.values())[:64]
-                await self.gcs.call(
-                    "resource_update",
-                    {
-                        "node_id": self.node_id.binary(),
-                        "available": self.resources_available,
-                        "demand_bundles": demand,
-                    },
-                )
+                await self._sync_resources(demand)
                 if self._task_events:
                     events, self._task_events = self._task_events, []
                     try:
