@@ -85,6 +85,14 @@ class GcsServer:
         self._started = asyncio.Event()
         self._stopping = False
         self._health_task: Optional[asyncio.Task] = None
+        # Per-component runtime metrics (stats/metric_defs.h role): RPC
+        # volume by method, exported through gcs_stats -> /metrics.
+        self.rpc_counts: Dict[str, int] = defaultdict(int)
+        self.rpc.on_request = (
+            lambda method: self.rpc_counts.__setitem__(
+                method, self.rpc_counts[method] + 1
+            )
+        )
 
         r = self.rpc.register
         # kv
@@ -137,6 +145,7 @@ class GcsServer:
         # metrics (stats agent + prometheus_exporter analog)
         r("metrics_report", self.h_metrics_report)
         r("metrics_snapshot", self.h_metrics_snapshot)
+        r("gcs_stats", self.h_gcs_stats)
         # misc
         r("ping", self.h_ping)
 
@@ -374,6 +383,23 @@ class GcsServer:
         for info in self.nodes.values():
             out.append({k: v for k, v in info.items() if k != "last_heartbeat"})
         return {"nodes": out}
+
+    async def h_gcs_stats(self, d, conn):
+        """GCS-internal runtime metrics (per-component stats, the
+        stats/metric_defs.h role): rpc volume by method + table sizes."""
+        return {
+            "rpc_counts": dict(self.rpc_counts),
+            "nodes_alive": sum(
+                1 for n in self.nodes.values() if n["state"] == "ALIVE"
+            ),
+            "kv_entries": sum(len(t) for t in self.kv.values()),
+            "task_events": len(self.task_events),
+            "subscriber_conns": sum(
+                len(s) for s in self.subscribers.values()
+            ),
+            "object_dir_entries": len(self.object_dir),
+            "placement_groups": len(self.placement_groups),
+        }
 
     async def h_resource_update(self, d, conn):
         """Raylet pushes its resource view (ray_syncer analog:

@@ -184,6 +184,10 @@ class RpcServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self.connections: set[ServerConnection] = set()
         self.on_disconnect: Optional[Callable[[ServerConnection], Awaitable[None]]] = None
+        # Per-method request counter hook (the stats/metric_defs.h role:
+        # per-component rpc volume metrics). Called synchronously with
+        # the method name before dispatch.
+        self.on_request: Optional[Callable[[str], None]] = None
 
     def register(self, method: str, handler: Handler):
         self.handlers[method] = handler
@@ -220,6 +224,8 @@ class RpcServer:
     async def _dispatch(self, conn: ServerConnection, frame):
         cid = frame.get("i", 0)
         method = frame.get("m")
+        if self.on_request is not None:
+            self.on_request(method)
         handler = self.handlers.get(method)
         if handler is None:
             if cid:

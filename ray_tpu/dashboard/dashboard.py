@@ -294,6 +294,18 @@ class Dashboard:
         for s, c in states.items():
             lines.append(f'rt_actors{{state="{s}"}} {c}')
 
+        # GCS-internal runtime metrics (per-component stats).
+        stats = await self.gcs.call("gcs_stats", {})
+        lines.append("# TYPE rt_gcs_rpc_total counter")
+        for method, count in sorted(stats["rpc_counts"].items()):
+            lines.append(
+                f'rt_gcs_rpc_total{{method="{_prom_escape(method)}"}} {count}'
+            )
+        for gauge in ("kv_entries", "task_events", "subscriber_conns",
+                      "object_dir_entries", "placement_groups"):
+            lines.append(f"# TYPE rt_gcs_{gauge} gauge")
+            lines.append(f"rt_gcs_{gauge} {stats[gauge]}")
+
         # User metrics (util/metrics.py) from the GCS aggregate.
         snapshot = (await self.gcs.call("metrics_snapshot", {}))["metrics"]
         for m in snapshot:
