@@ -952,7 +952,11 @@ class CoreClient:
             except ConnectionLost:
                 result = {"status": "worker_crashed", "error": "raylet connection lost"}
             status = result.get("status")
-            if status == "worker_crashed" and attempt < retries:
+            # max_retries=-1 = retry worker crashes forever (reference
+            # semantics; data tasks are idempotent and use it).
+            if status == "worker_crashed" and (
+                retries < 0 or attempt < retries
+            ):
                 attempt += 1
                 await asyncio.sleep(min(0.1 * attempt, 1.0))
                 continue

@@ -158,14 +158,14 @@ class Dataset:
         left = self.materialize()
         right = other.materialize()
         lrefs, rrefs = left._input_refs, right._input_refs
-        count_fn = rt.remote(_block_count)
+        count_fn = rt.remote(_block_count).options(max_retries=-1)
         lc = rt.get([count_fn.remote(r) for r in lrefs])
         rc = rt.get([count_fn.remote(r) for r in rrefs])
         if sum(lc) != sum(rc):
             raise ValueError(
                 f"zip requires equal lengths, got {sum(lc)} vs {sum(rc)}"
             )
-        zip_fn = rt.remote(_zip_blocks)
+        zip_fn = rt.remote(_zip_blocks).options(max_retries=-1)
         if lc == rc:
             return Dataset(
                 [zip_fn.remote(a, b) for a, b in zip(lrefs, rrefs)]
@@ -187,7 +187,7 @@ class Dataset:
         from ray_tpu.data import aggregate as A
 
         aggs = list(aggs)
-        fn = rt.remote(A.partial_states)
+        fn = rt.remote(A.partial_states).options(max_retries=-1)
         state_refs = [fn.remote(ref, aggs) for ref in self._executed_refs()]
         values = A.merge_states(rt.get(state_refs), aggs)
         return {agg.name: v for agg, v in zip(aggs, values)}
@@ -220,7 +220,7 @@ class Dataset:
     def unique(self, column: str) -> List[Any]:
         """Distinct values of a column (reference: Dataset.unique) —
         per-block distinct sets in remote tasks, union on the driver."""
-        fn = rt.remote(_distinct_block)
+        fn = rt.remote(_distinct_block).options(max_retries=-1)
         sets = rt.get([fn.remote(ref, column) for ref in self._executed_refs()])
         out = set()
         for s in sets:
@@ -371,11 +371,11 @@ class Dataset:
         scales with the cluster.
         """
         refs = self.materialize()._input_refs
-        count_fn = rt.remote(_block_count)
+        count_fn = rt.remote(_block_count).options(max_retries=-1)
         counts = rt.get([count_fn.remote(r) for r in refs])
         total = sum(counts)
         boundaries = [total * i // n for i in range(n + 1)]
-        slice_fn = rt.remote(_slice_block)
+        slice_fn = rt.remote(_slice_block).options(max_retries=-1)
         shard_refs: List[List] = [[] for _ in range(n)]
         offset = 0  # global row index of the current block's first row
         for ref, c in zip(refs, counts):
@@ -469,7 +469,7 @@ class GroupedData:
     def _shuffled_partitions(self) -> List:
         refs = self.ds.materialize()._input_refs
         n = max(len(refs), 1)
-        map_fn = rt.remote(_hash_partition_block)
+        map_fn = rt.remote(_hash_partition_block).options(max_retries=-1)
         pieces: List[List] = []
         for ref in refs:
             out = map_fn.options(num_returns=n).remote(ref, n, self.key)
@@ -477,7 +477,7 @@ class GroupedData:
         return [[pieces[i][j] for i in range(len(refs))] for j in range(n)]
 
     def _reduce(self, reduce_fn, *args) -> Dataset:
-        rfn = rt.remote(reduce_fn)
+        rfn = rt.remote(reduce_fn).options(max_retries=-1)
         out = [
             rfn.remote(self.key, *args, *partition)
             for partition in self._shuffled_partitions()
@@ -619,8 +619,8 @@ def _push_shuffle(refs: List, n_out: int, mode: str, map_key, reduce_key,
     if not refs:
         return refs
     n_out = max(n_out, 1)
-    map_fn = rt.remote(_shuffle_map_block)
-    reduce_fn = rt.remote(_shuffle_reduce)
+    map_fn = rt.remote(_shuffle_map_block).options(max_retries=-1)
+    reduce_fn = rt.remote(_shuffle_reduce).options(max_retries=-1)
     pieces: List[List] = []  # [map][partition] -> ref
     for i, ref in enumerate(refs):
         out = map_fn.options(num_returns=n_out).remote(
@@ -648,7 +648,7 @@ def _sort_refs(refs: List, key: str, descending: bool) -> List:
     n = max(len(refs), 1)
     # Sample keys from every block to pick n-1 partition boundaries
     # (all sample tasks in flight at once; one batched get).
-    sample_fn = rt.remote(_sample_keys)
+    sample_fn = rt.remote(_sample_keys).options(max_retries=-1)
     sample_refs = [sample_fn.remote(ref, key, 16) for ref in refs]
     samples: List = [s for chunk in rt.get(sample_refs) for s in chunk]
     samples.sort()

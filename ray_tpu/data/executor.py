@@ -105,9 +105,11 @@ class StreamingExecutor:
         number of blocks in flight (the backpressure window)."""
         remote_fns = []
         for st in stages:
+            # Block transforms are deterministic + idempotent: retry
+            # worker crashes forever (the reference's data-task default).
             f = rt.remote(
                 _apply_block_fn_indexed if st.with_index else _apply_block_fn
-            )
+            ).options(max_retries=-1)
             if st.resources:
                 f = f.options(resources=st.resources)
             remote_fns.append((f, st.fn, st.with_index))

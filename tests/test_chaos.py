@@ -190,3 +190,61 @@ def test_gcs_restart_during_task_storm(tmp_path):
         assert rt.get(work.remote(99), timeout=60) == 99
     finally:
         cluster.shutdown()
+
+
+def test_worker_kills_during_distributed_shuffle(tmp_path):
+    """SIGKILL workers while a push-based shuffle + hash groupby runs:
+    task retries and lineage reconstruction must still produce exact
+    aggregates (the nightly shuffle chaos test's assertion, scaled
+    down)."""
+    cluster = Cluster()
+    head = cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        from ray_tpu import data as rtd
+
+        stop = threading.Event()
+
+        def killer(max_kills: int = 6):
+            # Bounded like the reference's chaos windows: sustained
+            # adversarial kills on a 2-worker node can suppress liveness
+            # forever; recovery (not starvation) is what's under test.
+            kills = 0
+            while not stop.is_set() and kills < max_kills:
+                time.sleep(0.4)
+                victims = [
+                    w for w in head.workers.values()
+                    if w.proc is not None and w.conn is not None
+                    and w.actor_id is None
+                ]
+                for w in victims[:1]:
+                    try:
+                        os.kill(w.proc.pid, signal.SIGKILL)
+                        kills += 1
+                    except (ProcessLookupError, TypeError):
+                        pass
+
+        t = threading.Thread(target=killer, daemon=True)
+        t.start()
+        try:
+            ds = rtd.from_items(
+                [{"k": i % 5, "v": float(i)} for i in range(500)],
+                parallelism=8,
+            )
+            rows = (
+                ds.map(lambda r: {"k": r["k"], "v": r["v"] * 2})
+                .random_shuffle(seed=7)
+                .groupby("k")
+                .sum("v")
+                .take_all()
+            )
+        finally:
+            stop.set()
+            t.join()
+        got = {r["k"]: r["sum(v)"] for r in rows}
+        want = {}
+        for i in range(500):
+            want[i % 5] = want.get(i % 5, 0.0) + 2.0 * i
+        assert got == want
+    finally:
+        cluster.shutdown()
