@@ -80,11 +80,16 @@ class CheckpointManager:
         num_to_keep: Optional[int] = None,
         score_attribute: Optional[str] = None,
         score_order: str = "max",
+        storage=None,
     ):
         self.directory = directory
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
+        # Optional StorageContext: registered checkpoints also persist to
+        # the run's storage_path URI (reference: per-rank upload through
+        # StorageContext, train/_internal/storage.py:348).
+        self.storage = storage
         self.registered: List[Dict] = []
         os.makedirs(directory, exist_ok=True)
         self._index = 0
@@ -95,7 +100,15 @@ class CheckpointManager:
         return path
 
     def register(self, checkpoint: Checkpoint, metrics: Dict) -> None:
-        self.registered.append({"checkpoint": checkpoint, "metrics": metrics})
+        entry = {"checkpoint": checkpoint, "metrics": metrics}
+        if self.storage is not None:
+            try:
+                entry["uri"] = self.storage.persist(
+                    checkpoint, os.path.basename(checkpoint.path)
+                )
+            except Exception as e:  # noqa: BLE001 — storage outage must
+                entry["uri_error"] = str(e)  # not kill the training loop
+        self.registered.append(entry)
         self._enforce_retention()
         self._write_index()
 
@@ -135,7 +148,8 @@ class CheckpointManager:
 
     def _write_index(self):
         index = [
-            {"path": e["checkpoint"].path, "metrics": _json_safe(e["metrics"])}
+            {"path": e["checkpoint"].path, "metrics": _json_safe(e["metrics"]),
+             **({"uri": e["uri"]} if "uri" in e else {})}
             for e in self.registered
         ]
         with open(os.path.join(self.directory, "checkpoints.json"), "w") as f:

@@ -69,9 +69,23 @@ class RunConfig:
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:
+        """Local working directory for the run. A URI storage_path
+        (file://, s3://, ...) persists through StorageContext instead;
+        local scratch still lives under ~/ray_tpu_results."""
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        if "://" in base:
+            base = os.path.expanduser("~/ray_tpu_results")
         name = self.name or "run"
         return os.path.join(base, name)
+
+    def storage_context(self):
+        """StorageContext for a URI storage_path, else None (reference:
+        StorageContext resolution in train/_internal/storage.py:348)."""
+        if self.storage_path and "://" in self.storage_path:
+            from ray_tpu.train.storage import StorageContext
+
+            return StorageContext(self.storage_path, self.name or "run")
+        return None
 
 
 @dataclass

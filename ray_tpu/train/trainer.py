@@ -119,6 +119,7 @@ class DataParallelTrainer(BaseTrainer):
             num_to_keep=ckpt_config.num_to_keep,
             score_attribute=ckpt_config.checkpoint_score_attribute,
             score_order=ckpt_config.checkpoint_score_order,
+            storage=self.run_config.storage_context(),
         )
         self._latest_checkpoint = None
 

@@ -215,3 +215,61 @@ def test_async_checkpointer_overlaps_and_restores(tmp_path):
         assert float(r2["w"][0, 1]) == 2.0 and int(r2["step"]) == 8
     finally:
         ck.close()
+
+
+def test_storage_context_roundtrip(tmp_path):
+    """Checkpoints persist to a storage URI via pyarrow.fs and download
+    back intact (reference: StorageContext, train/_internal/storage.py)."""
+    import os
+
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.storage import StorageContext
+
+    src = tmp_path / "local_ckpt"
+    (src / "nested").mkdir(parents=True)
+    (src / "weights.bin").write_bytes(b"\x00\x01\x02" * 100)
+    (src / "nested" / "meta.json").write_text('{"step": 7}')
+
+    storage = StorageContext(f"file://{tmp_path}/remote", "exp1")
+    uri = storage.persist(Checkpoint.from_directory(str(src)), "ckpt_000")
+    assert uri.endswith("exp1/ckpt_000")
+    assert storage.list_checkpoints() == ["ckpt_000"]
+
+    back = storage.download("ckpt_000", str(tmp_path / "dl"))
+    assert open(os.path.join(back.path, "weights.bin"), "rb").read() == \
+        b"\x00\x01\x02" * 100
+    assert "step" in open(
+        os.path.join(back.path, "nested", "meta.json")
+    ).read()
+
+
+def test_trainer_persists_to_storage_uri(rt_start, tmp_path):
+    """A URI storage_path makes the trainer upload every registered
+    checkpoint; the run itself works from local scratch."""
+    import json
+    import os
+
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+    from ray_tpu.train.storage import StorageContext
+
+    def loop(config):
+        from ray_tpu import train as train_mod
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        ckpt = Checkpoint.from_dict({"w": 1})
+        train_mod.report({"loss": 0.5}, checkpoint=ckpt)
+
+    uri = f"file://{tmp_path}/bucket"
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="sp-test", storage_path=uri),
+    ).fit()
+    assert result.error is None
+    names = StorageContext(uri, "sp-test").list_checkpoints()
+    assert names, "no checkpoint persisted to the storage URI"
+    back = StorageContext(uri, "sp-test").download(names[-1])
+    from ray_tpu.train.checkpoint import Checkpoint as C
+
+    assert C.from_directory(back.path).to_dict() == {"w": 1}
