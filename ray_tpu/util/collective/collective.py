@@ -25,6 +25,7 @@ import numpy as np
 from ray_tpu._private import worker as worker_mod
 from ray_tpu.util.collective.dcn_group import DcnGroup
 from ray_tpu.util.collective.types import Backend, ReduceOp
+from ray_tpu.util.collective.hier_group import HierarchicalGroup
 from ray_tpu.util.collective.xla_group import XlaLocalGroup
 
 
@@ -46,6 +47,11 @@ class GroupManager:
             group = DcnGroup(client, world_size, rank, group_name)
         elif backend == Backend.XLA:
             group = XlaLocalGroup(world_size if world_size > 0 else None)
+        elif backend == Backend.HIER:
+            from ray_tpu.util.collective.hier_group import HierarchicalGroup
+
+            client = worker_mod.get_client()
+            group = HierarchicalGroup(client, world_size, rank, group_name)
         else:
             raise ValueError(backend)
         with self._lock:
@@ -136,7 +142,7 @@ def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     """In-place-style allreduce (reference :258). Returns the reduced value
     (numpy for DCN; device arrays for XLA)."""
     g = _manager.get(group_name)
-    if isinstance(g, XlaLocalGroup):
+    if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
         return g.allreduce(tensor, op)
     return g.allreduce(_as_numpy(tensor), op)
 
@@ -149,21 +155,21 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
-    if isinstance(g, XlaLocalGroup):
+    if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
         return g.broadcast(tensor, src_rank)
     return g.broadcast(_as_numpy(tensor), src_rank)
 
 
 def allgather(tensor, group_name: str = "default"):
     g = _manager.get(group_name)
-    if isinstance(g, XlaLocalGroup):
+    if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
         return g.allgather(tensor)
     return g.allgather(_as_numpy(tensor))
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     g = _manager.get(group_name)
-    if isinstance(g, XlaLocalGroup):
+    if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
         return g.reducescatter(tensor, op)
     return g.reducescatter(_as_numpy(tensor), op)
 

@@ -10,6 +10,9 @@ ReduceOp). The reference ships NCCL and GLOO; the TPU-native backends are:
     (the role gloo plays for the reference's CPU path; on TPU pods this is
     the DCN control path). Rendezvous goes through the GCS KV, as the
     reference's gloo backend does (gloo_util.py:271 RayInternalKvStore).
+  * "hier": two-tier composition — XLA over local devices (ICI), then a
+    DCN ring across processes with ONE copy per process on the slow tier
+    (the multi-slice allreduce schedule; see hier_group.py).
 """
 
 from __future__ import annotations
@@ -20,6 +23,7 @@ from enum import Enum
 class Backend(str, Enum):
     XLA = "xla"
     DCN = "dcn"
+    HIER = "hier"
 
     @classmethod
     def validate(cls, value: str) -> "Backend":

@@ -128,3 +128,65 @@ def test_xla_local_max():
         assert np.allclose(np.asarray(outs[0]), 7.0)
     finally:
         col.destroy_collective_group("xla_m")
+
+
+@rt.remote(num_cpus=0.5)
+class HierWorker:
+    """A process in a hierarchical (xla-local + dcn-cross) group; its
+    "devices" are the virtual CPU mesh the conftest configures."""
+
+    def init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        self.col = col
+        self.rank = rank
+        self.group = col.init_collective_group(
+            world_size, rank, backend, group_name
+        )
+        return self.group.local.world_size
+
+    def do_allreduce(self, group_name="hier"):
+        import numpy as np
+
+        n_local = self.group.local.world_size
+        # Device d of process r contributes r * n_local + d (global rank).
+        tensors = [
+            np.full(4, float(self.rank * n_local + d)) for d in range(n_local)
+        ]
+        out = self.col.allreduce(tensors, group_name)
+        return [np.asarray(o) for o in out]
+
+    def do_broadcast(self, group_name="hier"):
+        import numpy as np
+
+        n_local = self.group.local.world_size
+        val = 99.0 if self.rank == 0 else 0.0
+        out = self.col.broadcast(
+            [np.full(2, val) for _ in range(n_local)], 0, group_name
+        )
+        return np.asarray(out[-1])
+
+
+def test_hierarchical_allreduce_and_broadcast(rt_start):
+    """Two processes x N local devices: the hierarchical allreduce equals
+    the flat sum over all 2N global ranks, with one DCN crossing per
+    process (the multi-slice two-tier schedule)."""
+    from ray_tpu.util import collective as col
+
+    workers = [HierWorker.remote() for _ in range(2)]
+    n_locals = rt.get([
+        w.init_collective.remote(2, r, "hier", "hier")
+        for r, w in enumerate(workers)
+    ], timeout=300)
+    assert n_locals[0] == n_locals[1] and n_locals[0] >= 1
+    n_local = n_locals[0]
+    outs = rt.get([w.do_allreduce.remote() for w in workers], timeout=300)
+    total_ranks = 2 * n_local
+    want = float(sum(range(total_ranks)))  # sum of all global ranks
+    for per_process in outs:
+        for per_device in per_process:
+            np.testing.assert_allclose(per_device, np.full(4, want))
+
+    bcast = rt.get([w.do_broadcast.remote() for w in workers], timeout=300)
+    for b in bcast:
+        np.testing.assert_allclose(b, np.full(2, 99.0))
