@@ -24,7 +24,6 @@ def main():
         .training(lr=3e-3, updates_per_iteration=8, rollouts_per_update=2)
         .build()
     )
-    rollouts = []
     for i in range(6):
         result = algo.train()
         print(
@@ -36,8 +35,7 @@ def main():
         if result["episode_return_mean"] >= 100.0:
             break
     # Harvest one more round of experience for the offline stage.
-    ready, _ = rt.wait(list(algo._pending), num_returns=2, timeout=120)
-    rollouts = rt.get(ready)
+    rollouts = algo.pending_rollouts(num=2)
     algo.stop()
 
     # Offline: clone the final policy's behavior from the collected data.

@@ -224,7 +224,9 @@ class RpcServer:
     async def _dispatch(self, conn: ServerConnection, frame):
         cid = frame.get("i", 0)
         method = frame.get("m")
-        if self.on_request is not None:
+        # Count only known methods: a malformed/unknown frame must not
+        # plant unbounded (or None) keys in the metrics table.
+        if self.on_request is not None and method in self.handlers:
             self.on_request(method)
         handler = self.handlers.get(method)
         if handler is None:

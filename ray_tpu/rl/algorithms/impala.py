@@ -232,6 +232,21 @@ class IMPALA:
             **{f"learner/{k}": v for k, v in metrics.items()},
         }
 
+    def pending_rollouts(self, num: int = 1, timeout: float = 120.0):
+        """Harvest up to `num` completed rollouts from the standing
+        sample pipeline without consuming them for training — e.g. to
+        export experience to an offline dataset. Harvested runners are
+        resubmitted so the pipeline keeps flowing."""
+        ready, _ = rt.wait(
+            list(self._pending), num_returns=min(num, len(self._pending)),
+            timeout=timeout,
+        )
+        rollouts = rt.get(ready, timeout=timeout)
+        for ref in ready:
+            runner = self._pending.pop(ref)
+            self._pending[runner.sample.remote()] = runner
+        return rollouts
+
     def stop(self):
         self.learner_group.shutdown()
         for r in self.env_runners:

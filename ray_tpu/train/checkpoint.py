@@ -93,6 +93,7 @@ class CheckpointManager:
         self.registered: List[Dict] = []
         os.makedirs(directory, exist_ok=True)
         self._index = 0
+        self._uploaded = 0  # sequential storage names: ordering is meaning
 
     def next_checkpoint_path(self) -> str:
         path = os.path.join(self.directory, f"checkpoint_{self._index:06d}")
@@ -102,10 +103,15 @@ class CheckpointManager:
     def register(self, checkpoint: Checkpoint, metrics: Dict) -> None:
         entry = {"checkpoint": checkpoint, "metrics": metrics}
         if self.storage is not None:
+            # Sequential names: a local checkpoint dir may be a random
+            # tempdir (Checkpoint.from_dict), whose basename would make
+            # list_checkpoints() ordering — and "latest" selection —
+            # arbitrary.
+            name = f"checkpoint_{self._uploaded:06d}"
+            self._uploaded += 1
             try:
-                entry["uri"] = self.storage.persist(
-                    checkpoint, os.path.basename(checkpoint.path)
-                )
+                entry["uri"] = self.storage.persist(checkpoint, name)
+                entry["storage_name"] = name
             except Exception as e:  # noqa: BLE001 — storage outage must
                 entry["uri_error"] = str(e)  # not kill the training loop
         self.registered.append(entry)
@@ -144,6 +150,13 @@ class CheckpointManager:
                 shutil.rmtree(e["checkpoint"].path, ignore_errors=True)
             except OSError:
                 pass
+            # Retention applies to the storage URI too — dropping only
+            # the local copy would grow remote storage without bound.
+            if self.storage is not None and "storage_name" in e:
+                try:
+                    self.storage.delete(e["storage_name"])
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
             self.registered.remove(e)
 
     def _write_index(self):

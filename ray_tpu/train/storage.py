@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import posixpath
+import shutil
 import tempfile
 from typing import List, Optional, Tuple
 
@@ -59,7 +60,9 @@ class StorageContext:
                 with open(os.path.join(dirpath, fname), "rb") as src, \
                         self.fs.open_output_stream(
                             posixpath.join(rdir, fname)) as out:
-                    out.write(src.read())
+                    # Chunked copy: checkpoint shards can be multi-GB;
+                    # a whole-file read() would spike host RSS.
+                    shutil.copyfileobj(src, out, length=16 * 1024 * 1024)
         return (
             f"{self.storage_path.rstrip('/')}/"
             + (f"{self.experiment_name}/" if self.experiment_name else "")
@@ -84,8 +87,12 @@ class StorageContext:
             os.makedirs(os.path.dirname(local), exist_ok=True)
             with self.fs.open_input_stream(info.path) as inp, \
                     open(local, "wb") as out:
-                out.write(inp.read())
+                shutil.copyfileobj(inp, out, length=16 * 1024 * 1024)
         return Checkpoint.from_directory(local_dir)
+
+    def delete(self, name: str) -> None:
+        """Remove a persisted checkpoint (retention cleanup)."""
+        self.fs.delete_dir(self._remote_path(name))
 
     def list_checkpoints(self) -> List[str]:
         import pyarrow.fs as pafs

@@ -48,8 +48,6 @@ class GroupManager:
         elif backend == Backend.XLA:
             group = XlaLocalGroup(world_size if world_size > 0 else None)
         elif backend == Backend.HIER:
-            from ray_tpu.util.collective.hier_group import HierarchicalGroup
-
             client = worker_mod.get_client()
             group = HierarchicalGroup(client, world_size, rank, group_name)
         else:

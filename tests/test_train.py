@@ -273,3 +273,23 @@ def test_trainer_persists_to_storage_uri(rt_start, tmp_path):
     from ray_tpu.train.checkpoint import Checkpoint as C
 
     assert C.from_directory(back.path).to_dict() == {"w": 1}
+
+
+def test_storage_retention_prunes_remote(tmp_path):
+    """num_to_keep retention removes pruned checkpoints from the storage
+    URI too (orphaned uploads would grow remote storage without bound),
+    and storage names are sequential regardless of local dir names."""
+    from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+    from ray_tpu.train.storage import StorageContext
+
+    storage = StorageContext(f"file://{tmp_path}/bucket", "exp")
+    mgr = CheckpointManager(
+        str(tmp_path / "local"), num_to_keep=2, storage=storage
+    )
+    for step in range(4):
+        ckpt = Checkpoint.from_dict({"step": step})  # random tempdir name
+        mgr.register(ckpt, {"step": step})
+    names = storage.list_checkpoints()
+    # Only the 2 newest remain, in sequential-name order.
+    assert names == ["checkpoint_000002", "checkpoint_000003"]
+    assert storage.download("checkpoint_000003").to_dict() == {"step": 3}
