@@ -106,6 +106,38 @@ llama3_8b = TransformerConfig(
     rope_theta=500000.0,
 )
 
+tiny_gemma = TransformerConfig(
+    vocab_size=256,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=1,
+    d_ff=256,
+    max_seq=128,
+    dtype=jnp.float32,
+    remat=False,
+    activation="gelu",
+    final_logit_softcap=30.0,
+    tie_embeddings=True,
+    scale_embeddings=True,
+)
+
+# Gemma-2B architecture (arXiv:2403.08295: GeGLU MLP, MQA, tied
+# embeddings, sqrt(d) embedding scaling, final logit softcap).
+gemma_2b = TransformerConfig(
+    vocab_size=256128,
+    d_model=2048,
+    n_layers=18,
+    n_heads=8,
+    n_kv_heads=1,
+    d_ff=16384,
+    max_seq=8192,
+    activation="gelu",
+    final_logit_softcap=30.0,
+    tie_embeddings=True,
+    scale_embeddings=True,
+)
+
 mixtral_8x7b = TransformerConfig(
     vocab_size=32000,
     d_model=4096,
@@ -127,6 +159,8 @@ NAMED_CONFIGS = {
     "llama2-13b": llama2_13b,
     "llama2-70b": llama2_70b,
     "llama3-8b": llama3_8b,
+    "tiny_gemma": tiny_gemma,
+    "gemma-2b": gemma_2b,
     "mixtral-8x7b": mixtral_8x7b,
 }
 

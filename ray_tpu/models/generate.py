@@ -18,7 +18,12 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    _act,
+    _embed_tokens,
+    project_logits,
+)
 from ray_tpu.ops import apply_rope, rmsnorm, rope_frequencies
 
 NEG_INF = -1e30
@@ -71,7 +76,7 @@ def _forward_with_cache(params, tokens, cache, cfg: TransformerConfig):
     (logits for the final position, updated cache)."""
     if cfg.num_experts:
         raise ValueError("generation supports dense configs (MoE TBD)")
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed_tokens(params, tokens, cfg)
     b, lq = tokens.shape
     lmax = cache["k"].shape[2]
     cos, sin = rope_frequencies(cfg.head_dim, lmax, cfg.rope_theta)
@@ -96,7 +101,7 @@ def _forward_with_cache(params, tokens, cache, cfg: TransformerConfig):
         attn = _cached_attention(q, k_cache_l, v_cache_l, start + lq)
         x = x + (attn.reshape(b, lq, -1) @ lp["wo"]).astype(x.dtype)
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+        gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32))
         up = (h @ lp["w_up"]).astype(jnp.float32)
         x = x + (((gate * up).astype(x.dtype)) @ lp["w_down"])
         return x, (k_cache_l, v_cache_l)
@@ -105,7 +110,7 @@ def _forward_with_cache(params, tokens, cache, cfg: TransformerConfig):
         layer, x, (params["layers"], cache["k"], cache["v"])
     )
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = x[:, -1] @ params["lm_head"]  # [B, vocab]
+    logits = project_logits(x[:, -1], params, cfg)  # [B, vocab]
     new_cache = {"k": k_new, "v": v_new, "length": start + lq}
     return logits, new_cache
 

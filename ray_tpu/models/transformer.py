@@ -67,6 +67,14 @@ class TransformerConfig:
     # backward recomputes each chunk's logits (~3% extra FLOPs) in
     # exchange for the freed HBM. 0 = off (single fused matmul).
     ce_chunk: int = 0
+    # Family knobs beyond Llama (Gemma et al., arXiv:2403.08295):
+    # MLP activation ("silu" = Llama SwiGLU, "gelu" = Gemma GeGLU),
+    # tanh softcap on final logits (0 = off), input/output embedding
+    # tying, and sqrt(d_model) embedding scaling.
+    activation: str = "silu"
+    final_logit_softcap: float = 0.0
+    tie_embeddings: bool = False
+    scale_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -117,12 +125,16 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict:
                 "w_down": stack(sub[2], (E, ff, d), scale * (2 * L) ** -0.5),
             }
         )
-    return {
+    params = {
         "embed": _dense_init(keys[8], (cfg.vocab_size, d), 1.0, cfg.dtype),
         "layers": layer,
         "final_norm": jnp.ones((d,), dtype=cfg.dtype),
-        "lm_head": _dense_init(keys[9], (d, cfg.vocab_size), scale, cfg.dtype),
     }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(
+            keys[9], (d, cfg.vocab_size), scale, cfg.dtype
+        )
+    return params
 
 
 def param_logical_axes(cfg: TransformerConfig) -> Dict:
@@ -156,12 +168,44 @@ def param_logical_axes(cfg: TransformerConfig) -> Dict:
                 "w_down": ("stage", "expert", "mlp", "embed"),
             }
         )
-    return {
+    axes = {
         "embed": ("vocab", "embed"),
         "layers": layer,
         "final_norm": (None,),
-        "lm_head": ("embed", "vocab"),
     }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _act(cfg: TransformerConfig):
+    if cfg.activation == "silu":
+        return jax.nn.silu
+    if cfg.activation == "gelu":
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {cfg.activation!r}")
+
+
+def _embed_tokens(params, tokens, cfg: TransformerConfig):
+    x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.scale_embeddings:  # Gemma normalizes the embedding scale
+        x = x * jnp.asarray(cfg.d_model ** 0.5, dtype=cfg.dtype)
+    return x
+
+
+def lm_head_weight(params, cfg: TransformerConfig):
+    """[D, V] output projection (the embedding transposed when tied)."""
+    if cfg.tie_embeddings:
+        return params["embed"].T
+    return params["lm_head"]
+
+
+def project_logits(x, params, cfg: TransformerConfig):
+    logits = x @ lm_head_weight(params, cfg)
+    if cfg.final_logit_softcap:
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits.astype(jnp.float32) / cap)
+    return logits
 
 
 def _attention(cfg: TransformerConfig, q, k, v, mesh, positions):
@@ -199,8 +243,9 @@ def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
         x = x + (attn.reshape(b, l, -1) @ lp["wo"]).astype(x.dtype)
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        act = _act(cfg)
         if cfg.num_experts == 0:
-            gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+            gate = act((h @ lp["w_gate"]).astype(jnp.float32))
             up = (h @ lp["w_up"]).astype(jnp.float32)
             mlp_out = ((gate * up).astype(x.dtype)) @ lp["w_down"]
             aux = jnp.zeros((), dtype=jnp.float32)
@@ -208,7 +253,7 @@ def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
             from ray_tpu.parallel.moe import moe_layer
 
             def expert_fn(w, xin):  # xin: [E, C, D]
-                g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, w["gate"]))
+                g = act(jnp.einsum("ecd,edf->ecf", xin, w["gate"]))
                 u = jnp.einsum("ecd,edf->ecf", xin, w["up"])
                 return jnp.einsum("ecf,efd->ecd", g * u, w["down"])
 
@@ -255,15 +300,14 @@ def forward(
     """Returns (logits [B, L, vocab], aux_loss scalar); with
     return_hidden, the pre-lm_head hidden states [B, L, D] instead of
     logits (the chunked-CE loss applies lm_head itself)."""
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed_tokens(params, tokens, cfg)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     body = _layer_fn(cfg, mesh, cos, sin, positions)
     x, auxes = jax.lax.scan(body, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     if return_hidden:
         return x, auxes.sum()
-    logits = x @ params["lm_head"]
-    return logits, auxes.sum()
+    return project_logits(x, params, cfg), auxes.sum()
 
 
 def forward_pipelined(
@@ -314,7 +358,7 @@ def forward_pipelined(
             "(the MoE aux loss does not thread through the pp schedule)"
         )
 
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed_tokens(params, tokens, cfg)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     body = _layer_fn(cfg, mesh, cos, sin, None)
 
@@ -338,8 +382,7 @@ def forward_pipelined(
     )
     x = ym.reshape(b, l, x.shape[-1])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"]
-    return logits, jnp.zeros((), dtype=jnp.float32)
+    return project_logits(x, params, cfg), jnp.zeros((), dtype=jnp.float32)
 
 
 def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None,
@@ -359,7 +402,8 @@ def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None,
         hidden, aux = forward(params, tokens[:, :-1], cfg, mesh,
                               return_hidden=True)
         loss = chunked_lm_head_ce(
-            hidden, params["lm_head"], labels, cfg.ce_chunk
+            hidden, lm_head_weight(params, cfg), labels, cfg.ce_chunk,
+            softcap=cfg.final_logit_softcap,
         )
         return loss + aux_weight * aux
     else:

@@ -30,7 +30,8 @@ def _ce_forward(logits, labels):
 
 
 def chunked_lm_head_ce(hidden: jax.Array, lm_head: jax.Array,
-                       labels: jax.Array, chunk: int) -> jax.Array:
+                       labels: jax.Array, chunk: int,
+                       softcap: float = 0.0) -> jax.Array:
     """Mean next-token loss computing lm_head logits CHUNK tokens at a
     time, so the full [B, S, vocab] tensor never exists in HBM.
 
@@ -51,6 +52,10 @@ def chunked_lm_head_ce(hidden: jax.Array, lm_head: jax.Array,
     def body(acc, xy):
         x, y = xy
         logits = x @ lm_head
+        if softcap:
+            logits = softcap * jnp.tanh(
+                logits.astype(jnp.float32) / softcap
+            )
         loss, _ = _ce_forward(logits, y)
         return acc + loss.sum(), None
 
