@@ -352,6 +352,26 @@ class Dataset:
         if pending is not None:
             yield pending
 
+    def iter_torch_batches(self, batch_size: int = 256,
+                           dtypes=None, device: str = "cpu",
+                           **kwargs) -> Iterator:
+        """numpy batches as torch tensors (reference: iter_torch_batches,
+        data/iterator.py). `dtypes` maps column -> torch dtype; columns
+        default to torch.as_tensor inference. Interop surface for
+        torch-side consumers; the TPU path is iter_jax_batches."""
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy", **kwargs
+        ):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(v)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t.to(device) if device != "cpu" else t
+            yield out
+
     def schema(self):
         for block in self._iter_blocks():
             return B.block_schema(block)

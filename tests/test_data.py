@@ -274,3 +274,19 @@ def test_column_ops_and_sampling(rt_start):
         rtd.from_items([{"y": i * 10} for i in range(5)])
     )
     assert zipped.take(2) == [{"x": 0, "y": 0}, {"x": 1, "y": 10}]
+
+
+def test_iter_torch_batches():
+    import torch
+
+    ds = rtd.from_numpy({"x": np.arange(10, dtype=np.float32),
+                         "y": np.arange(10)})
+    batches = list(ds.iter_torch_batches(
+        batch_size=4, dtypes={"x": torch.float64}
+    ))
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["x"].dtype == torch.float64
+    np.testing.assert_array_equal(
+        torch.cat([b["y"] for b in batches]).numpy(), np.arange(10)
+    )
