@@ -1,0 +1,85 @@
+"""Inference benchmark: KV-cache decode throughput on the flagship model.
+
+Prints one JSON line per batch size: prefill tokens/s and steady-state
+decode tokens/s/chip for the 0.8B Llama config (the serving-side
+counterpart of bench.py's training MFU; decode is memory-bandwidth-bound,
+so tokens/s scales with batch until HBM saturates). Writes
+BENCH_INFER.json. CPU fallback uses the tiny config.
+
+Run: python bench_infer.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from ray_tpu.models import configs, init_params
+    from ray_tpu.models.generate import decode_step, init_kv_cache, prefill
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = replace(configs.get_config("llama2-1b"), n_layers=12,
+                      max_seq=1024, remat=False)
+        batches = (1, 8, 32)
+        prompt_len, decode_steps = 512, 64
+    else:
+        cfg = replace(configs.tiny, remat=False)
+        batches = (4,)
+        prompt_len, decode_steps = 32, 8
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    results = []
+    for batch in batches:
+        max_len = prompt_len + decode_steps
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
+        )
+        cache = init_kv_cache(cfg, batch, max_len)
+        jprefill = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))
+        jdecode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
+
+        # Warm both compilations.
+        logits, cache1 = jprefill(params, prompt, cache)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        _, cache2 = jdecode(params, tok, cache1)
+        jax.device_get(logits)
+
+        t0 = time.perf_counter()
+        logits, cache1 = jprefill(params, prompt, init_kv_cache(cfg, batch, max_len))
+        jax.device_get(logits)
+        prefill_s = time.perf_counter() - t0
+
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        t0 = time.perf_counter()
+        c = cache1
+        for _ in range(decode_steps):
+            logits, c = jdecode(params, tok, c)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        jax.device_get(tok)
+        decode_s = time.perf_counter() - t0
+
+        entry = {
+            "metric": "llama2(0.8B) decode tokens/s/chip" if on_tpu
+                      else "tiny decode tokens/s (cpu fallback)",
+            "batch": batch,
+            "prefill_tokens_per_s": round(batch * prompt_len / prefill_s, 1),
+            "decode_tokens_per_s": round(batch * decode_steps / decode_s, 1),
+            "ms_per_decode_step": round(decode_s / decode_steps * 1e3, 2),
+        }
+        print(json.dumps(entry), flush=True)
+        results.append(entry)
+
+    with open("BENCH_INFER.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
