@@ -24,15 +24,33 @@ import ray_tpu as rt
 
 class DeploymentResponse:
     """Awaitable-ish response wrapper: `.result()` blocks; `.ref` is the
-    underlying ObjectRef (reference: serve/handle.py DeploymentResponse)."""
+    underlying ObjectRef (reference: serve/handle.py DeploymentResponse).
 
-    def __init__(self, ref, on_done=None):
+    A replica that died mid-request (crash, scale-down, self-healing
+    restart) re-dispatches to another replica up to `max_retries` times —
+    the reference router's retry-on-replica-failure behavior."""
+
+    def __init__(self, ref, on_done=None, redispatch=None, max_retries=2):
         self.ref = ref
+        self._redispatch = redispatch
+        self._retries_left = max_retries
         if on_done is not None and ref._future is not None:
             ref._future.add_done_callback(lambda _f: on_done())
 
     def result(self, timeout: Optional[float] = 60.0):
-        return rt.get(self.ref, timeout=timeout)
+        # ActorError covers died AND unavailable (connection lost while
+        # the controller replaces the replica) — both mean "this replica
+        # will not answer; send the request somewhere else".
+        from ray_tpu.exceptions import ActorError, WorkerCrashedError
+
+        while True:
+            try:
+                return rt.get(self.ref, timeout=timeout)
+            except (ActorError, WorkerCrashedError):
+                if self._redispatch is None or self._retries_left <= 0:
+                    raise
+                self._retries_left -= 1
+                self.ref = self._redispatch()
 
 
 class DeploymentHandle:
@@ -119,22 +137,28 @@ class DeploymentHandle:
                 k: v for k, v in s["inflight"].items() if k in live
             }
 
-    def _pick_replica(self):
+    def _pick_replica(self, exclude=frozenset()):
         """Power-of-two by handle-local in-flight count (router.py:295) —
         no probe RPCs on the request path. Multiplexed requests hash the
-        model id to a stable replica so its weights stay resident."""
+        model id to a stable replica so its weights stay resident.
+        `exclude`: actor ids observed dead by a retrying response — skip
+        them while the controller's table still lists them."""
         self._refresh()
         s = self._shared
         with s["lock"]:
             replicas = list(s["replicas"])
-        if not replicas:
+        live = [r for r in replicas if r._actor_id.binary() not in exclude]
+        if not live:
             self._refresh(force=True)
             with s["lock"]:
                 replicas = list(s["replicas"])
-            if not replicas:
+            live = [r for r in replicas
+                    if r._actor_id.binary() not in exclude] or replicas
+            if not live:
                 raise RuntimeError(
                     f"no running replicas for app {self.app_name!r}"
                 )
+        replicas = live
         if self.multiplexed_model_id:
             idx = zlib.crc32(self.multiplexed_model_id.encode()) % len(replicas)
             return replicas[idx]
@@ -172,7 +196,25 @@ class DeploymentHandle:
         ref = replica.handle_request.remote(
             self.method, args, kwargs, self.multiplexed_model_id
         )
-        return DeploymentResponse(ref, on_done=done)
+
+        failed = {replica._actor_id.binary()}
+
+        def redispatch():
+            # The chosen replica died: drop the cached route table, pick
+            # a replica we haven't seen fail (the controller's table may
+            # still list the dead one while self-healing replaces it).
+            self._refresh(force=True)
+            r = self._pick_replica(exclude=frozenset(failed))
+            failed.add(r._actor_id.binary())
+            d = self._track(r)
+            new_ref = r.handle_request.remote(
+                self.method, args, kwargs, self.multiplexed_model_id
+            )
+            if new_ref._future is not None:
+                new_ref._future.add_done_callback(lambda _f: d())
+            return new_ref
+
+        return DeploymentResponse(ref, on_done=done, redispatch=redispatch)
 
     def _stream_call(self, args, kwargs):
         """Generator deployment: yields chunks as the replica produces

@@ -312,3 +312,33 @@ def test_dead_replica_replaced_and_service_heals(rt_serve):
         time.sleep(0.3)
     alive = new_pids - {victim_pid}
     assert len(alive) >= 2, f"replacement replica never served: {new_pids}"
+
+
+def test_handle_redispatches_to_live_replica(rt_serve):
+    """DeploymentResponse.result() re-dispatches a request whose replica
+    died before answering (reference: the router's retry-on-replica-
+    failure), without the caller seeing ActorDiedError."""
+    import os
+    import signal
+
+    @serve.deployment(num_replicas=2)
+    class App:
+        def __call__(self):
+            return os.getpid()
+
+    handle = serve.run(App.bind(), name="redispatch")
+    pids = set()
+    for _ in range(8):
+        pids.add(handle.remote().result(timeout=60))
+    victim = next(iter(pids))
+
+    # Dispatch a request to the victim by brute force: keep sending until
+    # a response's chosen ref targets the (about-to-die) pid... simpler:
+    # kill the victim, then immediately fire a burst — power-of-two will
+    # route some of the burst at the dead replica before any refresh, and
+    # every one of them must still succeed via re-dispatch.
+    os.kill(victim, signal.SIGKILL)
+    results = [handle.remote() for _ in range(8)]
+    got = [r.result(timeout=120) for r in results]
+    assert all(isinstance(p, int) for p in got)
+    assert victim not in got
