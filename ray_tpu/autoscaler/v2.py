@@ -203,6 +203,65 @@ class Scheduler:
 # -- reconciler --------------------------------------------------------------
 
 
+class GcsRayState:
+    """Live ray_state_fn backed by the GCS (the GcsAutoscalerStateManager
+    role): maps provider cloud ids to registered nodes via the provider's
+    rt-node-id tag, reports aliveness + free resources, and accumulates
+    idle seconds from observed full-availability transitions."""
+
+    def __init__(self, provider: NodeProvider, gcs_call):
+        """gcs_call: callable(method, payload) -> response dict (sync)."""
+        self.provider = provider
+        self.gcs_call = gcs_call
+        self._idle_since: Dict[str, float] = {}
+
+    def __call__(self) -> Dict[str, dict]:
+        nodes = {
+            n["node_id"].hex() if isinstance(n["node_id"], bytes)
+            else n["node_id"]: n
+            for n in self.gcs_call("get_nodes", {})["nodes"]
+        }
+        now = time.monotonic()
+        out: Dict[str, dict] = {}
+        for cloud_id in self.provider.non_terminated_nodes():
+            tags = self.provider.node_tags(cloud_id)
+            node = nodes.get(tags.get("rt-node-id", ""))
+            if node is None or node.get("state") != "ALIVE":
+                out[cloud_id] = {"alive": False, "idle_s": 0.0, "free": {}}
+                self._idle_since.pop(cloud_id, None)
+                continue
+            avail = dict(node.get("resources_available", {}))
+            total = node.get("resources_total", {})
+            idle = (
+                avail == dict(total)
+                and not node.get("demand_bundles")
+            )
+            if idle:
+                self._idle_since.setdefault(cloud_id, now)
+            else:
+                self._idle_since.pop(cloud_id, None)
+            out[cloud_id] = {
+                "alive": True,
+                "idle_s": now - self._idle_since.get(cloud_id, now),
+                "free": avail,
+            }
+        return out
+
+
+def gcs_demands(gcs_call):
+    """demands_fn reading queued-task resource bundles from the GCS node
+    table (the LoadMetrics role)."""
+
+    def demands() -> List[Dict[str, float]]:
+        out: List[Dict[str, float]] = []
+        for n in gcs_call("get_nodes", {})["nodes"]:
+            if n.get("state") == "ALIVE":
+                out.extend(n.get("demand_bundles") or [])
+        return out
+
+    return demands
+
+
 class Reconciler:
     """One tick: observe cloud + ray state, converge instances toward the
     schedule (reference: v2/instance_manager/reconciler.py).

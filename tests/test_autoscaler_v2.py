@@ -184,3 +184,71 @@ def test_reconciler_retries_failed_allocation():
     rec.step()  # cloud recovered
     assert len(im.instances((v2.REQUESTED,))) == 1
     assert rec.report()["cpu"][v2.REQUESTED] == 1
+
+
+def test_v2_reconciler_against_live_cluster():
+    """End-to-end v2: infeasible task demand reaches the GCS, the
+    reconciler launches a fake node through the full instance lifecycle
+    (QUEUED->...->RAY_RUNNING), the task completes, and idle timeout
+    walks the instance to TERMINATED."""
+    import time
+
+    import ray_tpu as rt
+    from ray_tpu.autoscaler import FakeMultiNodeProvider
+    from ray_tpu.autoscaler.v2 import GcsRayState, gcs_demands
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    try:
+        provider = FakeMultiNodeProvider(
+            cluster.io, "127.0.0.1", cluster.gcs_port
+        )
+        client = rt._worker.get_client()
+
+        def gcs_call(method, payload):
+            return client._run(client._gcs_call(method, payload))
+
+        im = InstanceManager()
+        rec = Reconciler(
+            im, provider,
+            {"worker": {"resources": {"CPU": 2}, "max_workers": 2}},
+            ray_state_fn=GcsRayState(provider, gcs_call),
+            demands_fn=gcs_demands(gcs_call),
+            idle_timeout_s=1.5,
+        )
+
+        @rt.remote(num_cpus=2)
+        def heavy():
+            time.sleep(0.3)
+            return 7
+
+        ref = heavy.remote()  # infeasible on the 1-CPU head
+        time.sleep(1.2)       # demand rides the heartbeat to the GCS
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rec.step()
+            done, _ = rt.wait([ref], timeout=0.3)
+            if done:
+                break
+            time.sleep(0.2)
+        assert rt.get(ref, timeout=60) == 7
+        assert any(
+            i.status == v2.RAY_RUNNING for i in im.instances()
+        ), rec.report()
+
+        # With the task done, the node idles past the timeout -> gone.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rec.step()
+            insts = im.instances()
+            if insts and all(i.status == v2.TERMINATED for i in insts):
+                break
+            time.sleep(0.3)
+        assert all(i.status == v2.TERMINATED for i in im.instances()), (
+            rec.report()
+        )
+    finally:
+        cluster.shutdown()
