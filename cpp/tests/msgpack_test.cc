@@ -1,0 +1,124 @@
+// Unit tests for the rt msgpack codec (no gtest dependency: asserts +
+// exit code, run by tests/test_cpp_client.py).
+//
+// Covers the format edges the Python side (msgpack-python) produces:
+// fixint boundaries, every int width, negative widths, float32/64,
+// str/bin length tiers, nested arrays/maps, and roundtrip stability.
+
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "rt/msgpack.h"
+
+using rt::Value;
+
+namespace {
+
+Value roundtrip(const Value& v) {
+  std::string buf;
+  v.pack(&buf);
+  Value out;
+  size_t pos = 0;
+  bool ok = Value::unpack(reinterpret_cast<const uint8_t*>(buf.data()),
+                          buf.size(), &pos, &out);
+  assert(ok && "unpack failed");
+  assert(pos == buf.size() && "trailing bytes after unpack");
+  return out;
+}
+
+void test_ints() {
+  const int64_t cases[] = {
+      0, 1, 127, 128, 255, 256, 65535, 65536, 2147483647LL, 2147483648LL,
+      INT64_MAX, -1, -32, -33, -128, -129, -32768, -32769, -2147483648LL,
+      -2147483649LL, INT64_MIN,
+  };
+  for (int64_t v : cases) {
+    assert(roundtrip(Value::I(v)).as_int() == v);
+  }
+  // Unsigned beyond int64 survives as kUint.
+  Value u = roundtrip(Value::U(UINT64_MAX));
+  assert(u.type() == Value::Type::kUint || u.as_int() == -1);
+}
+
+void test_floats() {
+  const double cases[] = {0.0, 1.5, -2.25, 3.14159265358979, 1e300, -1e-300};
+  for (double v : cases) {
+    assert(roundtrip(Value::F(v)).as_double() == v);
+  }
+}
+
+void test_strings_and_bins() {
+  const size_t lens[] = {0, 1, 31, 32, 255, 256, 65535, 65536};
+  for (size_t n : lens) {
+    std::string s(n, 'x');
+    assert(roundtrip(Value::S(s)).as_str() == s);
+    std::string b(n, '\0');
+    if (n > 0) b[n / 2] = '\x7f';
+    Value rb = roundtrip(Value::Bin(b));
+    assert(rb.type() == Value::Type::kBin);
+    assert(rb.as_bin() == b);
+  }
+}
+
+void test_containers() {
+  // Array length tiers: 0, 15, 16, 70000.
+  for (size_t n : {size_t(0), size_t(15), size_t(16), size_t(70000)}) {
+    Value arr = Value::Arr();
+    for (size_t i = 0; i < n; ++i) {
+      arr.arr().push_back(Value::I(static_cast<int64_t>(i % 1000)));
+    }
+    Value out = roundtrip(arr);
+    assert(out.as_arr().size() == n);
+    if (n > 3) assert(out.as_arr()[3].as_int() == 3);
+  }
+  // Nested map with every scalar type.
+  Value m = Value::Map();
+  m["nil"] = Value::Nil();
+  m["yes"] = Value::B(true);
+  m["n"] = Value::I(-42);
+  m["f"] = Value::F(2.5);
+  m["s"] = Value::S("hello");
+  m["b"] = Value::Bin(std::string("\x00\x01", 2));
+  Value inner = Value::Map();
+  inner["deep"] = Value::Arr({Value::I(1), Value::S("two")});
+  m["obj"] = inner;
+  Value out = roundtrip(m);
+  assert(out.find("nil")->is_nil());
+  assert(out.find("yes")->as_bool());
+  assert(out.find("n")->as_int() == -42);
+  assert(out.find("f")->as_double() == 2.5);
+  assert(out.find("s")->as_str() == "hello");
+  assert(out.find("b")->as_bin().size() == 2);
+  assert(out.find("obj")->find("deep")->as_arr()[1].as_str() == "two");
+}
+
+void test_truncation_rejected() {
+  Value m = Value::Map();
+  m["key"] = Value::S("a longer value here");
+  std::string buf;
+  m.pack(&buf);
+  // Every proper prefix must fail cleanly, never crash or succeed.
+  for (size_t cut = 0; cut + 1 < buf.size(); ++cut) {
+    Value out;
+    size_t pos = 0;
+    bool ok = Value::unpack(reinterpret_cast<const uint8_t*>(buf.data()),
+                            cut, &pos, &out);
+    assert(!ok || pos <= cut);
+  }
+}
+
+}  // namespace
+
+int main() {
+  test_ints();
+  test_floats();
+  test_strings_and_bins();
+  test_containers();
+  test_truncation_rejected();
+  std::printf("MSGPACK TESTS OK\n");
+  return 0;
+}

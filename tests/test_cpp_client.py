@@ -148,3 +148,14 @@ def test_cpp_put_readable_from_python(rt_start):
     assert ser.deserialize_from_bytes(chunk["data"]) == {
         "who": "python", "n": 7,
     }
+
+
+def test_cpp_msgpack_unit_tests():
+    """The native codec's own unit suite (format edges, length tiers,
+    truncation rejection) — built and run via make -C cpp test."""
+    out = subprocess.run(
+        ["make", "-s", "-C", CPP_DIR, "test"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "MSGPACK TESTS OK" in out.stdout
