@@ -42,6 +42,22 @@ def run(app: Application, name: Optional[str] = None,
     return DeploymentHandle(app_name)
 
 
+def call(app_name: str, *args, method: str = "__call__", **kwargs):
+    """Invoke a deployment and return its result, synchronously.
+
+    The cross-language serving entry point: a foreign client submits the
+    task `ray_tpu.serve:call` with plain args (e.g. the C++ client's
+    Submit("ray_tpu.serve:call", {app, payload...})), the executing pool
+    worker builds a handle and routes through the normal data plane —
+    power-of-two choice, batching, multiplexing all apply. (Reference
+    analog: the gRPC proxy's role for non-Python serve clients.)
+    """
+    handle = get_app_handle(app_name)
+    if method != "__call__":
+        handle = handle.options(method_name=method)
+    return handle.remote(*args, **kwargs).result(timeout=120)
+
+
 def get_app_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 

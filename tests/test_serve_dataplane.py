@@ -342,3 +342,43 @@ def test_handle_redispatches_to_live_replica(rt_serve):
     got = [r.result(timeout=120) for r in results]
     assert all(isinstance(p, int) for p in got)
     assert victim not in got
+
+
+def test_cross_language_serve_call(rt_serve):
+    """serve.call routes through the normal data plane from a plain
+    fn_name task — the path a C++ client uses to hit deployments
+    (Submit("ray_tpu.serve:call", [app, payload]))."""
+    import os as _os
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload, "pid": _os.getpid()}
+
+        def shout(self, payload):
+            return str(payload).upper()
+
+    serve.run(Echo.bind(), name="xlangserve")
+    # Direct driver-side use.
+    out = serve.call("xlangserve", "hello")
+    assert out["echo"] == "hello"
+    # The foreign-client path: a worker executes the fn_name task.
+    client = rt._worker.get_client()
+    spec = {
+        "task_id": _os.urandom(16),
+        "job_id": client.job_id.binary(),
+        "name": "ray_tpu.serve:call",
+        "fn_name": "ray_tpu.serve:call",
+        "plain_args": ["xlangserve", "from-cpp"],
+        "deps": [],
+        "num_returns": 1,
+        "resources": {"CPU": 1.0},
+        "retriable": False,
+    }
+    result = client._run(client.raylet.call("submit_task", spec, timeout=120))
+    assert result["status"] == "ok", result
+    from ray_tpu._private import serialization as ser
+
+    value = ser.deserialize_from_bytes(result["returns"][0]["data"])
+    assert value["echo"] == "from-cpp"
+    assert value["pid"] != _os.getpid()  # served by a replica process
