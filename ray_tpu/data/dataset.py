@@ -270,7 +270,12 @@ class Dataset:
 
     # -- consumption -----------------------------------------------------
     def count(self) -> int:
-        return sum(B.block_num_rows(b) for b in self._iter_blocks())
+        """Row count via per-block remote counts — blocks never move to
+        the driver (reference: count() off metadata)."""
+        fn = rt.remote(_block_count).options(max_retries=-1)
+        return sum(
+            rt.get([fn.remote(r) for r in self._executed_refs()])
+        )
 
     def take(self, n: int = 20) -> List[Any]:
         out = []
