@@ -1,0 +1,112 @@
+// Multithreaded store stress for the TSAN build (SURVEY §4: the
+// reference's race-detection story is TSAN over the C++ test suite; this
+// is the matching harness for the shm allocator — one process, many
+// threads hammering create/seal/get/release/delete so TSAN can observe
+// every lock interleaving the allocator permits).
+//
+// Build + run: make -C ray_tpu/native tsan_test
+//
+// Exit 0 + "STORE THREAD TESTS OK" when all operations stay coherent.
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rt_store_open(const char* name, uint64_t size, int create);
+void rt_store_close(void* handle);
+int rt_store_unlink(const char* name);
+uint8_t* rt_store_base(void* handle);
+int64_t rt_store_create_object(void* handle, const uint8_t* id, uint64_t size);
+int rt_store_seal(void* handle, const uint8_t* id);
+int64_t rt_store_get(void* handle, const uint8_t* id, uint64_t* size_out);
+int rt_store_release(void* handle, const uint8_t* id);
+int rt_store_contains(void* handle, const uint8_t* id);
+int rt_store_delete(void* handle, const uint8_t* id);
+}
+
+namespace {
+
+constexpr int kThreads = 8;
+constexpr int kOpsPerThread = 2000;
+constexpr uint64_t kStoreBytes = 16ull * 1024 * 1024;
+
+std::atomic<long> g_errors{0};
+
+void make_id(uint8_t* id, int thread, int n) {
+  std::memset(id, 0, 16);
+  std::memcpy(id, &thread, sizeof(thread));
+  std::memcpy(id + 4, &n, sizeof(n));
+}
+
+void worker(void* store, int tid) {
+  for (int i = 0; i < kOpsPerThread; ++i) {
+    uint8_t id[16];
+    make_id(id, tid, i);
+    uint64_t size = 64 + (i % 512);
+    int64_t off = rt_store_create_object(store, id, size);
+    if (off < 0) continue;  // store full / evicted: fine under pressure
+    uint8_t* base = rt_store_base(store);
+    std::memset(base + off, tid + 1, size);
+    if (rt_store_seal(store, id) != 0) {
+      g_errors.fetch_add(1);
+      continue;
+    }
+    rt_store_release(store, id);
+
+    // Read back an object of a NEIGHBORING thread (cross-thread get).
+    uint8_t other[16];
+    make_id(other, (tid + 1) % kThreads, i / 2);
+    uint64_t got_size = 0;
+    int64_t goff = rt_store_get(store, other, &got_size);
+    if (goff >= 0) {
+      // Payload must be uniformly the creator's fill byte.
+      uint8_t expect = static_cast<uint8_t>(((tid + 1) % kThreads) + 1);
+      const uint8_t* p = rt_store_base(store) + goff;
+      for (uint64_t b = 0; b < got_size; b += 37) {
+        if (p[b] != expect) {
+          g_errors.fetch_add(1);
+          break;
+        }
+      }
+      rt_store_release(store, other);
+    }
+
+    // Periodically delete own older objects to churn the free list.
+    if (i % 7 == 0 && i > 16) {
+      uint8_t old[16];
+      make_id(old, tid, i - 16);
+      rt_store_delete(store, old);
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  std::string name = "/rt_tsan_test_" + std::to_string(getpid());
+  void* store = rt_store_open(name.c_str(), kStoreBytes, 1);
+  if (store == nullptr) {
+    std::fprintf(stderr, "FAIL: store open\n");
+    return 1;
+  }
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back(worker, store, t);
+  }
+  for (auto& t : threads) t.join();
+  rt_store_close(store);
+  rt_store_unlink(name.c_str());
+  if (g_errors.load() != 0) {
+    std::fprintf(stderr, "FAIL: %ld coherence errors\n", g_errors.load());
+    return 1;
+  }
+  std::printf("STORE THREAD TESTS OK\n");
+  return 0;
+}

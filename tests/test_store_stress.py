@@ -156,3 +156,28 @@ def test_stress_under_asan_if_available(tmp_path):
         f"stderr: {p.stderr.decode()[-3000:]}"
     )
     assert b"AddressSanitizer" not in p.stderr
+
+
+def test_multithreaded_store_under_tsan_if_available():
+    """8 threads hammer create/seal/get/release/delete on one store under
+    ThreadSanitizer (SURVEY §4: the reference's race-detection story is
+    TSAN builds over the C++ tests). Skips where the toolchain lacks
+    -fsanitize=thread."""
+    import subprocess
+
+    native = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "ray_tpu", "native"
+    )
+    probe = subprocess.run(
+        ["g++", "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
+        input=b"int main(){return 0;}", capture_output=True,
+    )
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks -fsanitize=thread")
+    out = subprocess.run(
+        ["make", "-s", "-C", native, "tsan_test"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "STORE THREAD TESTS OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stderr
