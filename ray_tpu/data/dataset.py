@@ -778,36 +778,60 @@ def from_numpy(arrays: Dict[str, Any], parallelism: int = 4) -> Dataset:
     return from_items(rows, parallelism)
 
 
-def read_parquet(path: str, parallelism: int = 4) -> Dataset:
+def _read_file_block(path: str, fmt: str):
+    """Remote-task body: parse one file into a block (reads happen in
+    workers — rows/bytes never pass through the driver, the reference's
+    read-task model, data/datasource/)."""
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path)
+    if fmt == "json":
+        import pyarrow.json as pajson
+
+        return pajson.read_json(path)
+    if fmt == "text":
+        with open(path) as f:
+            return B.block_from_rows(
+                [{"text": line.rstrip("\n")} for line in f]
+            )
+    raise ValueError(f"unknown format {fmt!r}")
+
+
+def _read_files(path: str, fmt: str, glob_pat: str,
+                parallelism: int) -> Dataset:
     import glob as _glob
     import os
 
-    import pyarrow.parquet as pq
-
-    paths = sorted(_glob.glob(os.path.join(path, "*.parquet"))) if os.path.isdir(path) else [path]
-    refs = [rt.put(pq.read_table(p)) for p in paths]
-    ds = Dataset(refs)
-    if len(refs) < parallelism:
+    paths = (
+        sorted(_glob.glob(os.path.join(path, glob_pat)))
+        if os.path.isdir(path) else [path]
+    )
+    if not paths:
+        raise FileNotFoundError(f"no {glob_pat} files under {path!r}")
+    read_fn = rt.remote(_read_file_block).options(max_retries=-1)
+    ds = Dataset([read_fn.remote(p, fmt) for p in paths])
+    if len(paths) < parallelism:
         ds = ds.repartition(parallelism)
     return ds
 
 
-def read_csv(path: str, parallelism: int = 4) -> Dataset:
-    import pyarrow.csv as pacsv
+def read_parquet(path: str, parallelism: int = 4) -> Dataset:
+    return _read_files(path, "parquet", "*.parquet", parallelism)
 
-    table = pacsv.read_csv(path)
-    return Dataset([rt.put(table)]).repartition(parallelism)
+
+def read_csv(path: str, parallelism: int = 4) -> Dataset:
+    return _read_files(path, "csv", "*.csv", parallelism)
 
 
 def read_json(path: str, parallelism: int = 4) -> Dataset:
-    import pyarrow.json as pajson
-
-    table = pajson.read_json(path)
-    return Dataset([rt.put(table)]).repartition(parallelism)
+    return _read_files(path, "json", "*.jsonl", parallelism)
 
 
 def read_text(path: str, parallelism: int = 4) -> Dataset:
     """One row per line: {"text": line} (reference: data read_text)."""
-    with open(path) as f:
-        rows = [{"text": line.rstrip("\n")} for line in f]
-    return from_items(rows, parallelism)
+    return _read_files(path, "text", "*.txt", parallelism)
