@@ -422,54 +422,28 @@ class Dataset:
                 for sr in shard_refs]
 
     # -- output ----------------------------------------------------------
+    def _write(self, path: str, fmt: str) -> List[str]:
+        """One file per block, written by remote tasks (the reference's
+        write-task model: blocks serialize where they live, not on the
+        driver)."""
+        os.makedirs(path, exist_ok=True)
+        write_fn = rt.remote(_write_block_file).options(max_retries=-1)
+        refs = [
+            write_fn.remote(ref, os.path.abspath(path), i, fmt)
+            for i, ref in enumerate(self._executed_refs())
+        ]
+        return [fp for fp in rt.get(refs) if fp is not None]
+
     def write_parquet(self, path: str) -> List[str]:
         """One parquet file per block under `path` (reference:
         Dataset.write_parquet)."""
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-
-        os.makedirs(path, exist_ok=True)
-        out = []
-        for i, block in enumerate(self._iter_blocks()):
-            rows = B.block_to_rows(block)
-            if not rows:
-                continue
-            table = pa.Table.from_pylist(rows)
-            fp = os.path.join(path, f"part-{i:05d}.parquet")
-            pq.write_table(table, fp)
-            out.append(fp)
-        return out
+        return self._write(path, "parquet")
 
     def write_csv(self, path: str) -> List[str]:
-        import pyarrow as pa
-        import pyarrow.csv as pacsv
-
-        os.makedirs(path, exist_ok=True)
-        out = []
-        for i, block in enumerate(self._iter_blocks()):
-            rows = B.block_to_rows(block)
-            if not rows:
-                continue
-            fp = os.path.join(path, f"part-{i:05d}.csv")
-            pacsv.write_csv(pa.Table.from_pylist(rows), fp)
-            out.append(fp)
-        return out
+        return self._write(path, "csv")
 
     def write_json(self, path: str) -> List[str]:
-        import json as _json
-
-        os.makedirs(path, exist_ok=True)
-        out = []
-        for i, block in enumerate(self._iter_blocks()):
-            rows = B.block_to_rows(block)
-            if not rows:
-                continue
-            fp = os.path.join(path, f"part-{i:05d}.jsonl")
-            with open(fp, "w") as f:
-                for r in rows:
-                    f.write(_json.dumps(r, default=_json_fallback) + "\n")
-            out.append(fp)
-        return out
+        return self._write(path, "json")
 
     def __repr__(self):
         return (
@@ -776,6 +750,36 @@ def from_numpy(arrays: Dict[str, Any], parallelism: int = 4) -> Dataset:
     n = len(arrays[keys[0]])
     rows = [{k: _np_item(arrays[k][i]) for k in keys} for i in range(n)]
     return from_items(rows, parallelism)
+
+
+def _write_block_file(block, path: str, index: int, fmt: str):
+    """Remote-task body: persist one block as part-<index>; returns the
+    file path (None for empty blocks)."""
+    rows = B.block_to_rows(block)
+    if not rows:
+        return None
+    if fmt == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        fp = os.path.join(path, f"part-{index:05d}.parquet")
+        pq.write_table(pa.Table.from_pylist(rows), fp)
+    elif fmt == "csv":
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        fp = os.path.join(path, f"part-{index:05d}.csv")
+        pacsv.write_csv(pa.Table.from_pylist(rows), fp)
+    elif fmt == "json":
+        import json as _json
+
+        fp = os.path.join(path, f"part-{index:05d}.jsonl")
+        with open(fp, "w") as f:
+            for r in rows:
+                f.write(_json.dumps(r, default=_json_fallback) + "\n")
+    else:
+        raise ValueError(f"unknown format {fmt!r}")
+    return fp
 
 
 def _read_file_block(path: str, fmt: str):
