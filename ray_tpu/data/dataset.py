@@ -96,12 +96,24 @@ class Dataset:
         return Dataset(left._input_refs + right._input_refs)
 
     def limit(self, n: int) -> "Dataset":
-        rows = []
-        for row in self.iter_rows():
-            rows.append(row)
-            if len(rows) >= n:
+        """First n rows, formed from block refs: whole blocks pass by
+        reference, the boundary block is sliced in a remote task."""
+        refs = self.materialize()._input_refs
+        count_fn = rt.remote(_block_count).options(max_retries=-1)
+        counts = rt.get([count_fn.remote(r) for r in refs])
+        slice_fn = rt.remote(_slice_block).options(max_retries=-1)
+        out: List = []
+        remaining = n
+        for ref, c in zip(refs, counts):
+            if remaining <= 0:
                 break
-        return from_items(rows)
+            if c <= remaining:
+                out.append(ref)
+                remaining -= c
+            else:
+                out.append(slice_fn.remote(ref, 0, remaining))
+                remaining = 0
+        return Dataset(out if out else [rt.put(B.block_from_rows([]))])
 
     def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
         """Row -> value for a new column (reference: Dataset.add_column)."""
