@@ -186,6 +186,29 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_state: Optional[List[Dict]] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: Tuner.restore, tune/tuner.py): finished trials keep
+        their recorded results; unfinished ones re-run from their newest
+        checkpoint. `path` is the experiment dir (the run's
+        resolved_storage_path)."""
+        path = os.path.abspath(path)
+        state_file = os.path.join(path, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        run_config = RunConfig(
+            name=os.path.basename(path),
+            storage_path=os.path.dirname(path),
+        )
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config)
+        tuner._restore_state = state
+        return tuner
+
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
@@ -203,8 +226,50 @@ class Tuner:
         live: List[Trial] = []
         exhausted = False
 
+        # Tuner.restore: completed trials keep their results; unfinished
+        # ones re-queue with their recorded checkpoint. The searcher is
+        # not consulted — the experiment's trial set is already decided.
+        pending_restore: List[tuple] = []
+        if self._restore_state is not None:
+            exhausted = True
+            for t in self._restore_state:
+                if t["state"] == "TERMINATED":
+                    done = Trial(
+                        trial_id=t["trial_id"], config=t["config"],
+                        state="TERMINATED",
+                        last_metrics=t.get("last_metrics") or {},
+                        trial_dir=os.path.join(
+                            exp_dir, f"trial_{t['trial_id']}"
+                        ),
+                    )
+                    if t.get("checkpoint_path"):
+                        done.checkpoint = Checkpoint.from_directory(
+                            t["checkpoint_path"]
+                        )
+                    trials.append(done)
+                else:
+                    ckpt = (
+                        Checkpoint.from_directory(t["checkpoint_path"])
+                        if t.get("checkpoint_path") else None
+                    )
+                    pending_restore.append((t["trial_id"], t["config"], ckpt))
+
         # Controller event loop (reference: TuneController.step :709).
         while True:
+            # Re-launch restored trials first, then consult the searcher.
+            while pending_restore and len(live) < max_concurrent:
+                trial_id, config, ckpt = pending_restore.pop(0)
+                trial = Trial(trial_id=trial_id, config=config)
+                trial_dir = os.path.join(exp_dir, f"trial_{trial_id}")
+                os.makedirs(trial_dir, exist_ok=True)
+                trial.trial_dir = trial_dir
+                trial.checkpoint = ckpt
+                self._launch_actor(trial, config, ckpt, resources)
+                trial.state = "RUNNING"
+                if hasattr(scheduler, "on_trial_add"):
+                    scheduler.on_trial_add(trial_id, config)
+                trials.append(trial)
+                live.append(trial)
             # Launch new trials up to the concurrency cap.
             while not exhausted and len(live) < max_concurrent:
                 trial_id = uuid.uuid4().hex[:8]
@@ -225,7 +290,7 @@ class Tuner:
                 trials.append(trial)
                 live.append(trial)
 
-            if not live and exhausted:
+            if not live and exhausted and not pending_restore:
                 break
 
             # Poll live trials (per-trial isolation: one crashed actor
@@ -367,6 +432,9 @@ class Tuner:
                 "state": t.state,
                 "last_metrics": _json_safe(t.last_metrics),
                 "error": t.error,
+                # Restoration point for Tuner.restore (user checkpoints
+                # live wherever tune.report was given them).
+                "checkpoint_path": t.checkpoint.path if t.checkpoint else None,
             }
             for t in trials
         ]

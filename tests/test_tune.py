@@ -362,3 +362,66 @@ def test_trial_crash_exhausts_budget(tmp_path):
     )
     grid = tuner.fit()
     assert grid.errors, "exhausted failure budget must surface an error"
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_tuner_restore_resumes_unfinished_trials(tmp_path):
+    """Tuner.restore: finished trials keep their recorded results (their
+    functions never re-run); interrupted ones resume from their recorded
+    checkpoint instead of step 0 (reference: Tuner.restore)."""
+    import json as _json
+    import os
+
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.config import RunConfig
+
+    marker = str(tmp_path / "runs.jsonl")
+
+    def objective(config):
+        import json
+        import os
+        import tempfile
+
+        from ray_tpu import tune as tmod
+
+        start = 0
+        ckpt = tmod.get_checkpoint()
+        if ckpt:
+            start = json.load(open(os.path.join(ckpt.path, "s.json")))["step"] + 1
+        with open(config["marker"], "a") as f:
+            f.write(json.dumps({"x": config["x"], "start": start}) + "\n")
+        if config["x"] == 99 and start == 0:
+            # The "interrupted" trial: checkpoint step 3, then die.
+            d = tempfile.mkdtemp()
+            json.dump({"step": 3}, open(os.path.join(d, "s.json"), "w"))
+            from ray_tpu.train.checkpoint import Checkpoint as C
+
+            tmod.report({"score": 0.0}, checkpoint=C.from_directory(d))
+            raise RuntimeError("simulated interruption")
+        tmod.report({"score": float(config["x"] + start)})
+
+    exp_dir = str(tmp_path)
+    run_config = RunConfig(name="resume-exp", storage_path=exp_dir)
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 99]),
+                     "marker": marker},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=run_config,
+    ).fit()
+    # x=99 failed; the others finished.
+    assert len(grid.errors) == 1
+
+    restored = Tuner.restore(
+        os.path.join(exp_dir, "resume-exp"), objective,
+        tune_config=TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(restored) == 3 and not restored.errors
+    # The resumed trial continued from its checkpoint (start=4 => 103).
+    assert restored.get_best_result().metrics["score"] == 103.0
+    runs = [_json.loads(l) for l in open(marker)]
+    # Finished trials (x=1,2) ran exactly once — never re-executed.
+    assert sum(1 for r in runs if r["x"] == 1) == 1
+    assert sum(1 for r in runs if r["x"] == 2) == 1
+    # The interrupted trial ran twice: fresh, then from step 4.
+    assert [r["start"] for r in runs if r["x"] == 99] == [0, 4]
