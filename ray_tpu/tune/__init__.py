@@ -31,6 +31,26 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 
+def with_parameters(trainable, **large_objects):
+    """Attach large constant objects to a trainable WITHOUT serializing
+    them into every trial's config (reference: tune.with_parameters):
+    each object goes to the object store once; trials fetch by ref.
+
+        tuner = Tuner(tune.with_parameters(train_fn, data=big_df), ...)
+        def train_fn(config, data): ...
+    """
+    import ray_tpu as rt
+
+    refs = {k: rt.put(v) for k, v in large_objects.items()}
+
+    def wrapped(config):
+        resolved = {k: rt.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    wrapped.__name__ = getattr(trainable, "__name__", "trainable")
+    return wrapped
+
+
 def report(metrics: Dict, checkpoint=None):
     """Report metrics from inside a trial (reference: tune.report /
     session.report)."""
@@ -51,6 +71,7 @@ __all__ = [
     "ResultGrid",
     "report",
     "get_checkpoint",
+    "with_parameters",
     "uniform",
     "loguniform",
     "choice",

@@ -425,3 +425,26 @@ def test_tuner_restore_resumes_unfinished_trials(tmp_path):
     assert sum(1 for r in runs if r["x"] == 2) == 1
     # The interrupted trial ran twice: fresh, then from step 4.
     assert [r["start"] for r in runs if r["x"] == 99] == [0, 4]
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_with_parameters_ships_large_objects(tmp_path):
+    """tune.with_parameters: the object goes to the store once; every
+    trial receives it as a kwarg, not through config serialization."""
+    import numpy as np
+
+    from ray_tpu.train.config import RunConfig
+
+    data = np.arange(10_000, dtype=np.float64)
+
+    def objective(config, data):
+        tune.report({"total": float(data.sum()) + config["x"]})
+
+    grid = Tuner(
+        tune.with_parameters(objective, data=data),
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="total", mode="max"),
+        run_config=RunConfig(name="params", storage_path=str(tmp_path)),
+    ).fit()
+    want = float(data.sum())
+    assert sorted(r.metrics["total"] for r in grid) == [want + 1.0, want + 2.0]
