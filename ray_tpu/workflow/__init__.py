@@ -254,16 +254,55 @@ def resume(workflow_id: str, storage: Optional[str] = None,
     return result
 
 
+def run_async(
+    dag: DAGNode,
+    *args,
+    workflow_id: Optional[str] = None,
+    storage: Optional[str] = None,
+    max_step_retries: int = 3,
+) -> str:
+    """Start a workflow without blocking; returns its id immediately
+    (reference: workflow.run_async, workflow/api.py). Follow with
+    get_output(workflow_id, wait=...) or signal()/get_status()."""
+    import threading
+
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    t = threading.Thread(
+        target=run,
+        args=(dag, *args),
+        kwargs={
+            "workflow_id": workflow_id,
+            "storage": storage,
+            "max_step_retries": max_step_retries,
+        },
+        daemon=True,
+    )
+    t.start()
+    return workflow_id
+
+
 def get_status(workflow_id: str, storage: Optional[str] = None) -> Optional[str]:
     status = _read_status(_wf_dir(workflow_id, storage))
     return status.get("state") if status else None
 
 
-def get_output(workflow_id: str, storage: Optional[str] = None):
+def get_output(workflow_id: str, storage: Optional[str] = None,
+               wait: float = 0.0):
+    """The workflow's result. With wait > 0, blocks up to that many
+    seconds for an in-flight run (run_async) to finish; FAILED surfaces
+    as WorkflowError with the recorded error."""
     wf_dir = _wf_dir(workflow_id, storage)
     path = os.path.join(wf_dir, "output.pkl")
-    if not os.path.exists(path):
-        raise WorkflowError(f"workflow {workflow_id} has no output yet")
+    deadline = time.monotonic() + wait
+    while not os.path.exists(path):
+        status = _read_status(wf_dir) or {}
+        if status.get("state") == "FAILED":
+            raise WorkflowError(
+                f"workflow {workflow_id} failed: {status.get('error')}"
+            )
+        if time.monotonic() >= deadline:
+            raise WorkflowError(f"workflow {workflow_id} has no output yet")
+        time.sleep(0.05)
     with open(path, "rb") as f:
         return pickle.load(f)
 

@@ -184,3 +184,28 @@ def test_workflow_event_timeout(rt_start, tmp_path):
             use.bind(workflow.event("never", timeout_s=0.5)),
             workflow_id="wf-timeout", storage=str(tmp_path),
         )
+
+
+def test_workflow_run_async_and_waiting_output(rt_start, tmp_path):
+    """run_async returns immediately; get_output(wait=...) blocks for the
+    background run, including across the events/signal path."""
+    from ray_tpu import workflow
+
+    @rt.remote
+    def slow_double(x):
+        import time as _t
+
+        _t.sleep(0.4)
+        return x * 2
+
+    wid = workflow.run_async(
+        slow_double.bind(21), workflow_id="async-wf", storage=str(tmp_path)
+    )
+    assert wid == "async-wf"
+    # Not done yet (the step sleeps); non-waiting read raises.
+    import pytest as _pytest
+
+    with _pytest.raises(workflow.WorkflowError):
+        workflow.get_output(wid, storage=str(tmp_path))
+    assert workflow.get_output(wid, storage=str(tmp_path), wait=30) == 42
+    assert workflow.get_status(wid, storage=str(tmp_path)) == "SUCCEEDED"
