@@ -98,7 +98,7 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         """First n rows, formed from block refs: whole blocks pass by
         reference, the boundary block is sliced in a remote task."""
-        refs = self.materialize()._input_refs
+        refs = self._executed_refs()
         count_fn = rt.remote(_block_count).options(max_retries=-1)
         counts = rt.get([count_fn.remote(r) for r in refs])
         slice_fn = rt.remote(_slice_block).options(max_retries=-1)

@@ -233,10 +233,14 @@ class Tuner:
         if self._restore_state is not None:
             exhausted = True
             for t in self._restore_state:
-                if t["state"] == "TERMINATED":
+                # TERMINATED ran to completion; STOPPED was cut by the
+                # scheduler on purpose — re-running it would re-spend the
+                # compute early stopping deliberately saved. Both keep
+                # their recorded results.
+                if t["state"] in ("TERMINATED", "STOPPED"):
                     done = Trial(
                         trial_id=t["trial_id"], config=t["config"],
-                        state="TERMINATED",
+                        state=t["state"],
                         last_metrics=t.get("last_metrics") or {},
                         trial_dir=os.path.join(
                             exp_dir, f"trial_{t['trial_id']}"
@@ -438,8 +442,14 @@ class Tuner:
             }
             for t in trials
         ]
-        with open(os.path.join(exp_dir, "experiment_state.json"), "w") as f:
+        # Write-then-rename: a driver killed mid-snapshot (the exact
+        # scenario Tuner.restore exists for) must never truncate the
+        # state file into unrestorability.
+        path = os.path.join(exp_dir, "experiment_state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(state, f, indent=2)
+        os.replace(tmp, path)
 
 
 def _json_safe(d):

@@ -206,23 +206,22 @@ def run(
     wf_dir = _wf_dir(workflow_id, storage)
     os.makedirs(wf_dir, exist_ok=True)
     input_value = args[0] if args else None
-    with open(os.path.join(wf_dir, "input.pkl"), "wb") as f:
-        pickle.dump(input_value, f, protocol=5)
-    with open(os.path.join(wf_dir, "dag.pkl"), "wb") as f:
-        import cloudpickle
-
-        cloudpickle.dump(dag, f)
     _write_status(wf_dir, workflow_id=workflow_id, state="RUNNING",
                   created_at=time.time())
+    # Everything after the RUNNING mark reports failures durably —
+    # run_async callers would otherwise time out with no recorded error
+    # when e.g. the DAG is not serializable.
     try:
+        _checkpoint(os.path.join(wf_dir, "input.pkl"), input_value)
+        with open(os.path.join(wf_dir, "dag.pkl"), "wb") as f:
+            import cloudpickle
+
+            cloudpickle.dump(dag, f)
         result = _execute(dag, wf_dir, input_value, max_step_retries)
     except BaseException as e:
         _write_status(wf_dir, state="FAILED", error=str(e))
         raise
-    with open(os.path.join(wf_dir, "output.pkl"), "wb") as f:
-        pickle.dump(result, f, protocol=5)
-    _write_status(wf_dir, state="SUCCEEDED", finished_at=time.time())
-    return result
+    return _commit_output(wf_dir, result)
 
 
 def resume(workflow_id: str, storage: Optional[str] = None,
@@ -248,8 +247,14 @@ def resume(workflow_id: str, storage: Optional[str] = None,
     except BaseException as e:
         _write_status(wf_dir, state="FAILED", error=str(e))
         raise
-    with open(os.path.join(wf_dir, "output.pkl"), "wb") as f:
-        pickle.dump(result, f, protocol=5)
+    return _commit_output(wf_dir, result)
+
+
+def _commit_output(wf_dir: str, result):
+    """Durably commit a finished workflow: atomic output write, THEN the
+    SUCCEEDED status — readers key off the status, so they can never see
+    a partial output or a success without one."""
+    _checkpoint(os.path.join(wf_dir, "output.pkl"), result)
     _write_status(wf_dir, state="SUCCEEDED", finished_at=time.time())
     return result
 
@@ -290,21 +295,26 @@ def get_output(workflow_id: str, storage: Optional[str] = None,
                wait: float = 0.0):
     """The workflow's result. With wait > 0, blocks up to that many
     seconds for an in-flight run (run_async) to finish; FAILED surfaces
-    as WorkflowError with the recorded error."""
+    as WorkflowError with the recorded error.
+
+    Keys off the status, not the output file: SUCCEEDED is written after
+    the atomic output commit, so a SUCCEEDED status guarantees a complete
+    output.pkl."""
     wf_dir = _wf_dir(workflow_id, storage)
-    path = os.path.join(wf_dir, "output.pkl")
     deadline = time.monotonic() + wait
-    while not os.path.exists(path):
+    while True:
         status = _read_status(wf_dir) or {}
-        if status.get("state") == "FAILED":
+        state = status.get("state")
+        if state == "SUCCEEDED":
+            with open(os.path.join(wf_dir, "output.pkl"), "rb") as f:
+                return pickle.load(f)
+        if state == "FAILED":
             raise WorkflowError(
                 f"workflow {workflow_id} failed: {status.get('error')}"
             )
         if time.monotonic() >= deadline:
             raise WorkflowError(f"workflow {workflow_id} has no output yet")
         time.sleep(0.05)
-    with open(path, "rb") as f:
-        return pickle.load(f)
 
 
 def list_all(storage: Optional[str] = None) -> List[dict]:
