@@ -1,7 +1,23 @@
 """Flagship benchmark: Llama-family training-step throughput per chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+Architecture (hardened after two rounds of red gates):
+  * The parent process imports NO jax.  It spawns a worker subprocess
+    (``bench.py --worker tpu``) and supervises it with per-stage
+    watchdog timeouts, so a hung TPU tunnel (``jax.devices()`` blocking
+    forever in backend init) is killed and retried, never inherited.
+  * The worker prints staged progress to stderr (``::stage backend_init``,
+    ``compile``, ``run``, ``step i/N``) so a hang is diagnosable from the
+    driver log, and the final JSON line to stdout.
+  * On persistent TPU failure the parent falls back to a CPU worker so
+    the script still emits a valid, parseable JSON line (with a
+    ``tpu_error`` field recording why the real measurement was skipped)
+    and exits 0.  Only if even the CPU worker dies does it emit a JSON
+    error line and exit 1 — never a bare stack trace.
+  * A persistent XLA compilation cache (``.cache/jax`` in the repo) keeps
+    repeat runs well under the ~3-minute time-to-first-number target.
 
 On the real TPU chip this measures the full jit-compiled training step
 (forward + backward + AdamW update, bf16 params/activations, remat) on a
@@ -11,19 +27,16 @@ grads fit one 16GB v5e chip. `vs_baseline` is measured MFU divided by
 Llama-2 (BASELINE.md north star: match TorchTrainer+NCCL tokens/sec/chip);
 >1.0 means this stack extracts more of its chip than the baseline stack
 extracts of its A100.
-
-On CPU (no TPU visible) it falls back to a tiny config so the script still
-emits a valid line.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
-from dataclasses import replace
-
-import jax
-import jax.numpy as jnp
 
 # Peak dense bf16 TFLOP/s per chip by TPU generation.
 PEAK_TFLOPS = {
@@ -35,6 +48,160 @@ PEAK_TFLOPS = {
 }
 BASELINE_MFU = 0.40  # typical A100 TorchTrainer+NCCL MFU on Llama-2
 
+# ---------------------------------------------------------------------------
+# Parent-side supervision knobs (env-overridable for tests / slow tunnels).
+# ---------------------------------------------------------------------------
+STAGE_TIMEOUTS = {
+    "spawn": float(os.environ.get("RT_BENCH_T_SPAWN", 90)),
+    "backend_init": float(os.environ.get("RT_BENCH_T_BACKEND", 120)),
+    "setup": float(os.environ.get("RT_BENCH_T_SETUP", 150)),
+    "compile": float(os.environ.get("RT_BENCH_T_COMPILE", 420)),
+    "run": float(os.environ.get("RT_BENCH_T_RUN", 240)),
+}
+TPU_ATTEMPTS = int(os.environ.get("RT_BENCH_TPU_ATTEMPTS", 3))
+TPU_DEADLINE = float(os.environ.get("RT_BENCH_TPU_DEADLINE", 900))
+RETRY_BACKOFF = float(os.environ.get("RT_BENCH_RETRY_BACKOFF", 5))
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr, flush=True)
+
+
+class _Watchdog:
+    """Tracks the worker's current stage + last-output time."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.stage = "spawn"
+        self.last = time.monotonic()
+
+    def touch(self, line: str) -> None:
+        with self.lock:
+            self.last = time.monotonic()
+            if line.startswith("::stage "):
+                self.stage = line.split(None, 1)[1].strip()
+
+    def expired(self) -> "tuple[bool, str, float]":
+        with self.lock:
+            limit = STAGE_TIMEOUTS.get(self.stage, 300.0)
+            idle = time.monotonic() - self.last
+            return idle > limit, self.stage, idle
+
+
+def _run_worker(platform: str) -> "tuple[int, str, str]":
+    """Spawn one worker; returns (rc, stdout, reason). rc -9 == watchdog kill."""
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        # sitecustomize registers the (possibly hung) remote-TPU backend at
+        # interpreter startup when this is set; clear it for the CPU child.
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", platform]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True
+    )
+    dog = _Watchdog()
+    out_buf: list[str] = []
+
+    def read_stdout() -> None:
+        for line in proc.stdout:  # type: ignore[union-attr]
+            out_buf.append(line)
+            dog.touch("")
+
+    def read_stderr() -> None:
+        for line in proc.stderr:  # type: ignore[union-attr]
+            dog.touch(line)
+            sys.stderr.write(line)
+            sys.stderr.flush()
+
+    t_out = threading.Thread(target=read_stdout, daemon=True)
+    t_err = threading.Thread(target=read_stderr, daemon=True)
+    t_out.start()
+    t_err.start()
+
+    reason = ""
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        expired, stage, idle = dog.expired()
+        if expired:
+            reason = f"watchdog: no progress for {idle:.0f}s in stage '{stage}'"
+            _log(f"killing worker — {reason}")
+            proc.kill()
+            proc.wait()
+            rc = -9
+            break
+        time.sleep(0.5)
+    t_out.join(timeout=5)
+    t_err.join(timeout=5)
+    return (rc if rc is not None else -9), "".join(out_buf), reason
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def supervise() -> int:
+    t_start = time.monotonic()
+    tpu_error = ""
+    force_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+
+    if not force_cpu:
+        for attempt in range(1, TPU_ATTEMPTS + 1):
+            if time.monotonic() - t_start > TPU_DEADLINE:
+                tpu_error = f"TPU deadline {TPU_DEADLINE:.0f}s exhausted"
+                break
+            _log(f"TPU attempt {attempt}/{TPU_ATTEMPTS}")
+            rc, out, reason = _run_worker("tpu")
+            result = _last_json_line(out)
+            if rc == 0 and result is not None:
+                print(json.dumps(result), flush=True)
+                _log(f"done in {time.monotonic() - t_start:.0f}s")
+                return 0
+            tpu_error = reason or f"worker exited rc={rc}"
+            _log(f"TPU attempt {attempt} failed: {tpu_error}")
+            time.sleep(RETRY_BACKOFF)
+
+    _log(f"falling back to CPU worker (tpu_error={tpu_error or 'forced'})")
+    rc, out, reason = _run_worker("cpu")
+    result = _last_json_line(out)
+    if rc == 0 and result is not None:
+        if tpu_error:
+            result["tpu_error"] = tpu_error
+        print(json.dumps(result), flush=True)
+        return 0
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama2 train-step tokens/s/chip",
+                "value": 0.0,
+                "unit": "tokens/s/chip",
+                "vs_baseline": 0.0,
+                "error": f"tpu: {tpu_error or 'n/a'}; cpu: {reason or f'rc={rc}'}",
+            }
+        ),
+        flush=True,
+    )
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# Worker: the actual measurement. Runs in a child process the parent can kill.
+# ---------------------------------------------------------------------------
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name}", file=sys.stderr, flush=True)
+
 
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower().replace(" ", "")
@@ -44,17 +211,48 @@ def _peak_flops(device) -> float:
     return 197.0e12  # assume v5e-class
 
 
-def count_params(tree) -> int:
-    return sum(x.size for x in jax.tree.leaves(tree))
+def worker(platform: str) -> None:
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    _stage("backend_init")
+    import jax
 
+    if platform == "cpu":
+        # jax may already have been imported (and JAX_PLATFORMS read) by
+        # sitecustomize at interpreter startup — env vars alone are too late.
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        # Persistent compile cache keeps repeat TPU runs under the ~3-minute
+        # time-to-first-number target. TPU-only: a CPU AOT cache compiled on
+        # one host can SIGILL on another (machine-feature mismatch), and CPU
+        # compiles are fast anyway.
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".cache", "jax"
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
 
-def main():
+    t0 = time.monotonic()
+    dev = jax.devices()[0]
+    print(
+        f"[worker] backend up in {time.monotonic() - t0:.1f}s: "
+        f"{dev.platform}/{getattr(dev, 'device_kind', '?')} x{jax.device_count()}",
+        file=sys.stderr,
+        flush=True,
+    )
+    on_tpu = dev.platform == "tpu"
+
+    _stage("setup")
+    import jax.numpy as jnp  # noqa: F401
+    from dataclasses import replace
+
     import optax
 
-    from ray_tpu.models import configs, init_params, loss_fn, param_logical_axes
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
+    from ray_tpu.models import configs, init_params, loss_fn
 
     if on_tpu:
         # ~0.8B params: fits chip HBM with AdamW state + bf16 grads.
@@ -81,9 +279,10 @@ def main():
         batch, seq, steps, warmup = 8, 64, 5, 1
 
     params = init_params(jax.random.PRNGKey(0), cfg)
-    n_params = count_params(params)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
     optimizer = optax.adamw(1e-4)
     opt_state = jax.jit(optimizer.init)(params)
+    print(f"[worker] params built: {n_params:,}", file=sys.stderr, flush=True)
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
@@ -92,21 +291,30 @@ def main():
         return params, opt_state, loss
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
-                                cfg.vocab_size)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size
+    )
 
-    for _ in range(warmup):
+    _stage("compile")
+    t0 = time.monotonic()
+    for i in range(warmup):
         params, opt_state, loss = jstep(params, opt_state, tokens)
+        print(f"[worker] warmup {i + 1}/{warmup}", file=sys.stderr, flush=True)
     # On remote-tunneled TPU platforms block_until_ready can return before
     # execution finishes; a device_get of the scalar loss is a true sync.
     jax.device_get(loss)
+    print(f"[worker] compile+warmup done in {time.monotonic() - t0:.1f}s",
+          file=sys.stderr, flush=True)
     t0 = time.perf_counter()
     jax.device_get(loss)
     round_trip = time.perf_counter() - t0
 
+    _stage("run")
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         params, opt_state, loss = jstep(params, opt_state, tokens)
+        if (i + 1) % 5 == 0:
+            print(f"[worker] step {i + 1}/{steps}", file=sys.stderr, flush=True)
     jax.device_get(loss)
     dt = max(time.perf_counter() - t0 - round_trip, 1e-9)
 
@@ -136,9 +344,23 @@ def main():
                 "device": str(dev),
                 "loss": float(jax.device_get(loss)),
             }
-        )
+        ),
+        flush=True,
     )
 
 
+def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        platform = sys.argv[2] if len(sys.argv) > 2 else "tpu"
+        try:
+            worker(platform)
+            return 0
+        except Exception as exc:  # noqa: BLE001 — parent parses this
+            print(f"[worker] FAILED: {type(exc).__name__}: {exc}",
+                  file=sys.stderr, flush=True)
+            return 1
+    return supervise()
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
