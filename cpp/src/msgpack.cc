@@ -2,6 +2,7 @@
 
 #include "rt/msgpack.h"
 
+#include <algorithm>
 #include <cstring>
 
 namespace rt {
@@ -176,9 +177,16 @@ bool Value::unpack(const uint8_t* data, size_t len, size_t* pos, Value* out) {
     return true;
   };
   auto read_seq = [&](size_t count, bool map) -> bool {
+    // A hostile/truncated array32 or map32 header can claim up to 2^32-1
+    // elements; bound the speculative reserve by what the remaining input
+    // could possibly hold (>=1 byte per element, 2 per map entry) so a bad
+    // header yields a clean `false` from the element loop, not bad_alloc.
+    const size_t remaining = len - *pos;
+    const size_t reserve_cap =
+        std::min<size_t>(count, map ? remaining / 2 : remaining);
     if (map) {
       out->type_ = Type::kMap;
-      out->map_.reserve(count);
+      out->map_.reserve(reserve_cap);
       for (size_t i = 0; i < count; ++i) {
         Value k, v;
         if (!unpack(data, len, pos, &k) || !unpack(data, len, pos, &v)) {
@@ -188,7 +196,7 @@ bool Value::unpack(const uint8_t* data, size_t len, size_t* pos, Value* out) {
       }
     } else {
       out->type_ = Type::kArr;
-      out->arr_.reserve(count);
+      out->arr_.reserve(reserve_cap);
       for (size_t i = 0; i < count; ++i) {
         Value v;
         if (!unpack(data, len, pos, &v)) return false;

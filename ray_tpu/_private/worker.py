@@ -921,8 +921,9 @@ class CoreClient:
         }
         retries = cfg.task_max_retries if max_retries is None else max_retries
         # The raylet's OOM policy prefers killing retriable tasks
-        # (worker_killing_policy.cc retriable-FIFO).
-        spec["retriable"] = retries > 0
+        # (worker_killing_policy.cc retriable-FIFO). max_retries=-1 means
+        # infinite retries — very much retriable.
+        spec["retriable"] = retries != 0
         from ray_tpu.util import tracing
 
         trace_ctx = tracing.inject()

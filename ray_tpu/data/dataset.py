@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import random as _random
+import zlib
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import ray_tpu as rt
@@ -535,11 +536,30 @@ class GroupedData:
         return self._reduce(_group_map, fn)
 
 
+def _stable_hash(value) -> int:
+    """Process-stable, equality-consistent hash for shuffle keys.
+
+    Python salts only str/bytes hashing per process (PYTHONHASHSEED), so
+    those are rehashed with crc32; numeric types keep the builtin hash,
+    which is unsalted and consistent across numeric types (True == 1 ==
+    1.0 all co-partition, matching dict semantics)."""
+    if isinstance(value, bytes):
+        return zlib.crc32(value)
+    if isinstance(value, str):
+        return zlib.crc32(value.encode("utf-8", "surrogatepass"))
+    if isinstance(value, tuple):
+        h = 2166136261  # FNV-1a fold over element hashes
+        for el in value:
+            h = ((h ^ _stable_hash(el)) * 16777619) & 0xFFFFFFFF
+        return h
+    return hash(value) & 0xFFFFFFFF
+
+
 def _hash_partition_block(block, n: int, key: str):
     """Partition one block's rows by hash(key) across n pieces."""
     parts: List[List] = [[] for _ in range(n)]
     for r in B.block_to_rows(block):
-        parts[hash(r[key]) % n].append(r)
+        parts[_stable_hash(r[key]) % n].append(r)
     out = tuple(B.block_from_rows(p) for p in parts)
     return out if n > 1 else out[0]
 

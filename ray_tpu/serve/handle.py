@@ -56,11 +56,15 @@ class DeploymentResponse:
 class DeploymentHandle:
     def __init__(self, app_name: str, method: str = "__call__",
                  multiplexed_model_id: str = "", stream: bool = False,
-                 _shared=None):
+                 max_retries: int = 2, _shared=None):
         self.app_name = app_name
         self.method = method
         self.multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        # Retry-on-replica-failure count (reference: router retry config).
+        # Retries re-dispatch the same args — at-least-once semantics, so
+        # mutating deployments should set max_retries=0 via .options().
+        self.max_retries = max_retries
         # Router state shared across .options() copies of this handle.
         if _shared is None:
             _shared = {
@@ -75,13 +79,15 @@ class DeploymentHandle:
 
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                max_retries: Optional[int] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name,
             method_name if method_name is not None else self.method,
             (multiplexed_model_id if multiplexed_model_id is not None
              else self.multiplexed_model_id),
             stream if stream is not None else self._stream,
+            max_retries if max_retries is not None else self.max_retries,
             _shared=self._shared,
         )
 
@@ -214,7 +220,8 @@ class DeploymentHandle:
                 new_ref._future.add_done_callback(lambda _f: d())
             return new_ref
 
-        return DeploymentResponse(ref, on_done=done, redispatch=redispatch)
+        return DeploymentResponse(ref, on_done=done, redispatch=redispatch,
+                                  max_retries=self.max_retries)
 
     def _stream_call(self, args, kwargs):
         """Generator deployment: yields chunks as the replica produces

@@ -67,19 +67,25 @@ class VirtualActorClass:
                       **kwargs) -> "VirtualActorHandle":
         d = _actor_dir(actor_id, storage)
         state_path = os.path.join(d, "state.pkl")
+        handle = VirtualActorHandle(self._cls, actor_id, d)
         if not os.path.exists(state_path):
             os.makedirs(d, exist_ok=True)
-            instance = self._cls(*args, **kwargs)
-            # Atomic birth: losers of a concurrent create race simply see
-            # the winner's state file (rename is atomic; first one wins
-            # semantics match the reference's get-or-create).
-            if not os.path.exists(state_path):
-                _checkpoint(state_path, {
-                    "seq": 0,
-                    "state": dict(instance.__dict__),
-                    "created_at": time.time(),
-                })
-        return VirtualActorHandle(self._cls, actor_id, d)
+            # Initialization holds the same per-actor lock as _call:
+            # without it, two creators can both see state.pkl missing and
+            # the loser's late initial write (rename = last-writer-wins)
+            # would clobber transitions the winner already committed.
+            lock, token = handle._acquire()
+            try:
+                if not os.path.exists(state_path):
+                    instance = self._cls(*args, **kwargs)
+                    _checkpoint(state_path, {
+                        "seq": 0,
+                        "state": dict(instance.__dict__),
+                        "created_at": time.time(),
+                    })
+            finally:
+                handle._release(lock, token)
+        return handle
 
     def exists(self, actor_id: str, storage: Optional[str] = None) -> bool:
         return os.path.exists(
@@ -109,14 +115,18 @@ class VirtualActorHandle:
 
     # -- locking (cross-process mutual exclusion per actor id) ------------
     def _acquire(self, timeout_s: float = 30.0):
+        """Returns (lock_path, token). Release with _release — a blind
+        unlink could delete a *different* holder's lock if ours was
+        reaped as stale while we ran (slow user code past timeout_s)."""
         lock = os.path.join(self._dir, ".lock")
+        token = f"{os.getpid()}:{time.monotonic_ns()}"
         deadline = time.monotonic() + timeout_s
         while True:
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.write(fd, str(os.getpid()).encode())
+                os.write(fd, token.encode())
                 os.close(fd)
-                return lock
+                return lock, token
             except FileExistsError:
                 # Reap locks from dead holders (crash mid-call).
                 try:
@@ -132,6 +142,17 @@ class VirtualActorHandle:
                     ) from None
                 time.sleep(0.02)
 
+    @staticmethod
+    def _release(lock: str, token: str):
+        """Unlink the lock only if we still own it (our token inside)."""
+        try:
+            with open(lock, "rb") as f:
+                if f.read().decode(errors="replace") != token:
+                    return  # reaped as stale; someone else holds it now
+            os.unlink(lock)
+        except OSError:
+            pass
+
     def _call(self, method_name: str, args, kwargs):
         fn = getattr(self._cls, method_name)
         is_readonly = getattr(fn, "__rt_readonly__", False)
@@ -139,7 +160,7 @@ class VirtualActorHandle:
             record = self._load()
             instance = self._materialize(record)
             return fn(instance, *args, **kwargs)
-        lock = self._acquire()
+        lock, token = self._acquire()
         try:
             record = self._load()
             instance = self._materialize(record)
@@ -154,10 +175,7 @@ class VirtualActorHandle:
             })
             return result
         finally:
-            try:
-                os.unlink(lock)
-            except OSError:
-                pass
+            self._release(lock, token)
 
     def _materialize(self, record: Dict[str, Any]):
         instance = self._cls.__new__(self._cls)

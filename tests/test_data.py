@@ -74,14 +74,27 @@ def test_groupby():
     ds = rtd.from_items(
         [{"k": i % 3, "v": i} for i in range(9)]
     )
-    counts = ds.groupby("k").count().take_all()
+    counts = sorted(ds.groupby("k").count().take_all(), key=lambda r: r["k"])
     assert counts == [
         {"k": 0, "count()": 3},
         {"k": 1, "count()": 3},
         {"k": 2, "count()": 3},
     ]
-    sums = ds.groupby("k").sum("v").take_all()
-    assert sums[0]["sum(v)"] == 0 + 3 + 6
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] == 0 + 3 + 6
+
+
+def test_groupby_string_keys_cross_process():
+    """String keys must hash to the same shuffle partition in every worker
+    process — builtin hash() is salted per process (PYTHONHASHSEED), so a
+    salted hash silently duplicates groups across reduce partitions."""
+    ds = rtd.from_items(
+        [{"k": f"key-{i % 4}", "v": i} for i in range(32)]
+    ).repartition(8)
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {f"key-{i}": 8 for i in range(4)}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums["key-0"] == sum(i for i in range(32) if i % 4 == 0)
 
 
 def test_std_and_generic_aggregate():
