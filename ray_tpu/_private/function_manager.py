@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import weakref
 from typing import Any, Dict
 
 import cloudpickle
@@ -28,17 +29,37 @@ class FunctionManager:
         self._exported: set = set()
         self._cache: Dict[bytes, Any] = {}
         self._lock = threading.Lock()
+        # Same-object fast path: pickling the function on every submit just
+        # to compute its key was ~90us/task on the hot path. Weak keys so a
+        # collected function can't alias a recycled id.
+        self._id_cache: "weakref.WeakKeyDictionary[Any, bytes]" = (
+            weakref.WeakKeyDictionary()
+        )
 
     def export(self, obj: Any) -> bytes:
+        try:
+            key = self._id_cache.get(obj)
+        except TypeError:  # unhashable / not weakref-able
+            key = None
+        if key is not None:
+            return key
         pickled = cloudpickle.dumps(obj)
         key = function_key(pickled)
         with self._lock:
             if key in self._exported:
+                try:
+                    self._id_cache[obj] = key
+                except TypeError:
+                    pass
                 return key
         self._client.kv_put(key, pickled, ns=_NS, overwrite=False)
         with self._lock:
             self._exported.add(key)
             self._cache[key] = obj
+        try:
+            self._id_cache[obj] = key
+        except TypeError:
+            pass
         return key
 
     def fetch(self, key: bytes) -> Any:

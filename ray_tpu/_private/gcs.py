@@ -31,6 +31,8 @@ its managers collapsed into one asyncio process:
 from __future__ import annotations
 
 import asyncio
+import os
+import struct
 import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Set
@@ -63,6 +65,20 @@ class GcsServer:
         self.persist_path = persist_path
         self._persist_dirty = False
         self._persist_task: Optional[asyncio.Task] = None
+        # Write-ahead log (gcs_table_storage.h / redis_store_client.h:33
+        # role): every durable mutation appends a seq-numbered record
+        # BEFORE its reply, so an abrupt GCS kill loses nothing that was
+        # acknowledged — the debounced snapshot is only WAL compaction.
+        self._wal_path = persist_path + ".wal" if persist_path else None
+        self._wal_old_path = persist_path + ".wal.old" if persist_path else None
+        self._wal_fh = None
+        self._wal_seq = 0
+        self._wal_bytes = 0
+        self._wal_compact_bytes = int(
+            os.environ.get("RT_GCS_WAL_COMPACT_BYTES", 4 * 1024 * 1024)
+        )
+        self._wal_fsync = os.environ.get("RT_GCS_WAL_FSYNC") == "1"
+        self._base_handlers: Dict[str, Any] = {}
         # tables
         self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespace -> k -> v
         self.nodes: Dict[bytes, dict] = {}  # node_id -> info
@@ -152,18 +168,25 @@ class GcsServer:
         self.rpc.on_disconnect = self._on_disconnect
 
         if self.persist_path:
-            import os as _os
-
-            if _os.path.exists(self.persist_path):
+            if os.path.exists(self.persist_path):
                 self._restore(self.persist_path)
             for name in _WRITE_METHODS:
+                self._base_handlers[name] = self.rpc.handlers[name]
                 self.rpc.handlers[name] = self._wrap_durable(
-                    self.rpc.handlers[name]
+                    name, self.rpc.handlers[name]
                 )
 
     # -- persistence ----------------------------------------------------
-    def _wrap_durable(self, handler):
+    def _wrap_durable(self, name, handler):
         async def wrapped(d, conn):
+            # True write-AHEAD, at handler entry: handlers that await
+            # mid-mutation (e.g. placement-group creation pushing bundle
+            # reservations) would otherwise log in completion order, and
+            # replay could resurrect state a concurrent delete removed.
+            # Entry order == mutation-start order on this single loop.
+            # (A handler that then fails leaves a record whose replay
+            # deterministically fails the same way — harmless.)
+            self._wal_append(name, d)
             out = await handler(d, conn)
             self._mark_dirty()
             return out
@@ -177,6 +200,104 @@ class GcsServer:
         if self._persist_task is None or self._persist_task.done():
             self._persist_task = asyncio.ensure_future(self._persist_soon())
 
+    # -- write-ahead log -------------------------------------------------
+    def _wal_append(self, method: str, payload: Any):
+        if not self._wal_path:
+            return
+        import msgpack
+
+        if self._wal_fh is None:
+            self._wal_fh = open(self._wal_path, "ab")
+        self._wal_seq += 1
+        body = msgpack.packb(
+            {"s": self._wal_seq, "m": method, "d": payload}, use_bin_type=True
+        )
+        rec = struct.pack("<I", len(body)) + body
+        self._wal_fh.write(rec)
+        self._wal_fh.flush()
+        if self._wal_fsync:
+            os.fsync(self._wal_fh.fileno())
+        self._wal_bytes += len(rec)
+        if self._wal_bytes >= self._wal_compact_bytes:
+            self._mark_dirty()  # snapshot write doubles as compaction
+
+    def _rotate_wal(self) -> bool:
+        """Move the live WAL aside before a snapshot lands; returns True
+        if there is a .old file to delete once the snapshot succeeds. A
+        previously-failed compaction's .old is folded together with the
+        current file so at most two WAL files ever exist."""
+        if not self._wal_path or not os.path.exists(self._wal_path):
+            return os.path.exists(self._wal_old_path or "")
+        if self._wal_fh is not None:
+            self._wal_fh.close()
+            self._wal_fh = None
+        if os.path.exists(self._wal_old_path):
+            with open(self._wal_old_path, "ab") as dst, \
+                    open(self._wal_path, "rb") as src:
+                dst.write(src.read())
+            os.remove(self._wal_path)
+        else:
+            os.rename(self._wal_path, self._wal_old_path)
+        self._wal_bytes = 0
+        return True
+
+    @staticmethod
+    def _read_wal_records(path: str):
+        """Yield (seq, method, payload); a torn tail record (crash mid-
+        append) terminates the stream cleanly."""
+        import msgpack
+
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + 4 <= len(data):
+            (length,) = struct.unpack_from("<I", data, pos)
+            if pos + 4 + length > len(data):
+                break  # torn tail
+            try:
+                rec = msgpack.unpackb(
+                    data[pos + 4:pos + 4 + length],
+                    raw=False, strict_map_key=False,
+                )
+            except Exception:  # noqa: BLE001 — corrupt tail
+                break
+            yield rec["s"], rec["m"], rec["d"]
+            pos += 4 + length
+
+    async def _replay_wal(self):
+        """Redo acknowledged mutations newer than the snapshot."""
+        covered = self._wal_seq
+
+        class _ReplayConn:
+            closed = True
+            meta: Dict[str, Any] = {}
+
+            async def push(self, *_a, **_k):
+                pass
+
+            async def respond(self, *_a, **_k):
+                pass
+
+        conn = _ReplayConn()
+        replayed = 0
+        for path in (self._wal_old_path, self._wal_path):
+            if not path or not os.path.exists(path):
+                continue
+            for seq, method, payload in self._read_wal_records(path):
+                if seq <= covered:
+                    continue
+                handler = self._base_handlers.get(method)
+                if handler is None:
+                    continue
+                try:
+                    await handler(payload, conn)
+                    replayed += 1
+                except Exception:  # noqa: BLE001 — redo is best-effort per record
+                    pass
+                self._wal_seq = max(self._wal_seq, seq)
+        if replayed:
+            self._mark_dirty()
+
     def _snapshot_bytes(self) -> bytes:
         import pickle
 
@@ -189,13 +310,12 @@ class GcsServer:
                 "placement_groups": self.placement_groups,
                 "object_dir": self.object_dir,
                 "pg_counter": self.pg_counter,
+                "wal_seq": self._wal_seq,
             }
         )
 
     @staticmethod
     def _write_snapshot(path: str, data: bytes):
-        import os
-
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
@@ -210,9 +330,19 @@ class GcsServer:
             # the disk I/O is the slow part and must not head-of-line-block
             # heartbeats and scheduling.
             data = self._snapshot_bytes()
-            await asyncio.get_event_loop().run_in_executor(
-                None, self._write_snapshot, self.persist_path, data
-            )
+            had_old = self._rotate_wal()
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._write_snapshot, self.persist_path, data
+                )
+            except Exception:  # noqa: BLE001 — .old stays; replay covers it
+                continue
+            # Snapshot covers every rotated record: compaction complete.
+            if had_old:
+                try:
+                    os.remove(self._wal_old_path)
+                except OSError:
+                    pass
 
     def _restore(self, path: str):
         import pickle
@@ -227,9 +357,14 @@ class GcsServer:
         self.placement_groups.update(snap.get("placement_groups", {}))
         self.object_dir.update(snap.get("object_dir", {}))
         self.pg_counter = snap.get("pg_counter", self.pg_counter)
+        self._wal_seq = snap.get("wal_seq", 0)
 
     # ------------------------------------------------------------------
     async def start(self) -> int:
+        if self.persist_path:
+            # Redo acknowledged-but-unsnapshotted mutations before the
+            # listener opens — clients must never observe pre-replay state.
+            await self._replay_wal()
         port = await self.rpc.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
         self._started.set()
@@ -254,7 +389,39 @@ class GcsServer:
             # loop clears the dirty flag before its debounce sleep, so a
             # cancelled-in-flight task also means unflushed writes).
             self._persist_dirty = False
-            self._write_snapshot(self.persist_path, self._snapshot_bytes())
+            data = self._snapshot_bytes()
+            had_old = self._rotate_wal()
+            self._write_snapshot(self.persist_path, data)
+            # The final snapshot covers everything: drop compacted WALs.
+            if had_old:
+                try:
+                    os.remove(self._wal_old_path)
+                except OSError:
+                    pass
+        if self._wal_fh is not None:
+            try:
+                self._wal_fh.close()
+            except OSError:
+                pass
+            self._wal_fh = None
+        await self.rpc.stop()
+
+    async def kill(self):
+        """Abrupt death for fault injection: no final snapshot — only the
+        per-write WAL flushes survive, which is the point: chaos tests
+        validate WAL replay from exactly this state (the in-process
+        equivalent of `kill -9` on the GCS)."""
+        self._stopping = True
+        if self._health_task:
+            self._health_task.cancel()
+        if self._persist_task is not None and not self._persist_task.done():
+            self._persist_task.cancel()
+        if self._wal_fh is not None:
+            try:
+                self._wal_fh.close()
+            except OSError:
+                pass
+            self._wal_fh = None
         await self.rpc.stop()
 
     async def publish(self, channel: str, payload: Any):

@@ -56,6 +56,10 @@ class WorkerHandle:
         # Workers are cached per runtime-env hash (worker_pool.h); a task
         # only dispatches to a worker started with its env.
         self.runtime_env_hash = runtime_env_hash
+        # Direct-transport lease: resources held by an owner pushing tasks
+        # straight to this worker (direct_task_transport.cc OnWorkerIdle).
+        self.lease_resources: Optional[Dict[str, float]] = None
+        self.leased_by = None  # owner ServerConnection while leased
 
 
 class Raylet:
@@ -87,7 +91,13 @@ class Raylet:
         self.rpc = RpcServer(host, port)
         self.gcs: Optional[Connection] = None
         self.workers: Dict[bytes, WorkerHandle] = {}
-        self.task_queue: deque = deque()  # (spec, reply_future)
+        # Queues keyed by scheduling class (resource shape + runtime-env
+        # hash + pg bundle) — the reference queues per scheduling class
+        # (cluster_task_manager.cc) so one blocked shape never forces a
+        # rescan of every queued task: dispatch cost is O(classes +
+        # dispatched), not O(queued), per wake-up. A single global deque
+        # made a 10k-task drain O(n^2) (~100 tasks/s sustained).
+        self.task_queues: Dict[tuple, deque] = {}  # class -> (spec, fut)
         # Resources demanded by queued-but-undispatched tasks; makes the
         # submit-time spillover decision aware of committed local work
         # (ClusterResourceScheduler accounts for queued demand the same way).
@@ -98,6 +108,7 @@ class Raylet:
         self._peer_locks: Dict[bytes, asyncio.Lock] = {}
         self.node_cache: Dict[bytes, dict] = {}
         self._dispatch_event = asyncio.Event()
+        self._zygote = None  # lazy ZygoteManager (worker fork server)
         self._stopping = False
         self._bg: List[asyncio.Task] = []
         # Task state-transition events, batched to the GCS task-event sink
@@ -161,6 +172,10 @@ class Raylet:
         r("get_info", self.h_get_info)
         r("prestart_workers", self.h_prestart_workers)
         r("worker_stacks", self.h_worker_stacks)
+        r("lease_worker", self.h_lease_worker)
+        r("release_lease", self.h_release_lease)
+        # A crashed owner must not leak its leased workers' resources.
+        self.rpc.on_disconnect = self._on_client_disconnect
 
     # ------------------------------------------------------------------
     _GCS_CHANNELS = ("create_actor", "kill_actor_worker", "reserve_bundle",
@@ -225,14 +240,25 @@ class Raylet:
                 w.proc.terminate()
             except Exception:
                 pass
+        # One shared grace period, polled asynchronously: blocking per-worker
+        # wait() would stall the event loop that delivers zygote-fork death
+        # notices (2s per worker instead of 2s total).
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if all(
+                w.proc is None or w.proc.poll() is not None
+                for w in self.workers.values()
+            ):
+                break
+            await asyncio.sleep(0.05)
         for w in self.workers.values():
             try:
-                w.proc.wait(timeout=2)
-            except Exception:
-                try:
+                if w.proc is not None and w.proc.poll() is None:
                     w.proc.kill()
-                except Exception:
-                    pass
+            except Exception:
+                pass
+        if self._zygote is not None:
+            self._zygote.stop()
         await self.rpc.stop()
         if self.gcs:
             await self.gcs.close()
@@ -250,6 +276,8 @@ class Raylet:
                 w.proc.kill()
             except Exception:  # noqa: BLE001
                 pass
+        if self._zygote is not None:
+            self._zygote.stop()
         await self.rpc.stop()
         if self.gcs:
             await self.gcs.close()
@@ -350,17 +378,39 @@ class Raylet:
         # component is ever synthesized by a trailing separator.
         env["PYTHONPATH"] = self._propagated_pythonpath(env.get("PYTHONPATH", ""))
         env.update(getattr(self, "spawn_env_overrides", None) or {})
+        # Defer TPU tunnel attach: with PALLAS_AXON_POOL_IPS set,
+        # sitecustomize registers the remote-TPU jax backend (importing all
+        # of jax, ~2s) in EVERY interpreter at startup. Workers stash the
+        # tunnel config instead and re-attach lazily the first time a task
+        # actually requests TPU resources (worker_main.ensure_tpu_backend) —
+        # control-plane workers spawn ~6x faster.
+        if env.get("PALLAS_AXON_POOL_IPS") and not env.get("RT_EAGER_TPU_ATTACH"):
+            env["RT_DEFERRED_TPU_TUNNEL"] = env.pop("PALLAS_AXON_POOL_IPS")
+            if env.get("JAX_PLATFORMS"):
+                env["RT_DEFERRED_JAX_PLATFORMS"] = env.pop("JAX_PLATFORMS")
         env["RT_WORKER_ID"] = worker_id.hex()
         env["RT_NODE_ID"] = self.node_id.hex()
         env["RT_RAYLET_PORT"] = str(self.port)
         env["RT_GCS_ADDR"] = f"{self.gcs_host}:{self.gcs_port}"
         env["RT_STORE_NAME"] = self.store_name
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env,
-            stdout=None,
-            stderr=None,
-        )
+        # Fast path: fork from the zygote (one warm interpreter, see
+        # _private/zygote.py) instead of booting a fresh interpreter +
+        # imports (~300ms) per worker. Falls back to Popen while the
+        # zygote warms up or if it keeps dying.
+        proc = None
+        if not env.get("RT_DISABLE_ZYGOTE"):
+            if self._zygote is None:
+                from ray_tpu._private.zygote_client import ZygoteManager
+
+                self._zygote = ZygoteManager()
+            proc = self._zygote.spawn(env)
+        if proc is None:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env,
+                stdout=None,
+                stderr=None,
+            )
         handle = WorkerHandle(
             proc, worker_id,
             runtime_env_hash=runtime_env.get("hash") if runtime_env else None,
@@ -395,6 +445,13 @@ class Raylet:
 
     def _forget_worker(self, w: WorkerHandle):
         self.workers.pop(w.worker_id, None)
+        # Return a direct-transport lease's held resources.
+        if w.lease_resources is not None:
+            for k, v in w.lease_resources.items():
+                self.resources_available[k] = (
+                    self.resources_available.get(k, 0) + v
+                )
+            w.lease_resources = None
         # Return an actor worker's held resources.
         if w.actor_id is not None and w.actor_resources:
             bundle_key = getattr(w, "actor_bundle", None)
@@ -593,7 +650,10 @@ class Raylet:
                 {"actor_id": w.actor_id, "reason": "actor worker failed to start"},
             )
             return
-        await w.conn.push("create_actor", payload["create_spec"])
+        create_spec = dict(payload["create_spec"])
+        # The worker gates its lazy TPU-backend attach on the resource shape.
+        create_spec.setdefault("resources", resources)
+        await w.conn.push("create_actor", create_spec)
 
     @staticmethod
     def _propagated_pythonpath(existing: str = "") -> str:
@@ -947,11 +1007,93 @@ class Raylet:
                 # reference keeps infeasible tasks pending for the
                 # autoscaler to satisfy).
 
-        self.task_queue.append((spec, fut))
+        self._enqueue_task(spec, fut)
         self._queued_demand_add(resources, +1, spec)
         self._record_task_event(spec, "PENDING_SCHEDULING")
         self._dispatch_event.set()
         return await fut
+
+    @staticmethod
+    def _sched_class(spec) -> tuple:
+        """Scheduling class: tasks in one class are interchangeable for
+        dispatch (same resource shape, runtime env, and bundle), so a
+        blocked head task blocks only its own class."""
+        pg = spec.get("pg_bundle")
+        return (
+            spec.get("runtime_env_hash"),
+            tuple(sorted((spec.get("resources") or {}).items())),
+            tuple(pg) if pg else None,
+        )
+
+    def _enqueue_task(self, spec, fut):
+        self.task_queues.setdefault(self._sched_class(spec), deque()).append(
+            (spec, fut)
+        )
+
+    def _queued_task_count(self) -> int:
+        return sum(len(q) for q in self.task_queues.values())
+
+    async def h_lease_worker(self, d, conn):
+        """Grant an idle worker to the calling owner for direct task
+        pushes (RequestWorkerLease, direct_task_transport.cc:409). The
+        lease holds the requested resources until release_lease, worker
+        death, or owner disconnect; the owner streams run_task_direct
+        calls straight to the worker, skipping this raylet per task."""
+        resources = d.get("resources") or {}
+        renv_hash = d.get("runtime_env_hash")
+        worker = self._idle_worker(renv_hash)
+        if worker is None or not self._available_locally(resources):
+            # Opportunistically grow the pool so a later lease lands.
+            if self._available_for_new_work(resources):
+                cfg = get_config()
+                n_live = sum(
+                    1 for w in self.workers.values() if w.actor_id is None
+                )
+                n_starting = sum(
+                    1 for w in self.workers.values()
+                    if w.actor_id is None and w.conn is None
+                    and w.runtime_env_hash == renv_hash
+                )
+                if n_live < cfg.max_workers_per_node and n_starting < 4:
+                    self._spawn_worker(d.get("runtime_env"))
+            return {"status": "none"}
+        self._acquire(resources)
+        worker.idle = False
+        worker.lease_resources = dict(resources)
+        worker.leased_by = conn  # released if this owner disconnects
+        return {
+            "status": "ok",
+            "worker_id": worker.worker_id,
+            "host": self.host,
+            "port": worker.port,
+        }
+
+    def _release_lease_of(self, w: WorkerHandle):
+        if w.lease_resources is None:
+            return
+        for k, v in w.lease_resources.items():
+            self.resources_available[k] = (
+                self.resources_available.get(k, 0) + v
+            )
+        w.lease_resources = None
+        w.leased_by = None
+        w.idle = True
+        w.last_idle_time = time.monotonic()
+        self._dispatch_event.set()
+
+    async def h_release_lease(self, d, conn):
+        w = self.workers.get(d["worker_id"])
+        if w is not None:
+            self._release_lease_of(w)
+        return {"ok": True}
+
+    async def _on_client_disconnect(self, conn):
+        """An owner connection died: return every lease it held (the
+        reference's lease lifetime is likewise bounded by the owner,
+        direct_task_transport.cc ReturnWorker on disconnect)."""
+        for w in list(self.workers.values()):
+            if getattr(w, "leased_by", None) is conn:
+                self._release_lease_of(w)
 
     async def _forward_and_resolve(self, spec, fut, node_id: bytes):
         """Forward a queued task; on transport failure put it back in the
@@ -967,7 +1109,7 @@ class Raylet:
             and "target node unavailable" in str(result.get("error", ""))
         ):
             if not fut.done():
-                self.task_queue.append((spec, fut))
+                self._enqueue_task(spec, fut)
                 self._queued_demand_add(spec.get("resources", {}), +1, spec)
                 self._dispatch_event.set()
             return
@@ -1019,164 +1161,25 @@ class Raylet:
             return conn
 
     async def _dispatch_loop(self):
-        """LocalTaskManager::DispatchScheduledTasksToWorkers analog."""
+        """LocalTaskManager::DispatchScheduledTasksToWorkers analog.
+
+        Per wake-up, each scheduling class dispatches from its own queue
+        until that class blocks (no worker / no resources / infeasible).
+        A blocked class costs O(1) per pass, so draining N homogeneous
+        queued tasks is O(N) total, not O(N^2)."""
         cfg = get_config()
         while True:
             await self._dispatch_event.wait()
             self._dispatch_event.clear()
-            requeue = []
-            pass_nodes = None  # one get_nodes snapshot per pass (throttled)
-            while self.task_queue:
-                spec, fut = self.task_queue.popleft()
-                if fut.done():
-                    self._queued_demand_add(spec.get("resources", {}), -1, spec)
+            ctx = {"nodes": None}  # one get_nodes snapshot per pass
+            blocked = False
+            for key in list(self.task_queues.keys()):
+                q = self.task_queues.get(key)
+                if not q:
+                    self.task_queues.pop(key, None)
                     continue
-                resources = spec.get("resources", {})
-                if spec.get("pg_bundle") is not None and self._bundle_for(spec) is None:
-                    self._queued_demand_add(resources, -1, spec)
-                    if not fut.done():
-                        fut.set_result(
-                            {"status": "error",
-                             "error": "placement group bundle was removed"}
-                        )
-                    continue
-                if not self._feasible_locally(resources) and not spec.get("forwarded"):
-                    # Infeasible here: hand off once a feasible node joins
-                    # (autoscaled nodes register with the GCS). One cluster
-                    # snapshot per 0.5s pass serves ALL infeasible tasks —
-                    # a poison task must not starve placeable ones.
-                    now = time.monotonic()
-                    if pass_nodes is None and now - self._last_infeasible_check >= 0.5:
-                        self._last_infeasible_check = now
-                        try:
-                            pass_nodes = (await self.gcs.call("get_nodes", {}))["nodes"]
-                        except Exception:
-                            pass_nodes = []
-                    node = (
-                        self._pick_remote_node_from(pass_nodes, resources)
-                        if pass_nodes is not None
-                        else None
-                    )
-                    if node is not None:
-                        node["resources_available"] = {
-                            k: node["resources_available"].get(k, 0) - v
-                            for k, v in resources.items()
-                        } | {
-                            k: v
-                            for k, v in node["resources_available"].items()
-                            if k not in resources
-                        }
-                        self._queued_demand_add(resources, -1, spec)
-                        spawn(
-                            self._forward_and_resolve(spec, fut, node["node_id"])
-                        )
-                        continue
-                    tid = spec["task_id"]
-                    first = self._queued_since.setdefault(tid, now)
-                    if now - first > cfg.infeasible_warn_s and tid not in self._infeasible_warned:
-                        self._infeasible_warned.add(tid)
-                        print(
-                            f"[ray_tpu] WARNING: task {spec.get('name') or tid.hex()[:8]} "
-                            f"has been infeasible for 30s (needs {resources}); "
-                            "no node in the cluster can satisfy it — waiting "
-                            "for the autoscaler or a new node.",
-                            file=sys.stderr, flush=True,
-                        )
-                    requeue.append((spec, fut))
-                    continue
-                deps = spec.get("deps") or []
-                missing = [d for d in deps if not self.store.contains_raw(d)]
-                if missing:
-                    spawn(self._fetch_then_requeue(spec, fut, missing))
-                    continue
-                renv_hash = spec.get("runtime_env_hash")
-                bad = self._bad_runtime_envs.get(renv_hash)
-                if bad is not None and time.monotonic() - bad[1] < cfg.bad_runtime_env_ttl_s:
-                    self._queued_demand_add(resources, -1, spec)
-                    if not fut.done():
-                        fut.set_result(
-                            {"status": "error",
-                             "error": f"runtime_env setup failed: {bad[0]}"}
-                        )
-                    continue
-                worker = self._idle_worker(renv_hash)
-                if worker is None:
-                    if not self._available_locally(resources):
-                        # Every matching resource is already acquired by
-                        # running tasks — a fresh worker could not take this
-                        # task either. Spawning here is the storm that burns
-                        # CPU on worker startup instead of task execution.
-                        requeue.append((spec, fut))
-                        continue
-                    # Spawn only as many workers as there is queued work,
-                    # counting ones still starting up (WorkerPool prestart
-                    # logic, worker_pool.h:347) — never a spawn storm.
-                    n_live = sum(
-                        1 for w in self.workers.values() if w.actor_id is None
-                    )
-                    n_starting = sum(
-                        1
-                        for w in self.workers.values()
-                        if w.actor_id is None and w.conn is None
-                        and w.runtime_env_hash == renv_hash
-                    )
-                    # Bound prestart by how many tasks of this footprint can
-                    # actually run at once — with 4 free CPUs and CPU:1
-                    # tasks, 4 workers saturate the node; the 5th..16th only
-                    # burn startup CPU the running tasks need.
-                    cap = None
-                    for k, v in resources.items():
-                        if v > 0:
-                            c = int(self.resources_available.get(k, 0) // v)
-                            cap = c if cap is None else min(cap, c)
-                    wanted = 1 + len(self.task_queue) + len(requeue)
-                    if cap is not None:
-                        wanted = min(wanted, max(cap, 1))
-                    if n_live >= cfg.max_workers_per_node and n_starting == 0:
-                        # Pool full of other-env workers: replace an idle one
-                        # so a new env hash can't starve (the reference kills
-                        # idle workers to make room the same way).
-                        victim = next(
-                            (
-                                w
-                                for w in self.workers.values()
-                                if w.idle and w.actor_id is None
-                                and w.conn is not None
-                                and w.runtime_env_hash != renv_hash
-                            ),
-                            None,
-                        )
-                        if victim is not None:
-                            try:
-                                victim.proc.kill()
-                            except Exception:
-                                pass
-                            self._forget_worker(victim)
-                            n_live -= 1
-                    if n_live < cfg.max_workers_per_node and n_starting < wanted:
-                        self._spawn_worker(spec.get("runtime_env"))
-                    requeue.append((spec, fut))
-                    continue
-                if not self._try_acquire_for(spec):
-                    requeue.append((spec, fut))
-                    continue
-                self._queued_demand_add(resources, -1, spec)
-                worker.idle = False
-                worker.current_task = spec["task_id"]
-                self.inflight[spec["task_id"]] = {
-                    "spec": spec,
-                    "fut": fut,
-                    "worker": worker,
-                    "start": time.monotonic(),
-                }
-                self._metric_tasks_dispatched += 1
-                self._record_task_event(
-                    spec, "RUNNING", worker_id=worker.worker_id
-                )
-                await worker.conn.push("run_task", spec)
-            for item in requeue:
-                self.task_queue.append(item)
-            if requeue:
+                blocked |= await self._dispatch_class(q, ctx, cfg)
+            if blocked:
                 # Blocked on resources/workers: rescan the moment anything
                 # completes (h_task_done sets the event) instead of a fixed
                 # sleep — the sleep gated every wave of a large batch to
@@ -1189,6 +1192,161 @@ class Raylet:
                     )
                 except asyncio.TimeoutError:
                     self._dispatch_event.set()
+
+    async def _dispatch_class(self, q: deque, ctx: dict, cfg) -> bool:
+        """Dispatch one scheduling class until it empties or blocks.
+        Returns True if tasks remain queued (class is blocked)."""
+        while q:
+            spec, fut = q[0]
+            if fut.done():
+                q.popleft()
+                self._queued_demand_add(spec.get("resources", {}), -1, spec)
+                continue
+            resources = spec.get("resources", {})
+            if spec.get("pg_bundle") is not None and self._bundle_for(spec) is None:
+                q.popleft()
+                self._queued_demand_add(resources, -1, spec)
+                if not fut.done():
+                    fut.set_result(
+                        {"status": "error",
+                         "error": "placement group bundle was removed"}
+                    )
+                continue
+            if not self._feasible_locally(resources) and not spec.get("forwarded"):
+                # Infeasible here: hand off once a feasible node joins
+                # (autoscaled nodes register with the GCS). One cluster
+                # snapshot per 0.5s pass serves ALL infeasible classes —
+                # a poison class must not starve placeable ones.
+                now = time.monotonic()
+                if ctx["nodes"] is None and now - self._last_infeasible_check >= 0.5:
+                    self._last_infeasible_check = now
+                    try:
+                        ctx["nodes"] = (await self.gcs.call("get_nodes", {}))["nodes"]
+                    except Exception:
+                        ctx["nodes"] = []
+                node = (
+                    self._pick_remote_node_from(ctx["nodes"], resources)
+                    if ctx["nodes"] is not None
+                    else None
+                )
+                if node is not None:
+                    node["resources_available"] = {
+                        k: node["resources_available"].get(k, 0) - v
+                        for k, v in resources.items()
+                    } | {
+                        k: v
+                        for k, v in node["resources_available"].items()
+                        if k not in resources
+                    }
+                    q.popleft()
+                    self._queued_demand_add(resources, -1, spec)
+                    spawn(
+                        self._forward_and_resolve(spec, fut, node["node_id"])
+                    )
+                    continue
+                tid = spec["task_id"]
+                first = self._queued_since.setdefault(tid, now)
+                if now - first > cfg.infeasible_warn_s and tid not in self._infeasible_warned:
+                    self._infeasible_warned.add(tid)
+                    print(
+                        f"[ray_tpu] WARNING: task {spec.get('name') or tid.hex()[:8]} "
+                        f"has been infeasible for 30s (needs {resources}); "
+                        "no node in the cluster can satisfy it — waiting "
+                        "for the autoscaler or a new node.",
+                        file=sys.stderr, flush=True,
+                    )
+                return True
+            deps = spec.get("deps") or []
+            missing = [d for d in deps if not self.store.contains_raw(d)]
+            if missing:
+                q.popleft()
+                spawn(self._fetch_then_requeue(spec, fut, missing))
+                continue
+            renv_hash = spec.get("runtime_env_hash")
+            bad = self._bad_runtime_envs.get(renv_hash)
+            if bad is not None and time.monotonic() - bad[1] < cfg.bad_runtime_env_ttl_s:
+                q.popleft()
+                self._queued_demand_add(resources, -1, spec)
+                if not fut.done():
+                    fut.set_result(
+                        {"status": "error",
+                         "error": f"runtime_env setup failed: {bad[0]}"}
+                    )
+                continue
+            worker = self._idle_worker(renv_hash)
+            if worker is None:
+                if not self._available_locally(resources):
+                    # Every matching resource is already acquired by
+                    # running tasks — a fresh worker could not take this
+                    # task either. Spawning here is the storm that burns
+                    # CPU on worker startup instead of task execution.
+                    return True
+                # Spawn only as many workers as there is queued work,
+                # counting ones still starting up (WorkerPool prestart
+                # logic, worker_pool.h:347) — never a spawn storm.
+                n_live = sum(
+                    1 for w in self.workers.values() if w.actor_id is None
+                )
+                n_starting = sum(
+                    1
+                    for w in self.workers.values()
+                    if w.actor_id is None and w.conn is None
+                    and w.runtime_env_hash == renv_hash
+                )
+                # Bound prestart by how many tasks of this footprint can
+                # actually run at once — with 4 free CPUs and CPU:1
+                # tasks, 4 workers saturate the node; the 5th..16th only
+                # burn startup CPU the running tasks need.
+                cap = None
+                for k, v in resources.items():
+                    if v > 0:
+                        c = int(self.resources_available.get(k, 0) // v)
+                        cap = c if cap is None else min(cap, c)
+                wanted = len(q)
+                if cap is not None:
+                    wanted = min(wanted, max(cap, 1))
+                if n_live >= cfg.max_workers_per_node and n_starting == 0:
+                    # Pool full of other-env workers: replace an idle one
+                    # so a new env hash can't starve (the reference kills
+                    # idle workers to make room the same way).
+                    victim = next(
+                        (
+                            w
+                            for w in self.workers.values()
+                            if w.idle and w.actor_id is None
+                            and w.conn is not None
+                            and w.runtime_env_hash != renv_hash
+                        ),
+                        None,
+                    )
+                    if victim is not None:
+                        try:
+                            victim.proc.kill()
+                        except Exception:
+                            pass
+                        self._forget_worker(victim)
+                        n_live -= 1
+                if n_live < cfg.max_workers_per_node and n_starting < wanted:
+                    self._spawn_worker(spec.get("runtime_env"))
+                return True
+            if not self._try_acquire_for(spec):
+                return True
+            q.popleft()
+            self._queued_demand_add(resources, -1, spec)
+            worker.idle = False
+            worker.current_task = spec["task_id"]
+            self.inflight[spec["task_id"]] = {
+                "spec": spec,
+                "fut": fut,
+                "worker": worker,
+                "start": time.monotonic(),
+            }
+            self._metric_tasks_dispatched += 1
+            self._record_task_event(
+                spec, "RUNNING", worker_id=worker.worker_id
+            )
+            await worker.conn.push("run_task", spec)
+        return False
 
     def _idle_worker(self, renv_hash: Optional[str] = None) -> Optional[WorkerHandle]:
         for w in self.workers.values():
@@ -1210,7 +1368,7 @@ class Raylet:
             if not fut.done():
                 fut.set_result({"status": "error", "error": f"dependency fetch failed: {e}"})
             return
-        self.task_queue.append((spec, fut))
+        self._enqueue_task(spec, fut)
         self._dispatch_event.set()
 
     def _free_local(self, oid: bytes):

@@ -30,7 +30,13 @@ from ray_tpu._private.config import get_config
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, object_id_for_task
 from ray_tpu._private.object_store import ObjectStore
-from ray_tpu._private.protocol import Connection, ConnectionLost, connect
+from ray_tpu._private.protocol import (
+    Connection,
+    ConnectionLost,
+    RpcError,
+    connect,
+    spawn,
+)
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -258,6 +264,16 @@ class CoreClient:
         self._connected = False
         self.default_runtime_env = None  # job-level env from init()
         self._runtime_env_cache: Dict[str, Optional[dict]] = {}
+        # Direct task transport: leased workers per scheduling class
+        # (direct_task_transport.cc OnWorkerIdle — keep a granted worker
+        # hot and push queued tasks without re-contacting the raylet).
+        self._leases: Dict[tuple, dict] = {}
+        self._lease_reaper: Optional[asyncio.Task] = None
+        # Submit batching: bursts of .remote() calls cross the
+        # thread->loop boundary once, not once per task.
+        self._submit_buf: list = []
+        self._submit_scheduled = False
+        self._submit_lock = threading.Lock()
         # Owner-side lineage: store-kind return oid -> creating task spec,
         # for reconstruction when every copy is lost (TaskManager lineage +
         # ObjectRecoveryManager, object_recovery_manager.h:41).
@@ -377,6 +393,13 @@ class CoreClient:
         self._pins.clear()
 
         async def _close():
+            if self._lease_reaper is not None:
+                self._lease_reaper.cancel()
+                self._lease_reaper = None
+            try:
+                await self._release_all_leases()
+            except Exception:  # noqa: BLE001
+                pass
             for c in list(self._actor_conns.values()):
                 await c.close()
             if self.gcs:
@@ -940,10 +963,168 @@ class CoreClient:
             refs.append(ref)
             futures.append(fut)
         self._borrow_deps(spec, deps)
-        asyncio.run_coroutine_threadsafe(
-            self._submit_with_retries(spec, futures, retries), self.loop
-        )
+        with self._submit_lock:
+            self._submit_buf.append((spec, futures, retries))
+            need_schedule = not self._submit_scheduled
+            if need_schedule:
+                self._submit_scheduled = True
+        if need_schedule:
+            self.loop.call_soon_threadsafe(self._drain_submits)
         return refs
+
+    def _drain_submits(self):
+        """Runs on the loop: route a burst of queued submissions."""
+        with self._submit_lock:
+            buf, self._submit_buf = self._submit_buf, []
+            self._submit_scheduled = False
+        for item in buf:
+            if item[0] == "actor":
+                _, actor_id, request, spec, futures, retries = item
+                spawn(self._actor_call_with_retries(
+                    actor_id, request, spec, futures, retries
+                ))
+            elif self._direct_eligible(item[0]):
+                spawn(self._submit_direct(*item))
+            else:
+                spawn(self._submit_with_retries(*item))
+
+    @staticmethod
+    def _direct_eligible(spec) -> bool:
+        """Direct transport handles the plain case: no object deps (the
+        raylet owns dependency fetching), default scheduling, single
+        return. Everything else takes the classic submit path."""
+        return (
+            not spec.get("deps")
+            and spec.get("scheduling") is None
+            and spec.get("num_returns", 1) == 1
+        )
+
+    async def _submit_direct(self, spec, futures, retries):
+        entry = None
+        try:
+            entry = await self._lease_for(spec)
+        except Exception:  # noqa: BLE001 — lease machinery must never lose a task
+            entry = None
+        if entry is None:
+            return await self._submit_with_retries(spec, futures, retries)
+        entry["outstanding"] += 1
+        entry["last_used"] = time.monotonic()
+        try:
+            result = await entry["conn"].call("run_task_direct", spec,
+                                              timeout=None)
+        except (ConnectionLost, RpcError):
+            # Leased worker died mid-task. The task may have executed
+            # before the reply was lost, so max_retries=0 (at-most-once)
+            # must NOT re-run it — same contract as the classic path.
+            if retries == 0:
+                self._complete_task(
+                    spec,
+                    {"status": "worker_crashed",
+                     "error": "leased worker connection lost"},
+                    futures,
+                )
+                return
+            remaining = retries if retries < 0 else retries - 1
+            return await self._submit_with_retries(spec, futures, remaining)
+        finally:
+            entry["outstanding"] -= 1
+            entry["last_used"] = time.monotonic()
+        self._complete_task(spec, result, futures)
+
+    async def _lease_for(self, spec):
+        key = (
+            spec.get("runtime_env_hash"),
+            tuple(sorted((spec.get("resources") or {}).items())),
+        )
+        pool = self._leases.setdefault(
+            key, {"workers": [], "acquiring": False}
+        )
+        live = [w for w in pool["workers"] if not w["conn"]._closed]
+        pool["workers"] = live
+        best = min(live, key=lambda w: w["outstanding"], default=None)
+        # Grow while tasks are stacking up (up to the node's CPU-ish cap);
+        # single-flight so a burst requests one lease at a time.
+        if (
+            (best is None or best["outstanding"] >= 2)
+            and len(live) < 16
+            and not pool["acquiring"]
+        ):
+            pool["acquiring"] = True
+            try:
+                resp = await self.raylet.call(
+                    "lease_worker",
+                    {
+                        "resources": spec.get("resources") or {},
+                        "runtime_env_hash": spec.get("runtime_env_hash"),
+                        "runtime_env": spec.get("runtime_env"),
+                    },
+                    timeout=10,
+                )
+                if resp.get("status") == "ok":
+                    try:
+                        conn = await connect(resp["host"], resp["port"])
+                    except Exception:
+                        # Granted but unreachable: return it or the
+                        # raylet's resources leak until our conn dies.
+                        await self.raylet.call(
+                            "release_lease",
+                            {"worker_id": resp["worker_id"]}, timeout=5,
+                        )
+                        raise
+                    w = {
+                        "conn": conn,
+                        "worker_id": resp["worker_id"],
+                        "outstanding": 0,
+                        "last_used": time.monotonic(),
+                        "key": key,
+                    }
+                    pool["workers"].append(w)
+                    if best is None:
+                        best = w
+            except Exception:  # noqa: BLE001 — lease is opportunistic
+                pass
+            finally:
+                pool["acquiring"] = False
+        if best is not None and self._lease_reaper is None:
+            self._lease_reaper = spawn(self._reap_leases_loop())
+        return best
+
+    async def _reap_leases_loop(self):
+        """Return idle leases so the raylet can schedule other work."""
+        try:
+            while self._connected:
+                await asyncio.sleep(0.5)
+                now = time.monotonic()
+                for pool in self._leases.values():
+                    keep = []
+                    for w in pool["workers"]:
+                        if w["conn"]._closed:
+                            continue
+                        if w["outstanding"] == 0 and now - w["last_used"] > 1.0:
+                            await self._release_lease(w)
+                        else:
+                            keep.append(w)
+                    pool["workers"] = keep
+        except asyncio.CancelledError:
+            pass
+
+    async def _release_lease(self, w):
+        try:
+            await self.raylet.call(
+                "release_lease", {"worker_id": w["worker_id"]}, timeout=5
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            await w["conn"].close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def _release_all_leases(self):
+        for pool in self._leases.values():
+            for w in pool["workers"]:
+                await self._release_lease(w)
+            pool["workers"] = []
 
     async def _submit_with_retries(self, spec, futures, retries):
         attempt = 0
@@ -1152,12 +1333,17 @@ class CoreClient:
             futures.append(fut)
         spec = {"task_id": task_id.binary()}
         self._borrow_deps(spec, deps)
-        asyncio.run_coroutine_threadsafe(
-            self._actor_call_with_retries(
-                actor_id, request, spec, futures, max_task_retries
-            ),
-            self.loop,
-        )
+        # Same burst batching as plain tasks: one thread->loop crossing
+        # per burst of .remote() calls, not one per call.
+        with self._submit_lock:
+            self._submit_buf.append(
+                ("actor", actor_id, request, spec, futures, max_task_retries)
+            )
+            need_schedule = not self._submit_scheduled
+            if need_schedule:
+                self._submit_scheduled = True
+        if need_schedule:
+            self.loop.call_soon_threadsafe(self._drain_submits)
         return refs
 
     async def _actor_call_with_retries(self, actor_id, request, spec, futures, retries):

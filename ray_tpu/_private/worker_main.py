@@ -27,6 +27,48 @@ from ray_tpu._private.ids import JobID, ObjectID, TaskID, object_id_for_task
 from ray_tpu._private.protocol import RpcServer, connect, spawn
 from ray_tpu._private.worker import CoreClient, make_task_error
 
+_TPU_ATTACHED = False
+_TPU_ATTACH_LOCK = threading.Lock()
+
+
+def _wants_tpu(resources) -> bool:
+    return any(
+        k == "TPU" or k.startswith("TPU-") for k in (resources or {})
+    )
+
+
+def ensure_tpu_backend():
+    """Attach the deferred remote-TPU jax backend, once.
+
+    The raylet strips PALLAS_AXON_POOL_IPS from worker environments (so
+    sitecustomize skips its eager ~2s jax import at interpreter start) and
+    stashes it in RT_DEFERRED_TPU_TUNNEL. The first task/actor that
+    requests TPU resources restores the env and re-runs sitecustomize,
+    which performs the exact registration the interpreter would have done
+    at startup. CPU-only workers never pay for the tunnel."""
+    global _TPU_ATTACHED
+    # Serialized + flag-set-last: a concurrent TPU task must block until
+    # registration completes, not race past a pre-set flag into jax with
+    # no backend.
+    with _TPU_ATTACH_LOCK:
+        if _TPU_ATTACHED:
+            return
+        ips = os.environ.get("RT_DEFERRED_TPU_TUNNEL", "")
+        if not ips:
+            return
+        os.environ["PALLAS_AXON_POOL_IPS"] = ips
+        jp = os.environ.get("RT_DEFERRED_JAX_PLATFORMS")
+        if jp:
+            os.environ["JAX_PLATFORMS"] = jp
+        import sys as _sys
+
+        _sys.modules.pop("sitecustomize", None)
+        try:
+            import sitecustomize  # noqa: F401 — re-runs TPU registration
+        except Exception:
+            pass
+        _TPU_ATTACHED = True
+
 
 class _RawObject:
     """Pre-framed bytes (RTX1 cross-language objects) presented with the
@@ -77,6 +119,7 @@ class WorkerProcess:
         self.store_name = os.environ["RT_STORE_NAME"]
         self.rpc = RpcServer("127.0.0.1", 0)
         self.rpc.register("actor_call", self.h_actor_call)
+        self.rpc.register("run_task_direct", self.h_run_task_direct)
         self.rpc.register("dag_start", self.h_dag_start)
         self.rpc.register("dag_stop", self.h_dag_stop)
         self.rpc.register("ping", self.h_ping)
@@ -89,6 +132,7 @@ class WorkerProcess:
             max_workers=max(4, get_config().max_workers_per_node)
         )
         self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._direct_lock = asyncio.Lock()  # one leased task runs at a time
         # Actor-call state events (normal-task events are recorded by the
         # raylet; actor calls bypass it, so the receiving worker reports).
         self._task_events: list = []
@@ -210,6 +254,20 @@ class WorkerProcess:
             "task_done", {"task_id": spec["task_id"], "result": result}
         )
 
+    async def h_run_task_direct(self, d, conn):
+        """Leased-worker fast path: the owner pushes the task spec straight
+        to this worker and the result rides the RPC response — the raylet
+        is not on the per-task path (direct_task_transport.cc PushTask).
+
+        Execution is serialized: the lease holds resources for ONE task
+        shape, so pipelined pushes queue here rather than running
+        concurrently in the executor (which would oversubscribe the
+        node's accounting)."""
+        async with self._direct_lock:
+            return await self.loop.run_in_executor(
+                self.executor, self._execute_task, d
+            )
+
     def _execute_task(self, spec) -> dict:
         from ray_tpu.util import tracing
 
@@ -220,6 +278,8 @@ class WorkerProcess:
 
     def _execute_task_body(self, spec) -> dict:
         try:
+            if _wants_tpu(spec.get("resources")):
+                ensure_tpu_backend()
             if spec.get("fn_name"):
                 # Cross-language task (reference: cross_language.py /
                 # function-descriptor calls from java/cpp frontends): the
@@ -270,6 +330,8 @@ class WorkerProcess:
     # -- actor lifecycle --------------------------------------------------
     async def _create_actor(self, payload):
         def do_create():
+            if _wants_tpu(payload.get("resources")):
+                ensure_tpu_backend()
             cls = self.client.fn_manager.fetch(payload["cls_key"])
             args, kwargs = self.client.deserialize_args(payload["args"])
             return cls(*args, **kwargs)

@@ -1,0 +1,201 @@
+"""Raylet-side client for the worker fork server (zygote.py).
+
+Exposes `ZygoteManager.spawn(env) -> ZygoteProc | None`, a synchronous,
+non-blocking fork request the dispatch loop can issue in place of a
+subprocess.Popen. ZygoteProc mirrors the Popen surface the raylet uses
+(pid / poll / kill / terminate / wait / returncode) so WorkerHandle and
+the reap loop are agnostic to how the worker was started.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+
+class ZygoteProc:
+    """Popen-compatible handle for a zygote-forked worker.
+
+    The pid arrives asynchronously (the fork reply is read off the
+    zygote's stdout by the manager); kill/terminate before the pid is
+    known are remembered and delivered on assignment.
+    """
+
+    def __init__(self, mgr: "ZygoteManager"):
+        self._mgr = mgr
+        self.pid: Optional[int] = None
+        self.returncode: Optional[int] = None
+        self._pending_signal: Optional[int] = None
+
+    def _assign(self, pid: int) -> None:
+        self.pid = pid
+        if self._pending_signal is not None:
+            sig, self._pending_signal = self._pending_signal, None
+            self._signal(sig)
+
+    def _fail(self, rc: int) -> None:
+        if self.returncode is None:
+            self.returncode = rc
+
+    def _signal(self, sig: int) -> None:
+        if self.returncode is not None:
+            return
+        if self.pid is None:
+            self._pending_signal = sig
+            return
+        try:
+            os.kill(self.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is None and self.pid is not None:
+            rc = self._mgr._dead.pop(self.pid, None)
+            if rc is not None:
+                self.returncode = rc
+        return self.returncode
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("zygote-worker", timeout or 0)
+            time.sleep(0.01)
+        return self.returncode  # type: ignore[return-value]
+
+
+class ZygoteManager:
+    def __init__(self, base_env: Optional[dict] = None):
+        # The zygote itself must not import jax: strip the TPU tunnel
+        # trigger from its environment (children get their own env per
+        # spawn request and attach the backend lazily).
+        env = dict(base_env if base_env is not None else os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        self._base_env = env
+        self.proc: Optional[subprocess.Popen] = None
+        self._pending: deque[ZygoteProc] = deque()
+        self._dead: Dict[int, int] = {}
+        self._reader: Optional[threading.Thread] = None
+        self._loop = None
+        self._deaths = 0  # zygote process deaths; disable after 3
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def start(self) -> bool:
+        """Start the zygote process (sync, cheap — the import cost is paid
+        inside the zygote, not here)."""
+        if self.alive():
+            return True
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.zygote"],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=None,
+                env=self._base_env,
+                text=True,
+                bufsize=1,
+            )
+        except Exception:  # noqa: BLE001 — caller falls back to Popen spawns
+            self.proc = None
+            return False
+        # A dedicated DAEMON thread, not run_in_executor: a blocked
+        # readline in the loop's default executor is a non-daemon thread
+        # that keeps the interpreter alive at exit (observed as pytest
+        # printing its summary then hanging until killed).
+        self._loop = asyncio.get_event_loop()
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(self.proc,),
+            name="zygote-reader", daemon=True,
+        )
+        self._reader.start()
+        return True
+
+    def _read_loop(self, proc: subprocess.Popen) -> None:
+        """Daemon thread: reads zygote replies, applies them on the loop."""
+        loop = self._loop
+        while True:
+            try:
+                line = proc.stdout.readline()
+            except Exception:  # noqa: BLE001
+                line = ""
+            if not line:
+                try:
+                    loop.call_soon_threadsafe(self._on_zygote_death)
+                except RuntimeError:
+                    self._on_zygote_death()  # loop gone: apply inline
+                return
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            try:
+                loop.call_soon_threadsafe(self._on_message, msg)
+            except RuntimeError:
+                self._on_message(msg)
+
+    def _on_zygote_death(self) -> None:
+        # Pending forks never happened.
+        self._deaths += 1
+        while self._pending:
+            self._pending.popleft()._fail(-1)
+
+    def _on_message(self, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "spawned" and self._pending:
+            self._pending.popleft()._assign(msg["pid"])
+        elif op == "dead":
+            if len(self._dead) > 4096:  # unconsumed-notice backstop
+                self._dead.clear()
+            self._dead[msg["pid"]] = msg["rc"]
+
+    def spawn(self, env: dict) -> Optional[ZygoteProc]:
+        """Queue a fork request; returns None when the zygote isn't up yet
+        (caller uses a normal Popen spawn and the zygote warms for next
+        time)."""
+        if self._deaths >= 3:
+            return None  # repeatedly crashing: stick to Popen spawns
+        if not self.alive() and not self.start():
+            return None
+        zp = ZygoteProc(self)
+        self._pending.append(zp)
+        try:
+            self.proc.stdin.write(
+                json.dumps({"op": "spawn", "env": env}) + "\n"
+            )
+            self.proc.stdin.flush()
+        except Exception:  # noqa: BLE001 — zygote just died
+            try:
+                self._pending.remove(zp)
+            except ValueError:
+                pass
+            return None
+        return zp
+
+    def stop(self) -> None:
+        if self.proc is not None:
+            try:
+                self.proc.stdin.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+            self.proc = None
+        self._reader = None  # daemon thread exits on pipe EOF

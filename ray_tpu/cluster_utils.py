@@ -28,9 +28,12 @@ class Cluster:
         self.head = None
         self._client = None
 
-    def kill_gcs(self):
-        """Hard-stop the GCS process (fault injection)."""
-        self.io.run(self.gcs.stop(), timeout=5)
+    def kill_gcs(self, hard: bool = False):
+        """Stop the GCS (fault injection). `hard=True` skips the final
+        snapshot flush — recovery then depends entirely on WAL replay."""
+        self.io.run(
+            self.gcs.kill() if hard else self.gcs.stop(), timeout=5
+        )
 
     def restart_gcs(self):
         """Start a fresh GCS on the same port; with a persist path it

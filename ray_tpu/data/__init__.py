@@ -6,6 +6,7 @@ range / from_items / from_numpy / read_parquet / read_csv / read_json.
 
 from ray_tpu.data import aggregate
 from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.executor import ActorPoolStrategy
 from ray_tpu.data.dataset import (
     Dataset,
     from_items,
@@ -18,6 +19,7 @@ from ray_tpu.data.dataset import (
 )
 
 __all__ = [
+    "ActorPoolStrategy",
     "Dataset",
     "from_items",
     "from_numpy",

@@ -19,7 +19,13 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import ray_tpu as rt
 from ray_tpu.data import block as B
-from ray_tpu.data.executor import AllToAllStage, MapStage, StreamingExecutor
+from ray_tpu.data.executor import (
+    ActorPoolStage,
+    ActorPoolStrategy,
+    AllToAllStage,
+    MapStage,
+    StreamingExecutor,
+)
 
 
 class Dataset:
@@ -39,22 +45,45 @@ class Dataset:
 
         return self._with_stage(MapStage(block_fn, name="map"))
 
-    def map_batches(self, fn: Callable, batch_format: str = "numpy") -> "Dataset":
+    def map_batches(
+        self,
+        fn: Callable,
+        batch_format: str = "numpy",
+        compute: Optional["ActorPoolStrategy"] = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
+        resources: Optional[dict] = None,
+    ) -> "Dataset":
+        """Transform batches. With `compute=ActorPoolStrategy(size=N)`,
+        `fn` is a CLASS constructed once per pool actor (state — e.g. a
+        compiled TPU model — loads once and serves every batch routed to
+        that actor); otherwise `fn` runs as stateless tasks."""
+        if compute is not None:
+            ctor_kwargs = fn_constructor_kwargs or {}
+
+            def factory(cls=fn, a=tuple(fn_constructor_args), kw=ctor_kwargs):
+                return cls(*a, **kw)
+
+            def pool_fn(state, block, _fmt=batch_format):
+                batch = B.block_to_batch(block, _fmt)
+                return _batch_out_to_block(state(batch))
+
+            return self._with_stage(ActorPoolStage(
+                factory=factory,
+                fn=pool_fn,
+                name="map_batches(actors)",
+                pool_size=compute.size,
+                max_in_flight_per_actor=compute.max_tasks_in_flight_per_actor,
+                resources=resources,
+            ))
+
         def block_fn(block):
             batch = B.block_to_batch(block, batch_format)
-            out = fn(batch)
-            if isinstance(out, dict):
-                import numpy as np
+            return _batch_out_to_block(fn(batch))
 
-                keys = list(out.keys())
-                n = len(out[keys[0]])
-                rows = [
-                    {k: _np_item(out[k][i]) for k in keys} for i in range(n)
-                ]
-                return B.block_from_rows(rows)
-            return B.block_from_rows(list(out))
-
-        return self._with_stage(MapStage(block_fn, name="map_batches"))
+        return self._with_stage(
+            MapStage(block_fn, name="map_batches", resources=resources)
+        )
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
         def block_fn(block):
@@ -738,6 +767,17 @@ def _np_item(x):
     if isinstance(x, np.generic):
         return x.item()
     return x
+
+
+def _batch_out_to_block(out):
+    """Convert a map_batches UDF's return (column dict or row iterable)
+    back to a block."""
+    if isinstance(out, dict):
+        keys = list(out.keys())
+        n = len(out[keys[0]])
+        rows = [{k: _np_item(out[k][i]) for k in keys} for i in range(n)]
+        return B.block_from_rows(rows)
+    return B.block_from_rows(list(out))
 
 
 def _json_fallback(x):

@@ -76,6 +76,150 @@ def test_distributed_sort_range_partitioned():
         rt.shutdown()
 
 
+def _event_log_fn(log_path, stage, delay=0.0):
+    """Block fn that appends (stage, idx, start, end) lines to a shared
+    file — cross-process evidence of scheduling order."""
+
+    def fn(block, _stage=stage, _p=log_path, _d=delay):
+        import os
+        import time as _t
+
+        start = _t.monotonic()
+        if _d:
+            _t.sleep(_d)
+        with open(_p, "a") as f:
+            f.write(f"{_stage} {start} {_t.monotonic()}\n")
+            f.flush()
+        return block
+
+    return fn
+
+
+def test_block_level_pipelining(tmp_path):
+    """Stage 2 must start on early blocks while stage 1 is still running
+    later blocks — no stage barrier (streaming_executor.py:57)."""
+    from ray_tpu.data.executor import MapStage, StreamingExecutor
+
+    rt.init(num_cpus=2)
+    try:
+        log = str(tmp_path / "events.log")
+        refs = [rt.put([{"i": i}]) for i in range(8)]
+        ex = StreamingExecutor([
+            MapStage(_event_log_fn(log, "s1", delay=0.15), name="s1",
+                     max_in_flight=2, resources={"CPU": 0.1}),
+            MapStage(_event_log_fn(log, "s2", delay=0.15), name="s2",
+                     max_in_flight=2, resources={"CPU": 0.2}),
+        ])
+        out = ex.execute(refs)
+        assert len(out) == 8
+        events = []
+        with open(log) as f:
+            for line in f:
+                stage, start, end = line.split()
+                events.append((stage, float(start), float(end)))
+        s1_ends = sorted(e[2] for e in events if e[0] == "s1")
+        s2_starts = sorted(e[1] for e in events if e[0] == "s2")
+        assert len(s1_ends) == 8 and len(s2_starts) == 8
+        # The first stage-2 task started before the LAST stage-1 finished.
+        assert s2_starts[0] < s1_ends[-1], (
+            "no overlap between stages — executor is running a barrier"
+        )
+    finally:
+        rt.shutdown()
+
+
+def test_adjacent_maps_fuse_into_one_task(tmp_path):
+    """Chained maps with the same resource shape run as ONE task per
+    block (OperatorFusionRule analog)."""
+    from ray_tpu.data.executor import MapStage, StreamingExecutor
+
+    rt.init(num_cpus=2)
+    try:
+        refs = [rt.put([{"i": i}]) for i in range(6)]
+        ex = StreamingExecutor([
+            MapStage(lambda b: b, name="a"),
+            MapStage(lambda b: b, name="b"),
+            MapStage(lambda b: b, name="c"),
+        ])
+        out = ex.execute(refs)
+        assert len(out) == 6
+        (seg,) = ex.stats
+        assert seg["stage"] == "a+b+c"
+        assert seg["tasks"] == 6, (
+            f"fusion should run 6 tasks (one per block), ran {seg['tasks']}"
+        )
+    finally:
+        rt.shutdown()
+
+
+class _CountingModel:
+    """Stand-in for a compiled TPU model: expensive once-per-actor init."""
+
+    def __init__(self, log_path):
+        import os
+
+        with open(log_path, "a") as f:
+            f.write(f"init {os.getpid()}\n")
+        self.bias = 100.0
+
+    def __call__(self, batch):
+        import numpy as np
+
+        return {"y": np.asarray(batch["i"], dtype=float) + self.bias}
+
+
+def test_actor_pool_map_batches(tmp_path):
+    """map_batches(CallableClass, compute=ActorPoolStrategy): state is
+    built once per pool actor and reused for every routed block."""
+    log = str(tmp_path / "inits.log")
+    rt.init(num_cpus=2)
+    try:
+        ds = rtd.from_items(
+            [{"i": i} for i in range(24)], parallelism=8
+        ).map_batches(
+            _CountingModel,
+            compute=rtd.ActorPoolStrategy(size=2),
+            fn_constructor_args=(log,),
+        )
+        out = ds.take_all()
+        assert sorted(r["y"] for r in out) == [100.0 + i for i in range(24)]
+        with open(log) as f:
+            inits = f.readlines()
+        assert len(inits) == 2, (
+            f"pool of 2 should init exactly twice, saw {len(inits)}"
+        )
+    finally:
+        rt.shutdown()
+
+
+def test_backpressure_bounds_inflight(tmp_path):
+    """No more than the operator window of stage tasks may overlap."""
+    from ray_tpu.data.executor import MapStage, StreamingExecutor
+
+    rt.init(num_cpus=4)
+    try:
+        log = str(tmp_path / "bp.log")
+        refs = [rt.put([{"i": i}]) for i in range(10)]
+        ex = StreamingExecutor([
+            MapStage(_event_log_fn(log, "w", delay=0.1), name="w",
+                     max_in_flight=2),
+        ])
+        ex.execute(refs)
+        spans = []
+        with open(log) as f:
+            for line in f:
+                _, start, end = line.split()
+                spans.append((float(start), float(end)))
+        assert len(spans) == 10
+        peak = max(
+            sum(1 for s, e in spans if s <= t < e)
+            for t, _ in spans
+        )
+        assert peak <= 2, f"window=2 but {peak} tasks overlapped"
+    finally:
+        rt.shutdown()
+
+
 def test_repartition_distributed():
     rt.init(num_cpus=2)
     try:

@@ -1,0 +1,176 @@
+"""GKETPUNodeProvider against a recorded/mock GKE API surface.
+
+The provider's only IO is transport.request(method, url, body); this mock
+models node pools + instance-group managers + async setSize operations the
+way the container/compute APIs answer (the reference tests its providers
+against fakes the same way: autoscaler/_private/fake_multi_node)."""
+
+import re
+
+import pytest
+
+from ray_tpu.autoscaler.node_provider import GKETPUNodeProvider
+
+
+class MockGKE:
+    def __init__(self):
+        self.pools = {
+            "tpu-v5e-16": {"size": 0, "instances": [], "slice_hosts": 4},
+            "cpu-pool": {"size": 1, "instances": ["zones/z/instances/cpu-0"],
+                         "slice_hosts": 1},
+        }
+        self._op_counter = 0
+        self._pending_ops = {}  # op name -> remaining polls until DONE
+        self.calls = []  # recorded (method, url, body)
+
+    def _pool_of(self, url):
+        m = re.search(r"nodePools/([^:/]+)", url)
+        if m:
+            return m.group(1)
+        m = re.search(r"instanceGroupManagers/([^/]+)", url)
+        return m.group(1)
+
+    def request(self, method, url, body=None):
+        self.calls.append((method, url, body))
+        if ":setSize" in url:
+            pool = self.pools[self._pool_of(url)]
+            target = body["nodeCount"]
+            while len(pool["instances"]) < target:
+                pool["instances"].append(
+                    f"zones/z/instances/{self._pool_of(url)}-{len(pool['instances'])}"
+                )
+            pool["size"] = target
+            self._op_counter += 1
+            name = f"operation-{self._op_counter}"
+            self._pending_ops[name] = 2  # DONE after 2 polls
+            return {"name": name, "status": "RUNNING"}
+        if "/operations/" in url:
+            name = url.rsplit("/", 1)[1]
+            self._pending_ops[name] -= 1
+            done = self._pending_ops[name] <= 0
+            return {"name": name, "status": "DONE" if done else "RUNNING"}
+        if url.endswith("/listManagedInstances"):
+            pool = self.pools[self._pool_of(url)]
+            return {
+                "managedInstances": [
+                    {"instance": u} for u in pool["instances"]
+                ]
+            }
+        if url.endswith("/deleteInstances"):
+            pool = self.pools[self._pool_of(url)]
+            for u in body["instances"]:
+                if u in pool["instances"]:
+                    pool["instances"].remove(u)
+                    pool["size"] -= 1
+            return {"status": "DONE"}
+        if url.endswith("/nodePools") and method == "GET":
+            return {"nodePools": [{"name": n} for n in self.pools]}
+        if "/nodePools/" in url and method == "GET":
+            name = self._pool_of(url)
+            pool = self.pools[name]
+            return {
+                "name": name,
+                "initialNodeCount": pool["size"],
+                "instanceGroupUrls": [
+                    f"https://compute.googleapis.com/compute/v1/projects/p/"
+                    f"zones/z/instanceGroupManagers/{name}"
+                ],
+            }
+        raise AssertionError(f"unexpected GKE call: {method} {url}")
+
+
+@pytest.fixture
+def provider():
+    mock = MockGKE()
+    p = GKETPUNodeProvider(
+        "proj", "us-central2-b", "tpu-cluster",
+        transport=mock, poll_interval_s=0.0,
+    )
+    return p, mock
+
+
+def test_create_tpu_slice_is_whole_slice_atomic(provider):
+    p, mock = provider
+    ids = p.create_node(
+        "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, count=1
+    )
+    # One slice = 4 hosts created together; pool resized 0 -> 4 in ONE call.
+    assert len(ids) == 4
+    resizes = [c for c in mock.calls if ":setSize" in c[1]]
+    assert len(resizes) == 1
+    assert resizes[0][2] == {"nodeCount": 4}
+    assert mock.pools["tpu-v5e-16"]["size"] == 4
+    for nid in ids:
+        assert p.node_tags(nid)["rt-node-type"] == "v5e-16"
+
+
+def test_create_two_slices(provider):
+    p, mock = provider
+    ids = p.create_node(
+        "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, count=2
+    )
+    assert len(ids) == 8
+    assert mock.pools["tpu-v5e-16"]["size"] == 8
+
+
+def test_setsize_operation_is_polled_to_done(provider):
+    p, mock = provider
+    p.create_node("v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, 1)
+    op_polls = [c for c in mock.calls if "/operations/" in c[1]]
+    assert len(op_polls) >= 2, "async setSize must be polled until DONE"
+
+
+def test_terminate_deletes_instance_via_instance_group(provider):
+    p, mock = provider
+    ids = p.create_node(
+        "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, 1
+    )
+    p.terminate_node(ids[0])
+    deletes = [c for c in mock.calls if c[1].endswith("/deleteInstances")]
+    assert len(deletes) == 1
+    assert deletes[0][2]["instances"] == [ids[0].split("|", 1)[1]]
+    assert mock.pools["tpu-v5e-16"]["size"] == 3
+    assert ids[0] not in p.non_terminated_nodes()
+
+
+def test_non_terminated_reflects_live_pool_state():
+    mock = MockGKE()
+    p = GKETPUNodeProvider(
+        "proj", "us-central2-b", "tpu-cluster",
+        transport=mock, poll_interval_s=0.0,
+        managed_pools=["tpu-v5e-16"],  # scope to the TPU pool
+    )
+    ids = p.create_node(
+        "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, 1
+    )
+    live = p.non_terminated_nodes()
+    assert sorted(live) == sorted(ids)
+    # An instance that dies out-of-band disappears from the listing.
+    mock.pools["tpu-v5e-16"]["instances"].pop()
+    assert len(p.non_terminated_nodes()) == 3
+
+
+def test_restarted_provider_still_sees_nodes(provider):
+    """Node enumeration must come from the live API, not in-process
+    memory: a head restart creates a fresh provider that still has to
+    see (and be able to terminate) running TPU slices."""
+    p, mock = provider
+    ids = p.create_node(
+        "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, 1
+    )
+    fresh = GKETPUNodeProvider(
+        "proj", "us-central2-b", "tpu-cluster",
+        transport=mock, poll_interval_s=0.0,
+    )
+    live = fresh.non_terminated_nodes()
+    assert set(ids) <= set(live)
+    assert "cpu-pool|zones/z/instances/cpu-0" in live
+    fresh.terminate_node(ids[0])
+    assert mock.pools["tpu-v5e-16"]["size"] == 3
+
+
+def test_cpu_pool_single_host(provider):
+    p, mock = provider
+    ids = p.create_node("cpu", {"node_pool": "cpu-pool"}, count=2)
+    assert len(ids) == 2
+    assert mock.pools["cpu-pool"]["size"] == 3
