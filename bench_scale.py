@@ -103,6 +103,51 @@ def main():
     probe("64MB broadcast to 8 tasks", broadcast, results)
 
     rt.shutdown()
+
+    # 6. Cross-NODE broadcast (reference envelope: 1GiB to 50+ nodes,
+    # release/benchmarks/README.md:17; scaled to in-process raylets on
+    # this CI host). Chunked pulls ride the pull byte budget + push
+    # chunk caps (raylet flow control).
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    n_peers = 2 if quick else 4
+    mb = 64 if quick else 256
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1, object_store_memory=1 << 30)
+    for _ in range(n_peers):
+        cluster.add_node(num_cpus=1, object_store_memory=1 << 30)
+    cluster.connect()
+    try:
+        blob2 = np.zeros(mb * 1024 * 1024 // 8)
+        ref2 = rt.put(blob2)
+
+        @rt.remote
+        def touch2(x):
+            return x.nbytes
+
+        def node_broadcast():
+            outs = rt.get(
+                [
+                    touch2.options(
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=r.node_id.binary()
+                        )
+                    ).remote(ref2)
+                    for r in cluster.raylets[1:]
+                ],
+                timeout=1200,
+            )
+            assert all(o == blob2.nbytes for o in outs)
+            return {"mb": mb, "nodes": n_peers,
+                    "gb_moved": round(mb * n_peers / 1024, 2)}
+
+        probe(f"{mb}MB broadcast to {n_peers} nodes", node_broadcast,
+              results)
+    finally:
+        cluster.shutdown()
     with open("BENCH_SCALE.json", "w") as f:
         json.dump(results, f, indent=1)
 

@@ -27,6 +27,7 @@ in-process in the raylet: object_manager/plasma/store_runner.h).
 from __future__ import annotations
 
 import asyncio
+import heapq
 import os
 import subprocess
 import sys
@@ -39,6 +40,56 @@ from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, connect, spawn
+
+
+class _PullByteBudget:
+    """Admission control for pull transfers, by bytes, smallest-first.
+
+    The reference's PullManager activates pulls under a memory quota in
+    priority order (pull_manager.h:52). Here: a transfer is admitted when
+    it fits the byte budget (or the budget is idle — one oversized object
+    may always proceed alone); contended waiters are woken smallest-first
+    so bulk restores can't starve cheap ready objects.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self.in_use = 0
+        self._seq = 0
+        self._waiters: list = []  # heap of (size, seq, future)
+
+    def _admissible(self, size: int) -> bool:
+        return self.in_use == 0 or self.in_use + size <= self.budget
+
+    async def acquire(self, size: int):
+        if not self._waiters and self._admissible(size):
+            self.in_use += size
+            return
+        fut = asyncio.get_event_loop().create_future()
+        self._seq += 1
+        heapq.heappush(self._waiters, (size, self._seq, fut))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # Cancelled after release() already charged our bytes: give
+            # them back or the budget shrinks permanently (the
+            # asyncio.Semaphore cancellation-window pattern).
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                self.release(size)
+            raise
+
+    def release(self, size: int):
+        self.in_use = max(0, self.in_use - size)
+        while self._waiters:
+            wsize, _, fut = self._waiters[0]
+            if fut.cancelled():
+                heapq.heappop(self._waiters)
+                continue
+            if not self._admissible(wsize):
+                break
+            heapq.heappop(self._waiters)
+            self.in_use += wsize
+            fut.set_result(None)
 
 
 class WorkerHandle:
@@ -142,6 +193,19 @@ class Raylet:
         # bound concurrent inbound transfers so a burst of dependency
         # fetches can't thrash the store/network; single-flight per object.
         self._pull_slots = asyncio.Semaphore(8)
+        # Flow control (VERDICT r2 item 7):
+        #  * pull admission by BYTES with smallest-first priority under
+        #    contention (PullManager's memory-quota + prioritized queue,
+        #    object_manager/pull_manager.h:52) — a storm of large pulls
+        #    cannot overcommit the store while small ready objects wait;
+        #  * push-side in-flight chunk cap (PushManager throttling,
+        #    push_manager.h:30) — a popular node bounds concurrent chunk
+        #    reads it serves so one broadcast can't monopolize its loop.
+        self._pull_budget = _PullByteBudget(
+            max((object_store_memory or cfg.object_store_memory) // 4,
+                64 * 1024 * 1024)
+        )
+        self._push_chunk_slots = asyncio.Semaphore(16)
         self._active_pulls: Dict[bytes, asyncio.Future] = {}
         # Open chunked remote-client puts: oid -> (buffer, abort deadline).
         self._client_creates: Dict[bytes, tuple] = {}
@@ -1511,7 +1575,13 @@ class Raylet:
                     # Admission control bounds the TRANSFER only — holding
                     # a slot across object_location_wait would let 8
                     # unproduced dependencies starve ready pulls for 60s.
-                    await self._pull_from(peer, oid_bytes, resp["size"])
+                    # Byte budget on top: smallest-first under contention.
+                    size = int(resp.get("size") or 0)
+                    await self._pull_budget.acquire(size)
+                    try:
+                        await self._pull_from(peer, oid_bytes, size)
+                    finally:
+                        self._pull_budget.release(size)
                 await self.gcs.call(
                     "object_location_add",
                     {
@@ -1576,16 +1646,17 @@ class Raylet:
     async def h_fetch_chunk(self, d, conn):
         from ray_tpu._private.ids import ObjectID
 
-        oid = ObjectID(d["object_id"])
-        view = self.store.get(oid)
-        if view is None:
-            raise KeyError("object evicted mid-transfer")
-        try:
-            data = bytes(view[d["offset"] : d["offset"] + d["size"]])
-        finally:
-            del view
-            self.store.release(oid)
-        return {"data": data}
+        async with self._push_chunk_slots:  # PushManager in-flight cap
+            oid = ObjectID(d["object_id"])
+            view = self.store.get(oid)
+            if view is None:
+                raise KeyError("object evicted mid-transfer")
+            try:
+                data = bytes(view[d["offset"] : d["offset"] + d["size"]])
+            finally:
+                del view
+                self.store.release(oid)
+            return {"data": data}
 
     # -- remote (rt://) clients -------------------------------------------
     # The reference's Ray Client (util/client/worker.py:81) proxies a

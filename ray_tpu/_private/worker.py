@@ -1096,15 +1096,23 @@ class CoreClient:
                 await asyncio.sleep(0.5)
                 now = time.monotonic()
                 for pool in self._leases.values():
-                    keep = []
+                    # Partition synchronously FIRST: once an idle worker
+                    # leaves pool["workers"], _lease_for can no longer
+                    # hand it to a new task — only then is it safe to
+                    # await the release RPC (an await here with the
+                    # worker still visible let a fresh direct task race
+                    # the connection close).
+                    keep, to_release = [], []
                     for w in pool["workers"]:
                         if w["conn"]._closed:
                             continue
                         if w["outstanding"] == 0 and now - w["last_used"] > 1.0:
-                            await self._release_lease(w)
+                            to_release.append(w)
                         else:
                             keep.append(w)
                     pool["workers"] = keep
+                    for w in to_release:
+                        await self._release_lease(w)
         except asyncio.CancelledError:
             pass
 

@@ -65,8 +65,16 @@ def ensure_tpu_backend():
         _sys.modules.pop("sitecustomize", None)
         try:
             import sitecustomize  # noqa: F401 — re-runs TPU registration
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            # Leave the flag unset so the NEXT TPU task retries a
+            # transient tunnel failure — and say something, or this
+            # worker silently computes on CPU forever.
+            print(
+                f"[worker] TPU backend attach failed "
+                f"({type(e).__name__}: {e}); will retry on next TPU task",
+                file=_sys.stderr, flush=True,
+            )
+            return
         _TPU_ATTACHED = True
 
 

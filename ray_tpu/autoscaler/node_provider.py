@@ -223,10 +223,11 @@ class GKETPUNodeProvider(NodeProvider):
     def create_node(self, node_type: str, node_config: Dict, count: int) -> List[str]:
         pool = node_config["node_pool"]
         slice_hosts = int(node_config.get("slice_hosts", 1))
+        # Current size = the LIVE instance list. initialNodeCount is
+        # immutable creation-time metadata: trusting it on a pool that
+        # has since shrunk would over-provision whole (billed) slices.
         before = set(self._managed_instances(pool))
-        info = self._pool(pool)
-        current = int(info.get("initialNodeCount", len(before)) or len(before))
-        target = max(current, len(before)) + count * slice_hosts
+        target = len(before) + count * slice_hosts
         op = self.transport.request(
             "POST",
             f"{self._cluster_path()}/nodePools/{pool}:setSize",

@@ -95,6 +95,28 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
     return rt.get(_proxy.address.remote(), timeout=30)
 
 
+def start(proxy_location: str = "HeadOnly", host: str = "127.0.0.1",
+          port: int = 8000):
+    """Start serve's ingress tier (reference: serve.start + ProxyLocation).
+
+    ``proxy_location="EveryNode"`` hands proxy lifecycle to the
+    controller's ProxyStateManager: one proxy actor per ALIVE node
+    (node-affinity pinned, dead ones replaced each reconcile tick), each
+    exposing HTTP and a binary msgpack-framed ingress. Returns the
+    node_id -> address map ({"http": ..., "binary": [host, port]})."""
+    controller = get_or_create_controller()
+    if proxy_location == "EveryNode":
+        rt.get(controller.start_proxies.remote(), timeout=120)
+        return rt.get(controller.proxy_addresses.remote(), timeout=60)
+    return {"head": {"http": start_http_proxy(host, port), "binary": None}}
+
+
+def proxy_addresses() -> dict:
+    """Live per-node proxy addresses (EveryNode mode)."""
+    controller = get_or_create_controller()
+    return rt.get(controller.proxy_addresses.remote(), timeout=60)
+
+
 __all__ = [
     "deployment",
     "Deployment",
@@ -106,6 +128,8 @@ __all__ = [
     "delete",
     "status",
     "shutdown",
+    "start",
     "start_http_proxy",
+    "proxy_addresses",
     "run_from_config",
 ]

@@ -30,6 +30,13 @@ class ServeController:
         self._health_fails: Dict[bytes, int] = {}
         self._lock = threading.Lock()
         self._stop = False
+        # ProxyStateManager state (reference: serve/_private/proxy_state.py
+        # ProxyStateManager): when enabled, the reconcile loop keeps ONE
+        # proxy actor alive on every ALIVE cluster node, pinned there by
+        # node-affinity scheduling, replacing dead ones.
+        self._proxy_every_node = False
+        self._proxies: Dict[bytes, Dict] = {}  # node_id -> {actor, ...}
+        self._proxies_reconciling = False  # single-flight across threads
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
 
@@ -87,6 +94,11 @@ class ServeController:
             names = list(self.apps)
         for n in names:
             self.delete(n)
+        with self._lock:
+            entries = list(self._proxies.values())
+            self._proxies.clear()
+        for entry in entries:
+            _kill_quietly(entry["actor"])
         return True
 
     # -- reconciliation ---------------------------------------------------
@@ -154,8 +166,94 @@ class ServeController:
                     self._check_replica_health(name)
                     self._autoscale(name)
                     self._reconcile_once(name)
+                if self._proxy_every_node:
+                    self._reconcile_proxies()
             except Exception:
                 pass
+
+    # -- proxy state manager ---------------------------------------------
+    def start_proxies(self) -> int:
+        """Enable one-proxy-per-node mode; returns the current live-node
+        count (proxies come up within a reconcile tick)."""
+        self._proxy_every_node = True
+        self._reconcile_proxies()
+        with self._lock:
+            return len(self._proxies)
+
+    def _alive_nodes(self):
+        from ray_tpu._private import worker as worker_mod
+
+        client = worker_mod.get_client()
+        nodes = client._run(client._gcs_call("get_nodes", {}))["nodes"]
+        return [n for n in nodes if n.get("state") == "ALIVE"]
+
+    def _reconcile_proxies(self):
+        """Called from both the actor-call thread (start_proxies) and the
+        reconcile daemon thread: single-flighted, and every _proxies
+        read/write happens under self._lock (the slow actor RPCs do not)."""
+        from ray_tpu.serve.proxy import ProxyActor
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        with self._lock:
+            if self._proxies_reconciling:
+                return
+            self._proxies_reconciling = True
+        try:
+            alive = {n["node_id"]: n for n in self._alive_nodes()}
+            with self._lock:
+                existing = dict(self._proxies)
+            # Reap proxies on dead nodes / dead proxy actors.
+            for node_id, entry in existing.items():
+                dead = node_id not in alive
+                if not dead:
+                    try:
+                        rt.get(entry["actor"].ready.remote(), timeout=5)
+                    except Exception:  # noqa: BLE001 — proxy died
+                        dead = True
+                if dead:
+                    _kill_quietly(entry["actor"])
+                    with self._lock:
+                        self._proxies.pop(node_id, None)
+            for node_id in alive:
+                with self._lock:
+                    if node_id in self._proxies:
+                        continue
+                try:
+                    actor = ProxyActor.options(
+                        num_cpus=0.01,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=node_id
+                        ),
+                    ).remote("127.0.0.1", 0)
+                    rt.get(actor.ready.remote(), timeout=30)
+                    entry = {
+                        "actor": actor,
+                        "http": rt.get(actor.address.remote(), timeout=10),
+                        "binary": rt.get(
+                            actor.binary_address.remote(), timeout=10
+                        ),
+                    }
+                    with self._lock:
+                        self._proxies[node_id] = entry
+                except Exception:  # noqa: BLE001 — retried next tick
+                    pass
+        finally:
+            with self._lock:
+                self._proxies_reconciling = False
+
+    def proxy_addresses(self) -> Dict[str, Dict]:
+        """node_id hex -> {http, binary} for every live proxy."""
+        with self._lock:
+            entries = dict(self._proxies)
+        return {
+            nid.hex() if isinstance(nid, (bytes, bytearray)) else str(nid): {
+                "http": e["http"],
+                "binary": list(e["binary"]),
+            }
+            for nid, e in entries.items()
+        }
 
     def _check_replica_health(self, name: str):
         """Drop dead replicas so reconcile replaces them — the

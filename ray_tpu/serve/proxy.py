@@ -33,6 +33,7 @@ class ProxyActor:
         self.host = host
         self.port = port
         self._handles: Dict[str, DeploymentHandle] = {}
+        self._binary_port: Optional[int] = None
         self._ready = threading.Event()
 
         def get_handle(app_name: str) -> DeploymentHandle:
@@ -134,7 +135,32 @@ class ProxyActor:
         async def healthz(request):
             return web.json_response({"status": "ok"})
 
+        async def h_serve_call(d, conn):
+            """Binary-framed ingress (the reference gRPC proxy's role,
+            serve/_private/grpc_util.py): length-prefixed msgpack frames —
+            the same wire format the C++ client speaks — carrying
+            {app, method?, args?, kwargs?, multiplexed_model_id?}. The
+            result must be msgpack-encodable."""
+            app_name = d["app"]
+            handle = get_handle(app_name)
+            if d.get("method") and d["method"] != "__call__":
+                handle = handle.options(method_name=d["method"])
+            if d.get("multiplexed_model_id"):
+                handle = handle.options(
+                    multiplexed_model_id=d["multiplexed_model_id"]
+                )
+            args = d.get("args") or []
+            kwargs = d.get("kwargs") or {}
+            loop = asyncio.get_event_loop()
+            response = await loop.run_in_executor(
+                None, lambda: handle.remote(*args, **kwargs)
+            )
+            result = await resolve(loop, response)
+            return {"result": result}
+
         def run_server():
+            from ray_tpu._private.protocol import RpcServer
+
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             app = web.Application()
@@ -144,6 +170,13 @@ class ProxyActor:
             loop.run_until_complete(runner.setup())
             site = web.TCPSite(runner, self.host, self.port)
             loop.run_until_complete(site.start())
+            # port=0 -> the OS picked one; report the real port so many
+            # proxies can coexist on one test host.
+            self.port = site._server.sockets[0].getsockname()[1]
+            brpc = RpcServer(self.host, 0)
+            brpc.register("serve_call", h_serve_call)
+            loop.run_until_complete(brpc.start())
+            self._binary_port = brpc.port
             self._ready.set()
             loop.run_forever()
 
@@ -153,6 +186,10 @@ class ProxyActor:
 
     def address(self):
         return f"http://{self.host}:{self.port}"
+
+    def binary_address(self):
+        """(host, port) of the framed-msgpack ingress."""
+        return (self.host, self._binary_port)
 
     def ready(self) -> bool:
         return self._ready.is_set()

@@ -382,3 +382,127 @@ def test_cross_language_serve_call(rt_serve):
     value = ser.deserialize_from_bytes(result["returns"][0]["data"])
     assert value["echo"] == "from-cpp"
     assert value["pid"] != _os.getpid()  # served by a replica process
+
+
+def test_proxy_per_node_and_binary_ingress():
+    """EveryNode proxy mode: the controller's ProxyStateManager keeps one
+    proxy per ALIVE node (proxy_state.py analog); requests enter through
+    BOTH nodes' HTTP proxies and through the binary msgpack ingress."""
+    import asyncio
+    import json
+    import urllib.request
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        @serve.deployment(num_replicas=2)
+        def double(x=0):
+            return x * 2
+
+        serve.run(double.bind(), name="dbl")
+        addrs = serve.start(proxy_location="EveryNode")
+        assert len(addrs) == 2, f"expected a proxy per node, got {addrs}"
+
+        # HTTP through each node's proxy.
+        for entry in addrs.values():
+            req = urllib.request.Request(
+                entry["http"] + "/dbl",
+                data=json.dumps({"x": 21}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert json.loads(resp.read())["result"] == 42
+
+        # Binary framed ingress on the first proxy.
+        from ray_tpu._private.protocol import connect
+
+        host, port = next(iter(addrs.values()))["binary"]
+
+        async def bin_call():
+            conn = await connect(host, port)
+            out = await conn.call(
+                "serve_call", {"app": "dbl", "kwargs": {"x": 10}},
+                timeout=30,
+            )
+            await conn.close()
+            return out
+
+        loop = asyncio.new_event_loop()
+        try:
+            out = loop.run_until_complete(bin_call())
+        finally:
+            loop.close()
+        assert out == {"result": 20}
+    finally:
+        serve.shutdown()
+        cluster.shutdown()
+
+
+def test_autoscaling_reacts_to_replica_queue_depth(rt_serve):
+    """Replica-reported queue lengths (controller polls replica.queue_len)
+    drive scale-up under sustained load and scale-down when idle
+    (reference: autoscaling_policy.py from replica queue metrics)."""
+
+    @serve.deployment(
+        num_replicas=1,
+        max_ongoing_requests=4,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1,
+            max_replicas=3,
+            target_ongoing_requests=1,
+            upscale_delay_s=0.1,
+            downscale_delay_s=1.0,
+        ),
+    )
+    class Slowish:
+        def __call__(self, x=0):
+            time.sleep(0.3)
+            return x
+
+    serve.run(Slowish.bind(), name="auto")
+    handle = serve.get_app_handle("auto")
+
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                handle.remote(1).result(timeout=30)
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=pump, daemon=True) for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 40
+        scaled = False
+        while time.monotonic() < deadline:
+            n = len(rt.get(
+                serve.get_or_create_controller().get_replicas.remote("auto"),
+                timeout=10,
+            )["replicas"])
+            if n >= 2:
+                scaled = True
+                break
+            time.sleep(0.5)
+        assert scaled, "queue depth never triggered a scale-up"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    # Idle -> back toward min_replicas.
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline:
+        n = len(rt.get(
+            serve.get_or_create_controller().get_replicas.remote("auto"),
+            timeout=10,
+        )["replicas"])
+        if n == 1:
+            return
+        time.sleep(0.5)
+    pytest.fail("idle deployment did not scale back down")
