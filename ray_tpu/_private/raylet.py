@@ -321,8 +321,7 @@ class Raylet:
                     w.proc.kill()
             except Exception:
                 pass
-        if self._zygote is not None:
-            self._zygote.stop()
+        # The zygote is process-shared (atexit-owned): not stopped here.
         await self.rpc.stop()
         if self.gcs:
             await self.gcs.close()
@@ -340,8 +339,6 @@ class Raylet:
                 w.proc.kill()
             except Exception:  # noqa: BLE001
                 pass
-        if self._zygote is not None:
-            self._zygote.stop()
         await self.rpc.stop()
         if self.gcs:
             await self.gcs.close()
@@ -464,9 +461,9 @@ class Raylet:
         proc = None
         if not env.get("RT_DISABLE_ZYGOTE"):
             if self._zygote is None:
-                from ray_tpu._private.zygote_client import ZygoteManager
+                from ray_tpu._private.zygote_client import get_shared_manager
 
-                self._zygote = ZygoteManager()
+                self._zygote = get_shared_manager()
             proc = self._zygote.spawn(env)
         if proc is None:
             proc = subprocess.Popen(

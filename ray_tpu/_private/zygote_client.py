@@ -5,11 +5,19 @@ non-blocking fork request the dispatch loop can issue in place of a
 subprocess.Popen. ZygoteProc mirrors the Popen surface the raylet uses
 (pid / poll / kill / terminate / wait / returncode) so WorkerHandle and
 the reap loop are agnostic to how the worker was started.
+
+The manager is deliberately loop-agnostic (plain threading, one daemon
+reader thread, a mutex around shared state): one PROCESS-LEVEL zygote
+serves every raylet/session in the process (`get_shared_manager`).
+Children receive their complete environment per spawn request, so the
+zygote has no per-cluster state — sharing it across rt.init cycles saves
+the warm-interpreter cost on every session (a large win for test suites
+and notebooks that init/shutdown repeatedly).
 """
 
 from __future__ import annotations
 
-import asyncio
+import atexit
 import json
 import os
 import signal
@@ -25,8 +33,8 @@ class ZygoteProc:
     """Popen-compatible handle for a zygote-forked worker.
 
     The pid arrives asynchronously (the fork reply is read off the
-    zygote's stdout by the manager); kill/terminate before the pid is
-    known are remembered and delivered on assignment.
+    zygote's stdout by the manager's reader thread); kill/terminate
+    before the pid is known are remembered and delivered on assignment.
     """
 
     def __init__(self, mgr: "ZygoteManager"):
@@ -36,32 +44,43 @@ class ZygoteProc:
         self._pending_signal: Optional[int] = None
 
     def _assign(self, pid: int) -> None:
+        # Called under the manager lock.
         self.pid = pid
         if self._pending_signal is not None:
             sig, self._pending_signal = self._pending_signal, None
-            self._signal(sig)
+            self._kill(sig)
 
     def _fail(self, rc: int) -> None:
         if self.returncode is None:
             self.returncode = rc
 
-    def _signal(self, sig: int) -> None:
-        if self.returncode is not None:
-            return
-        if self.pid is None:
-            self._pending_signal = sig
-            return
+    @staticmethod
+    def _deliver(pid: int, sig: int) -> None:
         try:
-            os.kill(self.pid, sig)
+            os.kill(pid, sig)
         except (ProcessLookupError, PermissionError):
             pass
 
-    def poll(self) -> Optional[int]:
+    def _kill(self, sig: int) -> None:
         if self.returncode is None and self.pid is not None:
-            rc = self._mgr._dead.pop(self.pid, None)
-            if rc is not None:
-                self.returncode = rc
-        return self.returncode
+            self._deliver(self.pid, sig)
+
+    def _signal(self, sig: int) -> None:
+        with self._mgr._lock:
+            if self.returncode is not None:
+                return
+            if self.pid is None:
+                self._pending_signal = sig
+                return
+        self._deliver(self.pid, sig)
+
+    def poll(self) -> Optional[int]:
+        with self._mgr._lock:
+            if self.returncode is None and self.pid is not None:
+                rc = self._mgr._dead.pop(self.pid, None)
+                if rc is not None:
+                    self.returncode = rc
+            return self.returncode
 
     def kill(self) -> None:
         self._signal(signal.SIGKILL)
@@ -90,7 +109,7 @@ class ZygoteManager:
         self._pending: deque[ZygoteProc] = deque()
         self._dead: Dict[int, int] = {}
         self._reader: Optional[threading.Thread] = None
-        self._loop = None
+        self._lock = threading.Lock()
         self._deaths = 0  # zygote process deaths; disable after 3
 
     def alive(self) -> bool:
@@ -115,10 +134,8 @@ class ZygoteManager:
             self.proc = None
             return False
         # A dedicated DAEMON thread, not run_in_executor: a blocked
-        # readline in the loop's default executor is a non-daemon thread
-        # that keeps the interpreter alive at exit (observed as pytest
-        # printing its summary then hanging until killed).
-        self._loop = asyncio.get_event_loop()
+        # readline in a loop's default executor is a non-daemon thread
+        # that keeps the interpreter alive at exit.
         self._reader = threading.Thread(
             target=self._read_loop, args=(self.proc,),
             name="zygote-reader", daemon=True,
@@ -127,65 +144,60 @@ class ZygoteManager:
         return True
 
     def _read_loop(self, proc: subprocess.Popen) -> None:
-        """Daemon thread: reads zygote replies, applies them on the loop."""
-        loop = self._loop
+        """Daemon thread: reads zygote replies, applies them under lock."""
         while True:
             try:
                 line = proc.stdout.readline()
             except Exception:  # noqa: BLE001
                 line = ""
             if not line:
-                try:
-                    loop.call_soon_threadsafe(self._on_zygote_death)
-                except RuntimeError:
-                    self._on_zygote_death()  # loop gone: apply inline
+                with self._lock:
+                    # Pending forks never happened.
+                    self._deaths += 1
+                    while self._pending:
+                        self._pending.popleft()._fail(-1)
                 return
             try:
                 msg = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            try:
-                loop.call_soon_threadsafe(self._on_message, msg)
-            except RuntimeError:
-                self._on_message(msg)
-
-    def _on_zygote_death(self) -> None:
-        # Pending forks never happened.
-        self._deaths += 1
-        while self._pending:
-            self._pending.popleft()._fail(-1)
-
-    def _on_message(self, msg: dict) -> None:
-        op = msg.get("op")
-        if op == "spawned" and self._pending:
-            self._pending.popleft()._assign(msg["pid"])
-        elif op == "dead":
-            if len(self._dead) > 4096:  # unconsumed-notice backstop
-                self._dead.clear()
-            self._dead[msg["pid"]] = msg["rc"]
+            with self._lock:
+                op = msg.get("op")
+                if op == "spawned" and self._pending:
+                    self._pending.popleft()._assign(msg["pid"])
+                elif op == "dead":
+                    if len(self._dead) > 4096:  # unconsumed-notice backstop
+                        self._dead.clear()
+                    self._dead[msg["pid"]] = msg["rc"]
 
     def spawn(self, env: dict) -> Optional[ZygoteProc]:
-        """Queue a fork request; returns None when the zygote isn't up yet
-        (caller uses a normal Popen spawn and the zygote warms for next
-        time)."""
-        if self._deaths >= 3:
-            return None  # repeatedly crashing: stick to Popen spawns
-        if not self.alive() and not self.start():
-            return None
-        zp = ZygoteProc(self)
-        self._pending.append(zp)
-        try:
-            self.proc.stdin.write(
-                json.dumps({"op": "spawn", "env": env}) + "\n"
-            )
-            self.proc.stdin.flush()
-        except Exception:  # noqa: BLE001 — zygote just died
+        """Queue a fork request; returns None when the zygote can't serve
+        (caller uses a normal Popen spawn).
+
+        The whole liveness-check + enqueue + stdin write happens under
+        the manager lock: with the manager process-shared, two sessions'
+        threads spawning concurrently must observe the same FIFO order in
+        _pending as on the pipe (else the reader assigns pids to the
+        wrong handles), and must not double-start the zygote."""
+        with self._lock:
+            if self._deaths >= 3:
+                return None  # repeatedly crashing: stick to Popen spawns
+            if not self.alive() and not self.start():
+                return None
+            zp = ZygoteProc(self)
+            self._pending.append(zp)
             try:
-                self._pending.remove(zp)
-            except ValueError:
-                pass
-            return None
-        return zp
+                self.proc.stdin.write(
+                    json.dumps({"op": "spawn", "env": env}) + "\n"
+                )
+                self.proc.stdin.flush()
+            except Exception:  # noqa: BLE001 — zygote just died
+                try:
+                    self._pending.remove(zp)
+                except ValueError:
+                    pass
+                return None
+            return zp
 
     def stop(self) -> None:
         if self.proc is not None:
@@ -199,3 +211,18 @@ class ZygoteManager:
                 pass
             self.proc = None
         self._reader = None  # daemon thread exits on pipe EOF
+
+
+_shared: Optional[ZygoteManager] = None
+_shared_lock = threading.Lock()
+
+
+def get_shared_manager() -> ZygoteManager:
+    """The process-level zygote: shared across raylets/sessions (children
+    are fully parameterized by their per-spawn environment)."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = ZygoteManager()
+            atexit.register(_shared.stop)
+        return _shared

@@ -24,41 +24,225 @@ from ray_tpu._private.protocol import Connection, connect
 _HTML = """<!DOCTYPE html>
 <html><head><title>ray_tpu dashboard</title>
 <style>
- body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
- h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.6rem; }
- table { border-collapse: collapse; margin-top: .4rem; min-width: 40rem; }
- th, td { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem;
-          text-align: left; }
- th { background: #f3f3f3; }
- code { background: #f6f6f6; padding: 0 .2rem; }
+ :root { --bg:#fff; --fg:#1a1a1a; --muted:#667; --line:#d8dbe0;
+         --accent:#2563eb; --ok:#16a34a; --warn:#d97706; --bad:#dc2626; }
+ body { font-family: system-ui, sans-serif; margin:0; color:var(--fg);
+        background:var(--bg); }
+ header { display:flex; align-items:center; gap:1rem; padding:.7rem 1.2rem;
+          border-bottom:1px solid var(--line); }
+ header h1 { font-size:1.05rem; margin:0; }
+ nav button { border:0; background:none; padding:.45rem .8rem;
+              font-size:.9rem; cursor:pointer; color:var(--muted);
+              border-bottom:2px solid transparent; }
+ nav button.active { color:var(--accent);
+                     border-bottom-color:var(--accent); }
+ #status { margin-left:auto; font-size:.8rem; color:var(--muted); }
+ main { padding:1rem 1.2rem; }
+ table { border-collapse:collapse; width:100%; margin-top:.5rem; }
+ th, td { border-bottom:1px solid var(--line); padding:.3rem .6rem;
+          font-size:.82rem; text-align:left; vertical-align:top; }
+ th { color:var(--muted); font-weight:600; cursor:pointer;
+      white-space:nowrap; user-select:none; }
+ tr:hover td { background:#f6f8fa; }
+ .bar { background:#eef1f5; border-radius:4px; height:.9rem; width:14rem;
+        display:inline-block; vertical-align:middle; overflow:hidden; }
+ .bar i { display:block; height:100%; background:var(--accent); }
+ .cards { display:flex; gap:1rem; flex-wrap:wrap; margin:.4rem 0 1rem; }
+ .card { border:1px solid var(--line); border-radius:8px;
+         padding:.7rem 1rem; min-width:9rem; }
+ .card b { font-size:1.4rem; display:block; }
+ .card span { font-size:.78rem; color:var(--muted); }
+ .state-ALIVE, .state-RUNNING, .state-CREATED, .state-SUCCEEDED
+   { color:var(--ok); font-weight:600; }
+ .state-PENDING, .state-RESTARTING, .state-PENDING_SCHEDULING
+   { color:var(--warn); font-weight:600; }
+ .state-DEAD, .state-FAILED, .state-REMOVED { color:var(--bad);
+   font-weight:600; }
+ input[type=search] { padding:.3rem .5rem; border:1px solid var(--line);
+   border-radius:6px; font-size:.85rem; width:16rem; }
+ pre { background:#0f172a; color:#e2e8f0; padding: .8rem; border-radius:8px;
+       font-size:.78rem; overflow:auto; max-height:24rem; }
+ canvas { border:1px solid var(--line); border-radius:6px; }
+ code { background:#f2f4f7; padding:0 .25rem; border-radius:3px; }
 </style></head>
 <body>
-<h1>ray_tpu cluster</h1>
-<div id="root">loading…</div>
+<header>
+ <h1>ray_tpu</h1>
+ <nav id="tabs"></nav>
+ <span id="status">connecting…</span>
+</header>
+<main>
+ <div id="controls"></div>
+ <div id="main">loading…</div>
+</main>
 <script>
-const fmt = (o) => typeof o === "object" ? JSON.stringify(o) : o;
-async function refresh() {
-  const [status, nodes, actors, jobs] = await Promise.all([
-    fetch("api/cluster_status").then(r => r.json()),
-    fetch("api/nodes").then(r => r.json()),
-    fetch("api/actors").then(r => r.json()),
-    fetch("api/jobs").then(r => r.json()),
-  ]);
-  const rows = (items, cols) =>
-    "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>" +
-    items.map(it => "<tr>" + cols.map(c => `<td>${fmt(it[c] ?? "")}</td>`)
-      .join("") + "</tr>").join("") + "</table>";
-  document.getElementById("root").innerHTML =
-    `<p>${status.alive_nodes}/${status.total_nodes} nodes alive · ` +
-    Object.entries(status.resources_total).map(([k, v]) =>
-      `${k}: ${status.resources_available[k] ?? 0}/${v}`).join(" · ") + "</p>" +
-    "<h2>Nodes</h2>" + rows(nodes, ["node_id", "state", "address",
-                                    "resources_total", "resources_available"]) +
-    "<h2>Actors</h2>" + rows(actors, ["actor_id", "class_name", "state",
-                                      "name", "node_id"]) +
-    "<h2>Jobs</h2>" + rows(jobs, ["submission_id", "state", "entrypoint"]);
+"use strict";
+const TABS = ["overview","nodes","actors","tasks","objects",
+              "placement groups","jobs","metrics"];
+let tab = location.hash.slice(1) || "overview";
+let filter = "", sortKey = null, sortDir = 1, openJob = null;
+const hist = {};  // metric sparkline history
+
+const el = (id) => document.getElementById(id);
+const fmt = (o) => o === null || o === undefined ? "" :
+  typeof o === "object" ? JSON.stringify(o) : String(o);
+const esc = (s) => String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;")
+  .replace(/"/g,"&quot;").replace(/'/g,"&#39;");
+const api = (p) => fetch("api/" + p).then(r => r.json());
+
+function nav() {
+  el("tabs").innerHTML = TABS.map(t =>
+    `<button class="${t===tab?"active":""}"
+      onclick="setTab('${t}')">${t}</button>`).join("");
 }
-refresh(); setInterval(refresh, 2000);
+function setTab(t) { tab = t; location.hash = t; sortKey = null;
+  openJob = null; filter = ""; nav(); controls(); refresh(); }
+function controls() {
+  // The filter box lives OUTSIDE the refreshed content so typing never
+  // loses focus to a re-render; refreshes also pause while it has focus.
+  el("controls").innerHTML = tab === "overview" ? "" :
+    `<input type=search id=filterbox placeholder="filter…"
+       value="${esc(filter)}"
+       oninput="filter=this.value;render()">`;
+}
+
+function stateCell(v) {
+  return `<span class="state-${esc(v)}">${esc(v)}</span>`;
+}
+function cmpVals(a, b) {
+  if (typeof a === "number" && typeof b === "number") return a - b;
+  const fa = fmt(a), fb = fmt(b);
+  return fa < fb ? -1 : fa > fb ? 1 : 0;
+}
+function rows(items, cols, stateCol) {
+  if (filter) {
+    const f = filter.toLowerCase();
+    items = items.filter(it =>
+      cols.some(c => fmt(it[c]).toLowerCase().includes(f)));
+  }
+  if (sortKey) {
+    items = [...items].sort((a, b) =>
+      sortDir * cmpVals(a[sortKey], b[sortKey]));
+  }
+  return `<table><tr>${cols.map(c => `<th onclick="sortBy('${c}')">${c}
+     ${sortKey===c ? (sortDir>0?"▲":"▼") : ""}</th>`).join("")}</tr>` +
+   items.map(it => "<tr>" + cols.map(c =>
+     `<td>${c===stateCol ? stateCell(it[c]) : esc(fmt(it[c] ?? ""))}</td>`
+   ).join("") + "</tr>").join("") + "</table>" +
+   `<p style="color:var(--muted);font-size:.78rem">${items.length} rows</p>`;
+}
+function sortBy(c) {
+  sortDir = sortKey === c ? -sortDir : 1; sortKey = c; refresh();
+}
+
+function resourceBars(status) {
+  return Object.entries(status.resources_total).map(([k, total]) => {
+    const avail = status.resources_available[k] ?? 0;
+    const used = total - avail;
+    const pct = total ? Math.round(100 * used / total) : 0;
+    return `<div style="margin:.2rem 0">
+      <code>${esc(k)}</code> ${used.toFixed(2)} / ${total} used
+      <span class="bar"><i style="width:${pct}%"></i></span> ${pct}%
+      </div>`;
+  }).join("");
+}
+
+function spark(id, values, w=260, h=48) {
+  const c = el(id); if (!c) return;
+  const ctx = c.getContext("2d");
+  ctx.clearRect(0, 0, w, h);
+  if (values.length < 2) return;
+  const max = Math.max(...values, 1e-9), min = Math.min(...values, 0);
+  ctx.beginPath(); ctx.strokeStyle = "#2563eb"; ctx.lineWidth = 1.5;
+  values.forEach((v, i) => {
+    const x = i * (w - 4) / (values.length - 1) + 2;
+    const y = h - 3 - (v - min) * (h - 8) / (max - min || 1);
+    i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+  });
+  ctx.stroke();
+}
+
+async function render() {
+  if (tab === "overview") {
+    const [status, actors, tasks, jobs] = await Promise.all([
+      api("cluster_status"), api("actors"), api("tasks"), api("jobs")]);
+    const cpuT = status.resources_total.CPU || 0;
+    const cpuA = status.resources_available.CPU ?? cpuT;
+    (hist.cpu = hist.cpu || []).push(cpuT - cpuA);
+    if (hist.cpu.length > 120) hist.cpu.shift();
+    el("main").innerHTML = `
+      <div class="cards">
+       <div class="card"><b>${status.alive_nodes}/${status.total_nodes}</b>
+         <span>nodes alive</span></div>
+       <div class="card"><b>${actors.filter(a=>a.state==="ALIVE").length}</b>
+         <span>live actors</span></div>
+       <div class="card"><b>${tasks.length}</b><span>tasks seen</span></div>
+       <div class="card"><b>${jobs.length}</b><span>jobs</span></div>
+       <div class="card"><canvas id=cpuspark width=260 height=48></canvas>
+         <span>CPU in use (last ${hist.cpu.length} ticks)</span></div>
+      </div>
+      <h3>Cluster resources</h3>${resourceBars(status)}`;
+    spark("cpuspark", hist.cpu);
+  } else if (tab === "nodes") {
+    el("main").innerHTML = rows(await api("nodes"),
+      ["node_id","state","address","is_head","resources_total",
+       "resources_available"], "state");
+  } else if (tab === "actors") {
+    el("main").innerHTML = rows(await api("actors"),
+      ["actor_id","class_name","name","state","node_id"], "state");
+  } else if (tab === "tasks") {
+    el("main").innerHTML = rows(await api("tasks"),
+      ["task_id","name","type","state"], "state");
+  } else if (tab === "objects") {
+    el("main").innerHTML = rows(await api("objects"),
+      ["object_id","size","locations"]);
+  } else if (tab === "placement groups") {
+    el("main").innerHTML = rows(await api("placement_groups"),
+      ["pg_id","state","strategy","bundles"], "state");
+  } else if (tab === "jobs") {
+    const jobs = await api("jobs");
+    let html = `<table><tr><th>submission_id</th><th>state</th>
+        <th>entrypoint</th><th>logs</th></tr>` +
+      jobs.map(j => `<tr><td>${esc(j.submission_id ?? "")}</td>` +
+        `<td>${stateCell(j.state ?? "")}</td>` +
+        `<td>${esc(j.entrypoint ?? "")}</td>` +
+        `<td><a href="#jobs" data-sid="${esc(j.submission_id ?? "")}"
+           onclick="openJob=this.dataset.sid;refresh();return false"
+           >view</a></td></tr>`).join("") +
+      `</table>`;
+    if (openJob) {
+      const logs = await fetch(`api/jobs/${openJob}/logs`)
+        .then(r => r.text());
+      html += `<h3>logs: ${esc(openJob)}</h3><pre>${esc(logs)}</pre>`;
+    }
+    el("main").innerHTML = html;
+  } else if (tab === "metrics") {
+    const text = await fetch("metrics").then(r => r.text());
+    const rowsOut = [];
+    for (const line of text.split("\n")) {
+      if (!line || line.startsWith("#")) continue;
+      const i = line.lastIndexOf(" ");
+      const name = line.slice(0, i), val = parseFloat(line.slice(i + 1));
+      rowsOut.push({metric: name, value: val});
+      (hist[name] = hist[name] || []).push(val);
+      if (hist[name].length > 120) hist[name].shift();
+    }
+    el("main").innerHTML = rows(rowsOut, ["metric","value"]);
+  }
+}
+
+async function refresh() {
+  if (document.activeElement && document.activeElement.id === "filterbox")
+    return;  // don't repaint under the user's caret
+  try {
+    await render();
+    el("status").textContent =
+      "live · " + new Date().toLocaleTimeString();
+  } catch (e) {
+    el("status").textContent = "api error: " + e;
+  }
+}
+nav(); controls(); refresh(); setInterval(refresh, 2000);
 </script></body></html>
 """
 

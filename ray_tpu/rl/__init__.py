@@ -9,7 +9,8 @@ jit-compiled, multi-learner sync is collective-based.
 from ray_tpu.rl.core.learner import Learner
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
-from ray_tpu.rl.env_runner import EnvRunner, compute_gae
+from ray_tpu.rl.env_runner import EnvRunner, VectorEnvRunner, compute_gae
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig, appo_loss
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, dqn_loss
 from ray_tpu.rl.algorithms.impala import (
     IMPALA,
@@ -43,6 +44,10 @@ from ray_tpu.rl.offline import (
 from ray_tpu.rl.replay import ReplayBuffer
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
+    "appo_loss",
+    "VectorEnvRunner",
     "Learner",
     "LearnerGroup",
     "RLModuleSpec",

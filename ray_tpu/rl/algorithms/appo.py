@@ -1,0 +1,213 @@
+"""APPO: asynchronous PPO with V-trace off-policy correction.
+
+Reference analog: rllib/algorithms/appo (APPOConfig/APPO + its
+appo_learner loss: PPO's clipped surrogate computed on V-trace-corrected
+advantages, so slightly-stale rollouts from non-blocking samplers stay
+usable). TPU-first differences: rollouts come from VectorEnvRunner
+actors (one batched device call per step across N envs), the loss is a
+single jitted function over [B, T] rollouts, and the driver keeps sample
+futures standing across updates exactly like IMPALA's harvest loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
+from ray_tpu.rl.algorithms.impala import vtrace
+from ray_tpu.rl.env_runner import VectorEnvRunner
+
+
+def appo_loss(params, module, batch, gamma: float = 0.99,
+              clip_eps: float = 0.2, vf_coeff: float = 0.5,
+              entropy_coeff: float = 0.01):
+    """Clipped-surrogate policy loss on V-trace advantages, [B, T] batch."""
+    B, T = batch["actions"].shape
+    obs = batch["obs"].reshape(B * T, -1)
+    out = module.forward(params, obs)
+    logp_all = jax.nn.log_softmax(out["action_logits"]).reshape(B, T, -1)
+    values = out["value"].reshape(B, T)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    bootstrap = batch.get("last_values")
+    if bootstrap is None:
+        bootstrap = module.forward(params, batch["last_obs"])["value"]
+
+    vs, pg_adv = jax.vmap(
+        lambda bl, tl, r, v, bv, d: vtrace(bl, tl, r, v, bv, d, gamma=gamma)
+    )(batch["logp"], target_logp, batch["rewards"], values, bootstrap,
+      batch["dones"])
+    adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+    ratio = jnp.exp(target_logp - batch["logp"])
+    surr = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv,
+    )
+    policy_loss = -surr.mean()
+    value_loss = ((values - vs) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    loss = policy_loss + vf_coeff * value_loss - entropy_coeff * entropy
+    return loss, {
+        "total_loss": loss,
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+        "mean_ratio": ratio.mean(),
+    }
+
+
+@dataclass
+class APPOConfig:
+    """Builder-style config (reference: APPOConfig)."""
+
+    env_creator: Optional[Callable] = None
+    obs_dim: int = 4
+    num_actions: int = 2
+    hidden: tuple = (64, 64)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_length: int = 64
+    lr: float = 3e-3
+    gamma: float = 0.99
+    clip_eps: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    updates_per_iteration: int = 8
+    rollouts_per_update: int = 1
+    seed: int = 0
+
+    def environment(self, env_creator=None, obs_dim=None, num_actions=None):
+        if env_creator is not None:
+            self.env_creator = env_creator
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def env_runners(self, num_env_runners=None, num_envs_per_runner=None,
+                    rollout_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_runner is not None:
+            self.num_envs_per_runner = num_envs_per_runner
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, lr=None, gamma=None, clip_eps=None,
+                 updates_per_iteration=None, rollouts_per_update=None,
+                 vf_coeff=None, entropy_coeff=None):
+        for k, v in (("lr", lr), ("gamma", gamma), ("clip_eps", clip_eps),
+                     ("updates_per_iteration", updates_per_iteration),
+                     ("rollouts_per_update", rollouts_per_update),
+                     ("vf_coeff", vf_coeff),
+                     ("entropy_coeff", entropy_coeff)):
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO:
+    """Async actor-learner loop over vectorized samplers.
+
+    Sample futures stay standing across updates (IMPALA's harvest
+    pattern); each harvested (T, N, ...) rollout transposes to the
+    [B=N, T] layout the V-trace loss consumes."""
+
+    def __init__(self, config: APPOConfig):
+        assert config.env_creator is not None, "config.environment(...) first"
+        self.config = config
+        spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
+        module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
+
+        loss = lambda p, m, b: appo_loss(  # noqa: E731
+            p, m, b, gamma=config.gamma, clip_eps=config.clip_eps,
+            vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
+        )
+        self.learner_group = LearnerGroup(
+            module_factory, loss, num_learners=1, seed=config.seed,
+            lr=config.lr,
+        )
+        self.env_runners = [
+            VectorEnvRunner.options(num_cpus=0.5).remote(
+                config.env_creator,
+                module_factory,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1 + i,
+                rollout_length=config.rollout_length,
+                gamma=config.gamma,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        weights = self.learner_group.get_weights()
+        rt.get([r.set_weights.remote(weights) for r in self.env_runners],
+               timeout=300)
+        self._pending: Dict[Any, Any] = {
+            r.sample.remote(): r for r in self.env_runners
+        }
+        self._iteration = 0
+
+    @staticmethod
+    def _to_bt(rollout: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """(T, N, ...) time-major sample -> (B=N, T, ...) rollout batch."""
+        out = {}
+        for k in ("obs", "actions", "logp", "values", "rewards", "dones"):
+            a = rollout[k]
+            out[k] = np.swapaxes(a, 0, 1)
+        out["last_values"] = rollout["last_values"]
+        out["last_obs"] = rollout["last_obs"]
+        return out
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.updates_per_iteration):
+            want = min(cfg.rollouts_per_update, len(self._pending))
+            ready, _ = rt.wait(
+                list(self._pending), num_returns=want, timeout=300
+            )
+            if not ready:
+                continue
+            rollouts = [self._to_bt(b) for b in rt.get(ready, timeout=300)]
+            runners = [self._pending.pop(ref) for ref in ready]
+            batch = {
+                k: np.concatenate([b[k] for b in rollouts])
+                for k in rollouts[0]
+            }
+            metrics = self.learner_group.update_from_batch(batch)
+            weights = self.learner_group.get_weights()
+            for r in runners:
+                r.set_weights.remote(weights)
+                self._pending[r.sample.remote()] = r
+        self._iteration += 1
+        stats = rt.get(
+            [r.episode_stats.remote() for r in self.env_runners], timeout=300
+        )
+        returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        self.learner_group.shutdown()
+        for r in self.env_runners:
+            try:
+                rt.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

@@ -17,6 +17,8 @@ import pytest
 import ray_tpu as rt
 from ray_tpu.cluster_utils import Cluster
 
+pytestmark = pytest.mark.slow  # chaos/e2e tier — fast runs skip
+
 
 def test_worker_kill_storm_completes(tmp_path):
     """SIGKILL random workers while a task storm runs: retries must land

@@ -16,6 +16,8 @@ from ray_tpu.models.generate import (
     prefill,
 )
 
+pytestmark = pytest.mark.slow  # jax-compile-heavy compute-path tier
+
 
 @pytest.fixture(scope="module")
 def setup():

@@ -18,6 +18,8 @@ from ray_tpu import data as rtd
 from ray_tpu import serve
 from ray_tpu.train import Checkpoint, JaxTrainer, RunConfig, ScalingConfig
 
+pytestmark = pytest.mark.slow  # chaos/e2e tier — fast runs skip
+
 
 def _train_loop(config):
     """Fit y = 2x + 1 by gradient descent over a Data shard."""

@@ -123,7 +123,7 @@ def test_dashboard_endpoints(dashboard):
     assert rt.get(p.ping.remote()) == "pong"
 
     assert _get(dashboard + "/healthz") == "ok"
-    assert "ray_tpu cluster" in _get(dashboard + "/")
+    assert "ray_tpu dashboard" in _get(dashboard + "/")
 
     status = json.loads(_get(dashboard + "/api/cluster_status"))
     assert status["alive_nodes"] == 1

@@ -18,6 +18,8 @@ from ray_tpu.models import (
 from ray_tpu.models.mlp import init_mlp, mlp_classifier_loss, mlp_forward
 from ray_tpu.parallel import MeshConfig, build_mesh, shard_params
 
+pytestmark = pytest.mark.slow  # jax-compile-heavy compute-path tier
+
 
 @pytest.fixture(scope="module")
 def tiny_setup():

@@ -15,6 +15,8 @@ from ray_tpu.ops import (
 )
 from ray_tpu.parallel.ring_attention import reference_attention
 
+pytestmark = pytest.mark.slow  # jax-compile-heavy compute-path tier
+
 
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_attention_xla_fallback(causal):

@@ -23,6 +23,8 @@ from ray_tpu.parallel import (
 )
 from ray_tpu.parallel.ring_attention import reference_attention
 
+pytestmark = pytest.mark.slow  # jax-compile-heavy compute-path tier
+
 
 def test_mesh_config_factorization():
     cfg = MeshConfig.for_devices(8, tp=2)
@@ -164,6 +166,7 @@ def test_moe_layer_sharded_over_ep():
     out, aux = run(x, router_w, w_experts)
     assert out.shape == (tokens, d)
 
+@pytest.mark.slow
 def test_pipeline_transformer_trains_and_matches_single_device():
     """The REAL model under pp: loss AND grads must match a single-device
     run (VERDICT r1 weak #4 — pp must be a training capability, not a toy)."""
@@ -246,6 +249,7 @@ def test_pipeline_composes_with_dp():
                                    rtol=5e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_tp():
     """pp x tp: tensor-parallel weight shards inside each pipeline stage;
     loss and grads still match a single-device run."""

@@ -77,6 +77,7 @@ def test_gae_bootstrap_on_truncation():
 
 @pytest.mark.usefixtures("rt_start")
 @pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+@pytest.mark.slow
 def test_ppo_cartpole_improves():
     import gymnasium as gym
 
@@ -142,6 +143,7 @@ def test_vtrace_terminal_cuts_bootstrap():
     np.testing.assert_allclose(np.asarray(vs), [3.0, 2.0], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_impala_cartpole_improves(rt_start):
     import gymnasium as gym
 
@@ -187,6 +189,7 @@ def test_replay_buffer_ring_and_sampling():
     assert set(mb["actions"]) <= set(range(12))
 
 
+@pytest.mark.slow
 def test_dqn_cartpole_improves(rt_start):
     import gymnasium as gym
 
@@ -215,6 +218,75 @@ def test_dqn_cartpole_improves(rt_start):
         assert result["buffer_size"] > 400
         assert best >= 75.0, (
             f"DQN failed to learn CartPole: first={first} best={best}"
+        )
+    finally:
+        algo.stop()
+
+
+def test_vector_env_runner_shapes_and_stats(rt_start):
+    """N envs per runner, one batched policy call per step: output is
+    time-major (T, N, ...) with per-env bootstraps and real episode
+    bookkeeping across auto-resets (rllib vectorized EnvRunner analog)."""
+    import gymnasium as gym
+
+    from ray_tpu.rl import (
+        DiscretePolicyModule,
+        RLModuleSpec,
+        VectorEnvRunner,
+    )
+    from ray_tpu.rl.core.learner import Learner
+
+    spec = RLModuleSpec(4, 2, (32,))
+    runner = VectorEnvRunner.options(num_cpus=0.5).remote(
+        lambda: gym.make("CartPole-v1"),
+        lambda: DiscretePolicyModule(spec),
+        num_envs=4,
+        rollout_length=64,
+        seed=3,
+    )
+    learner = Learner(DiscretePolicyModule(spec), None, seed=0)
+    rt.get(runner.set_weights.remote(learner.get_weights()), timeout=120)
+    batch = rt.get(runner.sample.remote(), timeout=300)
+    assert batch["obs"].shape == (64, 4, 4)
+    assert batch["actions"].shape == (64, 4)
+    assert batch["logp"].shape == (64, 4)
+    assert batch["rewards"].shape == (64, 4)
+    assert batch["dones"].shape == (64, 4)
+    assert batch["last_values"].shape == (4,)
+    assert batch["last_obs"].shape == (4, 4)
+    # A 64*4=256-step random CartPole rollout sees episode ends.
+    assert batch["dones"].sum() > 0
+    stats = rt.get(runner.episode_stats.remote(), timeout=60)
+    assert stats["episodes"] > 0
+    rt.kill(runner)
+
+
+@pytest.mark.slow
+def test_appo_cartpole_improves(rt_start):
+    import gymnasium as gym
+
+    from ray_tpu.rl import APPOConfig
+
+    algo = (
+        APPOConfig()
+        .environment(lambda: gym.make("CartPole-v1"), obs_dim=4,
+                     num_actions=2)
+        .env_runners(num_env_runners=2, num_envs_per_runner=4,
+                     rollout_length=64)
+        .training(lr=3e-3, updates_per_iteration=8, rollouts_per_update=1)
+        .build()
+    )
+    try:
+        first = algo.train()
+        best = 0.0
+        for _ in range(8):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 75.0:
+                break
+        assert best > first["episode_return_mean"] or best > 60.0, (
+            f"no improvement: first={first['episode_return_mean']}, "
+            f"best={best}"
         )
     finally:
         algo.stop()

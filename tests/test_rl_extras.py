@@ -75,6 +75,7 @@ def _ma_config(policies, mapping_fn, seed=0):
     )
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_separate_policies(rt_start):
     spec = RLModuleSpec(obs_dim=3, num_actions=3)
     algo = _ma_config(
@@ -101,6 +102,7 @@ def test_multi_agent_ppo_separate_policies(rt_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_shared_policy(rt_start):
     spec = RLModuleSpec(obs_dim=3, num_actions=3)
     algo = _ma_config({"shared": spec}, lambda aid: "shared").build()

@@ -26,6 +26,7 @@ def rt_serve():
     rt.shutdown()
 
 
+@pytest.mark.slow
 def test_batched_llm_generation(rt_serve):
     @serve.deployment(max_ongoing_requests=8)
     class LLM:
@@ -76,6 +77,7 @@ def test_batched_llm_generation(rt_serve):
     assert max(stats["batch_sizes"]["generate_batch"]) > 1
 
 
+@pytest.mark.slow
 def test_streaming_token_generation(rt_serve):
     @serve.deployment
     class StreamLLM:

@@ -20,6 +20,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # chaos/e2e tier — fast runs skip
+
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import ObjectStore
 

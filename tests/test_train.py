@@ -74,6 +74,7 @@ def _dp_loop(config):
         train.report({"loss": float(loss), "rank": rank, "step": step})
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
 def test_data_parallel_two_workers(tmp_path):
     trainer = JaxTrainer(

@@ -265,6 +265,7 @@ def _pbt_trainable(config):
         time.sleep(0.1)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
 def test_pbt_exploits_and_improves(tmp_path):
     from ray_tpu.train.config import RunConfig
