@@ -21,8 +21,9 @@
   } while (0)
 
 int main(int argc, char** argv) {
-  if (argc != 3) {
-    std::fprintf(stderr, "usage: %s <gcs_host> <gcs_port>\n", argv[0]);
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <gcs_host> <gcs_port> [actor_name]\n",
+                 argv[0]);
     return 2;
   }
   rt::Client client;
@@ -80,6 +81,35 @@ int main(int argc, char** argv) {
   if (bad.ok) {
     std::fprintf(stderr, "FAIL error propagation: bad task succeeded\n");
     return 1;
+  }
+
+  // 6. Direct cross-language actor call (optional: pass the name of a
+  // live named actor as argv[3]; the Python harness creates one).
+  if (argc >= 4) {
+    auto actor = client.GetNamedActor(argv[3]);
+    if (!actor.ok) {
+      std::fprintf(stderr, "FAIL get_named_actor: %s\n",
+                   actor.error.c_str());
+      return 1;
+    }
+    auto r1 = client.ActorCall(actor, "add", {rt::Value::I(40)});
+    if (!r1.ok || r1.value.as_int() != 40) {
+      std::fprintf(stderr, "FAIL actor add: %s\n", r1.error.c_str());
+      return 1;
+    }
+    auto r2 = client.ActorCall(actor, "add", {rt::Value::I(2)});
+    if (!r2.ok || r2.value.as_int() != 42) {
+      std::fprintf(stderr, "FAIL actor state: %s (got %lld)\n",
+                   r2.error.c_str(),
+                   static_cast<long long>(r2.value.as_int()));
+      return 1;
+    }
+    auto r3 = client.ActorCall(actor, "nope", {});
+    if (r3.ok) {
+      std::fprintf(stderr, "FAIL actor error propagation\n");
+      return 1;
+    }
+    std::printf("CPP ACTOR OK\n");
   }
 
   std::printf("CPP CLIENT OK\n");

@@ -65,6 +65,25 @@ class Client {
     std::string error;
     Value value;
   };
+  struct ActorInfo {
+    bool ok = false;
+    std::string error;
+    std::string actor_id;   // 16 raw bytes
+    std::string address;    // worker host
+    int64_t port = 0;       // worker RPC port
+    std::string state;
+  };
+  // Resolve a named actor (reference: ray.get_actor) to its hosting
+  // worker's direct-call address.
+  ActorInfo GetNamedActor(const std::string& name,
+                          const std::string& ns = "");
+  // Direct cross-language actor method call: msgpack-plain args in,
+  // RTX1 result out, straight to the actor's worker (the reference's
+  // direct actor transport role for foreign frontends).
+  TaskResult ActorCall(const ActorInfo& actor, const std::string& method,
+                       const std::vector<Value>& args,
+                       double timeout_s = 60.0);
+
   // Run the Python function "module:attr" in a cluster worker with
   // msgpack-plain args; blocks for the result.
   TaskResult Submit(const std::string& fn_name,
@@ -72,6 +91,7 @@ class Client {
                     double timeout_s = 120.0);
 
  private:
+  TaskResult ParseTaskResult(const Value& r, double timeout_s);
   Value Call(int fd, const std::string& method, const Value& payload,
              bool* ok);
   bool SendFrame(int fd, const Value& frame);

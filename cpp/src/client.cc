@@ -302,36 +302,35 @@ std::optional<Value> Client::Get(const std::string& object_id,
   return out;
 }
 
-Client::TaskResult Client::Submit(const std::string& fn_name,
-                                  const std::vector<Value>& args,
-                                  double timeout_s) {
-  (void)timeout_s;  // the blocking call returns when the task completes
+Client::TaskResult Client::ParseTaskResult(const Value& r,
+                                           double timeout_s) {
   TaskResult result;
-  Value spec = Value::Map();
-  spec["task_id"] = Value::Bin(RandomId());
-  spec["job_id"] = Value::Bin(job_id_);
-  spec["name"] = Value::S(fn_name);
-  spec["fn_name"] = Value::S(fn_name);
-  spec["plain_args"] = Value::Arr(args);
-  spec["deps"] = Value::Arr();
-  spec["num_returns"] = Value::I(1);
-  Value res = Value::Map();
-  res["CPU"] = Value::F(1.0);
-  spec["resources"] = res;
-  spec["retriable"] = Value::B(false);
-
-  bool ok = false;
-  Value r = Call(raylet_fd_, "submit_task", spec, &ok);
-  if (!ok) {
-    result.error = error_;
-    return result;
-  }
   const Value* status = r.find("status");
   if (status == nullptr || status->as_str() != "ok") {
+    // Worker errors carry {cls, tb} (make_task_error); raylet errors
+    // carry {error}. Surface whichever detail is on the wire.
     const Value* err = r.find("error");
-    result.error = err != nullptr && !err->is_nil()
-                       ? err->as_str()
-                       : "task failed";
+    if (err != nullptr && !err->is_nil()) {
+      result.error = err->as_str();
+    } else {
+      const Value* cls = r.find("cls");
+      const Value* tb = r.find("tb");
+      std::string msg =
+          cls != nullptr && !cls->is_nil() ? cls->as_str() : "task failed";
+      if (tb != nullptr && !tb->is_nil()) {
+        // Last traceback line holds "Type: message".
+        const std::string& t = tb->as_str();
+        size_t end = t.find_last_not_of('\n');
+        size_t start = end == std::string::npos
+                           ? std::string::npos
+                           : t.rfind('\n', end);
+        if (end != std::string::npos) {
+          msg = t.substr(start == std::string::npos ? 0 : start + 1,
+                         end - (start == std::string::npos ? 0 : start));
+        }
+      }
+      result.error = msg;
+    }
     return result;
   }
   const Value* returns = r.find("returns");
@@ -358,8 +357,6 @@ Client::TaskResult Client::Submit(const std::string& fn_name,
     result.ok = true;
     return result;
   }
-  // Large result: stored in the cluster; fetch by the id the worker
-  // reported.
   const Value* oid = entry.find("object_id");
   if (oid == nullptr) {
     result.error = "stored result missing object_id";
@@ -373,6 +370,108 @@ Client::TaskResult Client::Submit(const std::string& fn_name,
   result.value = std::move(*fetched);
   result.ok = true;
   return result;
+}
+
+Client::ActorInfo Client::GetNamedActor(const std::string& name,
+                                        const std::string& ns) {
+  ActorInfo info;
+  Value d = Value::Map();
+  d["name"] = Value::S(name);
+  d["namespace"] = Value::S(ns);
+  bool ok = false;
+  Value r = Call(gcs_fd_, "get_named_actor", d, &ok);
+  if (!ok) {
+    info.error = error_;
+    return info;
+  }
+  const Value* actor = r.find("actor");
+  if (actor == nullptr || actor->is_nil()) {
+    info.error = "no such actor: " + name;
+    return info;
+  }
+  const Value* aid = actor->find("actor_id");
+  const Value* addr = actor->find("address");
+  const Value* port = actor->find("port");
+  const Value* state = actor->find("state");
+  if (aid == nullptr || addr == nullptr || addr->is_nil() ||
+      port == nullptr || port->is_nil()) {
+    info.error = "actor " + name + " is not ready (no address yet)";
+    return info;
+  }
+  info.actor_id = aid->as_bin();
+  info.address = addr->as_str();
+  info.port = port->as_int();
+  if (state != nullptr && !state->is_nil()) info.state = state->as_str();
+  if (info.state != "ALIVE") {
+    // A DEAD/RESTARTING actor's stale address would dial a dead (or
+    // recycled) port; report the real condition instead.
+    info.error = "actor " + name + " is " +
+                 (info.state.empty() ? "not alive" : info.state);
+    return info;
+  }
+  info.ok = true;
+  return info;
+}
+
+Client::TaskResult Client::ActorCall(const ActorInfo& actor,
+                                     const std::string& method,
+                                     const std::vector<Value>& args,
+                                     double timeout_s) {
+  TaskResult result;
+  if (!actor.ok) {
+    result.error = actor.error.empty() ? "invalid actor handle"
+                                       : actor.error;
+    return result;
+  }
+  // One connection per call keeps this client synchronous and simple;
+  // latency-sensitive callers can cache the fd themselves.
+  std::string err;
+  int fd = DialTcp(actor.address, static_cast<int>(actor.port), &err);
+  if (fd < 0) {
+    result.error = err;
+    return result;
+  }
+  Value d = Value::Map();
+  d["actor_id"] = Value::Bin(actor.actor_id);
+  d["task_id"] = Value::Bin(RandomId());
+  d["method"] = Value::S(method);
+  d["plain_args"] = Value::Arr(args);
+  d["num_returns"] = Value::I(1);
+  d["xlang"] = Value::B(true);
+  bool ok = false;
+  Value r = Call(fd, "actor_call", d, &ok);
+  close(fd);
+  if (!ok) {
+    result.error = error_;
+    return result;
+  }
+  return ParseTaskResult(r, timeout_s);
+}
+
+Client::TaskResult Client::Submit(const std::string& fn_name,
+                                  const std::vector<Value>& args,
+                                  double timeout_s) {
+  TaskResult result;
+  Value spec = Value::Map();
+  spec["task_id"] = Value::Bin(RandomId());
+  spec["job_id"] = Value::Bin(job_id_);
+  spec["name"] = Value::S(fn_name);
+  spec["fn_name"] = Value::S(fn_name);
+  spec["plain_args"] = Value::Arr(args);
+  spec["deps"] = Value::Arr();
+  spec["num_returns"] = Value::I(1);
+  Value res = Value::Map();
+  res["CPU"] = Value::F(1.0);
+  spec["resources"] = res;
+  spec["retriable"] = Value::B(false);
+
+  bool ok = false;
+  Value r = Call(raylet_fd_, "submit_task", spec, &ok);
+  if (!ok) {
+    result.error = error_;
+    return result;
+  }
+  return ParseTaskResult(r, timeout_s);
 }
 
 }  // namespace rt

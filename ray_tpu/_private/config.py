@@ -16,7 +16,12 @@ from typing import Any
 
 
 def _env(name: str, default: Any, typ: type) -> Any:
-    raw = os.environ.get(f"RT_{name}")
+    # Documented form is upper-case (RT_GCS_WAL_FSYNC, matching the
+    # reference's RAY_<NAME> convention); the verbatim field-name form is
+    # accepted too so nothing silently ignores an operator's setting.
+    raw = os.environ.get(f"RT_{name.upper()}")
+    if raw is None:
+        raw = os.environ.get(f"RT_{name}")
     if raw is None:
         return default
     if typ is bool:
@@ -114,6 +119,38 @@ class Config:
     # -- gcs --------------------------------------------------------------
     # Snapshot debounce for GCS persistence (RT_GCS_PERSIST_PATH).
     gcs_persist_debounce_s: float = 0.05
+    # WAL compaction threshold: a snapshot rewrite is scheduled once the
+    # write-ahead log passes this size (gcs_table_storage compaction role).
+    gcs_wal_compact_bytes: int = 4 * 1024 * 1024
+    # fsync each WAL record (strict durability; default flushes only).
+    gcs_wal_fsync: bool = False
+
+    # -- direct task transport (worker leases) ---------------------------
+    # Max leased workers per scheduling class per owner (the reference
+    # bounds leases by cluster capacity; direct_task_transport.cc).
+    direct_lease_max_workers: int = 16
+    # Outstanding direct tasks on the least-loaded lease that trigger
+    # acquiring another worker.
+    direct_lease_grow_outstanding: int = 2
+    # Idle seconds before an owner returns a leased worker.
+    direct_lease_idle_release_s: float = 1.0
+    # Worker fork server (zygote.py). Off -> every spawn is a fresh
+    # interpreter (RT_DISABLE_ZYGOTE also works per-spawn).
+    zygote_enabled: bool = True
+
+    # -- object-manager flow control -------------------------------------
+    # Concurrent pull transfers per node (PullManager admission).
+    pull_max_concurrent: int = 8
+    # Fraction of the object store reservable by in-flight pulls.
+    pull_budget_fraction: float = 0.25
+    # Concurrent outbound chunk reads served (PushManager throttling).
+    push_chunk_slots: int = 16
+
+    # -- wire protocol ---------------------------------------------------
+    # Frames at/above this size bypass coalescing and await drain.
+    rpc_direct_write_threshold: int = 64 * 1024
+    # Transport backlog that parks senders in drain() (backpressure).
+    rpc_write_buffer_drain: int = 256 * 1024
 
     # -- collective -----------------------------------------------------
     collective_rendezvous_timeout_s: float = 60.0

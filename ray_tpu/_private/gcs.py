@@ -74,10 +74,8 @@ class GcsServer:
         self._wal_fh = None
         self._wal_seq = 0
         self._wal_bytes = 0
-        self._wal_compact_bytes = int(
-            os.environ.get("RT_GCS_WAL_COMPACT_BYTES", 4 * 1024 * 1024)
-        )
-        self._wal_fsync = os.environ.get("RT_GCS_WAL_FSYNC") == "1"
+        self._wal_compact_bytes = get_config().gcs_wal_compact_bytes
+        self._wal_fsync = get_config().gcs_wal_fsync
         self._base_handlers: Dict[str, Any] = {}
         # tables
         self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespace -> k -> v

@@ -25,6 +25,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
+from ray_tpu._private.config import get_config
+
 _LEN = struct.Struct("<I")
 
 # asyncio holds only weak references to tasks: a fire-and-forget
@@ -58,12 +60,13 @@ class FrameSender:
     drain for backpressure (the gRPC write-buffer role,
     src/ray/rpc/grpc_client.h)."""
 
-    DIRECT_THRESHOLD = 64 * 1024  # frames this big await drain
-    BUFFER_DRAIN = 256 * 1024  # cumulative queued bytes forcing a drain
-
-    __slots__ = ("_writer", "_buf", "_size", "_scheduled", "_lock")
+    __slots__ = ("_writer", "_buf", "_size", "_scheduled", "_lock",
+                 "_direct", "_drain")
 
     def __init__(self, writer: asyncio.StreamWriter):
+        cfg = get_config()
+        self._direct = cfg.rpc_direct_write_threshold
+        self._drain = cfg.rpc_write_buffer_drain
         self._writer = writer
         self._buf: list = []
         self._size = 0
@@ -80,7 +83,7 @@ class FrameSender:
         self._writer.write(data)
 
     async def send(self, frame: bytes) -> None:
-        if len(frame) >= self.DIRECT_THRESHOLD:
+        if len(frame) >= self._direct:
             async with self._lock:
                 self.flush()
                 self._writer.write(frame)
@@ -101,10 +104,10 @@ class FrameSender:
         # to grow the buffer without bound.
         transport = self._writer.transport
         if (
-            self._size >= self.BUFFER_DRAIN
+            self._size >= self._drain
             or (
                 transport is not None
-                and transport.get_write_buffer_size() >= self.BUFFER_DRAIN
+                and transport.get_write_buffer_size() >= self._drain
             )
         ):
             async with self._lock:

@@ -192,7 +192,7 @@ class Raylet:
         # Pull admission control (PullManager analog, pull_manager.h:52):
         # bound concurrent inbound transfers so a burst of dependency
         # fetches can't thrash the store/network; single-flight per object.
-        self._pull_slots = asyncio.Semaphore(8)
+        self._pull_slots = asyncio.Semaphore(cfg.pull_max_concurrent)
         # Flow control (VERDICT r2 item 7):
         #  * pull admission by BYTES with smallest-first priority under
         #    contention (PullManager's memory-quota + prioritized queue,
@@ -202,10 +202,10 @@ class Raylet:
         #    push_manager.h:30) — a popular node bounds concurrent chunk
         #    reads it serves so one broadcast can't monopolize its loop.
         self._pull_budget = _PullByteBudget(
-            max((object_store_memory or cfg.object_store_memory) // 4,
-                64 * 1024 * 1024)
+            max(int((object_store_memory or cfg.object_store_memory)
+                    * cfg.pull_budget_fraction), 64 * 1024 * 1024)
         )
-        self._push_chunk_slots = asyncio.Semaphore(16)
+        self._push_chunk_slots = asyncio.Semaphore(cfg.push_chunk_slots)
         self._active_pulls: Dict[bytes, asyncio.Future] = {}
         # Open chunked remote-client puts: oid -> (buffer, abort deadline).
         self._client_creates: Dict[bytes, tuple] = {}
@@ -459,7 +459,7 @@ class Raylet:
         # imports (~300ms) per worker. Falls back to Popen while the
         # zygote warms up or if it keeps dying.
         proc = None
-        if not env.get("RT_DISABLE_ZYGOTE"):
+        if get_config().zygote_enabled and not env.get("RT_DISABLE_ZYGOTE"):
             if self._zygote is None:
                 from ray_tpu._private.zygote_client import get_shared_manager
 

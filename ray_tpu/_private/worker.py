@@ -1044,9 +1044,11 @@ class CoreClient:
         best = min(live, key=lambda w: w["outstanding"], default=None)
         # Grow while tasks are stacking up (up to the node's CPU-ish cap);
         # single-flight so a burst requests one lease at a time.
+        cfg = get_config()
         if (
-            (best is None or best["outstanding"] >= 2)
-            and len(live) < 16
+            (best is None
+             or best["outstanding"] >= cfg.direct_lease_grow_outstanding)
+            and len(live) < cfg.direct_lease_max_workers
             and not pool["acquiring"]
         ):
             pool["acquiring"] = True
@@ -1106,7 +1108,8 @@ class CoreClient:
                     for w in pool["workers"]:
                         if w["conn"]._closed:
                             continue
-                        if w["outstanding"] == 0 and now - w["last_used"] > 1.0:
+                        if (w["outstanding"] == 0 and now - w["last_used"]
+                                > get_config().direct_lease_idle_release_s):
                             to_release.append(w)
                         else:
                             keep.append(w)

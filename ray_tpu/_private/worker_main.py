@@ -390,6 +390,15 @@ class WorkerProcess:
             return make_task_error(
                 RuntimeError("actor not hosted by this worker")
             )
+        if d.get("xlang"):
+            # Cross-language caller (C++ client): plain msgpack args, RTX1
+            # result, no per-caller sequence protocol — foreign clients
+            # are synchronous request/response. The concurrency bound
+            # still applies (N foreign clients must not exceed it).
+            if actor.max_concurrency > 1:
+                async with actor.sema:
+                    return await self._invoke_actor_method(actor, d)
+            return await self._invoke_actor_method(actor, d)
         if actor.max_concurrency > 1:
             async with actor.sema:
                 return await self._invoke_actor_method(actor, d)
@@ -417,7 +426,10 @@ class WorkerProcess:
             from ray_tpu.util import tracing
 
             method = getattr(actor.instance, d["method"])
-            args, kwargs = self.client.deserialize_args(d["args"])
+            if d.get("xlang"):
+                args, kwargs = tuple(d.get("plain_args") or ()), {}
+            else:
+                args, kwargs = self.client.deserialize_args(d["args"])
 
             def invoke():
                 with tracing.activate(d.get("trace_ctx"), d["method"]):
@@ -438,7 +450,8 @@ class WorkerProcess:
             # _package_returns may block on GCS (location registration), so
             # it must not run on the event loop.
             result = await self.loop.run_in_executor(
-                self.executor, self._package_returns, spec, value
+                self.executor, self._package_returns, spec, value,
+                bool(d.get("xlang")),
             )
             self._record_task_event(d["task_id"], d["method"], "FINISHED")
             return result

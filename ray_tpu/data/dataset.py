@@ -674,6 +674,22 @@ def _shuffle_reduce(mode: str, key, seed, salt: int, *pieces):
     return B.block_from_rows(rows)
 
 
+def _concat_pieces(*pieces):
+    """Order-preserving concat of piece blocks (shuffle combine step)."""
+    rows: List = []
+    for b in pieces:
+        rows.extend(B.block_to_rows(b))
+    return B.block_from_rows(rows)
+
+
+# Max object args per reduce/combine task. A 1000-block shuffle would
+# otherwise hand every reduce task 1000 object arguments (resolved and
+# held in memory at once); the tree combine bounds per-task fan-in the
+# way the reference's multi-round push-based shuffle bounds merge width
+# (push_based_shuffle_task_scheduler.py: merge factor).
+_SHUFFLE_FANIN = 64
+
+
 def _push_shuffle(refs: List, n_out: int, mode: str, map_key, reduce_key,
                   seed=None) -> List:
     if not refs:
@@ -681,17 +697,25 @@ def _push_shuffle(refs: List, n_out: int, mode: str, map_key, reduce_key,
     n_out = max(n_out, 1)
     map_fn = rt.remote(_shuffle_map_block).options(max_retries=-1)
     reduce_fn = rt.remote(_shuffle_reduce).options(max_retries=-1)
+    combine_fn = rt.remote(_concat_pieces).options(max_retries=-1)
     pieces: List[List] = []  # [map][partition] -> ref
     for i, ref in enumerate(refs):
         out = map_fn.options(num_returns=n_out).remote(
             ref, n_out, mode, map_key, seed, i
         )
         pieces.append([out] if n_out == 1 else list(out))
-    return [
-        reduce_fn.remote(mode, reduce_key, seed, j,
-                         *[pieces[i][j] for i in range(len(refs))])
-        for j in range(n_out)
-    ]
+    outs = []
+    for j in range(n_out):
+        parts = [pieces[i][j] for i in range(len(refs))]
+        # Contiguous slices keep concat order stable, so seeded random
+        # shuffles stay deterministic regardless of tree depth.
+        while len(parts) > _SHUFFLE_FANIN:
+            parts = [
+                combine_fn.remote(*parts[k:k + _SHUFFLE_FANIN])
+                for k in range(0, len(parts), _SHUFFLE_FANIN)
+            ]
+        outs.append(reduce_fn.remote(mode, reduce_key, seed, j, *parts))
+    return outs
 
 
 def _repartition_refs(refs: List, num_blocks: int) -> List:

@@ -102,15 +102,32 @@ def test_cross_language_task_from_python(rt_start):
 @pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
 def test_cpp_demo_end_to_end(rt_start):
     """Build and run the C++ demo binary against the live cluster: KV,
-    object put/get, cross-language submit, error propagation."""
+    object put/get, cross-language submit, error propagation, and a
+    direct cross-language ACTOR call (stateful, across two calls)."""
     demo = _build_demo()
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, x):
+            self.n += x
+            return self.n
+
+    c = Counter.options(name="cpp-counter").remote()
+    rt.get(c.add.remote(0), timeout=60)  # ensure ready + addressable
+
     node = rt._node
     out = subprocess.run(
-        [demo, node.gcs_host, str(node.gcs_port)],
+        [demo, node.gcs_host, str(node.gcs_port), "cpp-counter"],
         capture_output=True, text=True, timeout=300,
     )
     assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "CPP ACTOR OK" in out.stdout
     assert "CPP CLIENT OK" in out.stdout
+    # The C++ calls mutated the SAME actor instance Python sees.
+    assert rt.get(c.add.remote(0), timeout=60) == 42
 
 
 @pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)

@@ -229,3 +229,25 @@ def test_repartition_distributed():
         assert sorted(r["i"] for r in rp.take_all()) == list(range(100))
     finally:
         rt.shutdown()
+
+
+@pytest.mark.slow
+def test_wide_shuffle_bounded_fanin():
+    """A 150-block shuffle must not hand any reduce task 150 object args:
+    the tree combine bounds fan-in (reference: push-based shuffle merge
+    factor) while preserving row multiset and seeded determinism."""
+    rt.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        n = 600
+        ds = rtd.from_items([{"i": i} for i in range(n)], parallelism=150)
+        rp = ds.repartition(4).materialize()
+        assert rp.num_blocks() == 4
+        assert sorted(r["i"] for r in rp.take_all()) == list(range(n))
+
+        s1 = [r["i"] for r in ds.random_shuffle(seed=11).take_all()]
+        s2 = [r["i"] for r in ds.random_shuffle(seed=11).take_all()]
+        assert s1 == s2, "seeded wide shuffle must be deterministic"
+        assert sorted(s1) == list(range(n))
+        assert s1 != list(range(n))
+    finally:
+        rt.shutdown()
