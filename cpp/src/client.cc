@@ -325,8 +325,8 @@ Client::TaskResult Client::ParseTaskResult(const Value& r,
                            ? std::string::npos
                            : t.rfind('\n', end);
         if (end != std::string::npos) {
-          msg = t.substr(start == std::string::npos ? 0 : start + 1,
-                         end - (start == std::string::npos ? 0 : start));
+          size_t first = start == std::string::npos ? 0 : start + 1;
+          msg = t.substr(first, end - first + 1);
         }
       }
       result.error = msg;

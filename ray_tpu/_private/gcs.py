@@ -294,6 +294,10 @@ class GcsServer:
                     pass
                 self._wal_seq = max(self._wal_seq, seq)
         if replayed:
+            from ray_tpu.util.event import record_event
+
+            record_event("gcs", "recovered from write-ahead log",
+                         severity="INFO", replayed_records=replayed)
             self._mark_dirty()
 
     def _snapshot_bytes(self) -> bytes:
@@ -480,6 +484,10 @@ class GcsServer:
         info["state"] = "DEAD"
         info["death_reason"] = reason
         self.node_conns.pop(node_id, None)
+        from ray_tpu.util.event import record_event
+
+        record_event("gcs", f"node marked DEAD: {reason}",
+                     severity="ERROR", node_id=node_id.hex())
         # Fail actors living on that node; restart if budget remains.
         for actor_id, a in list(self.actors.items()):
             if a.get("node_id") == node_id and a["state"] in ("ALIVE", "PENDING", "RESTARTING"):
@@ -580,6 +588,8 @@ class GcsServer:
             # Unknown node (GCS restarted before re-registration): the
             # raylet must re-register; meanwhile ask for a full view.
             return {"ok": False, "need_full": True}
+        if "proc_stats" in d:
+            info["proc_stats"] = d["proc_stats"]
         ver = d.get("version")
         full = "available" in d
         if ver is not None and not full:
@@ -888,6 +898,13 @@ class GcsServer:
         if a["restarts_used"] < a["max_restarts"] or a["max_restarts"] == -1:
             a["restarts_used"] += 1
             a["state"] = "RESTARTING"
+            from ray_tpu.util.event import record_event
+
+            record_event(
+                "gcs", f"actor restarting ({reason})", severity="WARNING",
+                actor_id=actor_id.hex(), class_name=a.get("class_name", ""),
+                restarts_used=a["restarts_used"],
+            )
             await self.publish("actor_update:" + actor_id.hex(), self._actor_view(a))
             ok = await self._schedule_actor(actor_id)
             if not ok:

@@ -111,6 +111,9 @@ class WorkerHandle:
         # straight to this worker (direct_task_transport.cc OnWorkerIdle).
         self.lease_resources: Optional[Dict[str, float]] = None
         self.leased_by = None  # owner ServerConnection while leased
+        # Per-process stats sampled from /proc each heartbeat.
+        self.cpu_percent: float = 0.0
+        self.rss_bytes: int = 0
 
 
 class Raylet:
@@ -160,6 +163,7 @@ class Raylet:
         self.node_cache: Dict[bytes, dict] = {}
         self._dispatch_event = asyncio.Event()
         self._zygote = None  # lazy ZygoteManager (worker fork server)
+        self._proc_samples: Dict[int, tuple] = {}  # pid -> (jiffies, t)
         self._stopping = False
         self._bg: List[asyncio.Task] = []
         # Task state-transition events, batched to the GCS task-event sink
@@ -528,6 +532,17 @@ class Raylet:
             w.actor_resources = {}
 
     async def _report_worker_dead(self, w: WorkerHandle, intended=False, reason=""):
+        if not intended:
+            from ray_tpu.util.event import record_event
+
+            record_event(
+                "raylet", f"worker died unexpectedly: {reason}",
+                severity="WARNING",
+                node_id=self.node_id.hex(),
+                worker_id=w.worker_id.hex()
+                if isinstance(w.worker_id, bytes) else str(w.worker_id),
+                actor_id=w.actor_id.hex() if w.actor_id else None,
+            )
         if w.actor_id is not None:
             await self.gcs.call(
                 "worker_dead",
@@ -578,6 +593,41 @@ class Raylet:
                     self._dispatch_event.set()
 
     # -- memory monitor / OOM policy --------------------------------------
+    def _sample_proc_stats(self):
+        """Per-worker CPU%% + RSS from /proc (the reference's per-process
+        native stats role, src/ray/stats/; sampled each heartbeat)."""
+        page = os.sysconf("SC_PAGE_SIZE")
+        hz = os.sysconf("SC_CLK_TCK")
+        now = time.monotonic()
+        for w in self.workers.values():
+            pid = getattr(w.proc, "pid", None)
+            if pid is None:
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    parts = f.read().rsplit(") ", 1)[-1].split()
+                utime, stime = int(parts[11]), int(parts[12])
+                with open(f"/proc/{pid}/statm") as f:
+                    rss_pages = int(f.read().split()[1])
+            except (OSError, IndexError, ValueError):
+                continue
+            jiffies = utime + stime
+            prev = self._proc_samples.get(pid)
+            cpu = 0.0
+            if prev is not None and now > prev[1]:
+                cpu = 100.0 * (jiffies - prev[0]) / hz / (now - prev[1])
+            self._proc_samples[pid] = (jiffies, now)
+            w.cpu_percent = round(max(cpu, 0.0), 1)
+            w.rss_bytes = rss_pages * page
+        # Prune exited workers: a recycled pid must not inherit a stale
+        # jiffies baseline (wrong first sample), nor may the dict grow
+        # with worker churn.
+        live = {
+            getattr(w.proc, "pid", None) for w in self.workers.values()
+        }
+        for pid in [p for p in self._proc_samples if p not in live]:
+            del self._proc_samples[pid]
+
     def _memory_usage_fraction(self) -> float:
         """Node memory usage (tests override this).
 
@@ -670,6 +720,15 @@ class Raylet:
                 )
                 try:
                     w.proc.kill()  # reap loop fails the task as retriable
+                    from ray_tpu.util.event import record_event
+
+                    record_event(
+                        "raylet", "OOM policy killed a worker",
+                        severity="ERROR",
+                        node_id=self.node_id.hex(),
+                        task=(entry["spec"].get("name") or ""),
+                        memory_fraction=round(frac, 3),
+                    )
                 except Exception:  # noqa: BLE001
                     pass
             except Exception:  # noqa: BLE001
@@ -1941,6 +2000,8 @@ class Raylet:
                     "idle": w.idle,
                     "actor_id": w.actor_id,
                     "current_task": w.current_task,
+                    "cpu_percent": w.cpu_percent,
+                    "rss_bytes": w.rss_bytes,
                 }
                 for w in self.workers.values()
             ],
@@ -1997,6 +2058,15 @@ class Raylet:
         payload = {
             "node_id": self.node_id.binary(),
             "version": self._sync_version,
+            "proc_stats": {
+                "workers": sum(
+                    1 for w in self.workers.values() if w.conn is not None
+                ),
+                "rss_bytes": sum(w.rss_bytes for w in self.workers.values()),
+                "cpu_percent": round(
+                    sum(w.cpu_percent for w in self.workers.values()), 1
+                ),
+            },
         }
         avail = dict(self.resources_available)
         if self._synced_resources is None:
@@ -2031,6 +2101,10 @@ class Raylet:
         while True:
             await asyncio.sleep(cfg.health_check_period_s / 2)
             try:
+                try:
+                    self._sample_proc_stats()
+                except Exception:  # noqa: BLE001 — stats are best-effort
+                    pass
                 try:
                     records, commits = self._runtime_metric_deltas()
                     self._metrics_seq += 1

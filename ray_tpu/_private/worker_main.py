@@ -394,11 +394,10 @@ class WorkerProcess:
             # Cross-language caller (C++ client): plain msgpack args, RTX1
             # result, no per-caller sequence protocol — foreign clients
             # are synchronous request/response. The concurrency bound
-            # still applies (N foreign clients must not exceed it).
-            if actor.max_concurrency > 1:
-                async with actor.sema:
-                    return await self._invoke_actor_method(actor, d)
-            return await self._invoke_actor_method(actor, d)
+            # still applies (the semaphore is sized max(1, max_concurrency),
+            # so serial actors stay serial for foreign callers too).
+            async with actor.sema:
+                return await self._invoke_actor_method(actor, d)
         if actor.max_concurrency > 1:
             async with actor.sema:
                 return await self._invoke_actor_method(actor, d)

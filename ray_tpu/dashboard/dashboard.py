@@ -78,7 +78,7 @@ _HTML = """<!DOCTYPE html>
 <script>
 "use strict";
 const TABS = ["overview","nodes","actors","tasks","objects",
-              "placement groups","jobs","metrics"];
+              "placement groups","jobs","events","metrics"];
 let tab = location.hash.slice(1) || "overview";
 let filter = "", sortKey = null, sortDir = 1, openJob = null;
 const hist = {};  // metric sparkline history
@@ -186,7 +186,7 @@ async function render() {
   } else if (tab === "nodes") {
     el("main").innerHTML = rows(await api("nodes"),
       ["node_id","state","address","is_head","resources_total",
-       "resources_available"], "state");
+       "resources_available","proc_stats"], "state");
   } else if (tab === "actors") {
     el("main").innerHTML = rows(await api("actors"),
       ["actor_id","class_name","name","state","node_id"], "state");
@@ -216,6 +216,15 @@ async function render() {
       html += `<h3>logs: ${esc(openJob)}</h3><pre>${esc(logs)}</pre>`;
     }
     el("main").innerHTML = html;
+  } else if (tab === "events") {
+    const evts = (await api("events")).reverse().map(e => ({
+      time: new Date(e.timestamp * 1000).toLocaleTimeString(),
+      source: e.source, severity: e.severity, message: e.message,
+      detail: Object.fromEntries(Object.entries(e).filter(([k]) =>
+        !["timestamp","source","severity","message","pid"].includes(k))),
+    }));
+    el("main").innerHTML = rows(evts,
+      ["time","source","severity","message","detail"]);
   } else if (tab === "metrics") {
     const text = await fetch("metrics").then(r => r.text());
     const rowsOut = [];
@@ -280,6 +289,7 @@ class Dashboard:
                 web.get("/api/objects", self.objects),
                 web.get("/api/placement_groups", self.placement_groups),
                 web.get("/api/jobs", self.jobs),
+                web.get("/api/events", self.events),
                 web.post("/api/jobs", self.submit_job),
                 web.get("/api/jobs/{submission_id}", self.job_info),
                 web.get("/api/jobs/{submission_id}/logs", self.job_logs),
@@ -351,6 +361,7 @@ class Dashboard:
                     "is_head": n.get("is_head", False),
                     "resources_total": n.get("resources_total", {}),
                     "resources_available": n.get("resources_available", {}),
+                    "proc_stats": n.get("proc_stats", {}),
                 }
                 for n in nodes
             ]
@@ -404,6 +415,14 @@ class Dashboard:
                 for p in pgs
             ]
         )
+
+    async def events(self, request):
+        """Merged structured event tail (reference: dashboard event
+        module over RAY_EVENT JSON files)."""
+        from ray_tpu.util.event import read_events
+
+        limit = int(request.query.get("limit", 200))
+        return self._json(read_events(limit=limit))
 
     async def jobs(self, request):
         jobs = (await self.gcs.call("list_jobs", {}))["jobs"]

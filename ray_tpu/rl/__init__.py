@@ -8,10 +8,21 @@ jit-compiled, multi-learner sync is collective-based.
 
 from ray_tpu.rl.core.learner import Learner
 from ray_tpu.rl.core.learner_group import LearnerGroup
-from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
-from ray_tpu.rl.env_runner import EnvRunner, VectorEnvRunner, compute_gae
+from ray_tpu.rl.core.rl_module import (
+    ContinuousModuleSpec,
+    ContinuousPolicyModule,
+    DiscretePolicyModule,
+    RLModuleSpec,
+)
+from ray_tpu.rl.env_runner import (
+    ContinuousTransitionRunner,
+    EnvRunner,
+    VectorEnvRunner,
+    compute_gae,
+)
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig, appo_loss
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, dqn_loss
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.algorithms.impala import (
     IMPALA,
     IMPALAConfig,
@@ -44,6 +55,11 @@ from ray_tpu.rl.offline import (
 from ray_tpu.rl.replay import ReplayBuffer
 
 __all__ = [
+    "SAC",
+    "SACConfig",
+    "ContinuousModuleSpec",
+    "ContinuousPolicyModule",
+    "ContinuousTransitionRunner",
     "APPO",
     "APPOConfig",
     "appo_loss",

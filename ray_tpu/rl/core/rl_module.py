@@ -85,3 +85,81 @@ class QNetworkModule:
         )
         explore = jax.random.uniform(k2, greedy.shape) < epsilon
         return jnp.where(explore, random_a, greedy)
+
+
+@dataclass(frozen=True)
+class ContinuousModuleSpec:
+    """Spec for continuous-control modules (SAC family)."""
+
+    obs_dim: int
+    action_dim: int
+    action_low: float = -1.0
+    action_high: float = 1.0
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+class ContinuousPolicyModule:
+    """Tanh-squashed Gaussian policy + twin Q towers (SAC's module;
+    reference analog: the SAC RLModules under rllib/algorithms/sac/).
+
+    Internally actions live in [-1, 1] (the tanh image); `scale_action`
+    maps to the env's [low, high]. Q towers consume (obs, normalized
+    action) concatenations.
+    """
+
+    LOG_STD_MIN = -5.0
+    LOG_STD_MAX = 2.0
+
+    def __init__(self, spec: ContinuousModuleSpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict:
+        kp, k1, k2 = jax.random.split(rng, 3)
+        sizes = [self.spec.obs_dim, *self.spec.hidden]
+        qin = self.spec.obs_dim + self.spec.action_dim
+        qsizes = [qin, *self.spec.hidden, 1]
+        return {
+            "pi": init_mlp(kp, sizes + [2 * self.spec.action_dim]),
+            "q1": init_mlp(k1, qsizes),
+            "q2": init_mlp(k2, qsizes),
+        }
+
+    def scale_action(self, a_norm: jax.Array) -> jax.Array:
+        lo, hi = self.spec.action_low, self.spec.action_high
+        return a_norm * (hi - lo) / 2.0 + (hi + lo) / 2.0
+
+    def _dist(self, params: Dict, obs: jax.Array):
+        out = mlp_forward(params["pi"], obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mu, log_std
+
+    def sample_with_logp(self, params: Dict, obs: jax.Array,
+                         rng: jax.Array):
+        """Reparameterized tanh-Gaussian sample + its log-prob."""
+        mu, log_std = self._dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mu.shape)
+        pre = mu + std * eps
+        a = jnp.tanh(pre)
+        # N(pre; mu, std) log-density with the tanh change of variables.
+        gauss_logp = (
+            -0.5 * (eps ** 2) - log_std - 0.5 * jnp.log(2.0 * jnp.pi)
+        ).sum(-1)
+        logp = gauss_logp - jnp.log(1.0 - a ** 2 + 1e-6).sum(-1)
+        return a, logp
+
+    def deterministic_action(self, params: Dict, obs: jax.Array):
+        mu, _ = self._dist(params, obs)
+        return jnp.tanh(mu)
+
+    def q_values(self, params: Dict, obs: jax.Array, a_norm: jax.Array):
+        x = jnp.concatenate([obs, a_norm], axis=-1)
+        q1 = mlp_forward(params["q1"], x)[..., 0]
+        q2 = mlp_forward(params["q2"], x)[..., 0]
+        return q1, q2
+
+    def sample_action(self, params: Dict, obs: jax.Array, rng: jax.Array):
+        """EnvRunner-facing: scaled action, logp, dummy value."""
+        a, logp = self.sample_with_logp(params, obs, rng)
+        return self.scale_action(a), logp, jnp.zeros(obs.shape[0])

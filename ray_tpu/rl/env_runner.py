@@ -352,3 +352,85 @@ class VectorEnvRunner:
             "episodes": episodes,
             "mean_return": float(np.mean(returns)) if returns else 0.0,
         }
+
+
+@rt.remote
+class ContinuousTransitionRunner:
+    """Off-policy transition collector for continuous control (SAC).
+
+    Stores NORMALIZED ([-1, 1]) actions so the learner's Q towers see the
+    exact values the policy emitted; env steps receive the scaled form.
+    `sample(random_actions=True)` provides the uniform warmup phase
+    (reference: SAC's num_steps_sampled_before_learning_starts)."""
+
+    def __init__(self, env_creator, module_factory, seed: int = 0,
+                 rollout_length: int = 200):
+        import jax
+
+        self.env = env_creator()
+        self.module = module_factory()
+        self.rollout_length = rollout_length
+        self.rng = jax.random.PRNGKey(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self.params = None
+        self._sample = None
+        self._obs = None
+        self._tracker = EpisodeTracker()
+
+    def set_weights(self, weights):
+        self.params = weights
+        return True
+
+    def sample(self, random_actions: bool = False) -> Dict[str, np.ndarray]:
+        import jax
+
+        if self._sample is None:
+            self._sample = jax.jit(self.module.sample_with_logp)
+        if self._obs is None:
+            obs, _ = self.env.reset()
+            self._obs = np.asarray(obs, dtype=np.float32)
+        T = self.rollout_length
+        adim = self.module.spec.action_dim
+        obs_buf = np.empty((T, self._obs.shape[0]), dtype=np.float32)
+        act_buf = np.empty((T, adim), dtype=np.float32)
+        rew_buf = np.empty(T, dtype=np.float32)
+        next_buf = np.empty_like(obs_buf)
+        done_buf = np.empty(T, dtype=np.float32)
+        for t in range(T):
+            if random_actions or self.params is None:
+                a_norm = self._np_rng.uniform(-1.0, 1.0, adim).astype(
+                    np.float32
+                )
+            else:
+                self.rng, key = jax.random.split(self.rng)
+                a, _ = self._sample(self.params, self._obs[None], key)
+                a_norm = np.asarray(a)[0]
+            scaled = np.asarray(
+                self.module.scale_action(a_norm), dtype=np.float64
+            )
+            nxt, reward, terminated, truncated, _ = self.env.step(scaled)
+            self._tracker.add(float(reward))
+            obs_buf[t] = self._obs
+            act_buf[t] = a_norm
+            rew_buf[t] = float(reward)
+            next_buf[t] = np.asarray(nxt, dtype=np.float32)
+            # Q targets bootstrap through time-limit truncations:
+            # dones records TERMINATED only (same contract as
+            # TransitionEnvRunner).
+            done_buf[t] = float(terminated)
+            if terminated or truncated:
+                self._tracker.end_episode()
+                obs, _ = self.env.reset()
+                self._obs = np.asarray(obs, dtype=np.float32)
+            else:
+                self._obs = next_buf[t]
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "rewards": rew_buf,
+            "next_obs": next_buf,
+            "dones": done_buf,
+        }
+
+    def episode_stats(self) -> Dict[str, Any]:
+        return self._tracker.stats()

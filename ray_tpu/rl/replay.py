@@ -13,11 +13,16 @@ import numpy as np
 
 
 class ReplayBuffer:
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: int = 0):
+        """action_dim=0 -> discrete int actions; >0 -> float vectors."""
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), dtype=np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), dtype=np.float32)
-        self.actions = np.zeros(capacity, dtype=np.int32)
+        if action_dim:
+            self.actions = np.zeros((capacity, action_dim), dtype=np.float32)
+        else:
+            self.actions = np.zeros(capacity, dtype=np.int32)
         self.rewards = np.zeros(capacity, dtype=np.float32)
         self.dones = np.zeros(capacity, dtype=np.float32)
         self._rng = np.random.default_rng(seed)

@@ -218,3 +218,69 @@ def test_gcs_runtime_metrics_reach_prometheus(dashboard):
     assert 'rt_gcs_rpc_total{method="register_node"}' in text
     assert "rt_gcs_kv_entries" in text
     assert "rt_gcs_task_events" in text
+
+
+def test_structured_events_and_proc_stats(tmp_path, monkeypatch):
+    """RAY_EVENT analog: components append JSON-line event files; the
+    dashboard merges them at /api/events. Per-process stats (cpu%%, rss
+    from /proc) flow raylet -> GCS node view."""
+    monkeypatch.setenv("RT_EVENT_DIR", str(tmp_path / "events"))
+    from ray_tpu.util.event import read_events, record_event
+
+    record_event("testcomp", "hello world", severity="WARNING", extra=7)
+    record_event("othercomp", "second")
+    evts = read_events()
+    assert len(evts) == 2
+    assert evts[0]["message"] == "hello world"
+    assert evts[0]["severity"] == "WARNING" and evts[0]["extra"] == 7
+    only = read_events(source="othercomp")
+    assert len(only) == 1 and only[0]["source"] == "othercomp"
+
+    # Live cluster: a killed worker emits a raylet event, and the GCS
+    # node view carries aggregated per-process stats within a heartbeat.
+    rt.init(num_cpus=2)
+    try:
+        @rt.remote
+        def hold():
+            import time as _t
+
+            _t.sleep(30)
+
+        ref = hold.remote()
+        import time as _t
+
+        from ray_tpu._private import worker as worker_mod
+
+        client = worker_mod.get_client()
+        deadline = _t.monotonic() + 30
+        stats = {}
+        while _t.monotonic() < deadline:
+            nodes = client._run(client._gcs_call("get_nodes", {}))["nodes"]
+            stats = nodes[0].get("proc_stats") or {}
+            if stats.get("workers", 0) >= 1 and stats.get("rss_bytes", 0) > 0:
+                break
+            _t.sleep(0.5)
+        assert stats.get("workers", 0) >= 1, stats
+        assert stats.get("rss_bytes", 0) > 0, stats
+
+        # SIGKILL the worker running `hold`: an unexpected-death event
+        # must appear in the raylet's structured log.
+        import os
+        import signal
+
+        infos = client._run(
+            client.raylet.call("get_info", {}), timeout=10
+        )["workers"]
+        busy = [w for w in infos if w["current_task"] is not None]
+        assert busy
+        os.kill(busy[0]["pid"], signal.SIGKILL)
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            evts = [e for e in read_events(source="raylet")
+                    if "died unexpectedly" in e["message"]]
+            if evts:
+                break
+            _t.sleep(0.5)
+        assert evts, "worker death produced no structured event"
+    finally:
+        rt.shutdown()
