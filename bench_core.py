@@ -10,9 +10,10 @@ Run: python bench_core.py [--quick]
 ## Throughput analysis (round 3)
 
 Measured on this image's single-core host (results in BENCH_CORE.json):
-~2k trivial tasks/s sync, ~6k tasks/s pipelined (async), ~1.5k/1.9k actor
-calls/s sync/async, ~7-9 GB/s large-object put+get (shared-memory
-zero-copy). Round-3 changes that moved these numbers:
+~1.8k trivial tasks/s sync, 3.5-6.5k tasks/s pipelined (async; this
+shared host's load swings runs), ~1.5k/2k actor calls/s sync/async,
+~8-9 GB/s large-object put+get (shared-memory zero-copy). Round-3
+changes that moved these numbers:
   * Direct task transport (worker.py _submit_direct + raylet
     h_lease_worker): the owner leases workers once per scheduling class
     and streams task specs straight to them — the raylet is off the
@@ -29,9 +30,11 @@ The remaining gap to the reference's 10-20k/s/core is interpreter cost
 in the per-task execute path (the reference runs it in C++ CoreWorker,
 core_worker.cc:1935); on a TPU pod host with real cores the processes
 stop timesharing one core and the same code measures several-fold
-higher. Scale probes (bench_scale.py): 10k queued tasks drain in ~7.5s
-(O(classes) per-wakeup dispatch, raylet.py _dispatch_class) and 200
-actors create+call in ~4.6s (zygote fork server, _private/zygote.py).
+higher. Scale probes (bench_scale.py): 10k queued tasks drain in ~3-8s
+(O(classes) per-wakeup dispatch + direct transport; was 97.8s), 200
+actors create+call in ~4.6s (zygote fork server, _private/zygote.py),
+and a 1GB cross-node broadcast moves in ~4s under pull/push flow
+control.
 """
 
 from __future__ import annotations

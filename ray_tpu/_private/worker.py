@@ -97,6 +97,9 @@ class ObjectRef:
             client = _global_client
             if client is not None:
                 client.promote_ref(self)
+                promoted = getattr(_ser_ctx, "promoted", None)
+                if promoted is not None:
+                    promoted.append(self.id.binary())
         return (_ref_from_binary, (self.id.binary(),))
 
     def __hash__(self):
@@ -481,6 +484,26 @@ class CoreClient:
             for dep in deps:
                 self._task_borrows[dep] = self._task_borrows.get(dep, 0) + 1
 
+    async def _release_ctor_borrows_when_live(self, actor_id: ActorID,
+                                              ctor_spec: dict,
+                                              timeout_s: float = 300.0):
+        """Release actor-constructor arg pins once creation has consumed
+        them (actor ALIVE or DEAD); timeout is the leak backstop."""
+        deadline = time.monotonic() + timeout_s
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    info = (await self._gcs_call(
+                        "get_actor", {"actor_id": actor_id.binary()}
+                    ))["actor"]
+                except Exception:  # noqa: BLE001 — transient GCS hiccup
+                    info = None
+                if info is not None and info["state"] in ("ALIVE", "DEAD"):
+                    break
+                await asyncio.sleep(0.25)
+        finally:
+            self._release_borrows(ctor_spec)
+
     def _release_borrows(self, spec: dict):
         deps = spec.pop("deps_borrowed", None)
         if not deps:
@@ -522,9 +545,18 @@ class CoreClient:
         return self._run(self._gcs_call("kv_keys", {"ns": ns, "prefix": prefix}))["keys"]
 
     # -- serialization helpers -------------------------------------------
-    def serialize_args(self, args, kwargs) -> Tuple[bytes, List[bytes]]:
+    def serialize_args(self, args, kwargs) -> Tuple[bytes, List[bytes], List[bytes]]:
         """Serialize (args, kwargs); top-level refs become _ArgRef markers,
         nested refs are promoted to the shared store.
+
+        Returns (payload, deps, borrow_oids): `deps` is what the raylet
+        prefetches (top-level store args only — it must stay empty for
+        plain tasks so they keep the direct-transport fast path);
+        `borrow_oids` additionally includes refs nested inside containers,
+        which the caller pins for the call's lifetime
+        (reference_count.h nested-ref tracking — without the pin, the
+        driver dropping its handle mid-flight frees the object under the
+        running task's rt.get).
 
         Mirrors the reference's plasma-promotion of serialized ObjectRefs
         and inline substitution of resolved top-level args
@@ -542,11 +574,17 @@ class CoreClient:
                 v = self._arg_for_ref(v, deps)
             processed_kwargs[k] = v
         _ser_ctx.promote = True
+        _ser_ctx.promoted = []
         try:
             payload = ser.serialize_to_bytes((processed_args, processed_kwargs))
         finally:
             _ser_ctx.promote = False
-        return payload, deps
+            promoted, _ser_ctx.promoted = _ser_ctx.promoted, []
+        borrow_oids = list(deps)
+        for oid in promoted:
+            if oid not in borrow_oids:
+                borrow_oids.append(oid)
+        return payload, deps, borrow_oids
 
     def _arg_for_ref(self, ref: ObjectRef, deps: List[bytes]):
         oid = ref.id.binary()
@@ -926,7 +964,7 @@ class CoreClient:
     ) -> List[ObjectRef]:
         cfg = get_config()
         fn_key = self.fn_manager.export(fn)
-        payload, deps = self.serialize_args(args, kwargs)
+        payload, deps, borrow_oids = self.serialize_args(args, kwargs)
         task_id = TaskID.from_random()
         resolved_env = self._resolve_runtime_env(runtime_env)
         spec = {
@@ -962,7 +1000,7 @@ class CoreClient:
             self._track_owned_ref(ref)
             refs.append(ref)
             futures.append(fut)
-        self._borrow_deps(spec, deps)
+        self._borrow_deps(spec, borrow_oids)
         with self._submit_lock:
             self._submit_buf.append((spec, futures, retries))
             need_schedule = not self._submit_scheduled
@@ -1213,8 +1251,18 @@ class CoreClient:
         runtime_env=None,
     ) -> ActorHandle:
         cls_key = self.fn_manager.export(cls)
-        payload, deps = self.serialize_args(args, kwargs)
+        payload, deps, borrow_oids = self.serialize_args(args, kwargs)
         actor_id = ActorID.from_random()
+        # Constructor args (top-level AND nested refs) stay pinned until
+        # the actor leaves PENDING/RESTARTING — creation may start long
+        # after the driver dropped its handles.
+        ctor_spec = {"task_id": actor_id.binary()}
+        self._borrow_deps(ctor_spec, borrow_oids)
+        if borrow_oids:
+            asyncio.run_coroutine_threadsafe(
+                self._release_ctor_borrows_when_live(actor_id, ctor_spec),
+                self.loop,
+            )
         resolved_env = self._resolve_runtime_env(runtime_env)
         create_spec = {
             "actor_id": actor_id.binary(),
@@ -1317,7 +1365,7 @@ class CoreClient:
         num_returns: int = 1,
         max_task_retries: int = 0,
     ) -> List[ObjectRef]:
-        payload, deps = self.serialize_args(args, kwargs)
+        payload, deps, borrow_oids = self.serialize_args(args, kwargs)
         task_id = TaskID.from_random()
         request = {
             "actor_id": actor_id.binary(),
@@ -1343,7 +1391,7 @@ class CoreClient:
             refs.append(ref)
             futures.append(fut)
         spec = {"task_id": task_id.binary()}
-        self._borrow_deps(spec, deps)
+        self._borrow_deps(spec, borrow_oids)
         # Same burst batching as plain tasks: one thread->loop crossing
         # per burst of .remote() calls, not one per call.
         with self._submit_lock:

@@ -420,3 +420,41 @@ def test_ref_in_closure_of_second_task(rt_start):
 
     out = rt.get(via_closure.remote(), timeout=60)
     assert out == 500.0
+
+
+def test_nested_ref_pinned_when_driver_drops_handle(rt_start):
+    """A ref nested INSIDE a container argument is borrow-pinned like a
+    top-level dep: the driver dropping its handle right after submit must
+    not free the object under the running task (reference_count.h nested
+    ref tracking)."""
+
+    @rt.remote
+    def read_nested(wrapped):
+        time.sleep(0.8)  # outlive the driver-side del + free debounce
+        return float(rt.get(wrapped["data"], timeout=30).sum())
+
+    inner = rt.put(np.ones(300_000))
+    out = read_nested.remote({"data": inner})
+    del inner
+    gc.collect()
+    assert rt.get(out, timeout=60) == 300_000.0
+
+
+def test_actor_ctor_nested_ref_pinned(rt_start):
+    """Constructor args with nested refs are pinned until the actor is
+    live: the driver dropping its handle right after Actor.remote() must
+    not free the arg before __init__ resolves it."""
+
+    @rt.remote
+    class Holder:
+        def __init__(self, wrapped):
+            self.total = float(rt.get(wrapped["data"], timeout=30).sum())
+
+        def total_(self):
+            return self.total
+
+    inner = rt.put(np.ones(200_000))
+    h = Holder.remote({"data": inner})
+    del inner
+    gc.collect()
+    assert rt.get(h.total_.remote(), timeout=60) == 200_000.0
