@@ -486,21 +486,41 @@ class CoreClient:
 
     async def _release_ctor_borrows_when_live(self, actor_id: ActorID,
                                               ctor_spec: dict,
-                                              timeout_s: float = 300.0):
-        """Release actor-constructor arg pins once creation has consumed
-        them (actor ALIVE or DEAD); timeout is the leak backstop."""
-        deadline = time.monotonic() + timeout_s
+                                              max_restarts: int = 0):
+        """Release actor-constructor arg pins once no creation replay can
+        read them again. Restartable actors keep their pins until DEAD:
+        the GCS replays the stored create_spec on every restart, and a
+        replayed __init__ must still be able to resolve nested refs the
+        driver has long dropped. State arrives via the actor_update push
+        channel (_on_push keeps _actor_cache fresh) — this loop only reads
+        the cache, no per-tick GCS RPCs."""
+        aid = actor_id.binary()
         try:
-            while time.monotonic() < deadline:
-                try:
-                    info = (await self._gcs_call(
-                        "get_actor", {"actor_id": actor_id.binary()}
-                    ))["actor"]
-                except Exception:  # noqa: BLE001 — transient GCS hiccup
-                    info = None
-                if info is not None and info["state"] in ("ALIVE", "DEAD"):
+            await self._gcs_call(
+                "subscribe", {"channel": "actor_update:" + actor_id.hex()}
+            )
+        except Exception:  # noqa: BLE001 — cache polls still progress below
+            pass
+        try:
+            first_rpc_done = False
+            while self._connected:
+                info = self._actor_cache.get(aid)
+                if info is None and not first_rpc_done:
+                    first_rpc_done = True
+                    try:
+                        info = (await self._gcs_call(
+                            "get_actor", {"actor_id": aid}
+                        ))["actor"]
+                        if info is not None:
+                            self._actor_cache[aid] = info
+                    except Exception:  # noqa: BLE001
+                        info = None
+                state = (info or {}).get("state")
+                if state == "DEAD":
                     break
-                await asyncio.sleep(0.25)
+                if state == "ALIVE" and max_restarts == 0:
+                    break  # no replay possible: creation consumed the args
+                await asyncio.sleep(1.0)
         finally:
             self._release_borrows(ctor_spec)
 
@@ -1080,6 +1100,19 @@ class CoreClient:
         live = [w for w in pool["workers"] if not w["conn"]._closed]
         pool["workers"] = live
         best = min(live, key=lambda w: w["outstanding"], default=None)
+        # Pipelining DEPTH (queueing a second task behind a running one on
+        # the same leased worker) is only sound for plain CPU shapes. A
+        # resource-bearing task (TPU gangs, custom resources) queued deep
+        # on a held worker would serialize on one node while the raylet
+        # could have spilled it to idle capacity elsewhere — the reference
+        # keeps leases 1:1 with running tasks for exactly this reason
+        # (direct_task_transport.cc). So: non-CPU shapes take an idle
+        # lease or fall back to the raylet's scheduler.
+        cpu_only = all(
+            k == "CPU" for k in (spec.get("resources") or {})
+        )
+        if not cpu_only and best is not None and best["outstanding"] > 0:
+            best = None
         # Grow while tasks are stacking up (up to the node's CPU-ish cap);
         # single-flight so a burst requests one lease at a time.
         cfg = get_config()
@@ -1260,7 +1293,9 @@ class CoreClient:
         self._borrow_deps(ctor_spec, borrow_oids)
         if borrow_oids:
             asyncio.run_coroutine_threadsafe(
-                self._release_ctor_borrows_when_live(actor_id, ctor_spec),
+                self._release_ctor_borrows_when_live(
+                    actor_id, ctor_spec, max_restarts
+                ),
                 self.loop,
             )
         resolved_env = self._resolve_runtime_env(runtime_env)

@@ -206,3 +206,31 @@ def test_actor_restart_after_kill(rt_cluster):
     time.sleep(1.0)
     # Restarted actor: state reset, calls start over.
     assert rt.get(p.call.remote(), timeout=30) == 1
+
+
+def test_gang_tasks_spread_not_pipelined(rt_cluster):
+    """Two concurrent node-saturating tasks ({pod:1, TPU:8}) must run on
+    TWO hosts: the direct transport may not queue a resource-bearing task
+    behind a running one on a held worker while the raylet could spill it
+    to idle capacity (lease depth is CPU-only; reference keeps leases 1:1
+    with running tasks, direct_task_transport.cc)."""
+    pod = "tpu-pod-spread"
+    for _ in range(2):
+        rt_cluster.add_node(
+            num_cpus=2, resources={"TPU": 8, pod: 1}
+        )
+    rt_cluster.connect()
+
+    @rt.remote
+    def hold_and_report():
+        import time as _t
+
+        _t.sleep(1.0)  # force overlap: the first holds its lease
+        return rt.get_runtime_context().node_id
+
+    refs = [
+        hold_and_report.options(resources={pod: 1, "TPU": 8}).remote()
+        for _ in range(2)
+    ]
+    hosts = set(rt.get(refs, timeout=120))
+    assert len(hosts) == 2, f"gang tasks serialized on one host: {hosts}"
