@@ -91,14 +91,6 @@ class AllToAllStage:
     name: str = "all_to_all"
 
 
-def _apply_block_fn(fn, block):
-    return fn(block)
-
-
-def _apply_block_fn_indexed(fn, block, index):
-    return fn(block, index)
-
-
 def _apply_fused(fns, block, index=None):
     for fn, with_index in fns:
         block = fn(block, index) if with_index else fn(block)
