@@ -61,6 +61,18 @@ STAGE_TIMEOUTS = {
 TPU_ATTEMPTS = int(os.environ.get("RT_BENCH_TPU_ATTEMPTS", 3))
 TPU_DEADLINE = float(os.environ.get("RT_BENCH_TPU_DEADLINE", 900))
 RETRY_BACKOFF = float(os.environ.get("RT_BENCH_RETRY_BACKOFF", 5))
+# Cheap tunnel probes (subprocess `jax.devices()` with a timeout) run on a
+# backoff loop for up to this long before we burn full worker attempts —
+# the tunnel is frequently dead for long stretches and a probe costs 75s
+# worst-case vs 2min+ for a full worker spawn.
+PROBE_DEADLINE = float(os.environ.get("RT_BENCH_PROBE_DEADLINE", 1200))
+PROBE_TIMEOUT = float(os.environ.get("RT_BENCH_PROBE_TIMEOUT", 75))
+LIVE_ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LIVE.json"
+)
+# A cached live artifact older than this is from a previous round — never
+# emit it as this round's number.
+LIVE_MAX_AGE = float(os.environ.get("RT_BENCH_LIVE_MAX_AGE", 14 * 3600))
 
 
 def _log(msg: str) -> None:
@@ -149,26 +161,100 @@ def _last_json_line(text: str):
     return None
 
 
+def _probe_tunnel() -> bool:
+    """Cheap subprocess probe: does `jax.devices()` answer with a TPU?"""
+    src = "import jax,sys; sys.stdout.write(jax.devices()[0].platform)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", src],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return out.returncode == 0 and "tpu" in out.stdout.lower()
+
+
 def supervise() -> int:
     t_start = time.monotonic()
     tpu_error = ""
     force_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
 
     if not force_cpu:
-        for attempt in range(1, TPU_ATTEMPTS + 1):
-            if time.monotonic() - t_start > TPU_DEADLINE:
-                tpu_error = f"TPU deadline {TPU_DEADLINE:.0f}s exhausted"
+        # Phase 1: cheap probes on a backoff loop until the tunnel answers
+        # (or the probe horizon expires). A dead tunnel hangs jax.devices()
+        # forever, so full worker attempts against it are pure waste.
+        tunnel_up = False
+        backoff = 10.0
+        n_probe = 0
+        while time.monotonic() - t_start < PROBE_DEADLINE:
+            n_probe += 1
+            _log(f"tunnel probe {n_probe}")
+            if _probe_tunnel():
+                tunnel_up = True
+                _log(f"tunnel alive after {time.monotonic() - t_start:.0f}s")
                 break
-            _log(f"TPU attempt {attempt}/{TPU_ATTEMPTS}")
-            rc, out, reason = _run_worker("tpu")
-            result = _last_json_line(out)
-            if rc == 0 and result is not None:
-                print(json.dumps(result), flush=True)
-                _log(f"done in {time.monotonic() - t_start:.0f}s")
-                return 0
-            tpu_error = reason or f"worker exited rc={rc}"
-            _log(f"TPU attempt {attempt} failed: {tpu_error}")
-            time.sleep(RETRY_BACKOFF)
+            if time.monotonic() - t_start + backoff >= PROBE_DEADLINE:
+                break
+            _log(f"tunnel dead; retrying in {backoff:.0f}s")
+            time.sleep(backoff)
+            backoff = min(backoff * 1.6, 120.0)
+        if not tunnel_up:
+            tpu_error = (
+                f"tunnel probe horizon {PROBE_DEADLINE:.0f}s exhausted "
+                f"({n_probe} probes)"
+            )
+        else:
+            # Phase 2: full supervised worker attempts.
+            deadline = time.monotonic() + TPU_DEADLINE
+            for attempt in range(1, TPU_ATTEMPTS + 1):
+                if time.monotonic() > deadline:
+                    tpu_error = f"TPU deadline {TPU_DEADLINE:.0f}s exhausted"
+                    break
+                _log(f"TPU attempt {attempt}/{TPU_ATTEMPTS}")
+                rc, out, reason = _run_worker("tpu")
+                result = _last_json_line(out)
+                if rc == 0 and result is not None:
+                    print(json.dumps(result), flush=True)
+                    _log(f"done in {time.monotonic() - t_start:.0f}s")
+                    return 0
+                tpu_error = reason or f"worker exited rc={rc}"
+                _log(f"TPU attempt {attempt} failed: {tpu_error}")
+                time.sleep(RETRY_BACKOFF)
+
+    # Phase 3: a TPU measurement captured earlier in the round by
+    # tools/tpu_live.py (the tunnel is often alive only in windows). The
+    # result is clearly labeled as cached with its capture timestamp.
+    if not force_cpu and os.path.exists(LIVE_ARTIFACT):
+        try:
+            with open(LIVE_ARTIFACT) as f:
+                live = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            live = None
+        age_ok = False
+        if live and live.get("measured_at"):
+            try:
+                import calendar
+
+                measured = calendar.timegm(
+                    time.strptime(live["measured_at"], "%Y-%m-%dT%H:%M:%SZ")
+                )
+                age_ok = 0 <= time.time() - measured <= LIVE_MAX_AGE
+            except ValueError:
+                age_ok = False
+        if live and age_ok and "tpu" in str(live.get("device", "")).lower():
+            live["cached"] = True
+            live["cache_note"] = (
+                "live tunnel dead at bench time; this is a real TPU "
+                "measurement captured earlier this round by tools/tpu_live.py "
+                f"(measured_at={live.get('measured_at', '?')})"
+            )
+            if tpu_error:
+                live["tpu_error"] = tpu_error
+            _log(f"emitting cached live-TPU artifact from {live.get('measured_at')}")
+            print(json.dumps(live), flush=True)
+            return 0
 
     _log(f"falling back to CPU worker (tpu_error={tpu_error or 'forced'})")
     rc, out, reason = _run_worker("cpu")

@@ -1,0 +1,114 @@
+"""Opportunistic TPU bench capture.
+
+The axon TPU tunnel can be dead for hours; ``jax.devices()`` then hangs
+forever.  This daemon probes the tunnel cheaply (subprocess + timeout) on
+a loop and, the moment the tunnel answers, runs the flagship bench
+(``bench.py``) and commits a timestamped ``BENCH_TPU_LIVE.json`` so a
+driver-verified TPU artifact exists even if the end-of-round bench window
+hits a dead tunnel.  (VERDICT r3 item 1b.)
+
+Run:  python tools/tpu_live.py [--once]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _probe_tunnel  # single source of truth for the probe
+
+OUT = os.path.join(REPO, "BENCH_TPU_LIVE.json")
+PROBE_INTERVAL = float(os.environ.get("RT_TPU_PROBE_INTERVAL", 180))
+SESSION_DEADLINE = float(os.environ.get("RT_TPU_SESSION_DEADLINE", 10.5 * 3600))
+BENCH_TIMEOUT = float(os.environ.get("RT_TPU_BENCH_TIMEOUT", 1800))
+
+
+def log(msg: str) -> None:
+    print(f"[tpu_live] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr, flush=True)
+
+
+def run_bench() -> dict | None:
+    """Run the flagship bench; return the parsed JSON line if it is a fresh
+    TPU measurement (bench.py's own cached-artifact fallback is rejected)."""
+    env = dict(os.environ)
+    # The probe just proved the tunnel; skip bench.py's own probe phase and
+    # go straight to full attempts.
+    env["RT_BENCH_PROBE_DEADLINE"] = "90"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO,
+        start_new_session=True,  # own process group: timeout kill sweeps the
+    )                            # jax worker grandchildren too
+    try:
+        stdout, _ = proc.communicate(timeout=BENCH_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        log("bench timed out; killing process group")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return None
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if parsed.get("cached"):
+                log("bench emitted its cached artifact, not a fresh run")
+                return None
+            if "tpu" in str(parsed.get("device", "")).lower():
+                return parsed
+            log(f"bench fell back off-TPU: device={parsed.get('device')}")
+            return None
+    log(f"bench produced no JSON (rc={proc.returncode})")
+    return None
+
+
+def commit(result: dict) -> None:
+    result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=1)
+    subprocess.run(
+        ["git", "commit", "-m", "Capture live TPU flagship bench artifact",
+         "--only", "--", "BENCH_TPU_LIVE.json"],
+        cwd=REPO,
+    )
+    log(f"captured: {result.get('value')} {result.get('unit')} "
+        f"mfu={result.get('mfu')} vs_baseline={result.get('vs_baseline')}")
+
+
+def main() -> int:
+    once = "--once" in sys.argv
+    t0 = time.monotonic()
+    n = 0
+    while time.monotonic() - t0 < SESSION_DEADLINE:
+        n += 1
+        if _probe_tunnel():
+            log(f"probe {n}: tunnel ALIVE — running flagship bench")
+            result = run_bench()
+            if result is not None:
+                commit(result)
+                return 0
+        else:
+            log(f"probe {n}: tunnel dead")
+        if once:
+            return 1
+        time.sleep(PROBE_INTERVAL)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
