@@ -7,34 +7,35 @@ Prints one JSON line per benchmark and writes BENCH_CORE.json.
 
 Run: python bench_core.py [--quick]
 
-## Throughput analysis (round 3)
+## Throughput analysis (round 4)
 
-Measured on this image's single-core host (results in BENCH_CORE.json):
-~1.8k trivial tasks/s sync, 3.5-6.5k tasks/s pipelined (async; this
-shared host's load swings runs), ~1.5k/2k actor calls/s sync/async,
-~8-9 GB/s large-object put+get (shared-memory zero-copy). Round-3
-changes that moved these numbers:
-  * Direct task transport (worker.py _submit_direct + raylet
-    h_lease_worker): the owner leases workers once per scheduling class
-    and streams task specs straight to them — the raylet is off the
-    per-task path entirely (reference: direct_task_transport.cc:197
-    OnWorkerIdle lease reuse). Pipelined task throughput went 1.4k/s ->
-    ~6k/s.
-  * Submit burst batching (worker.py _drain_submits): a burst of
-    .remote() calls crosses the thread->loop boundary once, and
-    protocol.FrameSender coalesces same-tick frames into one socket
-    write (7 syscalls/task -> ~2).
-  * Function-key identity cache (function_manager.py): no per-submit
-    cloudpickle of the function.
-The remaining gap to the reference's 10-20k/s/core is interpreter cost
-in the per-task execute path (the reference runs it in C++ CoreWorker,
-core_worker.cc:1935); on a TPU pod host with real cores the processes
-stop timesharing one core and the same code measures several-fold
-higher. Scale probes (bench_scale.py): 10k queued tasks drain in ~3-8s
-(O(classes) per-wakeup dispatch + direct transport; was 97.8s), 200
-actors create+call in ~4.6s (zygote fork server, _private/zygote.py),
-and a 1GB cross-node broadcast moves in ~4s under pull/push flow
-control.
+Measured on this image's single-core host (results in BENCH_CORE.json,
+median of 2 runs): ~1.8k trivial tasks/s sync, ~13.9k tasks/s pipelined,
+~2k/14k actor calls/s sync/async, ~22k small puts/s, actor
+register+ready+call ~170/s, ~8 GB/s large-object put+get (shared-memory
+zero-copy). Round-4 changes that moved these numbers (r3: 3.4k async
+tasks/s, 1.6k async actor calls/s, 3.6k puts/s, 42.5 actors/s):
+  * Batched direct transport (worker.py _submit_direct_group -> worker
+    h_run_tasks_batch): a burst of same-shape tasks rides one RPC frame
+    and ONE worker-side executor hop per chunk of 32, spread across the
+    lease pool by outstanding count.
+  * Actor-call batch frames (_actor_call_group -> h_actor_call_batch)
+    with contiguous seq runs executing in one executor hop.
+  * Async batched primary-copy registration: put() returns at store
+    seal; object_created notifications coalesce per loop tick into one
+    raylet frame, and the raylet registers locations with the GCS in one
+    batched frame (the reference's async plasma-notification socket
+    role).
+  * Actor-worker recycling (raylet _try_recycle_actor_worker -> worker
+    h_release_actor): a cleanly-killed idle actor's worker returns to
+    the pool; steady-state create/call/kill cycles fork nothing. Plus a
+    demand-triggered min-idle warm pool (debounced replenish) and a
+    zygote prewarm (first-use executor/event-loop machinery exercised
+    pre-fork: ~8ms off every worker boot).
+Sync (one-at-a-time) round trips stay ~2k/s: on this 1-core host each
+call pays context switches through driver/worker processes timesharing
+the core; the reference's C++ CoreWorker path measures its 10-20k/s on
+multi-core hosts where the peers run in parallel.
 """
 
 from __future__ import annotations

@@ -134,9 +134,24 @@ class Config:
     direct_lease_grow_outstanding: int = 2
     # Idle seconds before an owner returns a leased worker.
     direct_lease_idle_release_s: float = 1.0
+    # Max task specs coalesced into one direct-transport batch frame.
+    direct_submit_batch_max: int = 32
+    # Max pipelined calls to one actor coalesced into one batch frame
+    # (also bounds the receiver's per-executor-hop ordered run).
+    actor_call_batch_max: int = 64
     # Worker fork server (zygote.py). Off -> every spawn is a fresh
     # interpreter (RT_DISABLE_ZYGOTE also works per-spawn).
     zygote_enabled: bool = True
+    # Registered default-env workers kept warm once the node has seen
+    # demand; actor creations and leases adopt them instead of forking
+    # on the critical path (worker_pool.h:347 prestart role).
+    worker_pool_min_idle: int = 4
+    # Recycle a cleanly-killed idle actor's worker back into the pool
+    # (workers with running calls still die with the actor).
+    actor_worker_recycle: bool = True
+    # Delay before the pool replenisher forks, letting recycled workers
+    # return first (and keeping forks off creation critical paths).
+    worker_pool_replenish_debounce_s: float = 0.25
 
     # -- object-manager flow control -------------------------------------
     # Concurrent pull transfers per node (PullManager admission).

@@ -139,6 +139,7 @@ class GcsServer:
         r("stop_job", self.h_stop_job)
         # objects
         r("object_location_add", self.h_object_location_add)
+        r("object_locations_add", self.h_object_locations_add)
         r("object_location_get", self.h_object_location_get)
         r("object_location_wait", self.h_object_location_wait)
         r("object_location_remove", self.h_object_location_remove)
@@ -784,6 +785,11 @@ class GcsServer:
             "detached": d.get("detached", False),
             "scheduling": d.get("scheduling"),
         }
+        if d.get("subscribe"):
+            # Bundle the caller's actor_update subscription into the
+            # registration (saves the separate subscribe round trip the
+            # driver otherwise pays per actor).
+            self.subscribers["actor_update:" + actor_id.hex()].add(conn)
         ok = await self._schedule_actor(actor_id)
         if not ok:
             # Stay PENDING and retry as the cluster view changes — actor
@@ -862,6 +868,7 @@ class GcsServer:
             a["address"] = d["address"]
             a["port"] = d["port"]
             a["worker_id"] = d.get("worker_id")
+            a["methods"] = d.get("methods") or []
         await self.publish(
             "actor_update:" + d["actor_id"].hex(), self._actor_view(a)
         )
@@ -879,6 +886,7 @@ class GcsServer:
             "class_name": a["class_name"],
             "death_cause": a["death_cause"],
             "restarts_used": a["restarts_used"],
+            "methods": a.get("methods") or [],
         }
 
     async def h_get_actor(self, d, conn):
@@ -939,20 +947,41 @@ class GcsServer:
             return {"ok": False}
         if d.get("no_restart", True):
             a["max_restarts"] = 0
+        will_restart = (
+            a["max_restarts"] == -1
+            or a["restarts_used"] < a["max_restarts"]
+        )
         node = self.node_conns.get(a.get("node_id"))
         if node:
-            await node.push("kill_actor_worker", {"actor_id": actor_id})
+            # will_restart gates worker recycling: a restarted actor would
+            # be adopted onto the same worker/port and the caller's cached
+            # connection would resume stale seq counters (they reset only
+            # with the connection). Restartable kills take a fresh process.
+            await node.push(
+                "kill_actor_worker",
+                {"actor_id": actor_id, "will_restart": will_restart},
+            )
         return {"ok": True}
 
     # -- object directory ------------------------------------------------
     async def h_object_location_add(self, d, conn):
-        oid = d["object_id"]
+        self._location_add(d["object_id"], d["node_id"], d.get("size"))
+        return {"ok": True}
+
+    async def h_object_locations_add(self, d, conn):
+        """Batched location registration (one frame per raylet flush)."""
+        node_id = d["node_id"]
+        for o in d["objects"]:
+            self._location_add(o["object_id"], node_id, o.get("size"))
+        return {"ok": True}
+
+    def _location_add(self, oid: bytes, node_id: bytes, size):
         entry = self.object_dir.setdefault(oid, {"nodes": set(), "size": 0})
-        entry["nodes"].add(d["node_id"])
-        entry["size"] = d.get("size", entry["size"])
+        entry["nodes"].add(node_id)
+        if size is not None:
+            entry["size"] = size
         for ev in self.object_waiters.pop(oid, []):
             ev.set()
-        return {"ok": True}
 
     @staticmethod
     def _loc_view(entry) -> dict:

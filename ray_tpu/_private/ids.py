@@ -10,10 +10,31 @@ than in the ID bits.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 
 _ID_SIZE = 16
+
+# os.urandom costs ~25µs a call on this class of host — material on the
+# per-task submit path. Each thread slices IDs from a private pre-filled
+# entropy pool instead (one urandom syscall per 256 IDs).
+_POOL_IDS = 256
+_entropy = threading.local()
+
+# A forked child would inherit the parent's partially-consumed pool and
+# mint byte-identical IDs; drop it so the child refills from the kernel.
+os.register_at_fork(after_in_child=lambda: setattr(_entropy, "buf", None))
+
+
+def _random_id_bytes() -> bytes:
+    buf = getattr(_entropy, "buf", None)
+    off = getattr(_entropy, "off", 0)
+    if buf is None or off >= len(buf):
+        buf = _entropy.buf = os.urandom(_ID_SIZE * _POOL_IDS)
+        off = 0
+    _entropy.off = off + _ID_SIZE
+    return buf[off:off + _ID_SIZE]
 
 
 class BaseID:
@@ -27,11 +48,11 @@ class BaseID:
                 f"{type(self).__name__} requires {_ID_SIZE} bytes, got {id_bytes!r}"
             )
         self._bytes = id_bytes
-        self._hash = hash((type(self).__name__, id_bytes))
+        self._hash = None
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(_ID_SIZE))
+        return cls(_random_id_bytes())
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -51,6 +72,8 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self):
+        if self._hash is None:
+            self._hash = hash((type(self).__name__, self._bytes))
         return self._hash
 
     def __eq__(self, other):
@@ -114,8 +137,6 @@ def object_id_for_task(task_id: TaskID, return_index: int) -> ObjectID:
     Mirrors the reference's ObjectID::FromIndex (src/ray/common/id.h) so that
     lineage-based reconstruction can recompute the same IDs.
     """
-    import hashlib
-
     h = hashlib.blake2b(
         task_id.binary() + return_index.to_bytes(4, "little"), digest_size=_ID_SIZE
     )

@@ -111,6 +111,12 @@ class WorkerHandle:
         # straight to this worker (direct_task_transport.cc OnWorkerIdle).
         self.lease_resources: Optional[Dict[str, float]] = None
         self.leased_by = None  # owner ServerConnection while leased
+        # Set when the worker registers (or is forgotten): actor creation
+        # waits on this instead of a 50ms poll.
+        self.registered = asyncio.Event()
+        # Cached raylet->worker dial (the worker's own RPC port); lazily
+        # opened for request/response ops like release_actor.
+        self.dial: Optional[Connection] = None
         # Per-process stats sampled from /proc each heartbeat.
         self.cpu_percent: float = 0.0
         self.rss_bytes: int = 0
@@ -145,6 +151,10 @@ class Raylet:
         self.rpc = RpcServer(host, port)
         self.gcs: Optional[Connection] = None
         self.workers: Dict[bytes, WorkerHandle] = {}
+        # True once the node has spawned any worker: gates the warm-pool
+        # replenisher so idle nodes never fork spares.
+        self._pool_demand_seen = False
+        self._replenish_timer: Optional[asyncio.Task] = None
         # Queues keyed by scheduling class (resource shape + runtime-env
         # hash + pg bundle) — the reference queues per scheduling class
         # (cluster_task_manager.cc) so one blocked shape never forces a
@@ -229,6 +239,7 @@ class Raylet:
         r("fetch_chunk", self.h_fetch_chunk)
         r("wait_object_local", self.h_wait_object_local)
         r("object_created", self.h_object_created)
+        r("objects_created", self.h_objects_created)
         r("spill_objects", self.h_spill_objects)
         r("restore_spilled", self.h_restore_spilled)
         r("free_objects", self.h_free_objects)
@@ -365,8 +376,11 @@ class Raylet:
             for w in list(self.workers.values()):
                 if w.actor_id == aid:
                     await self._report_worker_dead(w, intended=True, reason="rt.kill")
-                    w.proc.kill()
-                    self._forget_worker(w)
+                    if payload.get("will_restart") or not (
+                        await self._try_recycle_actor_worker(w, aid)
+                    ):
+                        w.proc.kill()
+                        self._forget_worker(w)
         elif channel == "reserve_bundle":
             # Prepare phase: deduct from local availability so heartbeats
             # reflect the reservation and plain tasks cannot steal the
@@ -422,6 +436,7 @@ class Raylet:
     # -- worker pool -----------------------------------------------------
     def _spawn_worker(self, runtime_env: Optional[dict] = None) -> WorkerHandle:
         """Fork a worker process (WorkerPool::StartWorkerProcess analog)."""
+        self._pool_demand_seen = True
         worker_id = os.urandom(16)
         env = dict(os.environ)
         if runtime_env:
@@ -490,6 +505,7 @@ class Raylet:
             self.workers[d["worker_id"]] = w
         w.conn = conn
         w.port = d["port"]
+        w.registered.set()
         conn.meta["worker_id"] = d["worker_id"]
         # A successful start clears any recorded env failure for this hash.
         self._bad_runtime_envs.pop(w.runtime_env_hash, None)
@@ -508,8 +524,84 @@ class Raylet:
         self._dispatch_event.set()
         return {"ok": True}
 
+    async def _try_recycle_actor_worker(self, w: WorkerHandle, aid: bytes) -> bool:
+        """Return a cleanly-killed actor's worker to the pool instead of
+        forking its replacement from scratch. The worker refuses (and the
+        process dies, the reference semantics) when any call is still
+        running — a thread mid-call cannot be stopped. Workers are already
+        reused across tasks of a job; a torn-down actor has the same
+        contamination surface."""
+        if not get_config().actor_worker_recycle or w.port is None:
+            return False
+        try:
+            # w.conn is the worker->raylet push channel (ServerConnection,
+            # no request/response); dial the worker's own RPC port (cached
+            # across recycles).
+            if w.dial is None or w.dial._closed:
+                w.dial = await connect("127.0.0.1", w.port, timeout=2.0)
+            r = await asyncio.wait_for(
+                w.dial.call("release_actor", {"actor_id": aid}), 2.0
+            )
+        except Exception:  # noqa: BLE001 — worker wedged; kill it
+            return False
+        if not r.get("recycled"):
+            return False
+        # Return the actor's held resources (the _forget_worker accounting,
+        # without forgetting the worker).
+        bundle_key = getattr(w, "actor_bundle", None)
+        bundle = self.bundles.get(bundle_key) if bundle_key else None
+        if bundle is not None:
+            for k, v in w.actor_resources.items():
+                bundle["available"][k] = bundle["available"].get(k, 0) + v
+        else:
+            for k, v in w.actor_resources.items():
+                self.resources_available[k] = (
+                    self.resources_available.get(k, 0) + v
+                )
+        w.actor_resources = {}
+        w.actor_id = None
+        w.actor_bundle = None
+        w.idle = True
+        w.last_idle_time = time.monotonic()
+        self._dispatch_event.set()
+        return True
+
+    def _replenish_idle_pool(self):
+        """Keep a few registered default-env workers warm so actor creation
+        and lease grants skip the fork+boot on their critical path (the
+        reference's worker-pool prestart role, worker_pool.h:347 — here
+        demand-triggered: nothing forks until the node first spawns).
+
+        Debounced: the fork happens a beat later, off the creation/kill
+        critical path, and not at all if a recycled worker returns to the
+        pool in the meantime."""
+        if not get_config().worker_pool_min_idle or not self._pool_demand_seen:
+            return
+        if self._replenish_timer is None or self._replenish_timer.done():
+            self._replenish_timer = spawn(self._replenish_after_debounce())
+
+    async def _replenish_after_debounce(self):
+        await asyncio.sleep(get_config().worker_pool_replenish_debounce_s)
+        cfg = get_config()
+        n_pooled = sum(
+            1 for w in self.workers.values()
+            if w.actor_id is None and w.runtime_env_hash is None
+            and w.lease_resources is None and (w.idle or w.conn is None)
+        )
+        n_spawn = min(
+            cfg.worker_pool_min_idle - n_pooled,
+            cfg.max_workers_per_node - len(self.workers),
+        )
+        for _ in range(max(0, n_spawn)):
+            self._spawn_worker(None)
+
     def _forget_worker(self, w: WorkerHandle):
         self.workers.pop(w.worker_id, None)
+        w.registered.set()  # wake creation waiters; they re-check liveness
+        if w.actor_id is not None:
+            # An actor worker died: top the pool back up so the next
+            # creation burst adopts instead of forking.
+            self._replenish_idle_pool()
         # Return a direct-transport lease's held resources.
         if w.lease_resources is not None:
             for k, v in w.lease_resources.items():
@@ -752,18 +844,32 @@ class Raylet:
                 bundle["available"][k] = bundle["available"].get(k, 0) - v
         else:
             self._acquire(resources)
-        w = self._spawn_worker(payload["create_spec"].get("runtime_env"))
-        w.idle = False
+        renv = payload["create_spec"].get("runtime_env")
+        # A registered idle pool worker with the right env adopts the actor
+        # — the whole fork+boot disappears from the creation critical path
+        # (the reference pops actors from the shared worker pool the same
+        # way, worker_pool.cc PopWorker). A background replacement fork
+        # keeps the pool warm for the next creation burst.
+        w = self._idle_worker(renv.get("hash") if renv else None)
+        if w is not None:
+            w.idle = False
+        else:
+            w = self._spawn_worker(renv)
+            w.idle = False
+        self._replenish_idle_pool()
         w.actor_id = payload["actor_id"]
         w.actor_resources = dict(resources)
         w.actor_bundle = (sched["pg_id"], sched.get("bundle_index") or 0) if bundle is not None else None
         # Wait for registration, then push the creation task. The budget
         # covers runtime-env download/extraction in the starting worker.
-        deadline = time.monotonic() + get_config().worker_register_timeout_s
-        while time.monotonic() < deadline:
-            if w.conn is not None or w.worker_id not in self.workers:
-                break
-            await asyncio.sleep(0.05)
+        if w.conn is None and w.worker_id in self.workers:
+            try:
+                await asyncio.wait_for(
+                    w.registered.wait(),
+                    get_config().worker_register_timeout_s,
+                )
+            except asyncio.TimeoutError:
+                pass
         if w.conn is None:
             await self.gcs.call(
                 "worker_dead",
@@ -1832,22 +1938,44 @@ class Raylet:
             )
         return self._storage
 
-    async def h_object_created(self, d, conn):
-        """A local client sealed a primary copy: pin it (so LRU eviction
-        cannot drop the only copy) and register its location."""
-        oid = d["object_id"]
+    def _pin_created(self, oid: bytes, size: int) -> bool:
+        """Pin a freshly sealed primary copy so LRU eviction cannot drop
+        the only copy."""
         if oid not in self._primary_pins:
             view = self.store.get(ObjectID(oid))
             if view is None:
-                return {"ok": False, "error": "object not found at pin time"}
+                return False
             del view  # the store-side refcount holds the pin, not the view
-            self._primary_pins[oid] = d.get("size", 0)
+            self._primary_pins[oid] = size
         self._spilled.pop(oid, None)
+        return True
+
+    async def h_object_created(self, d, conn):
+        """A local client sealed a primary copy: pin + register location."""
+        oid = d["object_id"]
+        if not self._pin_created(oid, d.get("size", 0)):
+            return {"ok": False, "error": "object not found at pin time"}
         await self.gcs.call(
             "object_location_add",
             {"object_id": oid, "node_id": self.node_id.binary(),
              "size": d.get("size", 0)},
         )
+        return {"ok": True}
+
+    async def h_objects_created(self, d, conn):
+        """Batched seal notifications from one client flush: pin each and
+        register every location with the GCS in a single frame."""
+        registered = []
+        for o in d["objects"]:
+            if self._pin_created(o["object_id"], o.get("size", 0)):
+                registered.append(
+                    {"object_id": o["object_id"], "size": o.get("size", 0)}
+                )
+        if registered:
+            await self.gcs.call(
+                "object_locations_add",
+                {"node_id": self.node_id.binary(), "objects": registered},
+            )
         return {"ok": True}
 
     def _utilization(self) -> float:

@@ -48,6 +48,7 @@ from ray_tpu.exceptions import (
 
 # Thread-local flag: serializing task args => promote refs to the shared store.
 _ser_ctx = threading.local()
+_EMPTY_ARGS_PAYLOAD: Optional[bytes] = None
 
 
 class _InStoreSentinel:
@@ -290,11 +291,21 @@ class CoreClient:
         # borrows it as an argument — the owner frees the cluster copies.
         # Borrowers (processes that deserialized the ref) never free.
         self._owned_store_oids: set = set()
+        # Owned oids serialized out through task results: a borrower holds
+        # them now, so local ref death must not free the store copy.
+        self._escaped_oids: set = set()
         self._task_borrows: Dict[bytes, int] = {}
         self._free_dropped: set = set()   # dropped refs awaiting borrow==0
         self._free_queue: List[bytes] = []
         self._free_lock = threading.Lock()
         self._free_flusher = None
+        # Batched async primary-copy registration: put() returns after the
+        # store write; object_created notifications coalesce into one
+        # raylet RPC per loop tick (the reference's plasma-notification
+        # socket is asynchronous the same way).
+        self._obj_created_buf: list = []
+        self._obj_created_lock = threading.Lock()
+        self._obj_created_scheduled = False
         # GCS-restart survival (client half): see _gcs_call.
         self._subscribed_channels: set = set()
         self._gcs_redial_lock = None
@@ -305,9 +316,11 @@ class CoreClient:
         fut.result(timeout=get_config().rpc_connect_timeout_s * 3)
         self._connected = True
 
-    async def _connect(self):
+    async def _connect(self, raylet_conn: Optional[Connection] = None):
         self.gcs = await connect(*self.gcs_addr, push_handler=self._on_push)
-        self.raylet = await connect(*self.raylet_addr)
+        # Workers already hold a raylet connection (push channel); reuse it
+        # rather than paying a second TCP connect on the boot path.
+        self.raylet = raylet_conn or await connect(*self.raylet_addr)
 
     async def _gcs_call(self, method, payload=None, timeout=None):
         """GCS call that survives a GCS restart: on a dead connection,
@@ -458,7 +471,10 @@ class CoreClient:
                 oids, self._free_queue = self._free_queue, []
             if not oids:
                 return
-            to_free = [o for o in oids if o in self._owned_store_oids]
+            to_free = [
+                o for o in oids
+                if o in self._owned_store_oids and o not in self._escaped_oids
+            ]
             for o in oids:
                 self._owned_store_oids.discard(o)
                 self.lineage.pop(o, None)
@@ -582,6 +598,13 @@ class CoreClient:
         and inline substitution of resolved top-level args
         (transport/dependency_resolver.cc).
         """
+        if not args and not kwargs:
+            # The common trivial-call shape: one cached payload, no
+            # cloudpickle work on the per-submit path.
+            global _EMPTY_ARGS_PAYLOAD
+            if _EMPTY_ARGS_PAYLOAD is None:
+                _EMPTY_ARGS_PAYLOAD = ser.serialize_to_bytes(([], {}))
+            return _EMPTY_ARGS_PAYLOAD, [], []
         deps: List[bytes] = []
         processed_args = []
         for a in args:
@@ -617,6 +640,28 @@ class CoreClient:
                 return _InlineArg(value)
         deps.append(oid)
         return _StoreArg(oid)
+
+    def serialize_result(self, value):
+        """Serialize a task/actor return value. ObjectRefs inside escape to
+        a borrower: promote them to the shared store and exempt them from
+        this owner's local-ref-drop free — the recipient holds a handle the
+        owner can no longer see (reference_count.h borrower rule; without
+        this, the owner's GC frees the copy under the borrower).
+
+        Escaped objects are never auto-freed by this owner (the full
+        borrower-count protocol the reference runs is future work); they
+        stay spillable, so sustained pressure degrades them to disk rather
+        than occupying shm, and they are reclaimed with the job."""
+        _ser_ctx.promote = True
+        _ser_ctx.promoted = []
+        try:
+            so = ser.serialize(value)
+        finally:
+            _ser_ctx.promote = False
+            promoted, _ser_ctx.promoted = _ser_ctx.promoted, []
+        for oid in promoted:
+            self._escaped_oids.add(oid)
+        return so
 
     def deserialize_args(self, payload: bytes):
         args, kwargs = ser.deserialize_from_bytes(payload)
@@ -678,13 +723,63 @@ class CoreClient:
                     # finish (and become spillable) shortly.
                     time.sleep(0.25)
         if wrote:
-            self._run(
-                self.raylet.call(
-                    "object_created",
-                    {"object_id": oid.binary(), "size": so.total_size},
-                )
-            )
+            self._queue_object_created(oid.binary(), so.total_size)
         return wrote
+
+    def _queue_object_created(self, oid: bytes, size: int):
+        """Register + pin the sealed primary copy with the raylet — batched
+        and asynchronous (any thread). The raylet pins and records the
+        location in the GCS directory; readers that race the registration
+        fall back to the directory's probe/wait path.
+
+        Until the raylet's pin lands, the client holds its own store view:
+        the store-side refcount keeps LRU eviction off the sole copy
+        through the registration window (the old synchronous registration
+        guaranteed this by blocking put())."""
+        pinned = False
+        if self.store is not None:
+            try:
+                pinned = self.store.get(ObjectID(oid)) is not None
+            except Exception:  # noqa: BLE001 — registration still proceeds
+                pinned = False
+        with self._obj_created_lock:
+            self._obj_created_buf.append(
+                ({"object_id": oid, "size": size}, pinned)
+            )
+            need = not self._obj_created_scheduled
+            if need:
+                self._obj_created_scheduled = True
+        if need:
+            try:
+                self.loop.call_soon_threadsafe(self._flush_object_created)
+            except RuntimeError:
+                pass  # loop shutting down; node reclaims the store
+
+    def _flush_object_created(self):
+        with self._obj_created_lock:
+            buf, self._obj_created_buf = self._obj_created_buf, []
+            self._obj_created_scheduled = False
+        if buf and self._connected:
+            spawn(self._send_objects_created(buf))
+
+    async def _send_objects_created(self, buf):
+        try:
+            await self.raylet.call(
+                "objects_created", {"objects": [e for e, _ in buf]},
+                timeout=60,
+            )
+        except Exception:  # noqa: BLE001 — directory probes re-resolve
+            pass
+        finally:
+            # Drop the client-side pins now that the raylet holds its own
+            # (store.get refcounts require an explicit paired release).
+            if self.store is not None:
+                for e, pinned in buf:
+                    if pinned:
+                        try:
+                            self.store.release(ObjectID(e["object_id"]))
+                        except Exception:  # noqa: BLE001
+                            pass
 
     def _put_to_store(self, oid: ObjectID, value) -> int:
         so = ser.serialize(value)
@@ -1031,20 +1126,36 @@ class CoreClient:
         return refs
 
     def _drain_submits(self):
-        """Runs on the loop: route a burst of queued submissions."""
+        """Runs on the loop: route a burst of queued submissions.
+
+        Direct-eligible tasks sharing a lease key and pipelined calls to
+        the same actor are grouped into batch frames — one RPC (and one
+        worker-side executor hop) covers the whole run instead of one per
+        task, which is where the per-op interpreter cost lives on the
+        10k-tasks/s path."""
         with self._submit_lock:
             buf, self._submit_buf = self._submit_buf, []
             self._submit_scheduled = False
+        direct_groups: Dict[tuple, list] = {}
+        actor_groups: Dict[bytes, list] = {}
         for item in buf:
             if item[0] == "actor":
                 _, actor_id, request, spec, futures, retries = item
-                spawn(self._actor_call_with_retries(
-                    actor_id, request, spec, futures, retries
-                ))
+                actor_groups.setdefault(actor_id.binary(), []).append(
+                    (actor_id, request, spec, futures, retries)
+                )
             elif self._direct_eligible(item[0]):
-                spawn(self._submit_direct(*item))
+                key = self._lease_key(item[0])
+                direct_groups.setdefault(key, []).append(item)
             else:
                 spawn(self._submit_with_retries(*item))
+        for group in direct_groups.values():
+            spawn(self._submit_direct_group(group))
+        for calls in actor_groups.values():
+            if len(calls) == 1:
+                spawn(self._actor_call_with_retries(*calls[0]))
+            else:
+                spawn(self._actor_call_group(calls))
 
     @staticmethod
     def _direct_eligible(spec) -> bool:
@@ -1058,42 +1169,86 @@ class CoreClient:
         )
 
     async def _submit_direct(self, spec, futures, retries):
-        entry = None
-        try:
-            entry = await self._lease_for(spec)
-        except Exception:  # noqa: BLE001 — lease machinery must never lose a task
+        return await self._submit_direct_group([(spec, futures, retries)])
+
+    async def _submit_direct_group(self, items):
+        """Submit a burst of same-lease-key tasks as batch frames.
+
+        Chunks spread across the lease pool (least-outstanding first, the
+        pool growing while chunks stack up) so a big burst still fans out
+        over every leased worker; each chunk costs one RPC and one
+        worker-side executor hop regardless of size."""
+        # Only plain CPU shapes may share a lease (pipelining depth — see
+        # _lease_for): a batch of resource-bearing tasks on one worker
+        # would serialize a gang the raylet should spread across hosts.
+        cpu_only = all(
+            k == "CPU" for k in (items[0][0].get("resources") or {})
+        )
+        batch_max = get_config().direct_submit_batch_max if cpu_only else 1
+        i = 0
+        while i < len(items):
+            chunk = items[i:i + batch_max]
+            i += batch_max
             entry = None
-        if entry is None:
-            return await self._submit_with_retries(spec, futures, retries)
-        entry["outstanding"] += 1
-        entry["last_used"] = time.monotonic()
+            try:
+                entry = await self._lease_for(chunk[0][0])
+            except Exception:  # noqa: BLE001 — lease loss must never lose a task
+                entry = None
+            if entry is None:
+                for spec, futures, retries in chunk:
+                    spawn(self._submit_with_retries(spec, futures, retries))
+                continue
+            # Count the chunk against the worker NOW (not inside the spawned
+            # sender): _lease_for often returns without yielding, so the next
+            # loop iteration must already see this load or every chunk in the
+            # burst lands on the same worker.
+            entry["outstanding"] += len(chunk)
+            entry["last_used"] = time.monotonic()
+            spawn(self._send_direct_batch(entry, chunk))
+
+    async def _send_direct_batch(self, entry, chunk):
         try:
-            result = await entry["conn"].call("run_task_direct", spec,
-                                              timeout=None)
+            if len(chunk) == 1:
+                results = [await entry["conn"].call(
+                    "run_task_direct", chunk[0][0], timeout=None)]
+            else:
+                resp = await entry["conn"].call(
+                    "run_tasks_batch",
+                    {"specs": [c[0] for c in chunk]},
+                    timeout=None,
+                )
+                results = resp["results"]
         except (ConnectionLost, RpcError):
-            # Leased worker died mid-task. The task may have executed
+            # Leased worker died mid-batch. Any task may have executed
             # before the reply was lost, so max_retries=0 (at-most-once)
             # must NOT re-run it — same contract as the classic path.
-            if retries == 0:
-                self._complete_task(
-                    spec,
-                    {"status": "worker_crashed",
-                     "error": "leased worker connection lost"},
-                    futures,
-                )
-                return
-            remaining = retries if retries < 0 else retries - 1
-            return await self._submit_with_retries(spec, futures, remaining)
+            for spec, futures, retries in chunk:
+                if retries == 0:
+                    self._complete_task(
+                        spec,
+                        {"status": "worker_crashed",
+                         "error": "leased worker connection lost"},
+                        futures,
+                    )
+                else:
+                    remaining = retries if retries < 0 else retries - 1
+                    spawn(self._submit_with_retries(spec, futures, remaining))
+            return
         finally:
-            entry["outstanding"] -= 1
+            entry["outstanding"] -= len(chunk)
             entry["last_used"] = time.monotonic()
-        self._complete_task(spec, result, futures)
+        for (spec, futures, _), result in zip(chunk, results):
+            self._complete_task(spec, result, futures)
 
-    async def _lease_for(self, spec):
-        key = (
+    @staticmethod
+    def _lease_key(spec) -> tuple:
+        return (
             spec.get("runtime_env_hash"),
             tuple(sorted((spec.get("resources") or {}).items())),
         )
+
+    async def _lease_for(self, spec):
+        key = self._lease_key(spec)
         pool = self._leases.setdefault(
             key, {"workers": [], "acquiring": False}
         )
@@ -1321,14 +1476,13 @@ class CoreClient:
                     "create_spec": create_spec,
                     "detached": detached,
                     "scheduling": scheduling,
+                    "subscribe": True,  # bundle the actor_update sub
                 },
             )
         )
         if not resp.get("ok"):
             raise ValueError(resp.get("error", "actor registration failed"))
-        self._run(
-            self._gcs_call("subscribe", {"channel": "actor_update:" + actor_id.hex()})
-        )
+        self._subscribed_channels.add("actor_update:" + actor_id.hex())
         method_names = [
             m
             for m in dir(cls)
@@ -1440,6 +1594,94 @@ class CoreClient:
             self.loop.call_soon_threadsafe(self._drain_submits)
         return refs
 
+    async def _actor_conn_for_call(self, actor_id) -> Connection:
+        """Resolve the connection to an actor's worker. Cached-ALIVE is the
+        hot path and stays on the loop; only the blocking wait-for-ALIVE
+        resolution hops to a thread."""
+        info = self._actor_cache.get(actor_id.binary())
+        if info is None or info["state"] != "ALIVE":
+            info = await asyncio.get_event_loop().run_in_executor(
+                None, self._actor_info, actor_id
+            )
+        key = (info["address"], info["port"])
+        conn = self._actor_conns.get(key)
+        if conn is None or conn._closed:
+            conn = await connect(info["address"], info["port"])
+            self._actor_conns[key] = conn
+        return conn
+
+    @staticmethod
+    def _conn_actor_seqs(conn, actor_id_b: bytes):
+        # Counters live on the Connection object itself: their lifetime is
+        # exactly the connection's, so a restarted actor (new connection)
+        # always restarts seq at 0 and a recycled id() can never resurrect
+        # a stale counter.
+        seqs = getattr(conn, "_rt_actor_seq", None)
+        if seqs is None:
+            seqs = conn._rt_actor_seq = {}
+        return seqs.setdefault(actor_id_b, itertools.count())
+
+    async def _actor_call_group(self, calls):
+        """Send a burst of pipelined calls to one actor as batch frames:
+        seqs are assigned contiguously under the actor lock, the receiver
+        executes the run in order with one executor hop per batch."""
+        batch_max = get_config().actor_call_batch_max
+        actor_id = calls[0][0]
+        lock = self._actor_locks.setdefault(actor_id.binary(), asyncio.Lock())
+        i = 0
+        while i < len(calls):
+            chunk = calls[i:i + batch_max]
+            i += batch_max
+            try:
+                async with lock:
+                    conn = await self._actor_conn_for_call(actor_id)
+                    counter = self._conn_actor_seqs(conn, actor_id.binary())
+                    for _, request, _, _, _ in chunk:
+                        request["seq"] = next(counter)
+                    call_task = asyncio.ensure_future(conn.call(
+                        "actor_call_batch",
+                        {"calls": [c[1] for c in chunk]},
+                        timeout=None,
+                    ))
+                resp = await call_task
+            except (ConnectionLost, OSError):
+                # Actor may be restarting: fall back to the per-call retry
+                # machinery, which re-resolves the actor and burns one
+                # attempt for the loss we just observed. retries==0 calls
+                # may already have executed — at-most-once forbids a resend.
+                self._actor_cache.pop(actor_id.binary(), None)
+                err = ActorUnavailableError(
+                    f"actor {actor_id.hex()} connection lost"
+                )
+                for aid, request, spec, futures, retries in chunk:
+                    if retries == 0:
+                        self._release_borrows(spec)
+                        for f in futures:
+                            if not f.done():
+                                f.set_exception(err)
+                        continue
+                    request.pop("seq", None)
+                    spawn(self._actor_call_with_retries(
+                        aid, request, spec, futures,
+                        retries - 1 if retries > 0 else retries))
+                continue
+            except (ActorDiedError, ActorUnavailableError) as e:
+                for _, _, spec, futures, _ in chunk:
+                    self._release_borrows(spec)
+                    for f in futures:
+                        if not f.done():
+                            f.set_exception(e)
+                continue
+            except BaseException as e:  # noqa: BLE001
+                for _, _, spec, futures, _ in chunk:
+                    self._release_borrows(spec)
+                    for f in futures:
+                        if not f.done():
+                            f.set_exception(e)
+                continue
+            for (_, _, spec, futures, _), result in zip(chunk, resp["results"]):
+                self._complete_task(spec, result, futures)
+
     async def _actor_call_with_retries(self, actor_id, request, spec, futures, retries):
         """Send an ordered actor call, retrying across restarts.
 
@@ -1453,22 +1695,8 @@ class CoreClient:
         while True:
             try:
                 async with lock:
-                    info = await asyncio.get_event_loop().run_in_executor(
-                        None, self._actor_info, actor_id
-                    )
-                    key = (info["address"], info["port"])
-                    conn = self._actor_conns.get(key)
-                    if conn is None or conn._closed:
-                        conn = await connect(info["address"], info["port"])
-                        self._actor_conns[key] = conn
-                    # Counters live on the Connection object itself: their
-                    # lifetime is exactly the connection's, so a restarted
-                    # actor (new connection) always restarts seq at 0 and a
-                    # recycled id() can never resurrect a stale counter.
-                    seqs = getattr(conn, "_rt_actor_seq", None)
-                    if seqs is None:
-                        seqs = conn._rt_actor_seq = {}
-                    counter = seqs.setdefault(actor_id.binary(), itertools.count())
+                    conn = await self._actor_conn_for_call(actor_id)
+                    counter = self._conn_actor_seqs(conn, actor_id.binary())
                     request["seq"] = next(counter)
                     # Start the call inside the lock so the write order on
                     # the connection matches seq order; await outside.
@@ -1522,10 +1750,9 @@ class CoreClient:
         aid = ActorID(info["actor_id"])
         self._actor_cache[aid.binary()] = info
         self._run(self._gcs_call("subscribe", {"channel": "actor_update:" + aid.hex()}))
-        # Method names are discovered lazily server-side; fetch from KV.
-        meta = self.kv_get(b"actor_methods:" + aid.binary(), ns="actor")
-        methods = cloudpickle.loads(meta) if meta else []
-        return ActorHandle(aid, info["class_name"], methods)
+        # Method names ride the GCS actor record (reported by the hosting
+        # worker at actor_ready).
+        return ActorHandle(aid, info["class_name"], info.get("methods") or [])
 
     # -- cluster introspection --------------------------------------------
     def nodes(self) -> List[dict]:

@@ -26,6 +26,7 @@ from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import JobID, ObjectID, TaskID, object_id_for_task
 from ray_tpu._private.protocol import RpcServer, connect, spawn
 from ray_tpu._private.worker import CoreClient, make_task_error
+from ray_tpu.exceptions import ActorDiedError
 
 _TPU_ATTACHED = False
 _TPU_ATTACH_LOCK = threading.Lock()
@@ -119,15 +120,20 @@ class ActorState:
 
 class WorkerProcess:
     def __init__(self):
+        self._boot_stamp("init0")
         self.worker_id = bytes.fromhex(os.environ["RT_WORKER_ID"])
         self.node_id = bytes.fromhex(os.environ["RT_NODE_ID"])
         gcs_host, gcs_port = os.environ["RT_GCS_ADDR"].rsplit(":", 1)
         self.gcs_addr = (gcs_host, int(gcs_port))
         self.raylet_port = int(os.environ["RT_RAYLET_PORT"])
         self.store_name = os.environ["RT_STORE_NAME"]
+        self._boot_stamp("init_env")
         self.rpc = RpcServer("127.0.0.1", 0)
         self.rpc.register("actor_call", self.h_actor_call)
+        self.rpc.register("actor_call_batch", self.h_actor_call_batch)
+        self.rpc.register("release_actor", self.h_release_actor)
         self.rpc.register("run_task_direct", self.h_run_task_direct)
+        self.rpc.register("run_tasks_batch", self.h_run_tasks_batch)
         self.rpc.register("dag_start", self.h_dag_start)
         self.rpc.register("dag_stop", self.h_dag_stop)
         self.rpc.register("ping", self.h_ping)
@@ -136,14 +142,22 @@ class WorkerProcess:
         self.client: Optional[CoreClient] = None
         self.raylet_conn = None
         self.actor: Optional[ActorState] = None
+        self._boot_stamp("init_rpc")
+        _n_exec = max(4, get_config().max_workers_per_node)
+        self._boot_stamp("init_config")
         self.executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(4, get_config().max_workers_per_node)
+            max_workers=_n_exec
         )
+        self._boot_stamp("init_executor")
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._direct_lock = asyncio.Lock()  # one leased task runs at a time
         # Actor-call state events (normal-task events are recorded by the
         # raylet; actor calls bypass it, so the receiving worker reports).
         self._task_events: list = []
+        # In-flight actor calls (running or queued): a kill can only
+        # recycle this worker back into the pool when zero — a thread
+        # mid-call cannot be stopped, only the process can.
+        self._active_actor_calls = 0
 
     async def h_dump_stacks(self, d, conn):
         """Live thread stacks of this worker (the on-demand profiling
@@ -168,12 +182,22 @@ class WorkerProcess:
             "threads": threads,
         }
 
+    def _boot_stamp(self, stage: str):
+        log_path = os.environ.get("RT_WORKER_BOOT_LOG")
+        if log_path:
+            import time
+
+            with open(log_path, "a") as f:
+                f.write(f"{os.getpid()} {stage} {time.time()}\n")
+
     async def run(self):
         self.loop = asyncio.get_event_loop()
         port = await self.rpc.start()
+        self._boot_stamp("rpc_up")
         self.raylet_conn = await connect(
             "127.0.0.1", self.raylet_port, push_handler=self._on_raylet_push
         )
+        self._boot_stamp("raylet_conn")
         self.client = CoreClient(
             self.loop,
             self.gcs_addr,
@@ -183,8 +207,10 @@ class WorkerProcess:
             JobID.nil(),
             mode="worker",
         )
-        await self.client._connect()
+        self._boot_stamp("client_ctor")
+        await self.client._connect(raylet_conn=self.raylet_conn)
         self.client._connected = True
+        self._boot_stamp("client_up")
         worker_mod.set_client(self.client, "worker")
         # Materialize the runtime env (working_dir/py_modules download from
         # the GCS KV) before any task runs. Blocking KV reads must not run
@@ -218,6 +244,7 @@ class WorkerProcess:
             "register_worker", {"worker_id": self.worker_id, "port": port}
         )
         assert resp["node_id"] == self.node_id
+        self._boot_stamp("registered")
         spawn(self._flush_events_loop())
         await asyncio.Event().wait()
 
@@ -276,6 +303,19 @@ class WorkerProcess:
                 self.executor, self._execute_task, d
             )
 
+    async def h_run_tasks_batch(self, d, conn):
+        """Batched direct transport: a burst of leased tasks executes in
+        ONE executor hop, serially (the lease holds resources for one task
+        shape — same contract as run_task_direct)."""
+        specs = d["specs"]
+
+        def run_all():
+            return [self._execute_task(s) for s in specs]
+
+        async with self._direct_lock:
+            results = await self.loop.run_in_executor(self.executor, run_all)
+        return {"results": results}
+
     def _execute_task(self, spec) -> dict:
         from ray_tpu.util import tracing
 
@@ -323,7 +363,7 @@ class WorkerProcess:
         task_id = TaskID(spec["task_id"])
         for i, v in enumerate(values):
             so = (_RawObject(ser.serialize_xlang(v)) if xlang
-                  else ser.serialize(v))
+                  else self.client.serialize_result(v))
             if so.total_size <= cfg.max_inline_object_size:
                 returns.append({"kind": "inline", "data": so.to_bytes()})
             else:
@@ -345,7 +385,9 @@ class WorkerProcess:
             return cls(*args, **kwargs)
 
         try:
+            self._boot_stamp("create_recv")
             instance = await self.loop.run_in_executor(self.executor, do_create)
+            self._boot_stamp("instantiated")
             self.actor = ActorState(
                 payload["actor_id"], instance, payload.get("max_concurrency", 1)
             )
@@ -354,17 +396,9 @@ class WorkerProcess:
                 for m in dir(instance)
                 if callable(getattr(instance, m, None)) and not m.startswith("__")
             ]
-            import cloudpickle
-
-            await self.client._gcs_call(
-                "kv_put",
-                {
-                    "ns": "actor",
-                    "key": b"actor_methods:" + payload["actor_id"],
-                    "value": cloudpickle.dumps(methods),
-                    "overwrite": True,
-                },
-            )
+            # Method names ride the actor_ready report and live in the GCS
+            # actor record (one RPC, not a separate per-actor KV write) —
+            # get_actor() callers read them from the actor view.
             await self.client._gcs_call(
                 "actor_ready",
                 {
@@ -372,6 +406,7 @@ class WorkerProcess:
                     "address": "127.0.0.1",
                     "port": self.rpc.port,
                     "worker_id": self.worker_id,
+                    "methods": methods,
                 },
             )
         except BaseException as e:  # noqa: BLE001
@@ -388,35 +423,149 @@ class WorkerProcess:
         actor = self.actor
         if actor is None or actor.actor_id != d["actor_id"]:
             return make_task_error(
-                RuntimeError("actor not hosted by this worker")
+                ActorDiedError("actor not hosted by this worker")
             )
-        if d.get("xlang"):
-            # Cross-language caller (C++ client): plain msgpack args, RTX1
-            # result, no per-caller sequence protocol — foreign clients
-            # are synchronous request/response. The concurrency bound
-            # still applies (the semaphore is sized max(1, max_concurrency),
-            # so serial actors stay serial for foreign callers too).
-            async with actor.sema:
-                return await self._invoke_actor_method(actor, d)
-        if actor.max_concurrency > 1:
-            async with actor.sema:
-                return await self._invoke_actor_method(actor, d)
-        # Ordered path: execute strictly by per-caller sequence number.
+        self._active_actor_calls += 1
+        try:
+            if d.get("xlang"):
+                # Cross-language caller (C++ client): plain msgpack args,
+                # RTX1 result, no per-caller sequence protocol — foreign
+                # clients are synchronous request/response. The concurrency
+                # bound still applies (the semaphore is sized
+                # max(1, max_concurrency), so serial actors stay serial for
+                # foreign callers too).
+                async with actor.sema:
+                    return await self._invoke_actor_method(actor, d)
+            if actor.max_concurrency > 1:
+                async with actor.sema:
+                    return await self._invoke_actor_method(actor, d)
+            # Ordered path: execute strictly by per-caller sequence number.
+            fut = self._enqueue_ordered(actor, d)
+            await self._drain_ordered(actor, d.get("caller", b""))
+            return await fut
+        finally:
+            self._active_actor_calls -= 1
+
+    async def h_actor_call_batch(self, d, conn):
+        """A contiguous run of ordered calls from one caller: enqueue all
+        BEFORE draining so the whole run executes in one executor hop."""
+        actor = self.actor
+        calls = d["calls"]
+        if actor is None or any(actor.actor_id != c["actor_id"] for c in calls):
+            err = make_task_error(
+                ActorDiedError("actor not hosted by this worker")
+            )
+            return {"results": [err for _ in calls]}
+        self._active_actor_calls += len(calls)
+        try:
+            if actor.max_concurrency > 1:
+                async def one(c):
+                    async with actor.sema:
+                        return await self._invoke_actor_method(actor, c)
+
+                return {"results": await asyncio.gather(*[one(c) for c in calls])}
+            futs = [self._enqueue_ordered(actor, c) for c in calls]
+            await self._drain_ordered(actor, calls[0].get("caller", b""))
+            return {"results": await asyncio.gather(*futs)}
+        finally:
+            self._active_actor_calls -= len(calls)
+
+    async def h_release_actor(self, d, conn):
+        """Tear down the hosted actor so this worker returns to the pool
+        (clean rt.kill only). Refuses — forcing a process kill — when any
+        call is running or queued: a thread mid-call cannot be stopped."""
+        actor = self.actor
+        if actor is None or actor.actor_id != d["actor_id"]:
+            return {"recycled": True}
+        if self._active_actor_calls > 0 or self._dag_loops:
+            return {"recycled": False}
+        self.actor = None
+        instance = actor.instance
+        actor.instance = None
+
+        def cleanup():
+            nonlocal instance
+            try:
+                del instance
+            finally:
+                import gc
+
+                gc.collect()
+
+        await self.loop.run_in_executor(self.executor, cleanup)
+        return {"recycled": True}
+
+    def _enqueue_ordered(self, actor: ActorState, d):
         q = actor.queues.setdefault(d.get("caller", b""), _CallerQueue())
         fut = self.loop.create_future()
         heapq.heappush(q.pending, (d["seq"], id(d), d, fut))
-        if not q.draining:
-            q.draining = True
-            try:
-                while q.pending and q.pending[0][0] == q.next_seq:
+        return fut
+
+    async def _drain_ordered(self, actor: ActorState, caller: bytes):
+        q = actor.queues.setdefault(caller, _CallerQueue())
+        if q.draining:
+            return
+        q.draining = True
+        try:
+            while q.pending and q.pending[0][0] == q.next_seq:
+                # Pop the whole contiguous seq run and execute it in ONE
+                # executor hop — the thread handoff is the dominant cost
+                # of a small actor call on a busy host.
+                run = []
+                limit = get_config().actor_call_batch_max
+                while (q.pending and q.pending[0][0] == q.next_seq
+                       and len(run) < limit):
                     _, _, req, rfut = heapq.heappop(q.pending)
                     q.next_seq += 1
-                    result = await self._invoke_actor_method(actor, req)
-                    if not rfut.done():
-                        rfut.set_result(result)
-            finally:
-                q.draining = False
-        return await fut
+                    run.append((req, rfut))
+                if len(run) == 1:
+                    result = await self._invoke_actor_method(actor, run[0][0])
+                    if not run[0][1].done():
+                        run[0][1].set_result(result)
+                else:
+                    results = await self._invoke_actor_run(
+                        actor, [r for r, _ in run]
+                    )
+                    for (_, rfut), res in zip(run, results):
+                        if not rfut.done():
+                            rfut.set_result(res)
+        finally:
+            q.draining = False
+
+    async def _invoke_actor_run(self, actor: ActorState, reqs) -> list:
+        """Execute an ordered run of calls in a single executor hop."""
+        from ray_tpu.util import tracing
+
+        def do_run():
+            results = []
+            for d in reqs:
+                self._record_task_event(d["task_id"], d["method"], "RUNNING")
+                try:
+                    method = getattr(actor.instance, d["method"])
+                    if d.get("xlang"):
+                        args, kwargs = tuple(d.get("plain_args") or ()), {}
+                    else:
+                        args, kwargs = self.client.deserialize_args(d["args"])
+                    with tracing.activate(d.get("trace_ctx"), d["method"]):
+                        with actor.lock:
+                            if inspect.iscoroutinefunction(method):
+                                value = asyncio.run(method(*args, **kwargs))
+                            else:
+                                value = method(*args, **kwargs)
+                    spec = {"task_id": d["task_id"],
+                            "num_returns": d.get("num_returns", 1)}
+                    results.append(
+                        self._package_returns(spec, value,
+                                              bool(d.get("xlang")))
+                    )
+                    self._record_task_event(
+                        d["task_id"], d["method"], "FINISHED")
+                except BaseException as e:  # noqa: BLE001 — to the caller
+                    self._record_task_event(d["task_id"], d["method"], "FAILED")
+                    results.append(make_task_error(e))
+            return results
+
+        return await self.loop.run_in_executor(self.executor, do_run)
 
     async def _invoke_actor_method(self, actor: ActorState, d) -> dict:
         self._record_task_event(d["task_id"], d["method"], "RUNNING")
@@ -443,14 +592,17 @@ class WorkerProcess:
                     return invoke()
             return invoke()
 
-        try:
-            value = await self.loop.run_in_executor(self.executor, do_call)
+        def call_and_package():
+            # One executor hop covers both the user call and result
+            # packaging (_package_returns may block on the raylet during
+            # spill, so neither half may run on the event loop).
+            value = do_call()
             spec = {"task_id": d["task_id"], "num_returns": d.get("num_returns", 1)}
-            # _package_returns may block on GCS (location registration), so
-            # it must not run on the event loop.
+            return self._package_returns(spec, value, bool(d.get("xlang")))
+
+        try:
             result = await self.loop.run_in_executor(
-                self.executor, self._package_returns, spec, value,
-                bool(d.get("xlang")),
+                self.executor, call_and_package
             )
             self._record_task_event(d["task_id"], d["method"], "FINISHED")
             return result
