@@ -22,6 +22,9 @@ from ray_tpu.serve.replica import ReplicaActor
 CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
 
 
+CHECKPOINT_KEY = b"serve_controller_ckpt"
+
+
 @rt.remote
 class ServeController:
     def __init__(self):
@@ -37,8 +40,82 @@ class ServeController:
         self._proxy_every_node = False
         self._proxies: Dict[bytes, Dict] = {}  # node_id -> {actor, ...}
         self._proxies_reconciling = False  # single-flight across threads
+        # Crash recovery (reference: controller.py:91 checkpointing via
+        # KVStore + deployment_state.py:2321 _recover_from_checkpoint):
+        # every mutation persists the desired state INCLUDING live replica
+        # handles to the GCS KV; a restarted controller re-adopts running
+        # replicas, so controller death costs no routes and no replica
+        # restarts.
+        self._restore()
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
+
+    # -- checkpoint / recovery --------------------------------------------
+    def _checkpoint(self):
+        import cloudpickle
+
+        from ray_tpu._private import worker as worker_mod
+
+        with self._lock:
+            state = {
+                "apps": {
+                    name: {
+                        "deployment": app["deployment"],
+                        "init_args": app["init_args"],
+                        "init_kwargs": app["init_kwargs"],
+                        "replicas": list(app["replicas"]),
+                        "version": app["version"],
+                        "target": app["target"],
+                    }
+                    for name, app in self.apps.items()
+                },
+                "proxy_every_node": self._proxy_every_node,
+                "proxies": {
+                    nid: {"actor": e["actor"], "http": e["http"],
+                          "binary": e["binary"]}
+                    for nid, e in self._proxies.items()
+                },
+            }
+        try:
+            worker_mod.get_client().kv_put(
+                CHECKPOINT_KEY, cloudpickle.dumps(state), ns="serve"
+            )
+        except Exception:  # noqa: BLE001 — next mutation retries
+            pass
+
+    def _restore(self):
+        import cloudpickle
+
+        from ray_tpu._private import worker as worker_mod
+
+        try:
+            raw = worker_mod.get_client().kv_get(CHECKPOINT_KEY, ns="serve")
+        except Exception:  # noqa: BLE001
+            raw = None
+        if not raw:
+            return
+        try:
+            state = cloudpickle.loads(raw)
+        except Exception:  # noqa: BLE001 — corrupt checkpoint: start fresh
+            return
+        now = time.monotonic()
+        for name, app in state.get("apps", {}).items():
+            self.apps[name] = {
+                "deployment": app["deployment"],
+                "init_args": app["init_args"],
+                "init_kwargs": app["init_kwargs"],
+                # Live replicas are re-adopted as-is; the first health
+                # pass reaps any that died while the controller was down
+                # and reconcile replaces them.
+                "replicas": list(app["replicas"]),
+                "version": app["version"] + 1,
+                "target": app["target"],
+                "last_scale_up": now,
+                "last_scale_down": now,
+            }
+        self._proxy_every_node = state.get("proxy_every_node", False)
+        for nid, e in state.get("proxies", {}).items():
+            self._proxies[nid] = dict(e)
 
     # -- API -------------------------------------------------------------
     def deploy(self, name: str, deployment: Deployment, init_args, init_kwargs):
@@ -60,6 +137,7 @@ class ServeController:
                 "last_scale_down": time.monotonic(),
             }
         self._reconcile_once(name)
+        self._checkpoint()
         return True
 
     def delete(self, name: str):
@@ -68,6 +146,7 @@ class ServeController:
         if app:
             for r in app["replicas"]:
                 _kill_quietly(r)
+        self._checkpoint()
         return True
 
     def get_replicas(self, name: str):
@@ -99,6 +178,12 @@ class ServeController:
             self._proxies.clear()
         for entry in entries:
             _kill_quietly(entry["actor"])
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            worker_mod.get_client().kv_del(CHECKPOINT_KEY, ns="serve")
+        except Exception:  # noqa: BLE001
+            pass
         return True
 
     # -- reconciliation ---------------------------------------------------
@@ -132,12 +217,14 @@ class ServeController:
                 app["replicas"].extend(new)
                 app["version"] += 1
             self._publish_routes(name)
+            self._checkpoint()
         elif current > target:
             with self._lock:
                 excess = app["replicas"][target:]
                 app["replicas"] = app["replicas"][:target]
                 app["version"] += 1
             self._publish_routes(name)
+            self._checkpoint()
             for r in excess:
                 _kill_quietly(r)
 
@@ -239,6 +326,7 @@ class ServeController:
                         self._proxies[node_id] = entry
                 except Exception:  # noqa: BLE001 — retried next tick
                     pass
+            self._checkpoint()
         finally:
             with self._lock:
                 self._proxies_reconciling = False
@@ -306,6 +394,7 @@ class ServeController:
             ]
             app["version"] += 1
         self._publish_routes(name)
+        self._checkpoint()
         for r in dead:
             _kill_quietly(r)
 
@@ -330,14 +419,19 @@ class ServeController:
             if app is None:
                 return
             target = app["target"]
+            changed = False
             if avg > cfg.target_ongoing_requests and target < cfg.max_replicas:
                 if now - app["last_scale_up"] > cfg.upscale_delay_s:
                     app["target"] = min(target + 1, cfg.max_replicas)
                     app["last_scale_up"] = now
+                    changed = True
             elif avg < cfg.target_ongoing_requests * 0.5 and target > cfg.min_replicas:
                 if now - app["last_scale_down"] > cfg.downscale_delay_s:
                     app["target"] = max(target - 1, cfg.min_replicas)
                     app["last_scale_down"] = now
+                    changed = True
+        if changed:
+            self._checkpoint()
 
 
 def _kill_quietly(actor):
@@ -353,7 +447,9 @@ def get_or_create_controller():
     except ValueError:
         pass
     try:
-        return ServeController.options(name=CONTROLLER_NAME, num_cpus=0.1).remote()
+        return ServeController.options(
+            name=CONTROLLER_NAME, num_cpus=0.1, max_restarts=-1
+        ).remote()
     except ValueError:
         # Raced with another creator.
         return rt.get_actor(CONTROLLER_NAME)
