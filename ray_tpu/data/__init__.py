@@ -6,13 +6,23 @@ range / from_items / from_numpy / read_parquet / read_csv / read_json.
 
 from ray_tpu.data import aggregate
 from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.datasource import (
+    Datasink,
+    Datasource,
+    FileBasedDatasink,
+    FileBasedDatasource,
+    ReadTask,
+)
 from ray_tpu.data.executor import ActorPoolStrategy
+from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.dataset import (
     Dataset,
     from_items,
     from_numpy,
     range_dataset as range,  # noqa: A001 — mirrors ray.data.range
+    read_binary_files,
     read_csv,
+    read_datasource,
     read_json,
     read_parquet,
     read_text,
@@ -20,10 +30,18 @@ from ray_tpu.data.dataset import (
 
 __all__ = [
     "ActorPoolStrategy",
+    "DataIterator",
+    "Datasink",
+    "Datasource",
     "Dataset",
+    "FileBasedDatasink",
+    "FileBasedDatasource",
+    "ReadTask",
     "from_items",
     "from_numpy",
     "range",
+    "read_binary_files",
+    "read_datasource",
     "read_parquet",
     "read_text",
     "read_csv",

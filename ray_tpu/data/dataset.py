@@ -463,29 +463,61 @@ class Dataset:
         return [Dataset(sr if sr else [rt.put(B.block_from_rows([]))])
                 for sr in shard_refs]
 
+    def streaming_split(self, n: int, equal: bool = True,
+                        locality_hints: Optional[List] = None) -> List:
+        """n coordinated per-worker iterators over ONE shared streaming
+        execution per epoch (reference: dataset.py:1161 streaming_split +
+        StreamSplitDataIterator). Each DataIterator's iter_rows /
+        iter_batches call consumes one epoch; the pipeline re-executes
+        per epoch. equal=True balances splits by rows at block
+        granularity. Input blocks are promoted to the shared store up
+        front; pipeline stages stream."""
+        import cloudpickle
+
+        from ray_tpu.data.iterator import DataIterator, _SplitCoordinator
+
+        coord = _SplitCoordinator.options(
+            num_cpus=0.01, max_concurrency=2 * n + 4
+        ).remote(
+            self._input_refs, cloudpickle.dumps(self._stages), n, equal
+        )
+        return [DataIterator(coord, i, n) for i in range(n)]
+
     # -- output ----------------------------------------------------------
-    def _write(self, path: str, fmt: str) -> List[str]:
-        """One file per block, written by remote tasks (the reference's
-        write-task model: blocks serialize where they live, not on the
-        driver)."""
-        os.makedirs(path, exist_ok=True)
-        write_fn = rt.remote(_write_block_file).options(max_retries=-1)
+    def write_datasink(self, sink) -> List[Any]:
+        """Write through the Datasink plugin surface: one remote write
+        task per block, with driver-side start/complete/failed hooks
+        (reference: datasink.py + plan_write_op)."""
+        sink.on_write_start()
+        write_fn = rt.remote(_run_write_task).options(max_retries=-1)
         refs = [
-            write_fn.remote(ref, os.path.abspath(path), i, fmt)
+            write_fn.remote(sink, ref, i)
             for i, ref in enumerate(self._executed_refs())
         ]
-        return [fp for fp in rt.get(refs) if fp is not None]
+        try:
+            results = [r for r in rt.get(refs) if r is not None]
+        except Exception as e:  # noqa: BLE001 — sink sees the failure
+            sink.on_write_failed(e)
+            raise
+        sink.on_write_complete(results)
+        return results
 
     def write_parquet(self, path: str) -> List[str]:
         """One parquet file per block under `path` (reference:
         Dataset.write_parquet)."""
-        return self._write(path, "parquet")
+        from ray_tpu.data.datasource import ParquetDatasink
+
+        return self.write_datasink(ParquetDatasink(path))
 
     def write_csv(self, path: str) -> List[str]:
-        return self._write(path, "csv")
+        from ray_tpu.data.datasource import CSVDatasink
+
+        return self.write_datasink(CSVDatasink(path))
 
     def write_json(self, path: str) -> List[str]:
-        return self._write(path, "json")
+        from ray_tpu.data.datasource import JSONDatasink
+
+        return self.write_datasink(JSONDatasink(path))
 
     def __repr__(self):
         return (
@@ -836,102 +868,77 @@ def from_items(items: List[Any], parallelism: int = 4) -> Dataset:
 
 
 def range_dataset(n: int, parallelism: int = 4) -> Dataset:
-    return from_items([{"id": i} for i in range(n)], parallelism)
+    """Rows {"id": i}; generated inside read tasks, not on the driver."""
+    from ray_tpu.data.datasource import RangeDatasource
+
+    return read_datasource(RangeDatasource(n), parallelism)
 
 
 def from_numpy(arrays: Dict[str, Any], parallelism: int = 4) -> Dataset:
-    import numpy as np
+    from ray_tpu.data.datasource import NumpyDatasource
 
-    keys = list(arrays.keys())
-    n = len(arrays[keys[0]])
-    rows = [{k: _np_item(arrays[k][i]) for k in keys} for i in range(n)]
-    return from_items(rows, parallelism)
+    return read_datasource(NumpyDatasource(arrays), parallelism)
 
 
-def _write_block_file(block, path: str, index: int, fmt: str):
-    """Remote-task body: persist one block as part-<index>; returns the
-    file path (None for empty blocks)."""
-    rows = B.block_to_rows(block)
-    if not rows:
-        return None
-    if fmt == "parquet":
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-
-        fp = os.path.join(path, f"part-{index:05d}.parquet")
-        pq.write_table(pa.Table.from_pylist(rows), fp)
-    elif fmt == "csv":
-        import pyarrow as pa
-        import pyarrow.csv as pacsv
-
-        fp = os.path.join(path, f"part-{index:05d}.csv")
-        pacsv.write_csv(pa.Table.from_pylist(rows), fp)
-    elif fmt == "json":
-        import json as _json
-
-        fp = os.path.join(path, f"part-{index:05d}.jsonl")
-        with open(fp, "w") as f:
-            for r in rows:
-                f.write(_json.dumps(r, default=_json_fallback) + "\n")
-    else:
-        raise ValueError(f"unknown format {fmt!r}")
-    return fp
+def _run_write_task(sink, block, index: int):
+    """Remote-task body: hand one block to the Datasink."""
+    return sink.write(block, {"task_index": index})
 
 
-def _read_file_block(path: str, fmt: str):
-    """Remote-task body: parse one file into a block (reads happen in
-    workers — rows/bytes never pass through the driver, the reference's
-    read-task model, data/datasource/)."""
-    if fmt == "parquet":
-        import pyarrow.parquet as pq
-
-        return pq.read_table(path)
-    if fmt == "csv":
-        import pyarrow.csv as pacsv
-
-        return pacsv.read_csv(path)
-    if fmt == "json":
-        import pyarrow.json as pajson
-
-        return pajson.read_json(path)
-    if fmt == "text":
-        with open(path) as f:
-            return B.block_from_rows(
-                [{"text": line.rstrip("\n")} for line in f]
-            )
-    raise ValueError(f"unknown format {fmt!r}")
+def _run_read_task(task):
+    """Remote-task body: execute one ReadTask; concat its blocks."""
+    blocks = task()
+    if not blocks:
+        return B.block_from_rows([])
+    if len(blocks) == 1:
+        return blocks[0]
+    return B.block_concat(blocks)
 
 
-def _read_files(path: str, fmt: str, glob_pat: str,
-                parallelism: int) -> Dataset:
-    import glob as _glob
-    import os
-
-    paths = (
-        sorted(_glob.glob(os.path.join(path, glob_pat)))
-        if os.path.isdir(path) else [path]
-    )
-    if not paths:
-        raise FileNotFoundError(f"no {glob_pat} files under {path!r}")
-    read_fn = rt.remote(_read_file_block).options(max_retries=-1)
-    ds = Dataset([read_fn.remote(p, fmt) for p in paths])
-    if len(paths) < parallelism:
+def read_datasource(datasource, parallelism: int = 4) -> Dataset:
+    """Parallel ingestion through the Datasource plugin surface: the
+    source plans ReadTasks, each executes in a remote worker (reference:
+    read_api.py:read_datasource -> plan_read_op)."""
+    tasks = datasource.get_read_tasks(parallelism)
+    if not tasks:
+        return Dataset([rt.put(B.block_from_rows([]))])
+    read_fn = rt.remote(_run_read_task).options(max_retries=-1)
+    ds = Dataset([read_fn.remote(t) for t in tasks])
+    if len(tasks) < parallelism:
         ds = ds.repartition(parallelism)
     return ds
 
 
 def read_parquet(path: str, parallelism: int = 4) -> Dataset:
-    return _read_files(path, "parquet", "*.parquet", parallelism)
+    from ray_tpu.data.datasource import ParquetDatasource
+
+    return read_datasource(ParquetDatasource(path), parallelism)
 
 
 def read_csv(path: str, parallelism: int = 4) -> Dataset:
-    return _read_files(path, "csv", "*.csv", parallelism)
+    from ray_tpu.data.datasource import CSVDatasource
+
+    return read_datasource(CSVDatasource(path), parallelism)
 
 
 def read_json(path: str, parallelism: int = 4) -> Dataset:
-    return _read_files(path, "json", "*.jsonl", parallelism)
+    from ray_tpu.data.datasource import JSONDatasource
+
+    return read_datasource(JSONDatasource(path), parallelism)
+
+
+def read_binary_files(path: str, parallelism: int = 4) -> Dataset:
+    """One row per file: {"path", "bytes"} (reference: read_binary_files)."""
+    from ray_tpu.data.datasource import BinaryDatasource
+
+    return read_datasource(BinaryDatasource(path), parallelism)
 
 
 def read_text(path: str, parallelism: int = 4) -> Dataset:
     """One row per line: {"text": line} (reference: data read_text)."""
-    return _read_files(path, "text", "*.txt", parallelism)
+    from ray_tpu.data.datasource import TextDatasource
+
+    class _TxtSource(TextDatasource):
+        _GLOB = "*.txt"
+
+    return read_datasource(_TxtSource(path), parallelism)

@@ -1,0 +1,305 @@
+"""Datasource / Datasink plugin surface.
+
+Analog of the reference's pluggable IO layer
+(python/ray/data/datasource/datasource.py: Datasource.get_read_tasks /
+ReadTask, and datasink.py: Datasink.on_write_start/write/on_write_complete).
+A Datasource turns itself into independent read tasks (each a plain
+callable producing blocks, executed in remote workers so rows never pass
+through the driver); a Datasink receives one write call per block plus
+job-level start/complete/failed hooks.
+
+The built-in file formats (parquet/csv/json/text/binary/numpy/range) are
+implemented on this surface — the same extension point user formats use.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from ray_tpu.data import block as B
+
+
+class ReadTask:
+    """One unit of parallel read work: a no-arg callable returning an
+    iterable of blocks, plus optional metadata (row-count/size estimates
+    used for scheduling hints)."""
+
+    def __init__(self, read_fn: Callable[[], Iterable[Any]],
+                 metadata: Optional[Dict] = None):
+        self.read_fn = read_fn
+        self.metadata = metadata or {}
+
+    def __call__(self) -> List[Any]:
+        return list(self.read_fn())
+
+
+class Datasource(ABC):
+    """Produces ReadTasks for parallel ingestion (reference:
+    datasource.py:Datasource)."""
+
+    @abstractmethod
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        """Split this source into up to `parallelism` independent reads."""
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_name(self) -> str:
+        return type(self).__name__
+
+
+class Datasink(ABC):
+    """Receives blocks from parallel write tasks (reference:
+    datasink.py:Datasink)."""
+
+    def on_write_start(self) -> None:
+        """Driver-side hook before any write task runs."""
+
+    @abstractmethod
+    def write(self, block: Any, ctx: Dict) -> Any:
+        """Write one block (runs in a remote worker). `ctx` carries
+        {"task_index": int}. Returns a result collected by
+        on_write_complete."""
+
+    def on_write_complete(self, write_results: List[Any]) -> None:
+        """Driver-side hook after every write task succeeded."""
+
+    def on_write_failed(self, error: Exception) -> None:
+        """Driver-side hook when any write task failed."""
+
+    def get_name(self) -> str:
+        return type(self).__name__
+
+
+# ---------------------------------------------------------------------------
+# File-based sources
+# ---------------------------------------------------------------------------
+
+
+class FileBasedDatasource(Datasource):
+    """Shared machinery for one-file-per-read-task formats: expands a
+    path or directory glob, one ReadTask per file (reference:
+    file_based_datasource.py)."""
+
+    _GLOB = "*"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _paths(self) -> List[str]:
+        if os.path.isdir(self.path):
+            paths = sorted(_glob.glob(os.path.join(self.path, self._GLOB)))
+        else:
+            paths = sorted(_glob.glob(self.path)) or [self.path]
+        if not paths:
+            raise FileNotFoundError(
+                f"no {self._GLOB} files under {self.path!r}"
+            )
+        return paths
+
+    @abstractmethod
+    def _read_file(self, path: str) -> Any:
+        """Parse one file into a block (runs in a remote worker)."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        read = self._read_file
+        return [
+            ReadTask(
+                (lambda p=p: [read(p)]),
+                {"path": p, "size_bytes": _safe_size(p)},
+            )
+            for p in self._paths()
+        ]
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        try:
+            return sum(_safe_size(p) or 0 for p in self._paths())
+        except FileNotFoundError:
+            return None
+
+
+def _safe_size(p: str) -> Optional[int]:
+    try:
+        return os.path.getsize(p)
+    except OSError:
+        return None
+
+
+class ParquetDatasource(FileBasedDatasource):
+    _GLOB = "*.parquet"
+
+    def _read_file(self, path: str):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+
+
+class CSVDatasource(FileBasedDatasource):
+    _GLOB = "*.csv"
+
+    def _read_file(self, path: str):
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path)
+
+
+class JSONDatasource(FileBasedDatasource):
+    _GLOB = "*.jsonl"
+
+    def _read_file(self, path: str):
+        import pyarrow.json as pajson
+
+        return pajson.read_json(path)
+
+
+class TextDatasource(FileBasedDatasource):
+    _GLOB = "*"
+
+    def _read_file(self, path: str):
+        with open(path) as f:
+            return B.block_from_rows(
+                [{"text": line.rstrip("\n")} for line in f]
+            )
+
+
+class BinaryDatasource(FileBasedDatasource):
+    """Whole-file bytes rows: {"path", "bytes"} (reference:
+    binary_datasource.py)."""
+
+    _GLOB = "*"
+
+    def _read_file(self, path: str):
+        with open(path, "rb") as f:
+            return B.block_from_rows([{"path": path, "bytes": f.read()}])
+
+
+# ---------------------------------------------------------------------------
+# Synthetic / in-memory sources
+# ---------------------------------------------------------------------------
+
+
+class RangeDatasource(Datasource):
+    """Rows {"id": i} for i in [0, n) generated IN the read tasks — no
+    driver-side materialization (reference: range_datasource.py)."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        per = (self.n + parallelism - 1) // parallelism
+        tasks = []
+        for i in range(parallelism):
+            lo, hi = i * per, min((i + 1) * per, self.n)
+            if lo >= hi and i > 0:
+                continue
+            tasks.append(ReadTask(
+                (lambda lo=lo, hi=hi:
+                 [B.block_from_rows([{"id": j} for j in range(lo, hi)])]),
+                {"num_rows": hi - lo},
+            ))
+        return tasks
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return self.n * 8
+
+
+class NumpyDatasource(Datasource):
+    """Columnar numpy arrays split into row-range read tasks."""
+
+    def __init__(self, arrays: Dict[str, Any]):
+        self.arrays = arrays
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        keys = list(self.arrays.keys())
+        n = len(self.arrays[keys[0]]) if keys else 0
+        parallelism = max(1, min(parallelism, n or 1))
+        per = (n + parallelism - 1) // parallelism
+        arrays = self.arrays
+
+        def make(lo, hi):
+            def read():
+                rows = [
+                    {k: _np_item(arrays[k][i]) for k in keys}
+                    for i in range(lo, hi)
+                ]
+                return [B.block_from_rows(rows)]
+
+            return read
+
+        return [
+            ReadTask(make(i * per, min((i + 1) * per, n)),
+                     {"num_rows": min((i + 1) * per, n) - i * per})
+            for i in range(parallelism)
+            if i * per < n or i == 0
+        ]
+
+
+def _np_item(v):
+    return v.item() if hasattr(v, "item") and getattr(v, "ndim", 1) == 0 else v
+
+
+# ---------------------------------------------------------------------------
+# File-based sinks
+# ---------------------------------------------------------------------------
+
+
+class FileBasedDatasink(Datasink):
+    """One file per block under a directory (reference: the
+    _FileDatasink write model)."""
+
+    _EXT = "bin"
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    def on_write_start(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+
+    @abstractmethod
+    def _write_rows(self, rows: List[Any], file_path: str) -> None:
+        """Persist one block's rows (runs in a remote worker)."""
+
+    def write(self, block: Any, ctx: Dict) -> Any:
+        rows = B.block_to_rows(block)
+        if not rows:
+            return None
+        fp = os.path.join(self.path, f"part-{ctx['task_index']:05d}.{self._EXT}")
+        self._write_rows(rows, fp)
+        return fp
+
+
+class ParquetDatasink(FileBasedDatasink):
+    _EXT = "parquet"
+
+    def _write_rows(self, rows, file_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa.Table.from_pylist(rows), file_path)
+
+
+class CSVDatasink(FileBasedDatasink):
+    _EXT = "csv"
+
+    def _write_rows(self, rows, file_path):
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(pa.Table.from_pylist(rows), file_path)
+
+
+class JSONDatasink(FileBasedDatasink):
+    _EXT = "jsonl"
+
+    def _write_rows(self, rows, file_path):
+        import json as _json
+
+        from ray_tpu.data.dataset import _json_fallback
+
+        with open(file_path, "w") as f:
+            for r in rows:
+                f.write(_json.dumps(r, default=_json_fallback) + "\n")

@@ -332,10 +332,52 @@ class StreamingExecutor:
         })
         return out
 
+    def execute_iter(self, input_refs: List) -> "Iterator":
+        """Streaming variant of execute(): yields output block refs of the
+        FINAL pipeline segment as they complete (completion order), so a
+        consumer (streaming_split's coordinator) can hand blocks to
+        trainers while upstream tasks are still running. Barrier stages
+        (all-to-all) still synchronize internally."""
+        refs = list(input_refs)
+        plan = _fuse(self.stages)
+        segments: List = []
+        cur: List[_PhysicalOp] = []
+        for op in plan:
+            if isinstance(op, AllToAllStage):
+                if cur:
+                    segments.append(("ops", cur))
+                    cur = []
+                segments.append(("barrier", op))
+            else:
+                cur.append(op)
+        if cur:
+            segments.append(("ops", cur))
+        if not segments:
+            yield from refs
+            return
+        for kind, seg in segments[:-1]:
+            refs = seg.fn(refs) if kind == "barrier" else (
+                self._run_segment(seg, refs)
+            )
+        kind, last = segments[-1]
+        if kind == "barrier":
+            yield from last.fn(refs)
+        else:
+            for _idx, ref in self._run_segment_iter(last, refs):
+                yield ref
+
     def _run_segment(self, ops: List[_PhysicalOp], input_refs: List) -> List:
-        """Drive a barrier-free run of operators to completion."""
-        source = deque(enumerate(input_refs))
+        """Drive a barrier-free run of operators to completion; results in
+        input order."""
         out: List = [None] * len(input_refs)
+        for idx, ref in self._run_segment_iter(ops, input_refs):
+            out[idx] = ref
+        return out
+
+    def _run_segment_iter(self, ops: List[_PhysicalOp], input_refs: List):
+        """Generator core: yields (input_index, output_ref) as blocks
+        finish the segment."""
+        source = deque(enumerate(input_refs))
         budget = self._budget()
         n_done = 0
         try:
@@ -352,11 +394,12 @@ class StreamingExecutor:
                             sink.inq.append(op.outq.popleft())
                         else:
                             idx, ref = op.outq.popleft()
-                            # Results land at their ORIGINAL positions:
-                            # consumers (zip, ordered iteration) rely on
-                            # block order surviving completion order.
-                            out[idx] = ref
+                            # _run_segment lands results at their ORIGINAL
+                            # positions: consumers (zip, ordered
+                            # iteration) rely on block order surviving
+                            # completion order.
                             n_done += 1
+                            yield idx, ref
                 # Feed the first operator from the source.
                 first = ops[0]
                 while source and len(first.inq) < 2 * first.max_in_flight:
@@ -384,4 +427,3 @@ class StreamingExecutor:
         finally:
             for op in ops:
                 op.close()
-        return out

@@ -1,0 +1,190 @@
+"""Streaming split iteration for train ingestion.
+
+Analog of the reference's Dataset.streaming_split
+(python/ray/data/dataset.py:1161) + StreamSplitDataIterator
+(_internal/iterator/stream_split_iterator.py): one coordinator actor
+drives the dataset's streaming executor per epoch and deals completed
+output blocks to n consumer queues; each training worker holds a
+DataIterator that pulls from its queue. Blocks flow while upstream tasks
+are still running, and every epoch re-executes the pipeline (fresh
+random_shuffle draws etc.).
+
+`equal=True` balances splits by ROW count at block granularity (greedy
+least-loaded dispatch); the reference additionally slices boundary blocks
+for exact row equality.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Iterator, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.data import block as B
+
+# Undelivered blocks buffered per split before the producer stalls
+# (consumer backpressure; reference: per-split output queue bounds).
+_SPLIT_QUEUE_DEPTH = 4
+
+
+def _block_rows(block) -> int:
+    return B.block_num_rows(block)
+
+
+@rt.remote
+class _SplitCoordinator:
+    """Owns one streaming execution per epoch and deals blocks to n
+    split queues. max_concurrency must cover n blocked next_blocks()
+    calls plus control calls (set at creation in streaming_split)."""
+
+    def __init__(self, input_refs: List, stages_payload: bytes, n: int,
+                 equal: bool):
+        import cloudpickle
+
+        self._input_refs = list(input_refs)
+        self._stages = cloudpickle.loads(stages_payload)
+        self._n = n
+        self._equal = equal
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._epoch = -1          # epoch currently producing / produced
+        self._queues: List[deque] = [deque() for _ in range(n)]
+        self._rows: List[int] = [0] * n
+        self._producer_done = True
+        self._producer_error: Optional[str] = None
+
+    def start_epoch(self, epoch: int) -> bool:
+        """Idempotent across the n consumers: the first call for the next
+        epoch starts its producer thread; later/duplicate calls no-op."""
+        with self._lock:
+            if epoch <= self._epoch:
+                return False
+            if not self._producer_done:
+                # Previous epoch still streaming; callers retry after
+                # consuming it to the end.
+                return False
+            self._epoch = epoch
+            self._queues = [deque() for _ in range(self._n)]
+            self._rows = [0] * self._n
+            self._producer_done = False
+            self._producer_error = None
+        t = threading.Thread(target=self._produce, args=(epoch,), daemon=True)
+        t.start()
+        return True
+
+    def _produce(self, epoch: int):
+        from ray_tpu.data.executor import StreamingExecutor
+
+        # Fractional CPU: a row count must schedule even on a cluster
+        # whose whole-CPU budget is held by trainer/accumulator actors.
+        count_fn = rt.remote(_block_rows).options(
+            max_retries=-1, num_cpus=0.01
+        )
+        try:
+            executor = StreamingExecutor(list(self._stages))
+            rr = 0
+            for ref in executor.execute_iter(self._input_refs):
+                if self._equal:
+                    try:
+                        nrows = rt.get(count_fn.remote(ref), timeout=120)
+                    except Exception:  # noqa: BLE001 — fall back to RR
+                        nrows = 1
+                else:
+                    nrows = 1
+                with self._cond:
+                    if self._equal:
+                        target = min(range(self._n), key=lambda i: self._rows[i])
+                    else:
+                        target = rr % self._n
+                        rr += 1
+                    # Backpressure: stall until the chosen queue drains.
+                    while (len(self._queues[target]) >= _SPLIT_QUEUE_DEPTH
+                           and self._epoch == epoch):
+                        self._cond.wait(timeout=1.0)
+                    if self._epoch != epoch:
+                        return  # superseded (shutdown/restart)
+                    self._queues[target].append(ref)
+                    self._rows[target] += nrows
+                    self._cond.notify_all()
+        except Exception as e:  # noqa: BLE001 — surface to consumers
+            with self._cond:
+                self._producer_error = f"{type(e).__name__}: {e}"
+        finally:
+            with self._cond:
+                self._producer_done = True
+                self._cond.notify_all()
+
+    def next_blocks(self, epoch: int, split_idx: int, max_blocks: int = 2):
+        """Blocking pull: up to max_blocks refs for one split, or
+        {"done": True} at end of the split's epoch stream."""
+        with self._cond:
+            while True:
+                if self._producer_error:
+                    raise RuntimeError(
+                        f"streaming_split producer failed: {self._producer_error}"
+                    )
+                if epoch != self._epoch:
+                    # Stale consumer (epoch superseded): report done so it
+                    # unwinds cleanly.
+                    return {"blocks": [], "done": True}
+                q = self._queues[split_idx]
+                if q:
+                    out = [q.popleft() for _ in range(min(max_blocks, len(q)))]
+                    self._cond.notify_all()
+                    return {"blocks": out, "done": False}
+                if self._producer_done:
+                    return {"blocks": [], "done": True}
+                self._cond.wait(timeout=1.0)
+
+    def stats(self):
+        with self._lock:
+            return {"epoch": self._epoch, "rows_per_split": list(self._rows)}
+
+
+class DataIterator:
+    """Per-worker view of one split. Each iteration call (iter_rows /
+    iter_batches / iter_blocks) consumes ONE epoch: the underlying
+    pipeline re-executes per epoch, coordinated across the n iterators
+    (reference: data/iterator.py DataIterator semantics)."""
+
+    def __init__(self, coordinator, split_idx: int, n: int):
+        self._coord = coordinator
+        self._idx = split_idx
+        self._n = n
+        self._epoch = 0
+
+    def iter_blocks(self) -> Iterator[Any]:
+        epoch = self._epoch
+        self._epoch += 1
+        # Idempotent across the n iterators; whoever arrives first starts
+        # the epoch's producer.
+        rt.get(self._coord.start_epoch.remote(epoch))
+        while True:
+            out = rt.get(self._coord.next_blocks.remote(epoch, self._idx),
+                         timeout=600)
+            for ref in out["blocks"]:
+                yield rt.get(ref)
+            if out["done"]:
+                return
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from B.block_to_rows(block)
+
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "numpy") -> Iterator[Any]:
+        rows: List[Any] = []
+        for block in self.iter_blocks():
+            rows.extend(B.block_to_rows(block))
+            while len(rows) >= batch_size:
+                chunk, rows = rows[:batch_size], rows[batch_size:]
+                yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
+        if rows:
+            yield B.block_to_batch(B.block_from_rows(rows), batch_format)
+
+    def stats(self):
+        return rt.get(self._coord.stats.remote())
+
+    def __repr__(self):
+        return f"DataIterator(split={self._idx}/{self._n}, epoch={self._epoch})"

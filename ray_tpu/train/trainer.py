@@ -79,6 +79,7 @@ class DataParallelTrainer(BaseTrainer):
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        dataset_config: Optional["DataConfig"] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
     ):
         super().__init__(
@@ -90,6 +91,9 @@ class DataParallelTrainer(BaseTrainer):
         self.train_loop_config = train_loop_config or {}
         self.backend_config = backend_config or BackendConfig()
         self.datasets = datasets or {}
+        from ray_tpu.train.data_config import DataConfig
+
+        self.dataset_config = dataset_config or DataConfig()
 
     def fit(self) -> Result:
         failure_config = self.run_config.failure_config
@@ -177,15 +181,13 @@ class DataParallelTrainer(BaseTrainer):
         )
 
     def _shard_datasets(self, num_workers: int):
+        """Per-worker {name: shard} dicts via DataConfig: split datasets
+        become coordinated streaming_split DataIterators (one shared
+        streaming execution per epoch), others broadcast (reference:
+        train/_internal/data_config.py DataConfig.configure)."""
         if not self.datasets:
             return None
-        train_ds = self.datasets.get("train")
-        if train_ds is None:
-            return None
-        if hasattr(train_ds, "split"):
-            return train_ds.split(num_workers)
-        # Fallback: same dataset everywhere; workers shard by rank.
-        return [train_ds for _ in range(num_workers)]
+        return self.dataset_config.configure(self.datasets, num_workers)
 
 
 class JaxTrainer(DataParallelTrainer):

@@ -49,7 +49,13 @@ class TrainWorker:
             world_size=self.world_size,
             config=config,
             checkpoint=checkpoint,
-            dataset_shards={"train": dataset_shard} if dataset_shard is not None else {},
+            # DataConfig hands a {name: shard} dict per worker; legacy
+            # callers may still pass a bare train shard.
+            dataset_shards=(
+                dataset_shard if isinstance(dataset_shard, dict)
+                else {"train": dataset_shard} if dataset_shard is not None
+                else {}
+            ),
             trial_dir=trial_dir,
         )
         self._done = False

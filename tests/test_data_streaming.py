@@ -251,3 +251,155 @@ def test_wide_shuffle_bounded_fanin():
         assert s1 != list(range(n))
     finally:
         rt.shutdown()
+
+
+def test_datasource_datasink_plugin(rt_start, tmp_path):
+    """Custom Datasource/Datasink on the plugin ABC (VERDICT r3 item 4;
+    reference: data/datasource/datasource.py + datasink.py)."""
+    from ray_tpu.data import block as B
+    from ray_tpu.data.datasource import Datasink, Datasource, ReadTask
+
+    class SquaresSource(Datasource):
+        def __init__(self, n):
+            self.n = n
+
+        def get_read_tasks(self, parallelism):
+            per = (self.n + parallelism - 1) // parallelism
+            tasks = []
+            for i in range(parallelism):
+                lo, hi = i * per, min((i + 1) * per, self.n)
+                if lo >= hi:
+                    continue
+                tasks.append(ReadTask(
+                    lambda lo=lo, hi=hi: [B.block_from_rows(
+                        [{"i": j, "sq": j * j} for j in range(lo, hi)]
+                    )],
+                    {"num_rows": hi - lo},
+                ))
+            return tasks
+
+    class ManifestSink(Datasink):
+        def __init__(self, path):
+            self.path = str(path)
+            self.started = False
+            self.completed = None
+
+        def on_write_start(self):
+            self.started = True
+
+        def write(self, block, ctx):
+            rows = B.block_to_rows(block)
+            fp = f"{self.path}/chunk-{ctx['task_index']}.txt"
+            with open(fp, "w") as f:
+                for r in rows:
+                    f.write(f"{r['i']},{r['sq']}\n")
+            return {"file": fp, "rows": len(rows)}
+
+        def on_write_complete(self, results):
+            self.completed = results
+
+    ds = rtd.read_datasource(SquaresSource(30), parallelism=4)
+    assert ds.count() == 30
+    assert sorted(r["sq"] for r in ds.take_all())[:4] == [0, 1, 4, 9]
+
+    import os
+    os.makedirs(tmp_path / "out", exist_ok=True)
+    sink = ManifestSink(tmp_path / "out")
+    results = ds.write_datasink(sink)
+    assert sum(r["rows"] for r in results) == 30
+    # built-in formats ride the same surface
+    files = ds.write_parquet(str(tmp_path / "pq"))
+    assert files and all(f.endswith(".parquet") for f in files)
+    back = rtd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 30
+
+
+def test_read_binary_files(rt_start, tmp_path):
+    for i in range(3):
+        (tmp_path / f"blob{i}.bin").write_bytes(bytes([i]) * (10 + i))
+    ds = rtd.read_binary_files(str(tmp_path), parallelism=2)
+    rows = ds.take_all()
+    assert len(rows) == 3
+    assert sorted(len(r["bytes"]) for r in rows) == [10, 11, 12]
+
+
+def test_streaming_split_coverage_and_epochs(rt_start):
+    """streaming_split(n, equal=True): the n iterators cover every row
+    exactly once per epoch, balanced by rows, and re-execute per epoch
+    (reference: dataset.py:1161)."""
+    import threading
+
+    ds = rtd.range(90, parallelism=9).map(lambda r: {"id": r["id"]})
+    its = ds.streaming_split(3, equal=True)
+    for _epoch in range(2):
+        parts = [[] for _ in range(3)]
+
+        def consume(i):
+            parts[i] = [r["id"] for r in its[i].iter_rows()]
+
+        ts = [threading.Thread(target=consume, args=(i,)) for i in range(3)]
+        [t.start() for t in ts]
+        [t.join(timeout=120) for t in ts]
+        assert sorted(x for p in parts for x in p) == list(range(90))
+        sizes = sorted(len(p) for p in parts)
+        assert sizes[-1] - sizes[0] <= 10, sizes  # row-balanced (~30 each)
+
+
+def test_trainer_streaming_ingestion_multi_epoch(tmp_path):
+    """JaxTrainer ingests a Dataset per epoch through DataConfig +
+    streaming_split; a non-split dataset broadcasts whole (VERDICT r3
+    item 4 acceptance; reference: train/_internal/data_config.py)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.train.data_config import DataConfig
+
+    rt.init(num_cpus=4)
+    try:
+        @rt.remote
+        class EpochSums:
+            def __init__(self):
+                self.sums = {}
+
+            def add(self, epoch, rank, s):
+                self.sums.setdefault(epoch, {})[rank] = s
+                return True
+
+            def get(self):
+                return self.sums
+
+        acc = EpochSums.options(name="epoch_sums").remote()
+        rt.get(acc.add.remote(-1, -1, 0))  # ensure ready
+
+        train_ds = rtd.range(40, parallelism=8)
+        val_ds = rtd.range(5, parallelism=1)
+
+        def loop(config):
+            from ray_tpu import train
+
+            acc = rt.get_actor("epoch_sums")
+            shard = train.get_dataset_shard("train")
+            val = train.get_dataset_shard("val")
+            rank = train.get_world_rank()
+            for epoch in range(3):
+                s = sum(r["id"] for r in shard.iter_rows())
+                rt.get(acc.add.remote(epoch, rank, s))
+            # broadcast dataset: every worker sees all rows
+            assert sorted(r["id"] for r in val.iter_rows()) == list(range(5))
+            train.report({"done": True})
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="stream", storage_path=str(tmp_path)),
+            datasets={"train": train_ds, "val": val_ds},
+            dataset_config=DataConfig(datasets_to_split=["train"]),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        sums = rt.get(acc.get.remote())
+        expected = sum(range(40))
+        for epoch in range(3):
+            per_rank = sums.get(epoch, {})
+            assert len(per_rank) == 2, sums
+            assert sum(per_rank.values()) == expected, sums
+    finally:
+        rt.shutdown()
