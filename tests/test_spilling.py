@@ -131,3 +131,58 @@ def test_put_objects_not_reconstructable(rt_start):
     )
     with pytest.raises(rt.exceptions.ObjectLostError):
         rt.get(ref, timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# URI (cloud-shaped) spill backend — VERDICT r3 item 5
+# ---------------------------------------------------------------------------
+
+
+def test_uri_storage_s3_shaped_fake_fs(tmp_path):
+    """s3://-shaped spill URIs against an injected local filesystem
+    (reference: external_storage.py:445 smart_open S3 impl; here the
+    same pyarrow.fs layer train/storage.py drives)."""
+    import pyarrow.fs as pafs
+
+    from ray_tpu._private.external_storage import UriStorage, create_storage
+
+    fake_s3 = pafs.SubTreeFileSystem(str(tmp_path), pafs.LocalFileSystem())
+    store = UriStorage("s3://bucket/spill", filesystem=fake_s3,
+                       base_path="bucket/spill")
+
+    payload = np.arange(1000, dtype=np.float64).tobytes()
+    uri = store.spill(b"\x01" * 16, memoryview(payload))
+    assert uri.startswith("s3://bucket/spill/") and uri.endswith(".bin")
+    assert store.restore(uri) == payload
+    store.delete([uri])
+    with pytest.raises(Exception):
+        store.restore(uri)
+
+    # create_storage routes cloud-shaped URIs onto UriStorage.
+    st2 = create_storage("ab" * 8, "s3://bucket/spill", filesystem=fake_s3)
+    assert isinstance(st2, UriStorage)
+    uri2 = st2.spill(b"\x02" * 16, memoryview(b"xyz"))
+    assert st2.restore(uri2) == b"xyz"
+
+
+def test_spill_e2e_through_uri_backend(tmp_path, monkeypatch):
+    """End-to-end raylet spill+restore through the pyarrow.fs URI
+    backend (file:// exercises the identical UriStorage code path the
+    cloud schemes take, without credentials)."""
+    monkeypatch.setenv("RT_SPILL_DIR", "file://" + str(tmp_path / "spill"))
+    import ray_tpu._private.config as config_mod
+
+    config_mod._config = None
+    rt.init(num_cpus=2, object_store_memory=SMALL_STORE)
+    try:
+        arrays = [np.full(2_000_000, i, dtype=np.float64) for i in range(5)]
+        refs = [rt.put(a) for a in arrays]
+        assert _raylet()._spilled, "expected at least one spilled object"
+        spilled_uris = list(_raylet()._spilled.values())
+        assert any(str(u).startswith("file://") for u in spilled_uris), spilled_uris
+        for i, ref in enumerate(refs):
+            out = rt.get(ref, timeout=60)
+            assert out[0] == i and out.shape == (2_000_000,)
+    finally:
+        rt.shutdown()
+        config_mod._config = None
