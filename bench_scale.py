@@ -126,7 +126,21 @@ def main():
 
         @rt.remote
         def touch2(x):
-            return x.nbytes
+            return x.nbytes if x is not None else 0
+
+        # Warm one worker per peer node so the probe times the TRANSFER,
+        # not first-task worker spawns.
+        rt.get(
+            [
+                touch2.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=r.node_id.binary()
+                    )
+                ).remote(None)
+                for r in cluster.raylets[1:]
+            ],
+            timeout=300,
+        )
 
         def node_broadcast():
             outs = rt.get(

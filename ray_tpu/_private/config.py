@@ -160,12 +160,22 @@ class Config:
     pull_budget_fraction: float = 0.25
     # Concurrent outbound chunk reads served (PushManager throttling).
     push_chunk_slots: int = 16
+    # Chunk fetches in flight per pull (round trips hide behind each
+    # other; reference keeps a per-object chunk pipeline the same way).
+    pull_chunk_window: int = 4
+    # Same-machine peers move objects by direct store-to-store memcpy
+    # through /dev/shm instead of TCP chunks.
+    same_host_shm_transfer: bool = True
 
     # -- wire protocol ---------------------------------------------------
     # Frames at/above this size bypass coalescing and await drain.
     rpc_direct_write_threshold: int = 64 * 1024
     # Transport backlog that parks senders in drain() (backpressure).
     rpc_write_buffer_drain: int = 256 * 1024
+    # StreamReader buffer limit: must comfortably exceed the transfer
+    # chunk size or readexactly() of a bulk chunk thrashes the
+    # transport's pause/resume flow control (asyncio default is 64KiB).
+    rpc_stream_buffer_limit: int = 32 * 1024 * 1024
 
     # -- collective -----------------------------------------------------
     collective_rendezvous_timeout_s: float = 60.0

@@ -86,6 +86,7 @@ class GcsServer:
         self.jobs: Dict[bytes, dict] = {}
         self.placement_groups: Dict[bytes, dict] = {}
         self.object_dir: Dict[bytes, dict] = {}  # object_id -> {nodes: set, size}
+        self._partial_seq = 0  # chain-seniority counter for partial pulls
         self.object_waiters: Dict[bytes, List[asyncio.Event]] = defaultdict(list)
         self.task_events: List[dict] = []  # ring buffer of task state events
         # Aggregated user metrics: name -> {type, description, boundaries?,
@@ -540,6 +541,7 @@ class GcsServer:
             "address": d["address"],
             "port": d["port"],
             "object_store_name": d.get("object_store_name"),
+            "machine_id": d.get("machine_id"),
             "resources_total": d["resources"],
             "resources_available": dict(d["resources"]),
             "labels": d.get("labels", {}),
@@ -965,6 +967,20 @@ class GcsServer:
 
     # -- object directory ------------------------------------------------
     async def h_object_location_add(self, d, conn):
+        if d.get("partial"):
+            # An in-progress pull: the node can serve its filled prefix
+            # (chain/tree replication, reference object_manager.cc:339
+            # any-holder pulls). seq gives chain seniority: a puller may
+            # only chain to partials with a LOWER seq, which keeps the
+            # replication graph acyclic.
+            entry = self.object_dir.setdefault(
+                oid := d["object_id"], {"nodes": set(), "size": 0}
+            )
+            partial = entry.setdefault("partial", {})
+            if d["node_id"] not in partial:
+                self._partial_seq += 1
+                partial[d["node_id"]] = self._partial_seq
+            return {"ok": True, "seq": partial[d["node_id"]]}
         self._location_add(d["object_id"], d["node_id"], d.get("size"))
         return {"ok": True}
 
@@ -978,6 +994,7 @@ class GcsServer:
     def _location_add(self, oid: bytes, node_id: bytes, size):
         entry = self.object_dir.setdefault(oid, {"nodes": set(), "size": 0})
         entry["nodes"].add(node_id)
+        entry.get("partial", {}).pop(node_id, None)
         if size is not None:
             entry["size"] = size
         for ev in self.object_waiters.pop(oid, []):
@@ -989,6 +1006,14 @@ class GcsServer:
                "known": True}
         if entry.get("spilled"):
             out["spilled"] = entry["spilled"]
+        partial = entry.get("partial")
+        if partial:
+            # [node_id, seq] sorted senior-first: pullers may chain only
+            # to partials with seq lower than their own.
+            out["partial_nodes"] = sorted(
+                ([nid, seq] for nid, seq in partial.items()),
+                key=lambda x: x[1],
+            )
         return out
 
     async def h_object_location_get(self, d, conn):
@@ -1051,7 +1076,9 @@ class GcsServer:
     async def h_object_location_remove(self, d, conn):
         entry = self.object_dir.get(d["object_id"])
         if entry:
-            entry["nodes"].discard(d["node_id"])
+            entry.get("partial", {}).pop(d["node_id"], None)
+            if not d.get("partial_only"):
+                entry["nodes"].discard(d["node_id"])
         return {"ok": True}
 
     async def h_objects_freed(self, d, conn):

@@ -50,6 +50,18 @@ def pack_frame(obj: Any) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+class BinResponse:
+    """Handler return type for raw-payload responses: the header map rides
+    msgpack, the payload follows the frame as raw bytes (no msgpack copy
+    on either side — the bulk-transfer path for object chunks)."""
+
+    __slots__ = ("data", "payload")
+
+    def __init__(self, data: Any, payload):
+        self.data = data
+        self.payload = payload  # bytes / memoryview
+
+
 class FrameSender:
     """Coalesces small frames into one transport write per loop tick.
 
@@ -114,6 +126,17 @@ class FrameSender:
                 self.flush()
                 await self._writer.drain()
 
+    async def send_pair(self, frame: bytes, payload) -> None:
+        """Write a header frame + raw payload back-to-back with nothing
+        interleaved: both writes happen without a yield point under the
+        large-write lock (small sends cannot slip between either — they
+        have no await between our two write() calls)."""
+        async with self._lock:
+            self.flush()
+            self._writer.write(frame)
+            self._writer.write(payload)
+            await self._writer.drain()
+
     def _safe_flush(self) -> None:
         try:
             self.flush()
@@ -160,10 +183,16 @@ class Connection:
                 frame = await read_frame(self.reader)
                 kind = frame.get("k")
                 if kind == "resp":
+                    payload = None
+                    if frame.get("nb"):
+                        # Raw binary payload follows the header frame.
+                        payload = await self.reader.readexactly(frame["nb"])
                     fut = self._pending.pop(frame["i"], None)
                     if fut is not None and not fut.done():
                         if frame.get("e"):
                             fut.set_exception(RpcError(frame["e"]))
+                        elif payload is not None:
+                            fut.set_result((frame.get("d"), payload))
                         else:
                             fut.set_result(frame.get("d"))
                 elif kind == "push":
@@ -235,6 +264,17 @@ class ServerConnection:
         except (ConnectionError, RuntimeError):
             self.closed = True
 
+    async def respond_bin(self, cid: int, data: Any, payload):
+        """Header frame + raw payload bytes: the payload goes straight to
+        the transport — no msgpack pass over the bulk bytes."""
+        frame = pack_frame(
+            {"k": "resp", "i": cid, "d": data, "nb": len(payload)}
+        )
+        try:
+            await self._sender.send_pair(frame, payload)
+        except (ConnectionError, RuntimeError):
+            self.closed = True
+
 
 class RpcServer:
     """Dispatches method calls to registered async handlers.
@@ -259,7 +299,10 @@ class RpcServer:
         self.handlers[method] = handler
 
     async def start(self):
-        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port,
+            limit=get_config().rpc_stream_buffer_limit,
+        )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
 
@@ -303,7 +346,10 @@ class RpcServer:
         try:
             result = await handler(frame.get("d"), conn)
             if cid:
-                await conn.respond(cid, data=result)
+                if isinstance(result, BinResponse):
+                    await conn.respond_bin(cid, result.data, result.payload)
+                else:
+                    await conn.respond(cid, data=result)
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             import traceback
 
@@ -333,6 +379,9 @@ class RpcServer:
 
 async def connect(host: str, port: int, push_handler=None, timeout: float = 10.0) -> Connection:
     reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, port), timeout
+        asyncio.open_connection(
+            host, port, limit=get_config().rpc_stream_buffer_limit
+        ),
+        timeout,
     )
     return Connection(reader, writer, push_handler)

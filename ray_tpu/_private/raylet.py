@@ -92,6 +92,20 @@ class _PullByteBudget:
             fut.set_result(None)
 
 
+def _machine_id() -> str:
+    """Identity of the physical host (hostname + kernel boot id): two
+    raylets with equal machine ids share /dev/shm and can move objects by
+    direct store-to-store memcpy instead of TCP."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        boot = ""
+    import socket as _socket
+
+    return f"{_socket.gethostname()}:{boot}"
+
+
 class WorkerHandle:
     def __init__(self, proc: subprocess.Popen, worker_id: bytes,
                  runtime_env_hash: Optional[str] = None):
@@ -221,6 +235,11 @@ class Raylet:
         )
         self._push_chunk_slots = asyncio.Semaphore(cfg.push_chunk_slots)
         self._active_pulls: Dict[bytes, asyncio.Future] = {}
+        # In-progress pulls exposing their contiguous filled prefix for
+        # chained pullers: oid -> {buf, filled, total, event, failed}.
+        self._partial_pulls: Dict[bytes, dict] = {}
+        # Attached same-host peer stores (store_name -> ObjectStore).
+        self._peer_stores: Dict[str, Any] = {}
         # Open chunked remote-client puts: oid -> (buffer, abort deadline).
         self._client_creates: Dict[bytes, tuple] = {}
         # Runtime metric counters (reported as deltas on the heartbeat).
@@ -237,6 +256,7 @@ class Raylet:
         r("task_done", self.h_task_done)
         r("pull_object", self.h_pull_object)
         r("fetch_chunk", self.h_fetch_chunk)
+        r("fetch_chunk_raw", self.h_fetch_chunk_raw)
         r("wait_object_local", self.h_wait_object_local)
         r("object_created", self.h_object_created)
         r("objects_created", self.h_objects_created)
@@ -269,6 +289,7 @@ class Raylet:
                 "address": self.host,
                 "port": self.port,
                 "object_store_name": self.store_name,
+                "machine_id": _machine_id(),
                 "resources": self.resources_total,
                 "labels": self.labels,
                 "is_head": self.is_head,
@@ -1720,46 +1741,180 @@ class Raylet:
             resp = await self.gcs.call(
                 "object_location_get", {"object_id": oid_bytes}
             )
-        nodes = [n for n in resp["nodes"] if n != self.node_id.binary()]
-        if resp.get("timeout") or (not nodes and not self.store.contains_raw(oid_bytes)):
+        me = self.node_id.binary()
+        if resp.get("timeout") or (
+            not resp["nodes"] and not self.store.contains_raw(oid_bytes)
+        ):
             if self.store.contains_raw(oid_bytes):
                 return
             raise KeyError(f"object {oid_bytes.hex()} has no locations")
         if self.store.contains_raw(oid_bytes):
             return
-        last_err = None
-        for nid in nodes:
-            peer = await self._peer(nid)
-            if peer is None:
-                continue
-            try:
-                async with self._pull_slots:
-                    # Admission control bounds the TRANSFER only — holding
-                    # a slot across object_location_wait would let 8
-                    # unproduced dependencies starve ready pulls for 60s.
-                    # Byte budget on top: smallest-first under contention.
-                    size = int(resp.get("size") or 0)
-                    await self._pull_budget.acquire(size)
+        # Announce this pull as a PARTIAL location: once chunks land,
+        # other pullers may chain off our filled prefix instead of all
+        # fanning into the source (chain/tree replication; reference
+        # object_manager.cc:339 any-holder pulls). seq keeps chains
+        # acyclic: we only ever chain to partials senior to us.
+        reg = await self.gcs.call(
+            "object_location_add",
+            {"object_id": oid_bytes, "node_id": me, "partial": True},
+        )
+        my_seq = reg.get("seq")
+        progress = {
+            "buf": None, "filled": 0, "total": None,
+            "event": asyncio.Event(), "failed": False,
+        }
+        self._partial_pulls[oid_bytes] = progress
+        ok = False
+        try:
+            last_err = None
+            for attempt in range(3):
+                full = [n for n in resp["nodes"] if n != me]
+                partials = [
+                    nid for nid, seq in resp.get("partial_nodes", [])
+                    if nid != me and seq < my_seq
+                ]
+                # Same-host holders first: their store lives in the same
+                # /dev/shm, so the object moves as ONE cross-store memcpy
+                # (no TCP, no chunking) — the multi-raylet-per-host case
+                # the test clusters and single-host pods hit.
+                if get_config().same_host_shm_transfer:
+                    for nid in full:
+                        info = await self._node_info(nid)
+                        if (
+                            info
+                            and info.get("machine_id")
+                            and info.get("machine_id") == _machine_id()
+                            and info.get("object_store_name")
+                        ):
+                            try:
+                                if await self._shm_copy_from(
+                                    info["object_store_name"], oid_bytes
+                                ):
+                                    await self.gcs.call(
+                                        "object_location_add",
+                                        {"object_id": oid_bytes, "node_id": me,
+                                         "size": resp.get("size") or 0},
+                                    )
+                                    ok = True
+                                    return
+                            except Exception as e:  # noqa: BLE001
+                                last_err = e
+                for nid in full + partials:
+                    peer = await self._peer(nid)
+                    if peer is None:
+                        continue
                     try:
-                        await self._pull_from(peer, oid_bytes, size)
-                    finally:
-                        self._pull_budget.release(size)
-                await self.gcs.call(
-                    "object_location_add",
-                    {
-                        "object_id": oid_bytes,
-                        "node_id": self.node_id.binary(),
-                        "size": resp["size"],
-                    },
+                        async with self._pull_slots:
+                            # Admission control bounds the TRANSFER only —
+                            # holding a slot across object_location_wait
+                            # would let 8 unproduced dependencies starve
+                            # ready pulls for 60s. Byte budget on top:
+                            # smallest-first under contention.
+                            size = int(resp.get("size") or 0)
+                            await self._pull_budget.acquire(size)
+                            try:
+                                await self._pull_from(
+                                    peer, oid_bytes, size, progress
+                                )
+                            finally:
+                                self._pull_budget.release(size)
+                        await self.gcs.call(
+                            "object_location_add",
+                            {
+                                "object_id": oid_bytes,
+                                "node_id": me,
+                                "size": resp["size"],
+                            },
+                        )
+                        ok = True
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        last_err = e
+                # Every candidate failed (e.g. our upstream partial
+                # aborted): refresh the location view and retry.
+                resp = await self.gcs.call(
+                    "object_location_get", {"object_id": oid_bytes}
                 )
-                return
-            except Exception as e:  # noqa: BLE001
-                last_err = e
-        raise KeyError(f"failed to pull object {oid_bytes.hex()}: {last_err}")
+                if self.store.contains_raw(oid_bytes):
+                    ok = True
+                    return
+            raise KeyError(
+                f"failed to pull object {oid_bytes.hex()}: {last_err}"
+            )
+        finally:
+            self._partial_pulls.pop(oid_bytes, None)
+            progress["failed"] = not ok
+            progress["event"].set()  # wake chained servers either way
+            if not ok:
+                try:
+                    await self.gcs.call(
+                        "object_location_remove",
+                        {"object_id": oid_bytes, "node_id": me,
+                         "partial_only": True},
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
 
-    async def _pull_from(self, peer: Connection, oid_bytes: bytes, size: int):
+    async def _node_info(self, node_id: bytes) -> Optional[dict]:
+        info = self.node_cache.get(node_id)
+        if info is None:
+            resp = await self.gcs.call("get_nodes", {})
+            for n in resp["nodes"]:
+                self.node_cache[n["node_id"]] = n
+            info = self.node_cache.get(node_id)
+        return info
+
+    def _attach_peer_store(self, store_name: str):
+        st = self._peer_stores.get(store_name)
+        if st is None:
+            try:
+                st = ObjectStore(store_name)
+            except Exception:  # noqa: BLE001 — peer store gone/unreachable
+                return None
+            self._peer_stores[store_name] = st
+        return st
+
+    async def _shm_copy_from(self, store_name: str, oid_bytes: bytes) -> bool:
+        """Copy a sealed object straight out of a same-host peer's shared
+        -memory store (cross-process get/release ride the store's robust
+        shm mutex). Returns False if the peer doesn't hold it."""
+        peer_store = self._attach_peer_store(store_name)
+        if peer_store is None:
+            return False
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(oid_bytes)
+        view = peer_store.get(oid)  # refcount pin against peer eviction
+        if view is None:
+            return False
+        try:
+            total = len(view)
+            buf = await self._create_with_spill(oid, total)
+            if buf is None:
+                return True  # a concurrent pull materialized it
+            try:
+                buf[:] = view
+            except BaseException:
+                del buf
+                self.store.abort(oid)
+                raise
+            del buf
+            self.store.seal(oid)
+            self.store.release(oid)
+            return True
+        finally:
+            del view
+            peer_store.release(oid)
+
+    async def _pull_from(self, peer: Connection, oid_bytes: bytes, size: int,
+                         progress: Optional[dict] = None):
         """Chunked pull (ObjectManager::Push sends 5MiB chunks,
-        object_manager.cc:325; chunk size ray_config_def.h:362)."""
+        object_manager.cc:325; chunk size ray_config_def.h:362).
+        A WINDOW of chunk fetches rides the connection concurrently
+        (request/response round trips hide behind each other), and the
+        contiguous filled prefix is published through `progress` so
+        chained pullers can consume it mid-transfer."""
         cfg = get_config()
         from ray_tpu._private.ids import ObjectID
 
@@ -1773,22 +1928,51 @@ class Raylet:
         buf = await self._create_with_spill(oid, total)
         if buf is None:
             return  # concurrent pull is materializing it
-        try:
-            off = 0
-            chunk = cfg.object_transfer_chunk_size
-            while off < total:
-                n = min(chunk, total - off)
+        chunk = cfg.object_transfer_chunk_size
+        offsets = list(range(0, total, chunk))
+        received: set = set()
+        if progress is not None:
+            progress["buf"] = buf
+            progress["total"] = total
+        window = asyncio.Semaphore(max(1, cfg.pull_chunk_window))
+
+        async def fetch(off: int):
+            n = min(chunk, total - off)
+            async with window:
                 resp = await peer.call(
-                    "fetch_chunk",
+                    "fetch_chunk_raw",
                     {"object_id": oid_bytes, "offset": off, "size": n},
                 )
-                data = resp["data"]
-                buf[off : off + len(data)] = data
-                off += len(data)
-        except Exception:
+            data = resp[1]  # (header, raw payload)
+            if len(data) != n:
+                raise KeyError(
+                    f"short chunk at {off}: {len(data)} != {n}"
+                )
+            buf[off:off + n] = data
+            received.add(off)
+            if progress is not None:
+                # Advance the contiguous prefix; wake chained servers.
+                filled = progress["filled"]
+                while filled < total and filled in received:
+                    received.discard(filled)
+                    filled = min(filled + chunk, total)
+                progress["filled"] = filled
+                progress["event"].set()
+                progress["event"] = asyncio.Event()
+
+        try:
+            await asyncio.gather(*[fetch(off) for off in offsets])
+        except BaseException:
+            if progress is not None:
+                progress["buf"] = None
             del buf
             self.store.abort(oid)
             raise
+        if progress is not None:
+            progress["filled"] = total
+            progress["buf"] = None
+            progress["event"].set()
+            progress["event"] = asyncio.Event()
         del buf
         self.store.seal(oid)
         self.store.release(oid)
@@ -1798,27 +1982,61 @@ class Raylet:
 
         oid = ObjectID(d["object_id"])
         view = self.store.get(oid)
-        if view is None:
-            return {"ok": False, "error": "not found"}
-        size = len(view)
-        del view
-        self.store.release(oid)
-        return {"ok": True, "size": size}
+        if view is not None:
+            size = len(view)
+            del view
+            self.store.release(oid)
+            return {"ok": True, "size": size}
+        p = self._partial_pulls.get(d["object_id"])
+        if p is not None and not p["failed"] and p["total"] is not None:
+            return {"ok": True, "size": p["total"]}
+        return {"ok": False, "error": "not found"}
 
-    async def h_fetch_chunk(self, d, conn):
+    async def _read_chunk(self, oid_bytes: bytes, off: int, size: int) -> bytes:
+        """One chunk from the sealed copy or an in-progress pull's filled
+        prefix (chained replication), waiting briefly for the prefix to
+        advance."""
         from ray_tpu._private.ids import ObjectID
 
-        async with self._push_chunk_slots:  # PushManager in-flight cap
-            oid = ObjectID(d["object_id"])
+        oid = ObjectID(oid_bytes)
+        deadline = time.monotonic() + 30.0
+        while True:
             view = self.store.get(oid)
-            if view is None:
+            if view is not None:
+                # Sealed copy: serve under the PushManager in-flight cap.
+                try:
+                    async with self._push_chunk_slots:
+                        return bytes(view[off:off + size])
+                finally:
+                    del view
+                    self.store.release(oid)
+            p = self._partial_pulls.get(oid_bytes)
+            if p is None or p["failed"]:
                 raise KeyError("object evicted mid-transfer")
+            if p["buf"] is not None and p["filled"] >= off + size:
+                async with self._push_chunk_slots:
+                    return bytes(p["buf"][off:off + size])
+            if time.monotonic() > deadline:
+                raise KeyError("upstream pull stalled")
+            # Wait (OUTSIDE the chunk slots — a stalled upstream must not
+            # starve other transfers) for the prefix to advance.
+            ev = p["event"]
             try:
-                data = bytes(view[d["offset"] : d["offset"] + d["size"]])
-            finally:
-                del view
-                self.store.release(oid)
-            return {"data": data}
+                await asyncio.wait_for(ev.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+
+    async def h_fetch_chunk(self, d, conn):
+        return {"data": await self._read_chunk(
+            d["object_id"], d["offset"], d["size"])}
+
+    async def h_fetch_chunk_raw(self, d, conn):
+        """Raw-payload variant: the chunk bytes follow the response frame
+        without a msgpack pass (the raylet<->raylet bulk path)."""
+        from ray_tpu._private.protocol import BinResponse
+
+        data = await self._read_chunk(d["object_id"], d["offset"], d["size"])
+        return BinResponse({"n": len(data)}, data)
 
     # -- remote (rt://) clients -------------------------------------------
     # The reference's Ray Client (util/client/worker.py:81) proxies a

@@ -119,3 +119,91 @@ def test_cross_node_broadcast_under_flow_control():
         assert outs == [expected, expected]
     finally:
         cluster.shutdown()
+
+
+def test_broadcast_chain_tcp_path(monkeypatch):
+    """Multi-consumer broadcast over the TCP pull path (same-host shm
+    shortcut disabled): every consumer sees exact bytes while pullers may
+    chain off in-progress partial copies (VERDICT r3 item 7; reference:
+    object_manager.cc:339 any-holder pulls)."""
+    import ray_tpu._private.config as config_mod
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    monkeypatch.setenv("RT_SAME_HOST_SHM_TRANSFER", "0")
+    config_mod._config = None
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1, object_store_memory=256 * 1024 * 1024)
+    for _ in range(3):
+        cluster.add_node(num_cpus=1, object_store_memory=256 * 1024 * 1024)
+    cluster.connect()
+    try:
+        rng = np.random.default_rng(7)
+        payload = rng.standard_normal(4_000_000)  # 32MB
+        ref = rt.put(payload)
+
+        @rt.remote
+        def digest(x):
+            return float(x.sum()), x.nbytes
+
+        outs = rt.get(
+            [
+                digest.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=r.node_id.binary()
+                    )
+                ).remote(ref)
+                for r in cluster.raylets[1:]
+            ],
+            timeout=300,
+        )
+        want = float(payload.sum())
+        for s, nbytes in outs:
+            assert nbytes == payload.nbytes
+            assert abs(s - want) < 1e-6
+    finally:
+        cluster.shutdown()
+        config_mod._config = None
+
+
+def test_broadcast_same_host_shm_path():
+    """Same-machine peers move objects by direct store-to-store memcpy;
+    bytes must be exact and the location directory updated."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1, object_store_memory=256 * 1024 * 1024)
+    cluster.add_node(num_cpus=1, object_store_memory=256 * 1024 * 1024)
+    cluster.connect()
+    try:
+        rng = np.random.default_rng(11)
+        payload = rng.standard_normal(2_000_000)
+        ref = rt.put(payload)
+
+        @rt.remote
+        def digest(x):
+            return float(x.sum())
+
+        out = rt.get(
+            digest.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=cluster.raylets[1].node_id.binary()
+                )
+            ).remote(ref),
+            timeout=120,
+        )
+        assert abs(out - float(payload.sum())) < 1e-6
+        # The peer's copy is registered: a second consumer on that node
+        # reads locally.
+        from ray_tpu._private import worker as worker_mod
+
+        client = worker_mod.get_client()
+        locs = client._run(client.gcs.call(
+            "object_location_get", {"object_id": ref.id.binary()}
+        ))
+        assert len(locs["nodes"]) == 2
+    finally:
+        cluster.shutdown()
