@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
 from ray_tpu.rl.algorithms.impala import vtrace
@@ -66,7 +67,7 @@ def appo_loss(params, module, batch, gamma: float = 0.99,
 
 
 @dataclass
-class APPOConfig:
+class APPOConfig(ConfigEvalMixin):
     """Builder-style config (reference: APPOConfig)."""
 
     env_creator: Optional[Callable] = None
@@ -120,7 +121,7 @@ class APPOConfig:
         return APPO(self)
 
 
-class APPO:
+class APPO(AlgorithmBase):
     """Async actor-learner loop over vectorized samplers.
 
     Sample futures stay standing across updates (IMPALA's harvest
@@ -131,7 +132,7 @@ class APPO:
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
         spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
-        module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
+        module_factory = self._module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
 
         loss = lambda p, m, b: appo_loss(  # noqa: E731
             p, m, b, gamma=config.gamma, clip_eps=config.clip_eps,
@@ -197,14 +198,15 @@ class APPO:
             [r.episode_stats.remote() for r in self.env_runners], timeout=300
         )
         returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
-        return {
+        return self._finish_iteration({
             "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
             "episodes_total": sum(s["episodes"] for s in stats),
             **{f"learner/{k}": v for k, v in metrics.items()},
-        }
+        })
 
     def stop(self):
+        self.stop_eval_runners()
         self.learner_group.shutdown()
         for r in self.env_runners:
             try:

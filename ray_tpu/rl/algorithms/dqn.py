@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import QNetworkModule, RLModuleSpec
 from ray_tpu.rl.env_runner import TransitionEnvRunner
@@ -43,7 +44,7 @@ def dqn_loss(params, module, batch):
 
 
 @dataclass
-class DQNConfig:
+class DQNConfig(ConfigEvalMixin):
     """Builder-style config (reference: DQNConfig)."""
 
     env_creator: Optional[Callable] = None
@@ -117,14 +118,14 @@ class DQNConfig:
         return DQN(self)
 
 
-class DQN:
+class DQN(AlgorithmBase):
     """The algorithm object (reference: Algorithm; train() = one iteration)."""
 
     def __init__(self, config: DQNConfig):
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
         spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
-        module_factory = lambda: QNetworkModule(spec)  # noqa: E731
+        module_factory = self._module_factory = lambda: QNetworkModule(spec)  # noqa: E731
         self.module = module_factory()
 
         self.learner_group = LearnerGroup(
@@ -162,6 +163,13 @@ class DQN:
             weights = self.learner_group.get_weights()
         rt.get([r.set_weights.remote(weights) for r in self.env_runners],
                timeout=300)
+
+    def _checkpoint_extra_state(self):
+        return {"target_params": jax.device_get(self.target_params)}
+
+    def _restore_extra_state(self, extra):
+        if "target_params" in extra:
+            self.target_params = extra["target_params"]
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -205,16 +213,17 @@ class DQN:
             [r.episode_stats.remote() for r in self.env_runners], timeout=300
         )
         returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
-        return {
+        return self._finish_iteration({
             "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
             "episodes_total": sum(s["episodes"] for s in stats),
             "epsilon": eps,
             "buffer_size": len(self.buffer),
             **{f"learner/{k}": v for k, v in metrics.items()},
-        }
+        })
 
     def stop(self):
+        self.stop_eval_runners()
         self.learner_group.shutdown()
         for r in self.env_runners:
             try:

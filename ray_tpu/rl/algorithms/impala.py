@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
 from ray_tpu.rl.env_runner import EnvRunner
@@ -96,7 +97,7 @@ def impala_loss(params, module, batch, gamma: float = 0.99,
 
 
 @dataclass
-class IMPALAConfig:
+class IMPALAConfig(ConfigEvalMixin):
     """Builder-style config (reference: IMPALAConfig)."""
 
     env_creator: Optional[Callable] = None
@@ -149,7 +150,7 @@ class IMPALAConfig:
         return IMPALA(self)
 
 
-class IMPALA:
+class IMPALA(AlgorithmBase):
     """Async actor-learner loop.
 
     Unlike PPO's barrier (collect all -> update -> broadcast), sample
@@ -164,7 +165,7 @@ class IMPALA:
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
         spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
-        module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
+        module_factory = self._module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
 
         loss = lambda p, m, b: impala_loss(  # noqa: E731
             p, m, b, gamma=config.gamma, vf_coeff=config.vf_coeff,
@@ -225,12 +226,12 @@ class IMPALA:
             [r.episode_stats.remote() for r in self.env_runners], timeout=300
         )
         returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
-        return {
+        return self._finish_iteration({
             "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
             "episodes_total": sum(s["episodes"] for s in stats),
             **{f"learner/{k}": v for k, v in metrics.items()},
-        }
+        })
 
     def pending_rollouts(self, num: int = 1, timeout: float = 120.0):
         """Harvest up to `num` completed rollouts from the standing
@@ -248,6 +249,7 @@ class IMPALA:
         return rollouts
 
     def stop(self):
+        self.stop_eval_runners()
         self.learner_group.shutdown()
         for r in self.env_runners:
             try:

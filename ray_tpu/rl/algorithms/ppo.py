@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
 from ray_tpu.rl.env_runner import EnvRunner, compute_gae
@@ -50,7 +51,7 @@ def ppo_loss(params, module, batch):
 
 
 @dataclass
-class PPOConfig:
+class PPOConfig(ConfigEvalMixin):
     """Builder-style config (reference: AlgorithmConfig/PPOConfig)."""
 
     env_creator: Optional[Callable] = None
@@ -105,7 +106,7 @@ class PPOConfig:
         return PPO(self)
 
 
-class PPO:
+class PPO(AlgorithmBase):
     """The algorithm object (reference: Algorithm, a Tune Trainable —
     train() returns one iteration's metrics)."""
 
@@ -113,7 +114,7 @@ class PPO:
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
         spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
-        module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
+        module_factory = self._module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
 
         import optax
 
@@ -174,14 +175,15 @@ class PPO:
             [r.episode_stats.remote() for r in self.env_runners], timeout=300
         )
         returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
-        return {
+        return self._finish_iteration({
             "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
             "episodes_total": sum(s["episodes"] for s in stats),
             **{f"learner/{k}": v for k, v in metrics.items()},
-        }
+        })
 
     def stop(self):
+        self.stop_eval_runners()
         self.learner_group.shutdown()
         for r in self.env_runners:
             try:

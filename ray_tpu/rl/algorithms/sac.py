@@ -19,6 +19,7 @@ import numpy as np
 import optax
 
 import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.rl_module import (
     ContinuousModuleSpec,
     ContinuousPolicyModule,
@@ -120,7 +121,7 @@ def make_sac_update(module: ContinuousPolicyModule, pi_tx, q_tx, alpha_tx,
 
 
 @dataclass
-class SACConfig:
+class SACConfig(ConfigEvalMixin):
     """Builder-style config (reference: SACConfig)."""
 
     env_creator: Optional[Callable] = None
@@ -175,7 +176,7 @@ class SACConfig:
         return SAC(self)
 
 
-class SAC:
+class SAC(AlgorithmBase):
     """Off-policy actor-critic loop: collect -> replay -> jitted updates."""
 
     def __init__(self, config: SACConfig):
@@ -186,7 +187,7 @@ class SAC:
             config.action_low, config.action_high, config.hidden,
         )
         self.module = ContinuousPolicyModule(spec)
-        module_factory = lambda s=spec: ContinuousPolicyModule(s)  # noqa: E731
+        module_factory = self._module_factory = lambda s=spec: ContinuousPolicyModule(s)  # noqa: E731
 
         params = self.module.init(jax.random.PRNGKey(config.seed))
         pi_tx = optax.adam(config.lr)
@@ -232,6 +233,23 @@ class SAC:
         rt.get([r.set_weights.remote(weights) for r in self.env_runners],
                timeout=300)
 
+    # AlgorithmBase state hooks: the whole SAC update state (params,
+    # targets, temperature, all three optimizers) is one pytree.
+    def _get_learner_state(self):
+        return jax.device_get(self.state)
+
+    def _set_learner_state(self, state):
+        self.state = jax.tree.map(jnp.asarray, state)
+
+    def _current_weights(self):
+        return jax.device_get(self.state["params"])
+
+    def _checkpoint_extra_state(self):
+        return {"steps_sampled": self._steps_sampled}
+
+    def _restore_extra_state(self, extra):
+        self._steps_sampled = extra.get("steps_sampled", self._steps_sampled)
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         warm = self._steps_sampled < cfg.warmup_steps
@@ -260,15 +278,16 @@ class SAC:
             [r.episode_stats.remote() for r in self.env_runners], timeout=300
         )
         returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
-        return {
+        return self._finish_iteration({
             "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
             "episodes_total": sum(s["episodes"] for s in stats),
             "steps_sampled": self._steps_sampled,
             **{f"learner/{k}": v for k, v in metrics.items()},
-        }
+        })
 
     def stop(self):
+        self.stop_eval_runners()
         for r in self.env_runners:
             try:
                 rt.kill(r)

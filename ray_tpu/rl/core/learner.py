@@ -72,6 +72,18 @@ class Learner:
     def set_weights(self, weights):
         self.params = weights
 
+    def get_state(self) -> Dict:
+        """Full optimizer-inclusive state for Algorithm.save (reference:
+        learner.py get_state: module weights + optimizer state)."""
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state: Dict):
+        self.params = state["params"]
+        self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+
 
 def minibatch_epochs(update_fn, batch, num_epochs: int, minibatch_size: int,
                      rng) -> Dict:

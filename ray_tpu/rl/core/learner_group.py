@@ -57,6 +57,13 @@ class _LearnerActor:
         self.learner.set_weights(weights)
         return True
 
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, state):
+        self.learner.set_state(state)
+        return True
+
 
 class LearnerGroup:
     def __init__(
@@ -108,6 +115,14 @@ class LearnerGroup:
 
     def set_weights(self, weights):
         rt.get([a.set_weights.remote(weights) for a in self.actors], timeout=300)
+
+    def get_state(self):
+        """Optimizer-inclusive learner state (rank 0; replicas are
+        identical under data-parallel updates)."""
+        return rt.get(self.actors[0].get_state.remote(), timeout=300)
+
+    def set_state(self, state):
+        rt.get([a.set_state.remote(state) for a in self.actors], timeout=300)
 
     def shutdown(self):
         for a in self.actors:

@@ -120,6 +120,45 @@ class _EnvRunnerBase:
     def episode_stats(self) -> Dict[str, Any]:
         return self._tracker.stats()
 
+    # -- checkpoint support (Algorithm.save/restore) ---------------------
+    def get_runner_state(self) -> Dict[str, Any]:
+        """Everything needed to resume sampling bit-exactly: RNG key,
+        current observation (raw + connected — reconnecting would
+        double-count stateful connector statistics), episode tracker,
+        connector pipeline, and the env itself when it pickles."""
+        import cloudpickle
+
+        state = {
+            "rng": np.asarray(self.rng),
+            "obs": self._obs,
+            "obs_conn": self._obs_conn,
+            "tracker": cloudpickle.dumps(self._tracker),
+            "connectors": (cloudpickle.dumps(self.connectors)
+                           if self.connectors is not None else None),
+        }
+        try:
+            state["env"] = cloudpickle.dumps(self.env)
+        except Exception:  # noqa: BLE001 — unpicklable env: fresh on restore
+            state["env"] = None
+        return state
+
+    def set_runner_state(self, state: Dict[str, Any]):
+        import cloudpickle
+        import jax.numpy as jnp
+
+        self.rng = jnp.asarray(state["rng"])
+        self._tracker = cloudpickle.loads(state["tracker"])
+        if state.get("connectors") is not None:
+            self.connectors = cloudpickle.loads(state["connectors"])
+        if state.get("env") is not None:
+            try:
+                self.env = cloudpickle.loads(state["env"])
+            except Exception:  # noqa: BLE001 — keep the fresh env
+                pass
+        self._obs = state.get("obs")
+        self._obs_conn = state.get("obs_conn")
+        return True
+
 
 @rt.remote
 class EnvRunner(_EnvRunnerBase):
