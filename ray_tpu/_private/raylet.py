@@ -2499,6 +2499,7 @@ def main():  # pragma: no cover - run as subprocess
     p.add_argument("--resources", default="{}")
     p.add_argument("--object-store-memory", type=int, default=None)
     p.add_argument("--head", action="store_true")
+    p.add_argument("--labels", default="{}")
     args = p.parse_args()
 
     import json
@@ -2515,6 +2516,7 @@ def main():  # pragma: no cover - run as subprocess
             resources,
             object_store_memory=args.object_store_memory,
             is_head=args.head,
+            labels=json.loads(args.labels),
         )
         port = await raylet.start()
         print(f"RAYLET_PORT={port}", flush=True)

@@ -1,11 +1,13 @@
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
     FakeMultiNodeProvider,
+    ProcessNodeProvider,
     NodeProvider,
 )
 from ray_tpu.autoscaler.v2 import (  # noqa: F401
     Instance,
     InstanceManager,
+    Monitor,
     Reconciler,
     Scheduler,
 )

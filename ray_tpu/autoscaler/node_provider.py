@@ -92,6 +92,83 @@ class FakeMultiNodeProvider(NodeProvider):
         }
 
 
+class ProcessNodeProvider(NodeProvider):
+    """Launches each node as a real raylet SUBPROCESS against a live GCS —
+    the reference's fake_multi_node pattern
+    (autoscaler/_private/fake_multi_node/node_provider.py): full process
+    isolation, so autoscaler e2e tests exercise the same join/heartbeat/
+    death paths a real cloud node takes."""
+
+    def __init__(self, gcs_host: str, gcs_port: int):
+        self.gcs_host, self.gcs_port = gcs_host, gcs_port
+        self._nodes: Dict[str, dict] = {}  # provider id -> {proc, type, node_id}
+        self._counter = 0
+
+    def create_node(self, node_type: str, node_config: Dict, count: int) -> List[str]:
+        import json
+        import subprocess
+        import sys
+
+        created = []
+        for _ in range(count):
+            self._counter += 1
+            pid = f"proc-{node_type}-{self._counter}"
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "ray_tpu._private.raylet",
+                    "--gcs-host", self.gcs_host,
+                    "--gcs-port", str(self.gcs_port),
+                    "--resources",
+                    json.dumps(node_config.get("resources", {"CPU": 1})),
+                    "--labels", json.dumps({"rt-node-type": node_type}),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            node_id = None
+            for line in proc.stdout:  # startup banner
+                if line.startswith("RAYLET_NODE_ID="):
+                    node_id = line.strip().split("=", 1)[1]
+                if line.startswith("RAYLET_STORE="):
+                    break
+            self._nodes[pid] = {"proc": proc, "type": node_type,
+                                "node_id": node_id}
+            created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        node = self._nodes.pop(provider_node_id, None)
+        if node is None:
+            return
+        node["proc"].terminate()
+        try:
+            node["proc"].wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            node["proc"].kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        # A crashed raylet process counts as terminated (cloud-instance
+        # failure surface the reconciler must observe).
+        return [
+            pid for pid, n in self._nodes.items()
+            if n["proc"].poll() is None
+        ]
+
+    def node_tags(self, provider_node_id: str) -> Dict[str, str]:
+        node = self._nodes.get(provider_node_id)
+        if node is None:
+            return {}
+        return {
+            "rt-node-type": node["type"],
+            "rt-node-id": node["node_id"] or "",
+        }
+
+    def shutdown(self):
+        for pid in list(self._nodes):
+            self.terminate_node(pid)
+
+
 class GoogleCloudTransport:  # pragma: no cover - needs GCP network
     """Default HTTP transport for GKETPUNodeProvider: Bearer-token REST
     calls against the container/compute APIs, token from the GCE metadata

@@ -418,3 +418,36 @@ class Reconciler:
             t = out.setdefault(inst.node_type, {})
             t[inst.status] = t.get(inst.status, 0) + 1
         return out
+
+
+class Monitor:
+    """Background autoscaling loop: runs reconciler ticks on a daemon
+    thread (the reference's monitor.py process role — here a thread owned
+    by whoever starts autoscaling, typically the head node)."""
+
+    def __init__(self, reconciler: "Reconciler", interval_s: float = 1.0):
+        import threading
+
+        self.reconciler = reconciler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._errors: list = []
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="rt-autoscaler-v2"
+        )
+
+    def start(self) -> "Monitor":
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.reconciler.step()
+            except Exception as e:  # noqa: BLE001 — keep scaling
+                self._errors.append(f"{type(e).__name__}: {e}")
+                del self._errors[:-20]
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)

@@ -252,3 +252,75 @@ def test_v2_reconciler_against_live_cluster():
         )
     finally:
         cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_process_provider_monitor_e2e():
+    """VERDICT r3 item 9: a fake provider launching REAL raylet
+    subprocesses, driven by the background Monitor loop (no manual
+    stepping): infeasible demand -> scale-up -> process node joins ->
+    task schedules -> idle scale-down terminates the process (reference:
+    autoscaler/_private/fake_multi_node/)."""
+    import time
+
+    import ray_tpu as rt
+    from ray_tpu.autoscaler import Monitor, ProcessNodeProvider
+    from ray_tpu.autoscaler.v2 import GcsRayState, gcs_demands
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    provider = None
+    monitor = None
+    try:
+        provider = ProcessNodeProvider("127.0.0.1", cluster.gcs_port)
+        client = rt._worker.get_client()
+
+        def gcs_call(method, payload):
+            return client._run(client._gcs_call(method, payload))
+
+        im = InstanceManager()
+        rec = Reconciler(
+            im, provider,
+            {"worker": {"resources": {"CPU": 2}, "max_workers": 2}},
+            ray_state_fn=GcsRayState(provider, gcs_call),
+            demands_fn=gcs_demands(gcs_call),
+            idle_timeout_s=2.0,
+        )
+        monitor = Monitor(rec, interval_s=0.5).start()
+
+        @rt.remote(num_cpus=2)
+        def heavy():
+            time.sleep(0.3)
+            return 11
+
+        ref = heavy.remote()  # infeasible on the 1-CPU head
+        # The monitor must scale up on its own and the task must land on
+        # the subprocess node.
+        assert rt.get(ref, timeout=90) == 11
+        assert any(i.status == v2.RAY_RUNNING for i in im.instances()), (
+            rec.report()
+        )
+        live_pids = provider.non_terminated_nodes()
+        assert live_pids, "expected a live subprocess node"
+
+        # Idle past the timeout: the monitor terminates the process node.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            insts = im.instances()
+            if insts and all(i.status == v2.TERMINATED for i in insts) and (
+                not provider.non_terminated_nodes()
+            ):
+                break
+            time.sleep(0.4)
+        assert all(i.status == v2.TERMINATED for i in im.instances()), (
+            rec.report()
+        )
+        assert not provider.non_terminated_nodes()
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if provider is not None:
+            provider.shutdown()
+        cluster.shutdown()
