@@ -107,6 +107,12 @@ class Config:
     # -- raylet loops -----------------------------------------------------
     # Dead-worker reap / stale-client-create sweep period.
     reap_interval_s: float = 0.2
+    # Workers whose /proc stats are read per heartbeat tick (round-robin
+    # window: observability stays O(1)/tick on many-worker nodes).
+    proc_stats_sample_max: int = 64
+    # Concurrent worker interpreter boots per node (actor-creation burst
+    # throttle; an unbounded fork storm starves heartbeats).
+    worker_boot_concurrency: int = 16
     # Forced dispatch rescan period while tasks wait on resources.
     dispatch_rescan_interval_s: float = 0.1
     # How long a failed runtime env is remembered before retrying builds.

@@ -240,6 +240,12 @@ class Raylet:
         self._partial_pulls: Dict[bytes, dict] = {}
         # Attached same-host peer stores (store_name -> ObjectStore).
         self._peer_stores: Dict[str, Any] = {}
+        self._proc_stats_cursor = 0  # round-robin /proc sampling window
+        # Bounds concurrent worker interpreter boots (actor creation
+        # bursts) so the raylet loop keeps heartbeating under fork storms.
+        self._boot_gate = asyncio.Semaphore(
+            max(1, get_config().worker_boot_concurrency)
+        )
         # Open chunked remote-client puts: oid -> (buffer, abort deadline).
         self._client_creates: Dict[bytes, tuple] = {}
         # Runtime metric counters (reported as deltas on the heartbeat).
@@ -552,7 +558,18 @@ class Raylet:
         running — a thread mid-call cannot be stopped. Workers are already
         reused across tasks of a job; a torn-down actor has the same
         contamination surface."""
-        if not get_config().actor_worker_recycle or w.port is None:
+        cfg = get_config()
+        if not cfg.actor_worker_recycle or w.port is None:
+            return False
+        # Only recycle while the idle pool is short: a 1000-actor teardown
+        # must not strand 1000 idle interpreters (and their per-worker
+        # release RPCs) — beyond the pool target the process just dies.
+        n_pooled = sum(
+            1 for x in self.workers.values()
+            if x.actor_id is None and x.runtime_env_hash is None
+            and x.lease_resources is None and x.idle
+        )
+        if n_pooled >= max(cfg.worker_pool_min_idle, 1) * 2:
             return False
         try:
             # w.conn is the worker->raylet push channel (ServerConnection,
@@ -708,11 +725,20 @@ class Raylet:
     # -- memory monitor / OOM policy --------------------------------------
     def _sample_proc_stats(self):
         """Per-worker CPU%% + RSS from /proc (the reference's per-process
-        native stats role, src/ray/stats/; sampled each heartbeat)."""
+        native stats role, src/ray/stats/; sampled each heartbeat).
+        Bounded per tick: at most proc_stats_sample_max workers are read
+        per pass (round-robin), so observability cost stays O(1) per tick
+        however many workers the node hosts."""
         page = os.sysconf("SC_PAGE_SIZE")
         hz = os.sysconf("SC_CLK_TCK")
         now = time.monotonic()
-        for w in self.workers.values():
+        workers = list(self.workers.values())
+        cap = get_config().proc_stats_sample_max
+        if len(workers) > cap:
+            start = self._proc_stats_cursor % len(workers)
+            self._proc_stats_cursor = (start + cap) % len(workers)
+            workers = (workers + workers)[start:start + cap]
+        for w in workers:
             pid = getattr(w.proc, "pid", None)
             if pid is None:
                 continue
@@ -847,6 +873,15 @@ class Raylet:
             except Exception:  # noqa: BLE001
                 pass
 
+    @staticmethod
+    def _set_actor_fields(w: WorkerHandle, payload, resources, sched, bundle):
+        w.actor_id = payload["actor_id"]
+        w.actor_resources = dict(resources)
+        w.actor_bundle = (
+            (sched["pg_id"], sched.get("bundle_index") or 0)
+            if bundle is not None else None
+        )
+
     async def _create_actor_worker(self, payload):
         """Spawn a dedicated worker for an actor and hand it the create spec.
 
@@ -874,15 +909,29 @@ class Raylet:
         w = self._idle_worker(renv.get("hash") if renv else None)
         if w is not None:
             w.idle = False
+            self._replenish_idle_pool()
+            self._set_actor_fields(w, payload, resources, sched, bundle)
         else:
-            w = self._spawn_worker(renv)
-            w.idle = False
-        self._replenish_idle_pool()
-        w.actor_id = payload["actor_id"]
-        w.actor_resources = dict(resources)
-        w.actor_bundle = (sched["pg_id"], sched.get("bundle_index") or 0) if bundle is not None else None
-        # Wait for registration, then push the creation task. The budget
-        # covers runtime-env download/extraction in the starting worker.
+            # Fork under the boot gate: a 1000-actor burst must not start
+            # 1000 interpreter boots at once — unbounded boots starve the
+            # raylet loop long enough for the GCS to declare the NODE dead
+            # (health check timeout). K boots in flight keeps heartbeats
+            # flowing; queued creations wait their turn.
+            async with self._boot_gate:
+                w = self._spawn_worker(renv)
+                w.idle = False
+                self._set_actor_fields(w, payload, resources, sched, bundle)
+                self._replenish_idle_pool()
+                # Wait for registration INSIDE the gate (the boot is the
+                # resource being bounded). Budget covers runtime-env
+                # download/extraction in the starting worker.
+                try:
+                    await asyncio.wait_for(
+                        w.registered.wait(),
+                        get_config().worker_register_timeout_s,
+                    )
+                except asyncio.TimeoutError:
+                    pass
         if w.conn is None and w.worker_id in self.workers:
             try:
                 await asyncio.wait_for(

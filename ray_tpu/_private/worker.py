@@ -1462,26 +1462,53 @@ class CoreClient:
             "max_concurrency": max_concurrency,
             "runtime_env": resolved_env,
         }
-        resp = self._run(
-            self._gcs_call(
-                "register_actor",
-                {
-                    "actor_id": actor_id.binary(),
-                    "name": name,
-                    "namespace": namespace,
-                    "class_name": getattr(cls, "__name__", str(cls)),
-                    "job_id": self.job_id.binary(),
-                    "resources": resources if resources is not None else {"CPU": 1.0},
-                    "max_restarts": max_restarts,
-                    "create_spec": create_spec,
-                    "detached": detached,
-                    "scheduling": scheduling,
-                    "subscribe": True,  # bundle the actor_update sub
-                },
-            )
-        )
-        if not resp.get("ok"):
-            raise ValueError(resp.get("error", "actor registration failed"))
+        reg_payload = {
+            "actor_id": actor_id.binary(),
+            "name": name,
+            "namespace": namespace,
+            "class_name": getattr(cls, "__name__", str(cls)),
+            "job_id": self.job_id.binary(),
+            "resources": resources if resources is not None else {"CPU": 1.0},
+            "max_restarts": max_restarts,
+            "create_spec": create_spec,
+            "detached": detached,
+            "scheduling": scheduling,
+            "subscribe": True,  # bundle the actor_update sub
+        }
+        if name:
+            # Named actors keep the synchronous duplicate-name check
+            # (reference: .remote() raises ValueError on a taken name).
+            resp = self._run(self._gcs_call("register_actor", reg_payload))
+            if not resp.get("ok"):
+                raise ValueError(resp.get("error", "actor registration failed"))
+        else:
+            # Unnamed: registration pipelines — the handle returns
+            # immediately and a burst of creations overlaps GCS
+            # scheduling/forking with the driver's loop (reference: actor
+            # creation is asynchronous, gcs_actor_manager.cc). Failures
+            # surface as DEAD on the first call.
+            async def _register():
+                try:
+                    resp = await self._gcs_call("register_actor", reg_payload)
+                    err = None if resp.get("ok") else resp.get(
+                        "error", "actor registration failed")
+                except Exception as e:  # noqa: BLE001
+                    err = f"{type(e).__name__}: {e}"
+                if err is not None:
+                    self._actor_cache[actor_id.binary()] = {
+                        "actor_id": actor_id.binary(),
+                        "state": "DEAD",
+                        "address": None, "port": None, "node_id": None,
+                        "name": None, "namespace": namespace,
+                        "class_name": reg_payload["class_name"],
+                        "death_cause": err, "restarts_used": 0,
+                        "methods": [],
+                    }
+                    ev = self._actor_events.get(actor_id.binary())
+                    if ev is not None:
+                        ev.set()
+
+            asyncio.run_coroutine_threadsafe(_register(), self.loop)
         self._subscribed_channels.add("actor_update:" + actor_id.hex())
         method_names = [
             m
@@ -1500,6 +1527,17 @@ class CoreClient:
         info = self._actor_cache.get(aid)
         if info is None or info["state"] not in ("ALIVE", "DEAD"):
             info = self._run(self._gcs_call("get_actor", {"actor_id": aid}))["actor"]
+            if info is not None:
+                self._actor_cache[aid] = info
+        if info is None:
+            # Pipelined (unnamed) registration may still be in flight:
+            # poll briefly before declaring the actor unknown.
+            reg_deadline = time.monotonic() + 5.0
+            while info is None and time.monotonic() < reg_deadline:
+                time.sleep(0.02)
+                info = self._actor_cache.get(aid) or self._run(
+                    self._gcs_call("get_actor", {"actor_id": aid})
+                )["actor"]
             if info is not None:
                 self._actor_cache[aid] = info
         if info is None:
