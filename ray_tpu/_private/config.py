@@ -92,11 +92,37 @@ class Config:
     # Dial timeout for raylet->raylet peer connections (short: waiters
     # queue behind the per-peer lock, so a blackholed peer must fail fast).
     peer_dial_timeout_s: float = 2.0
+    # Dial timeout when reconnecting to a (possibly restarting) GCS.
+    gcs_reconnect_dial_timeout_s: float = 2.0
+    # Backoff between GCS redial attempts.
+    gcs_reconnect_backoff_s: float = 0.5
+    # Default timeout for ordinary GCS table/KV operations.
+    gcs_op_timeout_s: float = 120.0
+    # Dial timeout for raylet->local-worker control connections.
+    worker_dial_timeout_s: float = 2.0
 
     # -- client ----------------------------------------------------------
     # Probe period for blocking gets on remote objects (reference:
     # fetch_warn_timeout_milliseconds family).
     get_probe_interval_s: float = 5.0
+    # Timeout resolving a store-argument dependency inside a worker.
+    arg_fetch_timeout_s: float = 60.0
+    # Timeout for the owner's batched free_objects RPC.
+    free_objects_timeout_s: float = 30.0
+    # Timeout for spill_objects round trips under store pressure, and the
+    # backoff when nothing was spillable.
+    spill_rpc_timeout_s: float = 120.0
+    spill_retry_backoff_s: float = 0.25
+    # Worker-lease RPCs (grant/release; reference lease RPC deadline).
+    lease_rpc_timeout_s: float = 10.0
+    # Idle-lease reaper tick.
+    lease_reap_interval_s: float = 0.5
+    # Actor-call retry backoff (per attempt, capped).
+    actor_retry_backoff_s: float = 0.2
+    actor_retry_backoff_max_s: float = 2.0
+    # How long the first call waits for a pipelined (unnamed-actor)
+    # registration still in flight before declaring the actor unknown.
+    actor_register_wait_s: float = 5.0
     # In-process memory store bound (memory_store.h analog).
     memory_store_max_entries: int = 8192
     # Owner-side lineage table bound (lineage eviction).
@@ -121,6 +147,8 @@ class Config:
     infeasible_warn_s: float = 30.0
     # Abort an open chunked remote-client put after this long.
     client_create_ttl_s: float = 600.0
+    # Per-RPC timeout for remote (rt://) client store operations.
+    remote_client_op_timeout_s: float = 120.0
 
     # -- gcs --------------------------------------------------------------
     # Snapshot debounce for GCS persistence (RT_GCS_PERSIST_PATH).
@@ -172,6 +200,14 @@ class Config:
     # Same-machine peers move objects by direct store-to-store memcpy
     # through /dev/shm instead of TCP chunks.
     same_host_shm_transfer: bool = True
+    # How long a chunk server waits for an in-progress (partial) pull's
+    # prefix to advance before failing the chained consumer over to
+    # another holder.
+    chunk_serve_wait_s: float = 30.0
+    # Timeout for the recycle handshake with a killed actor's worker.
+    release_actor_timeout_s: float = 2.0
+    # Worker-side task-event flush period (batched to the GCS).
+    task_event_flush_interval_s: float = 1.0
 
     # -- wire protocol ---------------------------------------------------
     # Frames at/above this size bypass coalescing and await drain.
@@ -182,6 +218,19 @@ class Config:
     # chunk size or readexactly() of a bulk chunk thrashes the
     # transport's pause/resume flow control (asyncio default is 64KiB).
     rpc_stream_buffer_limit: int = 32 * 1024 * 1024
+
+    # -- serve ------------------------------------------------------------
+    # Controller reconcile tick (replica health, autoscaling, proxies).
+    serve_reconcile_interval_s: float = 0.5
+    # Consecutive failed health probes before a replica is replaced.
+    serve_health_fail_threshold: int = 3
+
+    # -- data -------------------------------------------------------------
+    # Undelivered blocks buffered per streaming_split consumer before the
+    # producer stalls (per-split backpressure).
+    data_split_queue_depth: int = 4
+    # Streaming-executor concurrency budget = cluster CPUs x this factor.
+    data_cpu_budget_factor: float = 2.0
 
     # -- collective -----------------------------------------------------
     collective_rendezvous_timeout_s: float = 60.0

@@ -315,7 +315,8 @@ class Raylet:
             try:
                 gcs = await connect(
                     self.gcs_host, self.gcs_port,
-                    push_handler=self._on_gcs_push, timeout=2.0,
+                    push_handler=self._on_gcs_push,
+                    timeout=get_config().gcs_reconnect_dial_timeout_s,
                 )
                 await self._register_with_gcs(gcs)
                 self.gcs = gcs
@@ -576,9 +577,13 @@ class Raylet:
             # no request/response); dial the worker's own RPC port (cached
             # across recycles).
             if w.dial is None or w.dial._closed:
-                w.dial = await connect("127.0.0.1", w.port, timeout=2.0)
+                w.dial = await connect(
+                    "127.0.0.1", w.port,
+                    timeout=cfg.worker_dial_timeout_s,
+                )
             r = await asyncio.wait_for(
-                w.dial.call("release_actor", {"actor_id": aid}), 2.0
+                w.dial.call("release_actor", {"actor_id": aid}),
+                cfg.release_actor_timeout_s,
             )
         except Exception:  # noqa: BLE001 — worker wedged; kill it
             return False
@@ -2048,7 +2053,7 @@ class Raylet:
         from ray_tpu._private.ids import ObjectID
 
         oid = ObjectID(oid_bytes)
-        deadline = time.monotonic() + 30.0
+        deadline = time.monotonic() + get_config().chunk_serve_wait_s
         while True:
             view = self.store.get(oid)
             if view is not None:

@@ -352,13 +352,14 @@ class CoreClient:
             while True:
                 try:
                     gcs = await connect(
-                        *self.gcs_addr, push_handler=self._on_push, timeout=2.0
+                        *self.gcs_addr, push_handler=self._on_push,
+                        timeout=get_config().gcs_reconnect_dial_timeout_s
                     )
                     break
                 except Exception:  # noqa: BLE001
                     if time.monotonic() > deadline:
                         raise ConnectionLost("GCS unreachable after restart")
-                    await asyncio.sleep(0.5)
+                    await asyncio.sleep(get_config().gcs_reconnect_backoff_s)
             for ch in list(self._subscribed_channels):
                 try:
                     await gcs.call("subscribe", {"channel": ch})
@@ -485,7 +486,8 @@ class CoreClient:
             if to_free:
                 try:
                     await self.raylet.call(
-                        "free_objects", {"object_ids": to_free}, timeout=30
+                        "free_objects", {"object_ids": to_free},
+                        timeout=get_config().free_objects_timeout_s
                     )
                 except Exception:  # noqa: BLE001 — eviction backstops
                     pass
@@ -567,18 +569,22 @@ class CoreClient:
         r = self._run(
             self._gcs_call(
                 "kv_put", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
-            )
+            ),
+            timeout=get_config().gcs_op_timeout_s,
         )
         return r["added"]
 
     def kv_get(self, key: bytes, ns: str = "") -> Optional[bytes]:
-        return self._run(self._gcs_call("kv_get", {"ns": ns, "key": key}))["value"]
+        return self._run(self._gcs_call("kv_get", {"ns": ns, "key": key}),
+                         timeout=get_config().gcs_op_timeout_s)["value"]
 
     def kv_del(self, key: bytes, ns: str = "") -> bool:
-        return self._run(self._gcs_call("kv_del", {"ns": ns, "key": key}))["deleted"]
+        return self._run(self._gcs_call("kv_del", {"ns": ns, "key": key}),
+                         timeout=get_config().gcs_op_timeout_s)["deleted"]
 
     def kv_keys(self, prefix: bytes = b"", ns: str = "") -> List[bytes]:
-        return self._run(self._gcs_call("kv_keys", {"ns": ns, "prefix": prefix}))["keys"]
+        return self._run(self._gcs_call("kv_keys", {"ns": ns, "prefix": prefix}),
+                         timeout=get_config().gcs_op_timeout_s)["keys"]
 
     # -- serialization helpers -------------------------------------------
     def serialize_args(self, args, kwargs) -> Tuple[bytes, List[bytes], List[bytes]]:
@@ -673,7 +679,10 @@ class CoreClient:
         if isinstance(a, _InlineArg):
             return a.value
         if isinstance(a, _StoreArg):
-            return self.get([ObjectRef(ObjectID(a.oid))], timeout=60.0)[0]
+            return self.get(
+            [ObjectRef(ObjectID(a.oid))],
+            timeout=get_config().arg_fetch_timeout_s,
+        )[0]
         return a
 
     def promote_ref(self, ref: ObjectRef):
@@ -717,11 +726,12 @@ class CoreClient:
             except ObjectStoreFullError:
                 if attempt == attempts - 1:
                     raise
-                r = self._run(self.raylet.call("spill_objects", {}), timeout=120)
+                r = self._run(self.raylet.call("spill_objects", {}),
+                              timeout=get_config().spill_rpc_timeout_s)
                 if not r.get("spilled"):
                     # Nothing spillable right now — concurrent writers may
                     # finish (and become spillable) shortly.
-                    time.sleep(0.25)
+                    time.sleep(get_config().spill_retry_backoff_s)
         if wrote:
             self._queue_object_created(oid.binary(), so.total_size)
         return wrote
@@ -902,7 +912,7 @@ class CoreClient:
             r = self._run(
                 self.raylet.call(
                     "client_put", {"object_id": oid.binary(), "data": data},
-                    timeout=120,
+                    timeout=get_config().remote_client_op_timeout_s,
                 )
             )
             return bool(r.get("ok"))
@@ -910,7 +920,7 @@ class CoreClient:
             self.raylet.call(
                 "client_create",
                 {"object_id": oid.binary(), "size": len(data)},
-                timeout=120,
+                timeout=get_config().remote_client_op_timeout_s,
             )
         )
         if not r.get("ok"):
@@ -924,7 +934,7 @@ class CoreClient:
                     "client_put_chunk",
                     {"object_id": oid.binary(), "offset": off,
                      "data": bytes(view[off:off + chunk])},
-                    timeout=120,
+                    timeout=get_config().remote_client_op_timeout_s,
                 )
             )
             if not r.get("ok"):
@@ -933,7 +943,7 @@ class CoreClient:
             self.raylet.call(
                 "client_seal",
                 {"object_id": oid.binary(), "size": len(data)},
-                timeout=120,
+                timeout=get_config().remote_client_op_timeout_s,
             )
         )
         return bool(r.get("ok"))
@@ -953,7 +963,7 @@ class CoreClient:
                 info = self._run(
                     self.raylet.call(
                         "client_get_info", {"object_id": oid.binary()},
-                        timeout=120,
+                        timeout=get_config().remote_client_op_timeout_s,
                     )
                 )
                 if not info.get("ok"):
@@ -971,7 +981,7 @@ class CoreClient:
                             "fetch_chunk",
                             {"object_id": oid.binary(), "offset": off,
                              "size": n},
-                            timeout=120,
+                            timeout=get_config().remote_client_op_timeout_s,
                         )
                     )
                     parts.append(r["data"])
@@ -1286,7 +1296,7 @@ class CoreClient:
                         "runtime_env_hash": spec.get("runtime_env_hash"),
                         "runtime_env": spec.get("runtime_env"),
                     },
-                    timeout=10,
+                    timeout=cfg.lease_rpc_timeout_s,
                 )
                 if resp.get("status") == "ok":
                     try:
@@ -1296,7 +1306,8 @@ class CoreClient:
                         # raylet's resources leak until our conn dies.
                         await self.raylet.call(
                             "release_lease",
-                            {"worker_id": resp["worker_id"]}, timeout=5,
+                            {"worker_id": resp["worker_id"]},
+                            timeout=cfg.lease_rpc_timeout_s,
                         )
                         raise
                     w = {
@@ -1321,7 +1332,7 @@ class CoreClient:
         """Return idle leases so the raylet can schedule other work."""
         try:
             while self._connected:
-                await asyncio.sleep(0.5)
+                await asyncio.sleep(get_config().lease_reap_interval_s)
                 now = time.monotonic()
                 for pool in self._leases.values():
                     # Partition synchronously FIRST: once an idle worker
@@ -1348,7 +1359,8 @@ class CoreClient:
     async def _release_lease(self, w):
         try:
             await self.raylet.call(
-                "release_lease", {"worker_id": w["worker_id"]}, timeout=5
+                "release_lease", {"worker_id": w["worker_id"]},
+                timeout=get_config().lease_rpc_timeout_s
             )
         except Exception:  # noqa: BLE001
             pass
@@ -1532,7 +1544,7 @@ class CoreClient:
         if info is None:
             # Pipelined (unnamed) registration may still be in flight:
             # poll briefly before declaring the actor unknown.
-            reg_deadline = time.monotonic() + 5.0
+            reg_deadline = time.monotonic() + get_config().actor_register_wait_s
             while info is None and time.monotonic() < reg_deadline:
                 time.sleep(0.02)
                 info = self._actor_cache.get(aid) or self._run(
@@ -1746,7 +1758,11 @@ class CoreClient:
                 self._actor_cache.pop(actor_id.binary(), None)
                 if attempt < retries:
                     attempt += 1
-                    await asyncio.sleep(min(0.2 * attempt, 2.0))
+                    cfg = get_config()
+                    await asyncio.sleep(min(
+                        cfg.actor_retry_backoff_s * attempt,
+                        cfg.actor_retry_backoff_max_s,
+                    ))
                     continue
                 self._release_borrows(spec)
                 err = ActorUnavailableError(

@@ -266,7 +266,7 @@ class WorkerProcess:
 
     async def _flush_events_loop(self):
         while True:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(get_config().task_event_flush_interval_s)
             if self._task_events:
                 events, self._task_events = self._task_events, []
                 try:

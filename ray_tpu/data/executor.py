@@ -289,7 +289,11 @@ class StreamingExecutor:
                 cpus = rt.cluster_resources().get("CPU", 4)
             except Exception:  # noqa: BLE001
                 cpus = 4
-            self._cpu_budget = max(int(cpus * 2), 4)
+            from ray_tpu._private.config import get_config
+
+            self._cpu_budget = max(
+                int(cpus * get_config().data_cpu_budget_factor), 4
+            )
         return self._cpu_budget
 
     def execute(self, input_refs: List) -> List:

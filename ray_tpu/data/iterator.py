@@ -23,9 +23,12 @@ from typing import Any, Iterator, List, Optional
 import ray_tpu as rt
 from ray_tpu.data import block as B
 
-# Undelivered blocks buffered per split before the producer stalls
-# (consumer backpressure; reference: per-split output queue bounds).
-_SPLIT_QUEUE_DEPTH = 4
+def _split_queue_depth() -> int:
+    # Undelivered blocks buffered per split before the producer stalls
+    # (consumer backpressure; reference: per-split output queue bounds).
+    from ray_tpu._private.config import get_config
+
+    return get_config().data_split_queue_depth
 
 
 def _block_rows(block) -> int:
@@ -99,7 +102,7 @@ class _SplitCoordinator:
                         target = rr % self._n
                         rr += 1
                     # Backpressure: stall until the chosen queue drains.
-                    while (len(self._queues[target]) >= _SPLIT_QUEUE_DEPTH
+                    while (len(self._queues[target]) >= _split_queue_depth()
                            and self._epoch == epoch):
                         self._cond.wait(timeout=1.0)
                     if self._epoch != epoch:

@@ -244,8 +244,10 @@ class ServeController:
             pass
 
     def _reconcile_loop(self):
+        from ray_tpu._private.config import get_config
+
         while not self._stop:
-            time.sleep(0.5)
+            time.sleep(get_config().serve_reconcile_interval_s)
             try:
                 with self._lock:
                     names = list(self.apps)
@@ -377,7 +379,9 @@ class ServeController:
                 continue
             fails = self._health_fails.get(key, 0) + 1
             self._health_fails[key] = fails
-            if fails >= 3:
+            from ray_tpu._private.config import get_config
+
+            if fails >= get_config().serve_health_fail_threshold:
                 dead.append(r)
         if not dead:
             return
