@@ -11,12 +11,15 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    BOHBSearcher,
     BasicVariantGenerator,
     Choice,
+    ExternalSearcher,
     ConcurrencyLimiter,
     Domain,
     GridSearch,
@@ -81,7 +84,10 @@ __all__ = [
     "ConcurrencyLimiter",
     "Searcher",
     "TPESearcher",
+    "BOHBSearcher",
+    "ExternalSearcher",
     "ASHAScheduler",
+    "PB2",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "FIFOScheduler",

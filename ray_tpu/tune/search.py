@@ -243,7 +243,17 @@ class TPESearcher(Searcher):
         goods = [to_x(c[key]) for c in good if key in c]
         bads = [to_x(c[key]) for c in bad if key in c]
         width = max(hi - lo, 1e-12)
-        bw = max(width / max(len(goods), 1) ** 0.5, width * 0.05)
+        # Silverman-style bandwidth from the good points' spread: a
+        # domain-width-based bandwidth degenerates with few goods (kernels
+        # so wide the acquisition peaks at the domain boundary).
+        n = max(len(goods), 1)
+        if len(goods) >= 2:
+            mean = sum(goods) / n
+            spread = (sum((g - mean) ** 2 for g in goods) / n) ** 0.5
+            spread = spread or width * 0.05
+        else:
+            spread = width * 0.25
+        bw = max(min(1.06 * spread * n ** -0.2, width), width * 0.02)
         # Sample from l(x): pick a good point's kernel, draw, clamp.
         center = self.rng.choice(goods) if goods else self.rng.uniform(lo, hi)
         x = min(hi, max(lo, self.rng.gauss(center, bw)))
@@ -302,3 +312,96 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id: str, result=None):
         self.live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result)
+
+
+class ExternalSearcher(Searcher):
+    """Adapter seam for third-party optimizers (the role of the
+    reference's tune/search/ integrations — optuna/hyperopt/etc. each
+    wrap an external ask/tell library behind Searcher).
+
+    Wrap ANY object exposing `ask() -> (token, config)` and
+    `tell(token, score)` (the near-universal external-optimizer
+    protocol); metric extraction and min/max normalization happen here,
+    so the external library always minimizes.
+    """
+
+    def __init__(self, external, metric: str, mode: str = "min",
+                 num_samples: int = 32):
+        assert mode in ("min", "max")
+        if not callable(getattr(external, "ask", None)) or not callable(
+            getattr(external, "tell", None)
+        ):
+            raise TypeError(
+                "external optimizer must expose ask() -> (token, config) "
+                "and tell(token, score)"
+            )
+        self.external = external
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self._suggested = 0
+        self._tokens: Dict[str, Any] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        token, config = self.external.ask()
+        self._tokens[trial_id] = token
+        return dict(config)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        token = self._tokens.pop(trial_id, None)
+        if token is None or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "max":
+            score = -score
+        try:
+            self.external.tell(token, score)
+        except Exception:  # noqa: BLE001 — a broken lib must not kill tuning
+            pass
+
+
+class BOHBSearcher(TPESearcher):
+    """Bayesian-optimization HyperBand searcher (reference: TuneBOHB,
+    tune/search/bohb/ — BOHB, Falkner et al. 2018). Pair with
+    ASHAScheduler (the HyperBandForBOHB role): the scheduler provides the
+    successive-halving rungs; this searcher fits its TPE model on results
+    from the HIGHEST fidelity (training_iteration rung) that has enough
+    observations, falling back rung-by-rung — BOHB's model-selection
+    rule — instead of modeling only completed trials.
+    """
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "min",
+                 time_attr: str = "training_iteration", **kwargs):
+        super().__init__(param_space, metric, mode, **kwargs)
+        self.time_attr = time_attr
+        # rung (fidelity) -> list[(config, minimized_score)]
+        self._rung_obs: Dict[int, List[tuple]] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict):
+        """Intermediate results land in their fidelity rung."""
+        config = self._configs.get(trial_id)
+        if config is None or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "max":
+            score = -score
+        rung = int(result.get(self.time_attr, 0))
+        self._rung_obs.setdefault(rung, []).append((dict(config), score))
+        self._refresh_model()
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        super().on_trial_complete(trial_id, result)
+        self._refresh_model()
+
+    def _refresh_model(self):
+        """Model on the highest rung with >= n_startup points (BOHB's
+        choose-the-best-budget rule); completed-trial observations from
+        the base class stay as the fallback."""
+        for rung in sorted(self._rung_obs, reverse=True):
+            obs = self._rung_obs[rung]
+            if len(obs) >= self.n_startup:
+                self._observations = list(obs)
+                return

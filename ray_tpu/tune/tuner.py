@@ -349,6 +349,10 @@ class Tuner:
                             scheduler.record_checkpoint(
                                 trial.trial_id, rep["checkpoint_path"]
                             )
+                    if hasattr(searcher, "on_trial_result"):
+                        # Multi-fidelity searchers (BOHB) model
+                        # intermediate rung results, not just finals.
+                        searcher.on_trial_result(trial.trial_id, metrics)
                     decision = scheduler.on_result(trial.trial_id, metrics)
                     if decision == STOP and not st["done"]:
                         trial.state = "STOPPED"
