@@ -78,7 +78,7 @@ _HTML = """<!DOCTYPE html>
 <script>
 "use strict";
 const TABS = ["overview","nodes","actors","tasks","objects",
-              "placement groups","jobs","events","metrics"];
+              "placement groups","jobs","events","metrics","stacks"];
 let tab = location.hash.slice(1) || "overview";
 let filter = "", sortKey = null, sortDir = 1, openJob = null;
 const hist = {};  // metric sparkline history
@@ -225,6 +225,23 @@ async function render() {
     }));
     el("main").innerHTML = rows(evts,
       ["time","source","severity","message","detail"]);
+  } else if (tab === "stacks") {
+    // On-demand per-worker thread stacks (the `rt stack` profiling
+    // drill-down; reference: dashboard reporter py-spy integration).
+    el("main").innerHTML = `<p style="color:var(--muted)">collecting live
+      thread stacks from every worker…</p>`;
+    const nodes = await api("stacks");
+    el("main").innerHTML = nodes.map(n => `
+      <h3>node ${esc(n.node_id)}</h3>` +
+      (n.error ? `<pre>error: ${esc(n.error)}</pre>` :
+       n.workers.map(w => `
+        <details><summary>pid ${esc(fmt(w.pid))}
+          ${w.actor ? "(actor)" : "(worker)"}
+          — ${(w.threads||[]).length} threads
+          ${w.error ? " — " + esc(w.error) : ""}</summary>
+          <pre>${esc((w.threads||[]).map(t =>
+            "-- " + t.thread + " --\n" + t.stack).join("\n"))}</pre>
+        </details>`).join(""))).join("");
   } else if (tab === "metrics") {
     const text = await fetch("metrics").then(r => r.text());
     const rowsOut = [];
@@ -240,9 +257,15 @@ async function render() {
   }
 }
 
+let lastStacks = 0;
 async function refresh() {
   if (document.activeElement && document.activeElement.id === "filterbox")
     return;  // don't repaint under the user's caret
+  if (tab === "stacks") {
+    // Expensive probe: refresh at most every 15s.
+    if (Date.now() - lastStacks < 15000) return;
+    lastStacks = Date.now();
+  }
   try {
     await render();
     el("status").textContent =
@@ -290,6 +313,7 @@ class Dashboard:
                 web.get("/api/placement_groups", self.placement_groups),
                 web.get("/api/jobs", self.jobs),
                 web.get("/api/events", self.events),
+                web.get("/api/stacks", self.stacks),
                 web.post("/api/jobs", self.submit_job),
                 web.get("/api/jobs/{submission_id}", self.job_info),
                 web.get("/api/jobs/{submission_id}/logs", self.job_logs),
@@ -415,6 +439,41 @@ class Dashboard:
                 for p in pgs
             ]
         )
+
+    async def stacks(self, request):
+        """Live per-worker thread stacks from every (or one) node — the
+        `rt stack` drill-down surfaced in the UI (reference: the
+        dashboard reporter's on-demand py-spy profiling,
+        dashboard/modules/reporter/profile_manager.py)."""
+        from ray_tpu._private.protocol import connect as _connect
+
+        node_filter = request.query.get("node_id")
+        out = []
+        for n in (await self.gcs.call("get_nodes", {}))["nodes"]:
+            if n["state"] != "ALIVE":
+                continue
+            nid = _hex(n["node_id"])
+            if node_filter and nid != node_filter:
+                continue
+            try:
+                conn = await _connect(n["address"], n["port"], timeout=5)
+                try:
+                    r = await asyncio.wait_for(
+                        conn.call("worker_stacks", {}), 30
+                    )
+                finally:
+                    await conn.close()
+                workers = []
+                for w in r.get("workers", []):
+                    w = dict(w)
+                    wid = w.get("worker_id")
+                    if isinstance(wid, (bytes, bytearray)):
+                        w["worker_id"] = wid.hex()
+                    workers.append(w)
+                out.append({"node_id": nid, "workers": workers})
+            except Exception as e:  # noqa: BLE001 — node mid-death
+                out.append({"node_id": nid, "error": f"{type(e).__name__}: {e}"})
+        return self._json(out)
 
     async def events(self, request):
         """Merged structured event tail (reference: dashboard event

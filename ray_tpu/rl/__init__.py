@@ -48,6 +48,8 @@ from ray_tpu.rl.multi_agent import (
 from ray_tpu.rl.offline import (
     BC,
     BCConfig,
+    MARWIL,
+    MARWILConfig,
     bc_loss,
     dataset_to_batch,
     episodes_to_dataset,
@@ -94,6 +96,8 @@ __all__ = [
     "ClipReward",
     "BC",
     "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "bc_loss",
     "episodes_to_dataset",
     "dataset_to_batch",

@@ -21,13 +21,16 @@ from ray_tpu.rl.core.learner import Learner
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
 
 
-def episodes_to_dataset(rollouts: List[Dict[str, np.ndarray]]):
+def episodes_to_dataset(rollouts: List[Dict[str, np.ndarray]],
+                        gamma: Optional[float] = None):
     """Flatten sampled rollout batches into a row-per-transition Dataset
     (reference: JsonWriter writing SampleBatches, rllib/offline/json_writer.py).
 
     Each row carries obs/action plus whatever per-step fields the rollout
     had (logp, rewards, dones, ...) so downstream offline algorithms can
-    pick what they need.
+    pick what they need. With `gamma`, each row additionally gets
+    "returns" — the discounted return-to-go within its episode — which
+    return-conditioned offline algorithms (MARWIL) train against.
     """
     rows = []
     for b in rollouts:
@@ -36,8 +39,21 @@ def episodes_to_dataset(rollouts: List[Dict[str, np.ndarray]]):
             k for k, v in b.items()
             if isinstance(v, np.ndarray) and v.shape[:1] == (T,)
         ]
+        returns = None
+        if gamma is not None and "rewards" in b:
+            returns = np.zeros(T, dtype=np.float32)
+            acc = float(b.get("last_value", 0.0))
+            dones = b.get("dones", np.zeros(T))
+            for t in range(T - 1, -1, -1):
+                if dones[t]:
+                    acc = 0.0
+                acc = float(b["rewards"][t]) + gamma * acc
+                returns[t] = acc
         for t in range(T):
-            rows.append({k: b[k][t] for k in step_keys})
+            row = {k: b[k][t] for k in step_keys}
+            if returns is not None:
+                row["returns"] = returns[t]
+            rows.append(row)
     return rt_data.from_items(rows)
 
 
@@ -125,3 +141,112 @@ class BC:
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
         out = self.module.forward(self.learner.params, obs)
         return np.asarray(jnp.argmax(out["action_logits"], axis=-1))
+
+
+def marwil_loss(beta: float):
+    """Monotonic advantage re-weighted imitation learning (reference:
+    rllib/algorithms/marwil/ — Wang et al. 2018): BC where each action's
+    log-likelihood is weighted by exp(beta * advantage), advantage
+    measured against a jointly-learned value baseline. beta=0 reduces to
+    plain BC."""
+
+    def loss(params, module, batch):
+        out = module.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(out["action_logits"])
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+        )[:, 0]
+        adv = batch["returns"] - out["value"]
+        vf_loss = (adv ** 2).mean()
+        # Normalized, gradient-stopped exponential weights (the c term of
+        # the paper approximated by the batch advantage scale), clipped
+        # for stability.
+        a = jax.lax.stop_gradient(adv)
+        scale = jnp.sqrt((a ** 2).mean()) + 1e-8
+        w = jnp.exp(jnp.clip(beta * a / scale, -5.0, 5.0))
+        policy_loss = -(w * logp).mean()
+        total = policy_loss + 0.5 * vf_loss
+        accuracy = (
+            jnp.argmax(out["action_logits"], axis=-1) == batch["actions"]
+        ).mean()
+        return total, {
+            "total_loss": total, "policy_loss": policy_loss,
+            "vf_loss": vf_loss, "accuracy": accuracy,
+            "mean_weight": w.mean(),
+        }
+
+    return loss
+
+
+@dataclass
+class MARWILConfig(BCConfig):
+    beta: float = 1.0
+    gamma: float = 0.99
+
+    def training(self, lr=None, minibatch_size=None, beta=None, gamma=None):
+        super().training(lr=lr, minibatch_size=minibatch_size)
+        if beta is not None:
+            self.beta = beta
+        if gamma is not None:
+            self.gamma = gamma
+        return self
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class MARWIL(BC):
+    """Advantage-weighted offline training over a transition Dataset
+    (rows need obs/actions/returns — see episodes_to_dataset(gamma=...)).
+    The dataset-backed loop streams batches through the Dataset executor
+    per epoch instead of materializing everything on the driver."""
+
+    _BATCH_KEYS = ("obs", "actions", "returns")
+
+    def __init__(self, config: MARWILConfig):
+        self.config = config
+        spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
+        self.module = DiscretePolicyModule(spec)
+        self.learner = Learner(
+            self.module, marwil_loss(config.beta), seed=config.seed,
+            lr=config.lr,
+        )
+        self._rng = np.random.default_rng(config.seed)
+
+    def train_on_dataset(self, ds, num_epochs: int = 1) -> Dict[str, float]:
+        """Streaming epochs: shuffle + iter_batches drives the Dataset's
+        executor each epoch; minibatches update as they arrive (the
+        reference's OfflineData iter_batches loop, offline/offline_data.py)."""
+        metrics: Dict[str, float] = {}
+        for epoch in range(num_epochs):
+            shuffled = ds.random_shuffle(seed=self.config.seed + epoch)
+            for batch in shuffled.iter_batches(
+                batch_size=self.config.minibatch_size, batch_format="numpy"
+            ):
+                mb = {
+                    # Row values may arrive as an object array of
+                    # per-row ndarrays; stack explicitly.
+                    "obs": np.stack([
+                        np.asarray(o, dtype=np.float32)
+                        for o in batch["obs"]
+                    ]),
+                    "actions": np.asarray(
+                        [int(a) for a in batch["actions"]], dtype=np.int32
+                    ),
+                    "returns": np.asarray(
+                        [float(r) for r in batch["returns"]],
+                        dtype=np.float32,
+                    ),
+                }
+                metrics = self.learner.update_from_batch(mb)
+        return metrics
+
+    def train_on_batch(self, batch: Dict[str, np.ndarray],
+                       num_epochs: int = 1) -> Dict[str, float]:
+        from ray_tpu.rl.core.learner import minibatch_epochs
+
+        return minibatch_epochs(
+            self.learner.update_from_batch,
+            {k: v for k, v in batch.items() if k in self._BATCH_KEYS},
+            num_epochs, self.config.minibatch_size, self._rng,
+        )

@@ -135,6 +135,15 @@ def test_dashboard_endpoints(dashboard):
     actors = json.loads(_get(dashboard + "/api/actors"))
     assert actors and actors[0]["class_name"] == "Pinger"
 
+    # Profiling drill-down: live worker thread stacks through the UI API
+    # (the `rt stack` backend surfaced per node).
+    stacks = json.loads(_get(dashboard + "/api/stacks"))
+    assert stacks and stacks[0].get("workers"), stacks
+    some = stacks[0]["workers"][0]
+    assert some.get("threads") and any(
+        "stack" in t for t in some["threads"]
+    )
+
     Counter("dash_counter").inc(3)
     body = _wait_for(
         lambda: (lambda t: t if "dash_counter" in t else None)(
