@@ -317,6 +317,37 @@ def cmd_serve(args):
         print("serve shut down")
 
 
+def cmd_up(args):
+    """`rt up cluster.yaml` (reference: scripts.py:566 up)."""
+    from ray_tpu.autoscaler.launcher import ClusterLauncher
+
+    ClusterLauncher.from_yaml(args.config).up()
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler.launcher import ClusterLauncher
+
+    ClusterLauncher.from_yaml(args.config).down()
+
+
+def cmd_exec(args):
+    from ray_tpu.autoscaler.launcher import ClusterLauncher
+
+    launcher = ClusterLauncher.from_yaml(args.config)
+    for out in launcher.exec(" ".join(args.cmd), all_nodes=args.all_nodes):
+        print(out, end="")
+
+
+def cmd_attach(args):
+    """Exec into an interactive shell on the head node."""
+    import shlex as _shlex
+
+    from ray_tpu.autoscaler.launcher import ClusterLauncher
+
+    cmd = ClusterLauncher.from_yaml(args.config).attach_command()
+    os.execvp("/bin/sh", ["/bin/sh", "-c", cmd])
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="rt", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -335,6 +366,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop services started by `rt start`")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a YAML-launched cluster")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("exec", help="run a command on the cluster head")
+    sp.add_argument("config")
+    sp.add_argument("--all-nodes", action="store_true")
+    sp.add_argument("cmd", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("attach", help="open a shell on the cluster head")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_attach)
 
     sp = sub.add_parser("status", help="cluster resource overview")
     sp.add_argument("--address")
