@@ -1,0 +1,341 @@
+"""TD3: twin-delayed deep deterministic policy gradient.
+
+Analog of the reference's TD3 (rllib/algorithms/td3 — Fujimoto et al.
+2018; the reference reaches it through its DDPG family). TPU framing
+mirrors this repo's SAC: the WHOLE update — twin-critic TD step with
+target-policy smoothing, the delayed deterministic policy step, and
+polyak target sync — is ONE jitted function over a state pytree, so an
+iteration's `updates_per_iteration` steps run as compiled device work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
+from ray_tpu.rl.core.rl_module import (
+    ContinuousModuleSpec,
+    init_mlp,
+    mlp_forward,
+)
+from ray_tpu.rl.env_runner import ContinuousTransitionRunner
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+class DeterministicPolicyModule:
+    """tanh-deterministic actor + twin Q towers (the DDPG/TD3 module
+    shape). Exploration noise is added by the runner-side sampler;
+    actions live normalized in [-1, 1] internally."""
+
+    def __init__(self, spec: ContinuousModuleSpec,
+                 explore_sigma: float = 0.1):
+        self.spec = spec
+        self.explore_sigma = explore_sigma
+
+    def init(self, rng: jax.Array) -> Dict:
+        kp, k1, k2 = jax.random.split(rng, 3)
+        sizes = [self.spec.obs_dim, *self.spec.hidden, self.spec.action_dim]
+        qin = self.spec.obs_dim + self.spec.action_dim
+        qsizes = [qin, *self.spec.hidden, 1]
+        return {
+            "pi": init_mlp(kp, sizes),
+            "q1": init_mlp(k1, qsizes),
+            "q2": init_mlp(k2, qsizes),
+        }
+
+    def scale_action(self, a_norm: jax.Array) -> jax.Array:
+        lo, hi = self.spec.action_low, self.spec.action_high
+        return a_norm * (hi - lo) / 2.0 + (hi + lo) / 2.0
+
+    def pi(self, params: Dict, obs: jax.Array) -> jax.Array:
+        return jnp.tanh(mlp_forward(params["pi"], obs))
+
+    def q_values(self, params: Dict, obs: jax.Array, a_norm: jax.Array):
+        x = jnp.concatenate([obs, a_norm], axis=-1)
+        return (mlp_forward(params["q1"], x)[..., 0],
+                mlp_forward(params["q2"], x)[..., 0])
+
+    def deterministic_action(self, params: Dict, obs: jax.Array):
+        return self.scale_action(self.pi(params, obs))
+
+    def sample_with_logp(self, params: Dict, obs: jax.Array,
+                         rng: jax.Array):
+        """Behavior policy: pi(s) + N(0, sigma), clipped to [-1, 1].
+        (Deterministic policy: logp is a placeholder so the runner's
+        interface matches the SAC module's.)"""
+        a = self.pi(params, obs)
+        noise = self.explore_sigma * jax.random.normal(rng, a.shape)
+        a_norm = jnp.clip(a + noise, -1.0, 1.0)
+        return a_norm, jnp.zeros(a_norm.shape[:-1])
+
+    def sample_action(self, params: Dict, obs: jax.Array, rng: jax.Array):
+        a_norm, logp = self.sample_with_logp(params, obs, rng)
+        return self.scale_action(a_norm), logp, jnp.zeros(a_norm.shape[:-1])
+
+
+def make_td3_update(module: DeterministicPolicyModule,
+                    pi_tx, q_tx, gamma: float, tau: float,
+                    target_noise: float, noise_clip: float,
+                    policy_delay: int):
+    """One TD3 gradient step as a pure function of (state, batch, rng)."""
+
+    def q_loss_fn(qp, params, target, batch, rng):
+        noise = jnp.clip(
+            target_noise * jax.random.normal(
+                rng, batch["actions"].shape
+            ),
+            -noise_clip, noise_clip,
+        )
+        next_a = jnp.clip(
+            module.pi({"pi": target["pi"]}, batch["next_obs"]) + noise,
+            -1.0, 1.0,
+        )
+        tq1, tq2 = module.q_values(
+            {**params, "q1": target["q1"], "q2": target["q2"]},
+            batch["next_obs"], next_a,
+        )
+        td_target = jax.lax.stop_gradient(
+            batch["rewards"]
+            + gamma * (1.0 - batch["dones"]) * jnp.minimum(tq1, tq2)
+        )
+        q1, q2 = module.q_values({**params, **qp}, batch["obs"],
+                                 batch["actions"])
+        return ((q1 - td_target) ** 2).mean() + ((q2 - td_target) ** 2).mean()
+
+    def pi_loss_fn(pp, params, batch):
+        a = module.pi({"pi": pp}, batch["obs"])
+        q1, _ = module.q_values(params, batch["obs"], a)
+        return -q1.mean()
+
+    def update(state, batch, rng):
+        params, target = state["params"], state["target"]
+        qp = {"q1": params["q1"], "q2": params["q2"]}
+        q_loss, q_grads = jax.value_and_grad(q_loss_fn)(
+            qp, params, target, batch, rng
+        )
+        q_updates, q_opt = q_tx.update(q_grads, state["q_opt"], qp)
+        qp = optax.apply_updates(qp, q_updates)
+        params = {**params, **qp}
+
+        def do_policy(_):
+            pi_loss, pi_grads = jax.value_and_grad(pi_loss_fn)(
+                params["pi"], params, batch
+            )
+            pi_updates, pi_opt = pi_tx.update(
+                pi_grads, state["pi_opt"], params["pi"]
+            )
+            new_pi = optax.apply_updates(params["pi"], pi_updates)
+            # Polyak targets move only on policy steps (TD3's delay).
+            new_target = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o,
+                state["target"],
+                {"pi": new_pi, "q1": params["q1"], "q2": params["q2"]},
+            )
+            return new_pi, pi_opt, new_target, pi_loss
+
+        def skip_policy(_):
+            return (params["pi"], state["pi_opt"], state["target"],
+                    jnp.asarray(0.0))
+
+        step = state["step"]
+        new_pi, pi_opt, new_target, pi_loss = jax.lax.cond(
+            step % policy_delay == 0, do_policy, skip_policy, None
+        )
+        new_state = {
+            "params": {**params, "pi": new_pi},
+            "target": new_target,
+            "pi_opt": pi_opt,
+            "q_opt": q_opt,
+            "step": step + 1,
+        }
+        metrics = {"q_loss": q_loss, "pi_loss": pi_loss,
+                   "mean_q": module.q_values(
+                       params, batch["obs"], batch["actions"])[0].mean()}
+        return new_state, metrics
+
+    return jax.jit(update)
+
+
+@dataclass
+class TD3Config(ConfigEvalMixin):
+    env_creator: Optional[Any] = None
+    obs_dim: int = 3
+    action_dim: int = 1
+    action_low: float = -1.0
+    action_high: float = 1.0
+    hidden: tuple = (64, 64)
+    num_env_runners: int = 1
+    rollout_length: int = 200
+    lr: float = 1e-3
+    gamma: float = 0.99
+    tau: float = 0.005
+    batch_size: int = 128
+    updates_per_iteration: int = 200
+    warmup_steps: int = 500
+    buffer_capacity: int = 100_000
+    explore_sigma: float = 0.1
+    target_noise: float = 0.2
+    noise_clip: float = 0.5
+    policy_delay: int = 2
+    seed: int = 0
+
+    def environment(self, env_creator=None, obs_dim=None, action_dim=None,
+                    action_low=None, action_high=None):
+        for name, val in (("env_creator", env_creator),
+                          ("obs_dim", obs_dim), ("action_dim", action_dim),
+                          ("action_low", action_low),
+                          ("action_high", action_high)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def env_runners(self, num_env_runners=None, rollout_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, lr=None, gamma=None, tau=None, batch_size=None,
+                 updates_per_iteration=None, warmup_steps=None,
+                 buffer_capacity=None, explore_sigma=None,
+                 target_noise=None, noise_clip=None, policy_delay=None):
+        for name, val in (
+            ("lr", lr), ("gamma", gamma), ("tau", tau),
+            ("batch_size", batch_size),
+            ("updates_per_iteration", updates_per_iteration),
+            ("warmup_steps", warmup_steps),
+            ("buffer_capacity", buffer_capacity),
+            ("explore_sigma", explore_sigma),
+            ("target_noise", target_noise), ("noise_clip", noise_clip),
+            ("policy_delay", policy_delay),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+class TD3(AlgorithmBase):
+    """Off-policy deterministic actor-critic loop: collect -> replay ->
+    jitted twin-delayed updates."""
+
+    def __init__(self, config: TD3Config):
+        assert config.env_creator is not None, "config.environment(...) first"
+        self.config = config
+        spec = ContinuousModuleSpec(
+            config.obs_dim, config.action_dim,
+            config.action_low, config.action_high, config.hidden,
+        )
+        self.module = DeterministicPolicyModule(spec, config.explore_sigma)
+        module_factory = self._module_factory = (
+            lambda s=spec, sg=config.explore_sigma:
+            DeterministicPolicyModule(s, sg)
+        )
+        params = self.module.init(jax.random.PRNGKey(config.seed))
+        pi_tx = optax.adam(config.lr)
+        q_tx = optax.adam(config.lr)
+        qp = {"q1": params["q1"], "q2": params["q2"]}
+        self.state = {
+            "params": params,
+            "target": jax.tree.map(
+                lambda x: x, {"pi": params["pi"], **qp}
+            ),
+            "pi_opt": pi_tx.init(params["pi"]),
+            "q_opt": q_tx.init(qp),
+            "step": jnp.asarray(0, dtype=jnp.int32),
+        }
+        self._update = make_td3_update(
+            self.module, pi_tx, q_tx, config.gamma, config.tau,
+            config.target_noise, config.noise_clip, config.policy_delay,
+        )
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, config.obs_dim, seed=config.seed,
+            action_dim=config.action_dim,
+        )
+        self.env_runners = [
+            ContinuousTransitionRunner.options(num_cpus=0.5).remote(
+                config.env_creator, module_factory,
+                seed=config.seed + 1 + i,
+                rollout_length=config.rollout_length,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self._rng = jax.random.PRNGKey(config.seed + 77)
+        self._steps_sampled = 0
+        self._iteration = 0
+        self._broadcast()
+
+    def _broadcast(self):
+        weights = jax.device_get(self.state["params"])
+        rt.get([r.set_weights.remote(weights) for r in self.env_runners],
+               timeout=300)
+
+    # AlgorithmBase state hooks (the SAC pattern: whole state, one pytree)
+    def _get_learner_state(self):
+        return jax.device_get(self.state)
+
+    def _set_learner_state(self, state):
+        self.state = jax.tree.map(jnp.asarray, state)
+
+    def _current_weights(self):
+        return jax.device_get(self.state["params"])
+
+    def _checkpoint_extra_state(self):
+        return {"steps_sampled": self._steps_sampled}
+
+    def _restore_extra_state(self, extra):
+        self._steps_sampled = extra.get("steps_sampled", self._steps_sampled)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        warm = self._steps_sampled < cfg.warmup_steps
+        rollouts = rt.get(
+            [r.sample.remote(random_actions=warm) for r in self.env_runners],
+            timeout=600,
+        )
+        for b in rollouts:
+            self.buffer.add_batch(b)
+            self._steps_sampled += len(b["obs"])
+        metrics: Dict[str, Any] = {}
+        if self._steps_sampled >= cfg.warmup_steps:
+            m = None
+            for _ in range(cfg.updates_per_iteration):
+                batch = {
+                    k: jnp.asarray(v)
+                    for k, v in self.buffer.sample(cfg.batch_size).items()
+                }
+                self._rng, key = jax.random.split(self._rng)
+                self.state, m = self._update(self.state, batch, key)
+            if m is not None:
+                metrics = {k: float(v) for k, v in m.items()}
+            self._broadcast()
+        self._iteration += 1
+        stats = rt.get(
+            [r.episode_stats.remote() for r in self.env_runners], timeout=300
+        )
+        returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
+        return self._finish_iteration({
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "steps_sampled": self._steps_sampled,
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        })
+
+    def stop(self):
+        self.stop_eval_runners()
+        for r in self.env_runners:
+            try:
+                rt.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
