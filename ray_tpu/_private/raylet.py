@@ -1948,6 +1948,11 @@ class Raylet:
             if buf is None:
                 return True  # a concurrent pull materialized it
             try:
+                # Same teardown guard as the chunk path: no await between
+                # the check and the write into our (possibly unmapped on
+                # stop) store.
+                if self._stopping:
+                    raise asyncio.CancelledError("raylet stopping")
                 buf[:] = view
             except BaseException:
                 del buf
@@ -2002,6 +2007,12 @@ class Raylet:
                 raise KeyError(
                     f"short chunk at {off}: {len(data)} != {n}"
                 )
+            # No await between this check and the write: stop()/kill()
+            # run on this same loop, so a raylet that began teardown (and
+            # may have unmapped the store) can never interleave INSIDE
+            # the write — writing after unmap is a segfault.
+            if self._stopping:
+                raise asyncio.CancelledError("raylet stopping")
             buf[off:off + n] = data
             received.add(off)
             if progress is not None:
@@ -2020,8 +2031,12 @@ class Raylet:
             if progress is not None:
                 progress["buf"] = None
             del buf
-            self.store.abort(oid)
+            if not self._stopping:  # teardown may have closed the store
+                self.store.abort(oid)
             raise
+        if self._stopping:
+            del buf
+            raise asyncio.CancelledError("raylet stopping")
         if progress is not None:
             progress["filled"] = total
             progress["buf"] = None

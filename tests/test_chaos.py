@@ -404,3 +404,62 @@ def test_serve_controller_killed():
         serve.shutdown()
     finally:
         rt.shutdown()
+
+
+def test_broadcast_survives_mid_chain_node_death():
+    """Kill a broadcast consumer node mid-transfer: pullers that chained
+    off its partial copy must re-route to surviving holders and still get
+    exact bytes (the partial-location retry path; reference:
+    object_manager.cc pull retry over remaining locations)."""
+    import numpy as np
+
+    import ray_tpu._private.config as config_mod
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    os.environ["RT_SAME_HOST_SHM_TRANSFER"] = "0"
+    config_mod._config = None
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1, object_store_memory=512 * 1024 * 1024)
+    victims = [cluster.add_node(num_cpus=1,
+                                object_store_memory=512 * 1024 * 1024)
+               for _ in range(3)]
+    cluster.connect()
+    try:
+        rng = np.random.default_rng(13)
+        payload = rng.standard_normal(8_000_000)  # 64MB
+        ref = rt.put(payload)
+        want = float(payload.sum())
+
+        @rt.remote
+        def digest(x):
+            return float(x.sum())
+
+        refs = [
+            digest.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=r.node_id.binary()
+                )
+            ).remote(ref)
+            for r in victims
+        ]
+        # Kill one consumer node shortly into the broadcast: any puller
+        # chained to its partial copy must fail over.
+        time.sleep(0.15)
+        cluster.remove_node(victims[0])
+        done, pending = rt.wait(refs, num_returns=3, timeout=120)
+        # The killed node's own task may fail/retry elsewhere; the other
+        # two MUST land with exact bytes.
+        ok = 0
+        for r in refs[1:]:
+            try:
+                assert abs(rt.get(r, timeout=60) - want) < 1e-6
+                ok += 1
+            except Exception:  # noqa: BLE001
+                pass
+        assert ok == 2, f"only {ok}/2 surviving consumers completed"
+    finally:
+        os.environ.pop("RT_SAME_HOST_SHM_TRANSFER", None)
+        config_mod._config = None
+        cluster.shutdown()
