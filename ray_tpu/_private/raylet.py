@@ -92,10 +92,16 @@ class _PullByteBudget:
             fut.set_result(None)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
 def _machine_id() -> str:
     """Identity of the physical host (hostname + kernel boot id): two
     raylets with equal machine ids share /dev/shm and can move objects by
-    direct store-to-store memcpy instead of TCP."""
+    direct store-to-store memcpy instead of TCP. Immutable for the
+    process lifetime — cached (the pull hot path compares it per
+    candidate holder)."""
     try:
         with open("/proc/sys/kernel/random/boot_id") as f:
             boot = f.read().strip()
@@ -928,8 +934,10 @@ class Raylet:
                 self._set_actor_fields(w, payload, resources, sched, bundle)
                 self._replenish_idle_pool()
                 # Wait for registration INSIDE the gate (the boot is the
-                # resource being bounded). Budget covers runtime-env
-                # download/extraction in the starting worker.
+                # resource being bounded; a second wait outside would
+                # double the stall for a worker that never registers).
+                # Budget covers runtime-env download/extraction in the
+                # starting worker.
                 try:
                     await asyncio.wait_for(
                         w.registered.wait(),
@@ -937,14 +945,6 @@ class Raylet:
                     )
                 except asyncio.TimeoutError:
                     pass
-        if w.conn is None and w.worker_id in self.workers:
-            try:
-                await asyncio.wait_for(
-                    w.registered.wait(),
-                    get_config().worker_register_timeout_s,
-                )
-            except asyncio.TimeoutError:
-                pass
         if w.conn is None:
             await self.gcs.call(
                 "worker_dead",

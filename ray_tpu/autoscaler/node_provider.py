@@ -132,6 +132,15 @@ class ProcessNodeProvider(NodeProvider):
                     node_id = line.strip().split("=", 1)[1]
                 if line.startswith("RAYLET_STORE="):
                     break
+            # Keep draining stdout forever: workers inherit this pipe and
+            # a full (unread) 64KB pipe blocks their print()s — wedging
+            # tasks with no diagnostic.
+            import threading
+
+            threading.Thread(
+                target=lambda s=proc.stdout: [None for _ in s],
+                daemon=True,
+            ).start()
             self._nodes[pid] = {"proc": proc, "type": node_type,
                                 "node_id": node_id}
             created.append(pid)

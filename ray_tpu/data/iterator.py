@@ -59,13 +59,14 @@ class _SplitCoordinator:
 
     def start_epoch(self, epoch: int) -> bool:
         """Idempotent across the n consumers: the first call for the next
-        epoch starts its producer thread; later/duplicate calls no-op."""
+        epoch starts its producer thread. Returns False (caller retries)
+        while the previous epoch is still streaming OR any consumer still
+        has undrained blocks — advancing then would silently truncate a
+        slower consumer's epoch."""
         with self._lock:
             if epoch <= self._epoch:
-                return False
-            if not self._producer_done:
-                # Previous epoch still streaming; callers retry after
-                # consuming it to the end.
+                return True  # already started (or past)
+            if not self._producer_done or any(self._queues):
                 return False
             self._epoch = epoch
             self._queues = [deque() for _ in range(self._n)]
@@ -127,9 +128,15 @@ class _SplitCoordinator:
                     raise RuntimeError(
                         f"streaming_split producer failed: {self._producer_error}"
                     )
-                if epoch != self._epoch:
-                    # Stale consumer (epoch superseded): report done so it
-                    # unwinds cleanly.
+                if epoch > self._epoch:
+                    # Our epoch hasn't started yet (another consumer is
+                    # still draining the previous one): wait for it.
+                    self._cond.wait(timeout=1.0)
+                    continue
+                if epoch < self._epoch:
+                    # Superseded. start_epoch refuses to advance while any
+                    # queue holds blocks, so nothing was dropped — this
+                    # consumer already drained its split.
                     return {"blocks": [], "done": True}
                 q = self._queues[split_idx]
                 if q:
@@ -158,11 +165,21 @@ class DataIterator:
         self._epoch = 0
 
     def iter_blocks(self) -> Iterator[Any]:
+        import time as _time
+
         epoch = self._epoch
         self._epoch += 1
         # Idempotent across the n iterators; whoever arrives first starts
-        # the epoch's producer.
-        rt.get(self._coord.start_epoch.remote(epoch))
+        # the epoch's producer. False = previous epoch still draining
+        # elsewhere — retry until the coordinator can roll over.
+        deadline = _time.monotonic() + 600
+        while not rt.get(self._coord.start_epoch.remote(epoch)):
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"epoch {epoch} never started: another split is still "
+                    "consuming the previous epoch"
+                )
+            _time.sleep(0.05)
         while True:
             out = rt.get(self._coord.next_blocks.remote(epoch, self._idx),
                          timeout=600)
@@ -170,6 +187,15 @@ class DataIterator:
                 yield rt.get(ref)
             if out["done"]:
                 return
+
+    def stop(self):
+        """Kill the shared coordinator actor (releases its hold on the
+        dataset's input blocks). Call from the split's owner once ALL n
+        iterators are finished — the trainer does this automatically."""
+        try:
+            rt.kill(self._coord)
+        except Exception:  # noqa: BLE001 — already gone
+            pass
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():

@@ -35,7 +35,11 @@ class EvalEnvRunner(_EnvRunnerBase):
             self._sample = jax.jit(self.module.sample_action)
         greedy = None
         if not explore:
-            greedy = jax.jit(self._greedy_action)
+            # Cached like _sample: a fresh jit wrapper per eval round
+            # would recompile every evaluation.
+            if getattr(self, "_greedy", None) is None:
+                self._greedy = jax.jit(self._greedy_action)
+            greedy = self._greedy
         returns, lengths = [], []
         for _ in range(num_episodes):
             obs, _ = self.env.reset()

@@ -340,8 +340,6 @@ def cmd_exec(args):
 
 def cmd_attach(args):
     """Exec into an interactive shell on the head node."""
-    import shlex as _shlex
-
     from ray_tpu.autoscaler.launcher import ClusterLauncher
 
     cmd = ClusterLauncher.from_yaml(args.config).attach_command()

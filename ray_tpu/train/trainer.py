@@ -171,6 +171,7 @@ class DataParallelTrainer(BaseTrainer):
                 time.sleep(0.05)
         finally:
             executor.shutdown()
+            self._stop_shards(dataset_shards)
         best = manager.best_checkpoint() or self._latest_checkpoint
         return Result(
             metrics=final_metrics,
@@ -179,6 +180,24 @@ class DataParallelTrainer(BaseTrainer):
             path=trial_dir,
             metrics_history=metrics_history,
         )
+
+    @staticmethod
+    def _stop_shards(dataset_shards):
+        """Kill streaming_split coordinator actors once training ends —
+        they hold the dataset's input block refs and nothing else ever
+        reclaims them (one coordinator per split dataset per fit)."""
+        seen = set()
+        for entry in dataset_shards or []:
+            shards = entry.values() if isinstance(entry, dict) else [entry]
+            for shard in shards:
+                coord = getattr(shard, "_coord", None)
+                stop = getattr(shard, "stop", None)
+                if coord is not None and callable(stop):
+                    key = getattr(coord, "_actor_id", id(coord))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    stop()
 
     def _shard_datasets(self, num_workers: int):
         """Per-worker {name: shard} dicts via DataConfig: split datasets
