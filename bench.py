@@ -161,6 +161,44 @@ def _last_json_line(text: str):
     return None
 
 
+def load_live_artifact(path: str = None, max_age: float = None,
+                       now: float = None):
+    """The opportunistically-captured TPU result (tools/tpu_live.py), IF
+    it is fresh (this round) and really a TPU measurement — labeled as
+    cached. None otherwise."""
+    path = path or LIVE_ARTIFACT
+    max_age = LIVE_MAX_AGE if max_age is None else max_age
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            live = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not live.get("measured_at"):
+        return None
+    try:
+        import calendar
+
+        measured = calendar.timegm(
+            time.strptime(live["measured_at"], "%Y-%m-%dT%H:%M:%SZ")
+        )
+    except ValueError:
+        return None
+    age = (time.time() if now is None else now) - measured
+    if not (0 <= age <= max_age):
+        return None
+    if "tpu" not in str(live.get("device", "")).lower():
+        return None
+    live["cached"] = True
+    live["cache_note"] = (
+        "live tunnel dead at bench time; this is a real TPU "
+        "measurement captured earlier this round by tools/tpu_live.py "
+        f"(measured_at={live.get('measured_at', '?')})"
+    )
+    return live
+
+
 def _probe_tunnel() -> bool:
     """Cheap subprocess probe: does `jax.devices()` answer with a TPU?"""
     src = "import jax,sys; sys.stdout.write(jax.devices()[0].platform)"
@@ -226,30 +264,9 @@ def supervise() -> int:
     # Phase 3: a TPU measurement captured earlier in the round by
     # tools/tpu_live.py (the tunnel is often alive only in windows). The
     # result is clearly labeled as cached with its capture timestamp.
-    if not force_cpu and os.path.exists(LIVE_ARTIFACT):
-        try:
-            with open(LIVE_ARTIFACT) as f:
-                live = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            live = None
-        age_ok = False
-        if live and live.get("measured_at"):
-            try:
-                import calendar
-
-                measured = calendar.timegm(
-                    time.strptime(live["measured_at"], "%Y-%m-%dT%H:%M:%SZ")
-                )
-                age_ok = 0 <= time.time() - measured <= LIVE_MAX_AGE
-            except ValueError:
-                age_ok = False
-        if live and age_ok and "tpu" in str(live.get("device", "")).lower():
-            live["cached"] = True
-            live["cache_note"] = (
-                "live tunnel dead at bench time; this is a real TPU "
-                "measurement captured earlier this round by tools/tpu_live.py "
-                f"(measured_at={live.get('measured_at', '?')})"
-            )
+    if not force_cpu:
+        live = load_live_artifact()
+        if live is not None:
             if tpu_error:
                 live["tpu_error"] = tpu_error
             _log(f"emitting cached live-TPU artifact from {live.get('measured_at')}")

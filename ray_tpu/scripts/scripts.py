@@ -317,6 +317,27 @@ def cmd_serve(args):
         print("serve shut down")
 
 
+def cmd_config(args):
+    """List the runtime config registry (the ray_config_def.h analog):
+    every knob, its current value, and the RT_* env var that tunes it."""
+    from dataclasses import fields
+
+    from ray_tpu._private.config import Config, get_config
+
+    cfg = get_config()
+    defaults = Config.__new__(Config)
+    rows = []
+    for f in fields(Config):
+        cur = getattr(cfg, f.name)
+        default = f.default
+        rows.append((f.name, cur, default))
+    width = max(len(r[0]) for r in rows)
+    for name, cur, default in sorted(rows):
+        marker = " *" if cur != default else ""
+        print(f"RT_{name.upper():<{width}}  {cur!r}{marker}")
+    print(f"\n{len(rows)} knobs; * = overridden from default")
+
+
 def cmd_up(args):
     """`rt up cluster.yaml` (reference: scripts.py:566 up)."""
     from ray_tpu.autoscaler.launcher import ClusterLauncher
@@ -364,6 +385,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop services started by `rt start`")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("config", help="list runtime config knobs")
+    sp.set_defaults(fn=cmd_config)
 
     sp = sub.add_parser("up", help="launch a cluster from a YAML config")
     sp.add_argument("config")
