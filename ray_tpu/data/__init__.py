@@ -17,8 +17,10 @@ from ray_tpu.data.executor import ActorPoolStrategy
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.dataset import (
     Dataset,
+    from_arrow,
     from_items,
     from_numpy,
+    from_pandas,
     range_dataset as range,  # noqa: A001 — mirrors ray.data.range
     read_binary_files,
     read_csv,
@@ -37,8 +39,10 @@ __all__ = [
     "FileBasedDatasink",
     "FileBasedDatasource",
     "ReadTask",
+    "from_arrow",
     "from_items",
     "from_numpy",
+    "from_pandas",
     "range",
     "read_binary_files",
     "read_datasource",

@@ -463,6 +463,18 @@ class Dataset:
         return [Dataset(sr if sr else [rt.put(B.block_from_rows([]))])
                 for sr in shard_refs]
 
+    def to_arrow(self):
+        """Materialize as ONE pyarrow Table (reference:
+        Dataset.to_arrow_refs, concatenated)."""
+        blocks = [rt.get(r) for r in self._executed_refs()]
+        return B.block_to_batch(B.block_concat(blocks), "pyarrow")
+
+    def to_pandas(self, limit: Optional[int] = None):
+        """Materialize as a pandas DataFrame (reference:
+        Dataset.to_pandas; `limit` guards accidental huge pulls)."""
+        ds = self.limit(limit) if limit is not None else self
+        return ds.to_arrow().to_pandas()
+
     def streaming_split(self, n: int, equal: bool = True,
                         locality_hints: Optional[List] = None) -> List:
         """n coordinated per-worker iterators over ONE shared streaming
@@ -872,6 +884,33 @@ def range_dataset(n: int, parallelism: int = 4) -> Dataset:
     from ray_tpu.data.datasource import RangeDatasource
 
     return read_datasource(RangeDatasource(n), parallelism)
+
+
+def from_pandas(dfs, parallelism: int = 4) -> Dataset:
+    """DataFrame(s) -> Dataset, one arrow block per frame (reference:
+    ray.data.from_pandas)."""
+    import pyarrow as pa
+
+    if not isinstance(dfs, (list, tuple)):
+        dfs = [dfs]
+    refs = [rt.put(pa.Table.from_pandas(df, preserve_index=False))
+            for df in dfs]
+    ds = Dataset(refs)
+    if len(refs) < parallelism:
+        ds = ds.repartition(parallelism)
+    return ds
+
+
+def from_arrow(tables, parallelism: int = 4) -> Dataset:
+    """pyarrow Table(s) -> Dataset; tables ARE the block format, so this
+    is zero-conversion (reference: ray.data.from_arrow)."""
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    refs = [rt.put(t) for t in tables]
+    ds = Dataset(refs)
+    if len(refs) < parallelism:
+        ds = ds.repartition(parallelism)
+    return ds
 
 
 def from_numpy(arrays: Dict[str, Any], parallelism: int = 4) -> Dataset:

@@ -303,3 +303,25 @@ def test_iter_torch_batches():
     np.testing.assert_array_equal(
         torch.cat([b["y"] for b in batches]).numpy(), np.arange(10)
     )
+
+
+def test_pandas_arrow_interop(rt_start):
+    import pandas as pd
+    import pyarrow as pa
+
+    df = pd.DataFrame({"a": [1, 2, 3, 4], "b": ["x", "y", "z", "w"]})
+    ds = rtd.from_pandas(df, parallelism=2)
+    assert ds.count() == 4
+    back = ds.sort("a").to_pandas()
+    assert list(back["a"]) == [1, 2, 3, 4]
+    assert list(back.columns) == ["a", "b"]
+
+    t = pa.Table.from_pydict({"v": [10, 20, 30]})
+    ds2 = rtd.from_arrow(t)
+    assert ds2.count() == 3
+    out = ds2.map(lambda r: {"v": r["v"] + 1}).to_arrow()
+    assert sorted(out.column("v").to_pylist()) == [11, 21, 31]
+
+    # limit guard on to_pandas
+    big = rtd.range(100)
+    assert len(big.to_pandas(limit=7)) == 7
