@@ -163,8 +163,11 @@ def main():
         multiplier=20, duration=duration, results=results,
     )
 
-    with open("BENCH_CORE.json", "w") as f:
-        json.dump(results, f, indent=1)
+    if not quick:
+        # --quick is a smoke run with 1s windows on a possibly-loaded box;
+        # only full runs overwrite the committed artifact.
+        with open("BENCH_CORE.json", "w") as f:
+            json.dump(results, f, indent=1)
     rt.shutdown()
 
 

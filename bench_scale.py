@@ -237,8 +237,10 @@ def main():
               results)
     finally:
         cluster.shutdown()
-    with open("BENCH_SCALE.json", "w") as f:
-        json.dump(results, f, indent=1)
+    if not quick:
+        # Only full runs overwrite the committed artifact.
+        with open("BENCH_SCALE.json", "w") as f:
+            json.dump(results, f, indent=1)
 
 
 if __name__ == "__main__":
