@@ -23,7 +23,7 @@ from ray_tpu.rl.env_runner import (
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig, appo_loss
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, dqn_loss
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
-from ray_tpu.rl.algorithms.td3 import TD3, TD3Config
+from ray_tpu.rl.algorithms.td3 import DDPGConfig, TD3, TD3Config
 from ray_tpu.rl.algorithms.impala import (
     IMPALA,
     IMPALAConfig,
@@ -62,6 +62,7 @@ __all__ = [
     "SACConfig",
     "TD3",
     "TD3Config",
+    "DDPGConfig",
     "ContinuousModuleSpec",
     "ContinuousPolicyModule",
     "ContinuousTransitionRunner",

@@ -339,3 +339,19 @@ class TD3(AlgorithmBase):
                 rt.kill(r)
             except Exception:  # noqa: BLE001
                 pass
+
+
+@dataclass
+class DDPGConfig(TD3Config):
+    """DDPG as the degenerate TD3 (reference: rllib's DDPG, which TD3
+    historically extended): no policy delay, no target-policy smoothing.
+    The twin critic stays (strictly helps; set nothing to recover the
+    classic single-critic behavior is intentionally not offered — the
+    minimum over twins only reduces overestimation)."""
+
+    policy_delay: int = 1
+    target_noise: float = 0.0
+    noise_clip: float = 0.0
+
+    def build(self) -> "TD3":
+        return TD3(self)
