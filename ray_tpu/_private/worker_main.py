@@ -68,13 +68,18 @@ def ensure_tpu_backend():
             import sitecustomize  # noqa: F401 — re-runs TPU registration
         except Exception as e:  # noqa: BLE001
             # Leave the flag unset so the NEXT TPU task retries a
-            # transient tunnel failure — and say something, or this
-            # worker silently computes on CPU forever.
-            print(
-                f"[worker] TPU backend attach failed "
-                f"({type(e).__name__}: {e}); will retry on next TPU task",
-                file=_sys.stderr, flush=True,
-            )
+            # transient tunnel failure — and say something (rate-limited:
+            # every TPU task retries, and a dead tunnel would spam one
+            # line per task), or this worker silently computes on CPU
+            # forever.
+            from ray_tpu.util.debug import log_every_n_seconds
+
+            if log_every_n_seconds("tpu-attach-failed", 30.0):
+                print(
+                    f"[worker] TPU backend attach failed "
+                    f"({type(e).__name__}: {e}); will retry on next TPU task",
+                    file=_sys.stderr, flush=True,
+                )
             return
         _TPU_ATTACHED = True
 

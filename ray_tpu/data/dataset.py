@@ -466,7 +466,7 @@ class Dataset:
     def to_arrow(self):
         """Materialize as ONE pyarrow Table (reference:
         Dataset.to_arrow_refs, concatenated)."""
-        blocks = [rt.get(r) for r in self._executed_refs()]
+        blocks = rt.get(list(self._executed_refs()))
         return B.block_to_batch(B.block_concat(blocks), "pyarrow")
 
     def to_pandas(self, limit: Optional[int] = None):

@@ -352,6 +352,3 @@ class DDPGConfig(TD3Config):
     policy_delay: int = 1
     target_noise: float = 0.0
     noise_clip: float = 0.0
-
-    def build(self) -> "TD3":
-        return TD3(self)

@@ -325,7 +325,6 @@ def cmd_config(args):
     from ray_tpu._private.config import Config, get_config
 
     cfg = get_config()
-    defaults = Config.__new__(Config)
     rows = []
     for f in fields(Config):
         cur = getattr(cfg, f.name)

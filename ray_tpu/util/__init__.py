@@ -1,3 +1,4 @@
+from ray_tpu.util import debug
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
@@ -7,6 +8,7 @@ from ray_tpu.util.placement_group import (
 
 __all__ = [
     "ActorPool",
+    "debug",
     "PlacementGroup",
     "placement_group",
     "remove_placement_group",

@@ -31,7 +31,7 @@ def _write_live(tmp_path, device="TPU_0(process=0)", age_s=60.0,
 
 def test_live_artifact_fresh_tpu_is_labeled_cached(tmp_path):
     path = _write_live(tmp_path, age_s=3600)
-    live = bench.load_live_artifact(path)
+    live = bench.load_live_artifact(path, max_age=14 * 3600)
     assert live is not None
     assert live["cached"] is True
     assert "tpu_live.py" in live["cache_note"]
@@ -42,10 +42,10 @@ def test_live_artifact_stale_is_rejected(tmp_path):
     """An artifact older than the round window (e.g. committed last
     round) must never be replayed as this round's number."""
     path = _write_live(tmp_path, age_s=20 * 3600)
-    assert bench.load_live_artifact(path) is None
+    assert bench.load_live_artifact(path, max_age=14 * 3600) is None
     # Future timestamps (clock skew) are rejected too.
     path = _write_live(tmp_path, age_s=-3600)
-    assert bench.load_live_artifact(path) is None
+    assert bench.load_live_artifact(path, max_age=14 * 3600) is None
 
 
 def test_live_artifact_non_tpu_is_rejected(tmp_path):

@@ -364,3 +364,33 @@ def test_td3_pendulum_improves(rt_start):
         )
     finally:
         algo.stop()
+
+
+def test_ddpg_preset_trains(rt_start):
+    """DDPG = TD3 preset (policy_delay=1, no target smoothing): fields,
+    build, and one real train iteration."""
+    import gymnasium as gym
+
+    from ray_tpu.rl import DDPGConfig, TD3
+
+    cfg = (
+        DDPGConfig()
+        .environment(lambda: gym.make("Pendulum-v1"), obs_dim=3,
+                     action_dim=1, action_low=-2.0, action_high=2.0)
+        .env_runners(num_env_runners=1, rollout_length=64)
+        .training(batch_size=32, updates_per_iteration=4, warmup_steps=32)
+    )
+    assert cfg.policy_delay == 1
+    assert cfg.target_noise == 0.0
+    algo = cfg.build()
+    assert isinstance(algo, TD3)
+    try:
+        r1 = algo.train()  # warmup fill
+        r2 = algo.train()  # real updates
+        assert r2["training_iteration"] == 2
+        assert "learner/q_loss" in r2
+        import numpy as np
+
+        assert np.isfinite(r2["learner/q_loss"])
+    finally:
+        algo.stop()

@@ -209,3 +209,28 @@ def test_workflow_run_async_and_waiting_output(rt_start, tmp_path):
         workflow.get_output(wid, storage=str(tmp_path))
     assert workflow.get_output(wid, storage=str(tmp_path), wait=30) == 42
     assert workflow.get_status(wid, storage=str(tmp_path)) == "SUCCEEDED"
+
+
+def test_util_debug_log_gates():
+    """ray.util.debug surface: log_once / log_every_n_seconds /
+    reset_log_once / disable_log_once_globally."""
+    from ray_tpu.util import debug
+
+    key = "t-debug-gate"
+    debug.reset_log_once(key)
+    assert debug.log_once(key)
+    assert not debug.log_once(key)
+    debug.reset_log_once(key)
+    assert debug.log_once(key)
+
+    pkey = "t-debug-periodic"
+    debug.reset_log_once(pkey)
+    assert debug.log_every_n_seconds(pkey, 60.0)
+    assert not debug.log_every_n_seconds(pkey, 60.0)
+    assert debug.log_every_n_seconds(pkey, 0.0)
+
+    debug.disable_log_once_globally()
+    try:
+        assert not debug.log_once("t-debug-disabled")
+    finally:
+        debug.enable_periodic_logging()
