@@ -568,15 +568,17 @@ class Raylet:
         cfg = get_config()
         if not cfg.actor_worker_recycle or w.port is None:
             return False
-        # Only recycle while the idle pool is short: a 1000-actor teardown
-        # must not strand 1000 idle interpreters (and their per-worker
-        # release RPCs) — beyond the pool target the process just dies.
+        # Only recycle while the pool is below the node's worker cap: a
+        # 1000-actor teardown must not strand 1000 idle interpreters (and
+        # their per-worker release RPCs) — beyond the cap the process
+        # just dies. Up to the cap, recycled workers are exactly the pool
+        # the next creation burst adopts from.
         n_pooled = sum(
             1 for x in self.workers.values()
             if x.actor_id is None and x.runtime_env_hash is None
             and x.lease_resources is None and x.idle
         )
-        if n_pooled >= max(cfg.worker_pool_min_idle, 1) * 2:
+        if n_pooled >= cfg.max_workers_per_node:
             return False
         try:
             # w.conn is the worker->raylet push channel (ServerConnection,
