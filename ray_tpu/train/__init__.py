@@ -31,6 +31,8 @@ from ray_tpu.train.session import (
     report,
 )
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
+from ray_tpu.train.data_config import DataConfig
+from ray_tpu.train import torch  # noqa: F401 — train.torch.TorchTrainer
 
 __all__ = [
     "Backend",
@@ -48,6 +50,8 @@ __all__ = [
     "BaseTrainer",
     "DataParallelTrainer",
     "JaxTrainer",
+    "DataConfig",
+    "torch",
     "report",
     "get_checkpoint",
     "get_dataset_shard",

@@ -1,0 +1,138 @@
+"""Torch training backend: DDP over gloo on the worker group.
+
+Analog of the reference's TorchConfig/_TorchBackend
+(train/torch/config.py:22,148 — pick a master addr/port, run
+dist.init_process_group on every worker) and the prepare_model/
+prepare_data_loader helpers (train/torch/train_loop_utils.py:74). The
+JAX stack is this framework's first-class path; TorchTrainer exists so
+reference workloads (BASELINE.md: "TorchTrainer fashion-MNIST, 2 CPU
+workers, gloo backend") port without rewrites. CPU/gloo only — there is
+no NCCL in the TPU world; torch models that need accelerators belong on
+the JAX path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+    def backend_cls(self):
+        return _TorchBackend
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig):
+        n = len(worker_group)
+        if n < 1:
+            return
+        addrs = worker_group.execute(_get_host_ip)
+        port = _pick_free_port()
+        worker_group.execute_with_rank(
+            _torch_process_group_init,
+            master_addr=addrs[0],
+            master_port=port,
+            world_size=n,
+            backend=backend_config.backend,
+            timeout_s=backend_config.init_timeout_s,
+        )
+
+    def on_shutdown(self, worker_group, backend_config: TorchConfig):
+        try:
+            worker_group.execute(_torch_process_group_destroy)
+        except Exception:  # noqa: BLE001 — workers may already be gone
+            pass
+
+
+def _get_host_ip():
+    import socket
+
+    return socket.gethostbyname(socket.gethostname())
+
+
+def _pick_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _torch_process_group_init(rank: int, master_addr: str, master_port: int,
+                              world_size: int, backend: str,
+                              timeout_s: float):
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    if not dist.is_initialized():
+        dist.init_process_group(
+            backend=backend,
+            rank=rank,
+            world_size=world_size,
+            timeout=datetime.timedelta(seconds=timeout_s),
+        )
+    return True
+
+
+def _torch_process_group_destroy():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+def prepare_model(model):
+    """Wrap a torch module for data-parallel training (reference:
+    train.torch.prepare_model — DDP when world_size > 1)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Shard a DataLoader across the training workers (reference:
+    train.torch.prepare_data_loader — DistributedSampler insertion)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_initialized() and dist.get_world_size() > 1):
+        return loader
+    sampler = DistributedSampler(loader.dataset)
+    return DataLoader(
+        loader.dataset,
+        batch_size=loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=loader.collate_fn,
+        drop_last=loader.drop_last,
+    )
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer preconfigured with the torch/gloo backend
+    (reference: train/torch/torch_trainer.py TorchTrainer)."""
+
+    def __init__(self, train_loop_per_worker, *, backend_config=None,
+                 **kwargs):
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=backend_config or TorchConfig(),
+            **kwargs,
+        )

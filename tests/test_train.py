@@ -294,3 +294,66 @@ def test_storage_retention_prunes_remote(tmp_path):
     # Only the 2 newest remain, in sequential-name order.
     assert names == ["checkpoint_000002", "checkpoint_000003"]
     assert storage.download("checkpoint_000003").to_dict() == {"step": 3}
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_torch_trainer_ddp_gloo(tmp_path):
+    """TorchTrainer over the gloo process group (BASELINE.md reference
+    config: TorchTrainer, 2 CPU workers, gloo): DDP-wrapped training on a
+    sharded loader; worker params must stay bit-identical (gradient
+    allreduce) and the loss must drop."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+            import torch
+            import torch.distributed as dist
+            from torch.utils.data import DataLoader, TensorDataset
+
+            from ray_tpu import train
+            from ray_tpu.train.torch import prepare_data_loader, prepare_model
+
+            torch.manual_seed(0)  # identical init on every worker
+            assert dist.is_initialized()
+            assert dist.get_world_size() == 2
+
+            g = torch.Generator().manual_seed(7)
+            x = torch.randn(256, 4, generator=g)
+            w_true = torch.tensor([[1.0], [-2.0], [0.5], [3.0]])
+            y = x @ w_true
+            loader = prepare_data_loader(
+                DataLoader(TensorDataset(x, y), batch_size=32)
+            )
+            model = prepare_model(torch.nn.Linear(4, 1))
+            opt = torch.optim.SGD(model.parameters(), lr=0.1)
+            first = last = None
+            for _epoch in range(12):
+                for xb, yb in loader:
+                    opt.zero_grad()
+                    loss = ((model(xb) - yb) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    if first is None:
+                        first = float(loss)
+                    last = float(loss)
+            flat = torch.cat(
+                [p.detach().reshape(-1) for p in model.parameters()]
+            )
+            train.report({
+                "first": first, "last": last,
+                "psum": float(flat.sum()),
+                "rank": train.get_world_rank(),
+            })
+
+    trainer = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torch", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    m = result.metrics
+    assert m["last"] < m["first"] * 0.2, m
