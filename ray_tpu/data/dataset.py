@@ -514,22 +514,22 @@ class Dataset:
         sink.on_write_complete(results)
         return results
 
-    def write_parquet(self, path: str) -> List[str]:
+    def write_parquet(self, path: str, filesystem=None) -> List[str]:
         """One parquet file per block under `path` (reference:
         Dataset.write_parquet)."""
         from ray_tpu.data.datasource import ParquetDatasink
 
-        return self.write_datasink(ParquetDatasink(path))
+        return self.write_datasink(ParquetDatasink(path, filesystem))
 
-    def write_csv(self, path: str) -> List[str]:
+    def write_csv(self, path: str, filesystem=None) -> List[str]:
         from ray_tpu.data.datasource import CSVDatasink
 
-        return self.write_datasink(CSVDatasink(path))
+        return self.write_datasink(CSVDatasink(path, filesystem))
 
-    def write_json(self, path: str) -> List[str]:
+    def write_json(self, path: str, filesystem=None) -> List[str]:
         from ray_tpu.data.datasource import JSONDatasink
 
-        return self.write_datasink(JSONDatasink(path))
+        return self.write_datasink(JSONDatasink(path, filesystem))
 
     def __repr__(self):
         return (
@@ -948,29 +948,29 @@ def read_datasource(datasource, parallelism: int = 4) -> Dataset:
     return ds
 
 
-def read_parquet(path: str, parallelism: int = 4) -> Dataset:
+def read_parquet(path: str, parallelism: int = 4, filesystem=None) -> Dataset:
     from ray_tpu.data.datasource import ParquetDatasource
 
-    return read_datasource(ParquetDatasource(path), parallelism)
+    return read_datasource(ParquetDatasource(path, filesystem), parallelism)
 
 
-def read_csv(path: str, parallelism: int = 4) -> Dataset:
+def read_csv(path: str, parallelism: int = 4, filesystem=None) -> Dataset:
     from ray_tpu.data.datasource import CSVDatasource
 
-    return read_datasource(CSVDatasource(path), parallelism)
+    return read_datasource(CSVDatasource(path, filesystem), parallelism)
 
 
-def read_json(path: str, parallelism: int = 4) -> Dataset:
+def read_json(path: str, parallelism: int = 4, filesystem=None) -> Dataset:
     from ray_tpu.data.datasource import JSONDatasource
 
-    return read_datasource(JSONDatasource(path), parallelism)
+    return read_datasource(JSONDatasource(path, filesystem), parallelism)
 
 
-def read_binary_files(path: str, parallelism: int = 4) -> Dataset:
+def read_binary_files(path: str, parallelism: int = 4, filesystem=None) -> Dataset:
     """One row per file: {"path", "bytes"} (reference: read_binary_files)."""
     from ray_tpu.data.datasource import BinaryDatasource
 
-    return read_datasource(BinaryDatasource(path), parallelism)
+    return read_datasource(BinaryDatasource(path, filesystem), parallelism)
 
 
 def read_text(path: str, parallelism: int = 4) -> Dataset:

@@ -82,18 +82,50 @@ class Datasink(ABC):
 class FileBasedDatasource(Datasource):
     """Shared machinery for one-file-per-read-task formats: expands a
     path or directory glob, one ReadTask per file (reference:
-    file_based_datasource.py)."""
+    file_based_datasource.py). Cloud URIs (s3:// gs:// hdfs:// ...)
+    resolve through pyarrow.fs — the same layer checkpoint storage and
+    spilling ride; an explicit `filesystem` overrides resolution (tests
+    inject local fakes for cloud-shaped paths)."""
 
     _GLOB = "*"
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, filesystem=None):
         self.path = path
+        self.filesystem = filesystem
+
+    def _fs(self):
+        """(pyarrow FileSystem, fs-local base path) or (None, local path)."""
+        if self.filesystem is not None:
+            return self.filesystem, self.path.split("://", 1)[-1]
+        if "://" in self.path and not self.path.startswith("file://"):
+            import pyarrow.fs as pafs
+
+            return pafs.FileSystem.from_uri(self.path)
+        return None, self.path.removeprefix("file://")
 
     def _paths(self) -> List[str]:
-        if os.path.isdir(self.path):
-            paths = sorted(_glob.glob(os.path.join(self.path, self._GLOB)))
+        import fnmatch
+
+        fs, base = self._fs()
+        if fs is None:
+            if os.path.isdir(base):
+                paths = sorted(_glob.glob(os.path.join(base, self._GLOB)))
+            else:
+                paths = sorted(_glob.glob(base)) or [base]
         else:
-            paths = sorted(_glob.glob(self.path)) or [self.path]
+            import pyarrow.fs as pafs
+
+            info = fs.get_file_info(base)
+            if info.type == pafs.FileType.Directory:
+                sel = pafs.FileSelector(base, recursive=False)
+                paths = sorted(
+                    f.path for f in fs.get_file_info(sel)
+                    if f.is_file and fnmatch.fnmatch(
+                        os.path.basename(f.path), self._GLOB
+                    )
+                )
+            else:
+                paths = [base]
         if not paths:
             raise FileNotFoundError(
                 f"no {self._GLOB} files under {self.path!r}"
@@ -102,21 +134,40 @@ class FileBasedDatasource(Datasource):
 
     @abstractmethod
     def _read_file(self, path: str) -> Any:
-        """Parse one file into a block (runs in a remote worker)."""
+        """Parse one file into a block (runs in a remote worker). `path`
+        is opened through _open (local or pyarrow.fs)."""
+
+    def _open(self, path: str, mode: str = "rb", seekable: bool = False):
+        fs, _ = self._fs()
+        if fs is None:
+            return open(path, mode)
+        # Parquet readers need random access; sequential formats stream.
+        return (fs.open_input_file(path) if seekable
+                else fs.open_input_stream(path))
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         read = self._read_file
         return [
             ReadTask(
                 (lambda p=p: [read(p)]),
-                {"path": p, "size_bytes": _safe_size(p)},
+                {"path": p, "size_bytes": self._safe_size(p)},
             )
             for p in self._paths()
         ]
 
+    def _safe_size(self, p: str) -> Optional[int]:
+        fs, _ = self._fs()
+        if fs is None:
+            return _safe_size(p)
+        try:
+            info = fs.get_file_info(p)
+            return info.size if info.is_file else None
+        except Exception:  # noqa: BLE001
+            return None
+
     def estimate_inmemory_data_size(self) -> Optional[int]:
         try:
-            return sum(_safe_size(p) or 0 for p in self._paths())
+            return sum(self._safe_size(p) or 0 for p in self._paths())
         except FileNotFoundError:
             return None
 
@@ -134,7 +185,8 @@ class ParquetDatasource(FileBasedDatasource):
     def _read_file(self, path: str):
         import pyarrow.parquet as pq
 
-        return pq.read_table(path)
+        with self._open(path, seekable=True) as f:
+            return pq.read_table(f)
 
 
 class CSVDatasource(FileBasedDatasource):
@@ -143,7 +195,8 @@ class CSVDatasource(FileBasedDatasource):
     def _read_file(self, path: str):
         import pyarrow.csv as pacsv
 
-        return pacsv.read_csv(path)
+        with self._open(path) as f:
+            return pacsv.read_csv(f)
 
 
 class JSONDatasource(FileBasedDatasource):
@@ -152,16 +205,21 @@ class JSONDatasource(FileBasedDatasource):
     def _read_file(self, path: str):
         import pyarrow.json as pajson
 
-        return pajson.read_json(path)
+        with self._open(path) as f:
+            return pajson.read_json(f)
 
 
 class TextDatasource(FileBasedDatasource):
     _GLOB = "*"
 
     def _read_file(self, path: str):
-        with open(path) as f:
+        import io
+
+        with self._open(path) as f:
+            text = io.TextIOWrapper(f, encoding="utf-8") if not isinstance(
+                f, io.TextIOBase) else f
             return B.block_from_rows(
-                [{"text": line.rstrip("\n")} for line in f]
+                [{"text": line.rstrip("\n")} for line in text]
             )
 
 
@@ -172,7 +230,7 @@ class BinaryDatasource(FileBasedDatasource):
     _GLOB = "*"
 
     def _read_file(self, path: str):
-        with open(path, "rb") as f:
+        with self._open(path) as f:
             return B.block_from_rows([{"path": path, "bytes": f.read()}])
 
 
@@ -249,27 +307,53 @@ def _np_item(v):
 
 class FileBasedDatasink(Datasink):
     """One file per block under a directory (reference: the
-    _FileDatasink write model)."""
+    _FileDatasink write model). Cloud URIs resolve through pyarrow.fs;
+    an explicit `filesystem` overrides resolution."""
 
     _EXT = "bin"
 
-    def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+    def __init__(self, path: str, filesystem=None):
+        self.filesystem = filesystem
+        if filesystem is not None:
+            self.path = path.split("://", 1)[-1]
+            self._uri_prefix = path.rsplit(self.path, 1)[0]
+        elif "://" in path and not path.startswith("file://"):
+            import pyarrow.fs as pafs
+
+            self.filesystem, self.path = pafs.FileSystem.from_uri(path)
+            self._uri_prefix = path[: len(path) - len(self.path)]
+        else:
+            self.path = os.path.abspath(path.removeprefix("file://"))
+            self._uri_prefix = ""
 
     def on_write_start(self) -> None:
-        os.makedirs(self.path, exist_ok=True)
+        if self.filesystem is not None:
+            self.filesystem.create_dir(self.path, recursive=True)
+        else:
+            os.makedirs(self.path, exist_ok=True)
 
     @abstractmethod
     def _write_rows(self, rows: List[Any], file_path: str) -> None:
-        """Persist one block's rows (runs in a remote worker)."""
+        """Persist one block's rows (runs in a remote worker); open the
+        target through _open_output."""
+
+    def _open_output(self, file_path: str, text: bool = False):
+        if self.filesystem is not None:
+            stream = self.filesystem.open_output_stream(file_path)
+            if text:
+                import io
+
+                return io.TextIOWrapper(stream, encoding="utf-8")
+            return stream
+        return open(file_path, "w" if text else "wb")
 
     def write(self, block: Any, ctx: Dict) -> Any:
         rows = B.block_to_rows(block)
         if not rows:
             return None
-        fp = os.path.join(self.path, f"part-{ctx['task_index']:05d}.{self._EXT}")
+        fp = f"{self.path}/part-{ctx['task_index']:05d}.{self._EXT}"
         self._write_rows(rows, fp)
-        return fp
+        return self._uri_prefix + fp if self._uri_prefix else fp
 
 
 class ParquetDatasink(FileBasedDatasink):
@@ -279,7 +363,8 @@ class ParquetDatasink(FileBasedDatasink):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        pq.write_table(pa.Table.from_pylist(rows), file_path)
+        with self._open_output(file_path) as f:
+            pq.write_table(pa.Table.from_pylist(rows), f)
 
 
 class CSVDatasink(FileBasedDatasink):
@@ -289,7 +374,8 @@ class CSVDatasink(FileBasedDatasink):
         import pyarrow as pa
         import pyarrow.csv as pacsv
 
-        pacsv.write_csv(pa.Table.from_pylist(rows), file_path)
+        with self._open_output(file_path) as f:
+            pacsv.write_csv(pa.Table.from_pylist(rows), f)
 
 
 class JSONDatasink(FileBasedDatasink):
@@ -300,6 +386,6 @@ class JSONDatasink(FileBasedDatasink):
 
         from ray_tpu.data.dataset import _json_fallback
 
-        with open(file_path, "w") as f:
+        with self._open_output(file_path, text=True) as f:
             for r in rows:
                 f.write(_json.dumps(r, default=_json_fallback) + "\n")

@@ -325,3 +325,30 @@ def test_pandas_arrow_interop(rt_start):
     # limit guard on to_pandas
     big = rtd.range(100)
     assert len(big.to_pandas(limit=7)) == 7
+
+
+def test_cloud_shaped_io_through_fake_fs(rt_start, tmp_path):
+    """read_/write_ with s3://-shaped URIs over an injected local
+    filesystem (the pyarrow.fs layer cloud IO rides; reference:
+    data/datasource file IO with filesystem=)."""
+    import pyarrow.fs as pafs
+
+    fake_s3 = pafs.SubTreeFileSystem(str(tmp_path), pafs.LocalFileSystem())
+    ds = rtd.from_items([{"i": i, "x": i * 0.5} for i in range(20)],
+                        parallelism=4)
+    files = ds.write_parquet("s3://bucket/out", filesystem=fake_s3)
+    assert len(files) == 4 and all(f.startswith("s3://bucket/out/part-")
+                                   for f in files)
+    back = rtd.read_parquet("s3://bucket/out", filesystem=fake_s3)
+    assert sorted(r["i"] for r in back.take_all()) == list(range(20))
+
+    jl = ds.write_json("s3://bucket/jl", filesystem=fake_s3)
+    assert jl and all(f.endswith(".jsonl") for f in jl)
+    back2 = rtd.read_json("s3://bucket/jl", filesystem=fake_s3)
+    assert back2.count() == 20
+
+    # file:// URIs resolve with no injection at all.
+    local = ds.write_csv("file://" + str(tmp_path / "csvs"))
+    assert local
+    back3 = rtd.read_csv(str(tmp_path / "csvs"))
+    assert back3.count() == 20
