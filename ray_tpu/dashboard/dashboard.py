@@ -78,7 +78,7 @@ _HTML = """<!DOCTYPE html>
 <script>
 "use strict";
 const TABS = ["overview","nodes","actors","tasks","objects",
-              "placement groups","jobs","events","metrics","stacks"];
+              "placement groups","serve","jobs","events","metrics","stacks"];
 let tab = location.hash.slice(1) || "overview";
 let filter = "", sortKey = null, sortDir = 1, openJob = null;
 const hist = {};  // metric sparkline history
@@ -199,6 +199,12 @@ async function render() {
   } else if (tab === "placement groups") {
     el("main").innerHTML = rows(await api("placement_groups"),
       ["pg_id","state","strategy","bundles"], "state");
+  } else if (tab === "serve") {
+    const apps = await api("serve");
+    el("main").innerHTML = apps.length
+      ? rows(apps, ["app","deployment","target_replicas",
+                    "running_replicas","version"])
+      : `<p style="color:var(--muted)">no serve applications</p>`;
   } else if (tab === "jobs") {
     const jobs = await api("jobs");
     let html = `<table><tr><th>submission_id</th><th>state</th>
@@ -314,6 +320,7 @@ class Dashboard:
                 web.get("/api/jobs", self.jobs),
                 web.get("/api/events", self.events),
                 web.get("/api/stacks", self.stacks),
+                web.get("/api/serve", self.serve_apps),
                 web.post("/api/jobs", self.submit_job),
                 web.get("/api/jobs/{submission_id}", self.job_info),
                 web.get("/api/jobs/{submission_id}/logs", self.job_logs),
@@ -439,6 +446,35 @@ class Dashboard:
                 for p in pgs
             ]
         )
+
+    async def serve_apps(self, request):
+        """Serve application/deployment view, read from the controller's
+        GCS-KV checkpoint (written on every mutation — the dashboard
+        needs no actor-call machinery; reference: the dashboard serve
+        module reading controller state)."""
+        import cloudpickle
+
+        try:
+            r = await self.gcs.call(
+                "kv_get", {"ns": "serve", "key": b"serve_controller_ckpt"}
+            )
+            raw = r.get("value")
+            if not raw:
+                return self._json([])
+            state = cloudpickle.loads(raw)
+        except Exception:  # noqa: BLE001 — no serve running
+            return self._json([])
+        out = []
+        for name, app in (state.get("apps") or {}).items():
+            dep = app.get("deployment")
+            out.append({
+                "app": name,
+                "deployment": getattr(dep, "name", str(dep)),
+                "target_replicas": app.get("target"),
+                "running_replicas": len(app.get("replicas") or []),
+                "version": app.get("version"),
+            })
+        return self._json(out)
 
     async def stacks(self, request):
         """Live per-worker thread stacks from every (or one) node — the

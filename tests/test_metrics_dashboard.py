@@ -144,6 +144,23 @@ def test_dashboard_endpoints(dashboard):
         "stack" in t for t in some["threads"]
     )
 
+    # Serve tab source: controller checkpoint -> /api/serve.
+    from ray_tpu import serve as rt_serve
+
+    @rt_serve.deployment(num_replicas=1)
+    def dash_echo(x):
+        return x
+
+    rt_serve.run(dash_echo.bind(), name="dash_app")
+    apps = _wait_for(
+        lambda: (lambda a: a if a else None)(
+            json.loads(_get(dashboard + "/api/serve"))
+        )
+    )
+    assert any(a["app"] == "dash_app" and a["running_replicas"] == 1
+               for a in apps), apps
+    rt_serve.shutdown()
+
     Counter("dash_counter").inc(3)
     body = _wait_for(
         lambda: (lambda t: t if "dash_counter" in t else None)(
