@@ -19,7 +19,11 @@ from ray_tpu._version import version as __version__  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private import worker as _worker
 from ray_tpu._private.ids import JobID
-from ray_tpu._private.worker import ActorHandle, ObjectRef  # noqa: F401
+from ray_tpu._private.worker import (  # noqa: F401
+    ActorHandle,
+    ObjectRef,
+    ObjectRefGenerator,
+)
 from ray_tpu.actor import ActorClass, method  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
 

@@ -113,6 +113,75 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
 
+class ObjectRefGenerator:
+    """Iterator over a dynamic-generator task's item refs
+    (num_returns="dynamic"; reference: ObjectRefGenerator /
+    streaming_generator). Items STREAM: ref i becomes available as soon
+    as the running task yields item i and stores it — iteration does not
+    wait for task completion. Item oids derive deterministically from
+    (task_id, index), so retries regenerate the same refs."""
+
+    def __init__(self, task_id: "TaskID", future, client):
+        self._task_id = task_id
+        self._future = future  # resolves to ("__gen__", n) / raises
+        self._client = client
+        self._i = 0
+        self._n: Optional[int] = None
+
+    def _read_n(self):
+        val = self._future.result(0)
+        if isinstance(val, tuple) and val and val[0] == "__gen__":
+            self._n = val[1]
+        else:  # non-generator value under dynamic: single item
+            self._n = 1
+
+    def _adopt(self, oid: bytes) -> ObjectRef:
+        ref = ObjectRef(ObjectID(oid))
+        c = self._client
+        c._in_store.add(oid)
+        c._owned_store_oids.add(oid)
+        c.known_refs[oid] = ref
+        c._track_owned_ref(ref)
+        return ref
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        c = self._client
+        oid = object_id_for_task(self._task_id, self._i).binary()
+        while True:
+            if self._n is not None and self._i >= self._n:
+                raise StopIteration
+            # Item already visible? (local store, or known to the
+            # directory after the producer's registration flush.)
+            if c.store is not None and c.store.contains_raw(oid):
+                break
+            try:
+                known = c._run(
+                    c.gcs.call("object_location_get", {"object_id": oid}),
+                    timeout=30,
+                )
+                if known.get("nodes") or known.get("spilled"):
+                    break
+            except Exception:  # noqa: BLE001 — transient; retry below
+                pass
+            if self._future.done():
+                if self._n is None:
+                    self._read_n()  # raises the task's error if it failed
+                    continue  # recheck i < n, then item visibility
+                # Completed, i < n, but the item never appeared: the
+                # store lost it; let get()'s recovery path deal with it.
+                break
+            time.sleep(0.01)
+        self._i += 1
+        return self._adopt(oid)
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator(task={self._task_id.hex()[:12]}, "
+                f"next={self._i})")
+
+
 def _ref_from_binary(b: bytes) -> ObjectRef:
     client = _global_client
     if client is not None:
@@ -1115,16 +1184,24 @@ class CoreClient:
         trace_ctx = tracing.inject()
         if trace_ctx:
             spec["trace_ctx"] = trace_ctx
-        refs = []
-        futures = []
-        for i in range(num_returns):
-            oid = object_id_for_task(task_id, i)
+        if num_returns == "dynamic":
+            # Streaming generator task: ONE future carries completion +
+            # item count; item refs materialize through the
+            # ObjectRefGenerator as the task yields.
             fut = concurrent.futures.Future()
-            ref = ObjectRef(oid, fut)
-            self.known_refs[oid.binary()] = ref
-            self._track_owned_ref(ref)
-            refs.append(ref)
-            futures.append(fut)
+            refs = [ObjectRefGenerator(task_id, fut, self)]
+            futures = [fut]
+        else:
+            refs = []
+            futures = []
+            for i in range(num_returns):
+                oid = object_id_for_task(task_id, i)
+                fut = concurrent.futures.Future()
+                ref = ObjectRef(oid, fut)
+                self.known_refs[oid.binary()] = ref
+                self._track_owned_ref(ref)
+                refs.append(ref)
+                futures.append(fut)
         self._borrow_deps(spec, borrow_oids)
         with self._submit_lock:
             self._submit_buf.append((spec, futures, retries))
@@ -1175,7 +1252,7 @@ class CoreClient:
         return (
             not spec.get("deps")
             and spec.get("scheduling") is None
-            and spec.get("num_returns", 1) == 1
+            and spec.get("num_returns", 1) in (1, "dynamic")
         )
 
     async def _submit_direct(self, spec, futures, retries):
@@ -1397,6 +1474,11 @@ class CoreClient:
     def _complete_task(self, spec, result, futures):
         self._release_borrows(spec)
         status = result.get("status")
+        if status == "ok" and result.get("generator"):
+            # Dynamic-generator task: items already live in the store
+            # under (task_id, i) oids; the future resolves to the count.
+            futures[0].set_result(("__gen__", result["num_items"]))
+            return
         if status == "ok":
             for i, entry in enumerate(result["returns"]):
                 oid = object_id_for_task(TaskID(spec["task_id"]), i).binary()

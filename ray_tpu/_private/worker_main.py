@@ -355,6 +355,22 @@ class WorkerProcess:
     def _package_returns(self, spec, value, xlang: bool = False) -> dict:
         cfg = get_config()
         num_returns = spec.get("num_returns", 1)
+        if num_returns == "dynamic":
+            # Streaming generator task (reference: streaming_generator /
+            # num_returns="dynamic"): each yielded item is serialized and
+            # stored under (task_id, i) AS PRODUCED, so consumers holding
+            # the ObjectRefGenerator read item i while the generator is
+            # still running. The final count rides the task result.
+            items = value if inspect.isgenerator(value) else iter([value])
+            task_id = TaskID(spec["task_id"])
+            n = 0
+            for i, v in enumerate(items):
+                so = ser.serialize(v)
+                self.client.put_serialized_with_spill(
+                    object_id_for_task(task_id, i), so
+                )
+                n += 1
+            return {"status": "ok", "generator": True, "num_items": n}
         if num_returns == 1:
             values = [value]
         else:

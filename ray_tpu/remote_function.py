@@ -63,7 +63,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries"),
             runtime_env=opts.get("runtime_env"),
         )
-        return refs[0] if num_returns == 1 else refs
+        return refs[0] if num_returns in (1, "dynamic") else refs
 
     def options(self, **new_options):
         merged = {**self._options, **new_options}
