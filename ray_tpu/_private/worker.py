@@ -251,7 +251,7 @@ class ActorMethod:
             num_returns=self._num_returns,
             max_task_retries=retries,
         )
-        return refs[0] if self._num_returns == 1 else refs
+        return refs[0] if self._num_returns in (1, "dynamic") else refs
 
     def bind(self, *args, **kwargs):
         """Lazy DAG composition (reference: dag/class_node.py)."""
@@ -1702,15 +1702,21 @@ class CoreClient:
         trace_ctx = tracing.inject()
         if trace_ctx:
             request["trace_ctx"] = trace_ctx
-        refs, futures = [], []
-        for i in range(num_returns):
-            oid = object_id_for_task(task_id, i)
+        if num_returns == "dynamic":
+            # Streaming generator actor method (same contract as dynamic
+            # tasks: items store under (task_id, i) as yielded).
             fut = concurrent.futures.Future()
-            ref = ObjectRef(oid, fut)
-            self.known_refs[oid.binary()] = ref
-            self._track_owned_ref(ref)
-            refs.append(ref)
-            futures.append(fut)
+            refs, futures = [ObjectRefGenerator(task_id, fut, self)], [fut]
+        else:
+            refs, futures = [], []
+            for i in range(num_returns):
+                oid = object_id_for_task(task_id, i)
+                fut = concurrent.futures.Future()
+                ref = ObjectRef(oid, fut)
+                self.known_refs[oid.binary()] = ref
+                self._track_owned_ref(ref)
+                refs.append(ref)
+                futures.append(fut)
         spec = {"task_id": task_id.binary()}
         self._borrow_deps(spec, borrow_oids)
         # Same burst batching as plain tasks: one thread->loop crossing

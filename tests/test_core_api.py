@@ -319,3 +319,20 @@ def test_dynamic_generator_error_propagates():
     with pytest.raises(Exception, match="mid-stream failure"):
         for ref in gen:
             rt.get(ref, timeout=30)
+
+
+def test_dynamic_generator_actor_method():
+    """Generator ACTOR methods stream items too (reference: streaming
+    generator actor calls — the Serve token-streaming substrate)."""
+
+    @rt.remote
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok-{i}"
+
+    s = Streamer.remote()
+    gen = s.tokens.options(num_returns="dynamic").remote(4)
+    assert isinstance(gen, rt.ObjectRefGenerator)
+    out = [rt.get(r, timeout=30) for r in gen]
+    assert out == ["tok-0", "tok-1", "tok-2", "tok-3"]
