@@ -12,6 +12,7 @@ from ray_tpu.rl.core.rl_module import (
     ContinuousModuleSpec,
     ContinuousPolicyModule,
     DiscretePolicyModule,
+    DuelingQNetworkModule,
     RLModuleSpec,
 )
 from ray_tpu.rl.env_runner import (
@@ -23,6 +24,7 @@ from ray_tpu.rl.env_runner import (
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig, appo_loss
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, dqn_loss
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rl.algorithms.td3 import DDPGConfig, TD3, TD3Config
 from ray_tpu.rl.algorithms.impala import (
     IMPALA,
@@ -55,13 +57,19 @@ from ray_tpu.rl.offline import (
     dataset_to_batch,
     episodes_to_dataset,
 )
-from ray_tpu.rl.replay import ReplayBuffer
+from ray_tpu.rl.replay import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    n_step_transitions,
+)
 
 __all__ = [
     "SAC",
     "SACConfig",
     "TD3",
     "TD3Config",
+    "CQL",
+    "CQLConfig",
     "DDPGConfig",
     "ContinuousModuleSpec",
     "ContinuousPolicyModule",
@@ -74,12 +82,15 @@ __all__ = [
     "LearnerGroup",
     "RLModuleSpec",
     "DiscretePolicyModule",
+    "DuelingQNetworkModule",
     "EnvRunner",
     "compute_gae",
     "DQN",
     "DQNConfig",
     "dqn_loss",
     "ReplayBuffer",
+    "PrioritizedReplayBuffer",
+    "n_step_transitions",
     "TransitionEnvRunner",
     "PPO",
     "PPOConfig",

@@ -1,0 +1,321 @@
+"""CQL: conservative Q-learning for offline continuous control.
+
+Reference analog: rllib/algorithms/cql/ (CQLConfig/CQL layered on SAC).
+Kumar et al. 2020's CQL(H): the SAC twin-critic update plus a
+conservative penalty that pushes Q down on out-of-distribution actions
+(importance-sampled logsumexp over random + policy actions) and up on
+dataset actions, so the learned Q never over-estimates actions the
+dataset can't support. Like the reference it is offline-first: training
+consumes a transition Dataset (episodes_to_dataset rows with
+obs/actions/rewards/next_obs/dones), no env interaction.
+
+TPU framing: the entire update — twin-critic TD + conservative penalty
+(3K candidate-action Q evaluations batched as one (3K*B, obs+act) tower
+pass), reparameterized actor, auto temperature, polyak sync — is ONE
+jitted function over a state pytree, so a learner step is a single
+device program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.core.rl_module import (
+    ContinuousModuleSpec,
+    ContinuousPolicyModule,
+)
+
+
+def make_cql_update(module: ContinuousPolicyModule, pi_tx, q_tx, alpha_tx,
+                    gamma: float, tau: float, target_entropy: float,
+                    cql_alpha: float, num_candidates: int):
+    """Builds the jitted CQL update: state pytree in, state pytree out."""
+
+    d = module.spec.action_dim
+    log_unif = -d * jnp.log(2.0)  # uniform density over [-1, 1]^d
+
+    def _tiled_q(params, qp, obs, actions):
+        """Q towers over K candidate actions per state: actions is
+        (K, B, d); returns two (K, B) value grids in one tower pass."""
+        K, B = actions.shape[0], actions.shape[1]
+        obs_t = jnp.broadcast_to(obs[None], (K, B, obs.shape[-1]))
+        q1, q2 = module.q_values(
+            {**params, **qp},
+            obs_t.reshape(K * B, -1), actions.reshape(K * B, -1),
+        )
+        return q1.reshape(K, B), q2.reshape(K, B)
+
+    def update(state, batch, rng):
+        params, target = state["params"], state["target"]
+        log_alpha = state["log_alpha"]
+        alpha = jnp.exp(log_alpha)
+        k_next, k_pi, k_rand, k_cur, k_nxt = jax.random.split(rng, 5)
+        B = batch["obs"].shape[0]
+        K = num_candidates
+
+        # -- twin critic TD loss against the soft target ------------------
+        next_a, next_logp = module.sample_with_logp(
+            params, batch["next_obs"], k_next
+        )
+        tq1, tq2 = module.q_values(
+            {**params, "q1": target["q1"], "q2": target["q2"]},
+            batch["next_obs"], next_a,
+        )
+        soft_next = jnp.minimum(tq1, tq2) - alpha * next_logp
+        td_target = jax.lax.stop_gradient(
+            batch["rewards"] + gamma * (1.0 - batch["dones"]) * soft_next
+        )
+
+        # -- conservative candidate actions (sampled outside the q grad) --
+        a_rand = jax.random.uniform(k_rand, (K, B, d), minval=-1.0,
+                                    maxval=1.0)
+        def per_key(k, obs):
+            return module.sample_with_logp(params, obs, k)
+
+        a_cur, logp_cur = jax.vmap(per_key, in_axes=(0, None))(
+            jax.random.split(k_cur, K), batch["obs"]
+        )
+        a_nxt, logp_nxt = jax.vmap(per_key, in_axes=(0, None))(
+            jax.random.split(k_nxt, K), batch["next_obs"]
+        )
+        a_cur = jax.lax.stop_gradient(a_cur)
+        a_nxt = jax.lax.stop_gradient(a_nxt)
+        logp_cur = jax.lax.stop_gradient(logp_cur)
+        logp_nxt = jax.lax.stop_gradient(logp_nxt)
+
+        def q_loss_fn(qp):
+            q1, q2 = module.q_values(
+                {**params, **qp}, batch["obs"], batch["actions"]
+            )
+            td_loss = ((q1 - td_target) ** 2).mean() + (
+                (q2 - td_target) ** 2
+            ).mean()
+            # CQL(H) penalty: importance-sampled logsumexp over
+            # {uniform, pi(.|s), pi(.|s')} candidates minus dataset Q.
+            r1, r2 = _tiled_q(params, qp, batch["obs"], a_rand)
+            c1, c2 = _tiled_q(params, qp, batch["obs"], a_cur)
+            n1, n2 = _tiled_q(params, qp, batch["obs"], a_nxt)
+            cat1 = jnp.concatenate(
+                [r1 - log_unif, c1 - logp_cur, n1 - logp_nxt], axis=0
+            )
+            cat2 = jnp.concatenate(
+                [r2 - log_unif, c2 - logp_cur, n2 - logp_nxt], axis=0
+            )
+            gap1 = (jax.nn.logsumexp(cat1, axis=0) - jnp.log(3 * K) - q1)
+            gap2 = (jax.nn.logsumexp(cat2, axis=0) - jnp.log(3 * K) - q2)
+            cql_loss = cql_alpha * (gap1.mean() + gap2.mean())
+            return td_loss + cql_loss, (td_loss, cql_loss)
+
+        qp = {"q1": params["q1"], "q2": params["q2"]}
+        (q_loss, (td_loss, cql_loss)), q_grads = jax.value_and_grad(
+            q_loss_fn, has_aux=True
+        )(qp)
+        q_updates, q_opt = q_tx.update(q_grads, state["q_opt"], qp)
+        qp = optax.apply_updates(qp, q_updates)
+
+        # -- actor loss (reparameterized, against the UPDATED critics) ----
+        def pi_loss_fn(pi_params):
+            a, logp = module.sample_with_logp(
+                {**params, "pi": pi_params}, batch["obs"], k_pi
+            )
+            q1, q2 = module.q_values({**params, **qp}, batch["obs"], a)
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+        (pi_loss, logp), pi_grads = jax.value_and_grad(
+            pi_loss_fn, has_aux=True
+        )(params["pi"])
+        pi_updates, pi_opt = pi_tx.update(pi_grads, state["pi_opt"],
+                                          params["pi"])
+        pi_params = optax.apply_updates(params["pi"], pi_updates)
+
+        # -- automatic temperature ---------------------------------------
+        def alpha_loss_fn(la):
+            return -(
+                jnp.exp(la)
+                * jax.lax.stop_gradient(logp + target_entropy)
+            ).mean()
+
+        alpha_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+        a_update, alpha_opt = alpha_tx.update(
+            a_grad, state["alpha_opt"], log_alpha
+        )
+        log_alpha = optax.apply_updates(log_alpha, a_update)
+
+        # -- polyak target sync ------------------------------------------
+        new_target = jax.tree.map(
+            lambda t, o: (1.0 - tau) * t + tau * o,
+            target, {"q1": qp["q1"], "q2": qp["q2"]},
+        )
+        new_state = {
+            "params": {"pi": pi_params, **qp},
+            "target": new_target,
+            "log_alpha": log_alpha,
+            "pi_opt": pi_opt,
+            "q_opt": q_opt,
+            "alpha_opt": alpha_opt,
+        }
+        metrics = {
+            "q_loss": q_loss,
+            "td_loss": td_loss,
+            "cql_loss": cql_loss,
+            "actor_loss": pi_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": jnp.exp(log_alpha),
+            "entropy": -logp.mean(),
+        }
+        return new_state, metrics
+
+    return jax.jit(update)
+
+
+@dataclass
+class CQLConfig:
+    """Builder-style config (reference: CQLConfig extends SACConfig)."""
+
+    obs_dim: int = 3
+    action_dim: int = 1
+    action_low: float = -1.0
+    action_high: float = 1.0
+    hidden: tuple = (64, 64)
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    target_entropy: Optional[float] = None  # default: -action_dim
+    cql_alpha: float = 5.0
+    num_candidate_actions: int = 4  # K per candidate family (3K total)
+    minibatch_size: int = 128
+    seed: int = 0
+
+    def module(self, obs_dim=None, action_dim=None, action_low=None,
+               action_high=None, hidden=None):
+        for k, v in (("obs_dim", obs_dim), ("action_dim", action_dim),
+                     ("action_low", action_low),
+                     ("action_high", action_high), ("hidden", hidden)):
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def training(self, lr=None, gamma=None, tau=None, cql_alpha=None,
+                 num_candidate_actions=None, minibatch_size=None,
+                 target_entropy=None):
+        for k, v in (("lr", lr), ("gamma", gamma), ("tau", tau),
+                     ("cql_alpha", cql_alpha),
+                     ("num_candidate_actions", num_candidate_actions),
+                     ("minibatch_size", minibatch_size),
+                     ("target_entropy", target_entropy)):
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    """Offline conservative Q-learning over a transition Dataset.
+
+    Rows need obs/actions/rewards/next_obs/dones (normalized [-1, 1]
+    actions, as ContinuousTransitionRunner stores and
+    episodes_to_dataset preserves).
+    """
+
+    _BATCH_KEYS = ("obs", "actions", "rewards", "next_obs", "dones")
+
+    def __init__(self, config: CQLConfig):
+        self.config = config
+        spec = ContinuousModuleSpec(
+            config.obs_dim, config.action_dim,
+            config.action_low, config.action_high, config.hidden,
+        )
+        self.module = ContinuousPolicyModule(spec)
+        params = self.module.init(jax.random.PRNGKey(config.seed))
+        pi_tx = optax.adam(config.lr)
+        q_tx = optax.adam(config.lr)
+        alpha_tx = optax.adam(config.lr)
+        qp = {"q1": params["q1"], "q2": params["q2"]}
+        self.state = {
+            "params": params,
+            "target": jax.tree.map(lambda x: x, qp),
+            "log_alpha": jnp.asarray(0.0),
+            "pi_opt": pi_tx.init(params["pi"]),
+            "q_opt": q_tx.init(qp),
+            "alpha_opt": alpha_tx.init(jnp.asarray(0.0)),
+        }
+        tgt_ent = (
+            config.target_entropy
+            if config.target_entropy is not None
+            else -float(config.action_dim)
+        )
+        self._update = make_cql_update(
+            self.module, pi_tx, q_tx, alpha_tx,
+            config.gamma, config.tau, tgt_ent,
+            config.cql_alpha, config.num_candidate_actions,
+        )
+        self._rng = jax.random.PRNGKey(config.seed + 99)
+        self._np_rng = np.random.default_rng(config.seed)
+
+    def train_on_batch(self, batch: Dict[str, np.ndarray],
+                       num_epochs: int = 1) -> Dict[str, float]:
+        """Minibatch epochs of the jitted CQL update over materialized
+        transition arrays."""
+        n = len(batch["obs"])
+        metrics = {}
+        for _ in range(num_epochs):
+            order = self._np_rng.permutation(n)
+            for s in range(0, n, self.config.minibatch_size):
+                idx = order[s:s + self.config.minibatch_size]
+                mb = {
+                    k: jnp.asarray(batch[k][idx]) for k in self._BATCH_KEYS
+                }
+                self._rng, key = jax.random.split(self._rng)
+                self.state, m = self._update(self.state, mb, key)
+            metrics = {k: float(v) for k, v in m.items()}
+        return metrics
+
+    def train_on_dataset(self, ds, num_epochs: int = 1) -> Dict[str, float]:
+        """Streaming epochs through the Dataset executor (the reference's
+        OfflineData iter_batches loop)."""
+        metrics: Dict[str, float] = {}
+        for epoch in range(num_epochs):
+            shuffled = ds.random_shuffle(seed=self.config.seed + epoch)
+            for batch in shuffled.iter_batches(
+                batch_size=self.config.minibatch_size, batch_format="numpy"
+            ):
+                mb = {
+                    "obs": np.stack([
+                        np.asarray(o, dtype=np.float32) for o in batch["obs"]
+                    ]),
+                    "actions": np.stack([
+                        np.asarray(a, dtype=np.float32)
+                        for a in batch["actions"]
+                    ]),
+                    "rewards": np.asarray(
+                        [float(r) for r in batch["rewards"]],
+                        dtype=np.float32,
+                    ),
+                    "next_obs": np.stack([
+                        np.asarray(o, dtype=np.float32)
+                        for o in batch["next_obs"]
+                    ]),
+                    "dones": np.asarray(
+                        [float(x) for x in batch["dones"]], dtype=np.float32
+                    ),
+                }
+                jb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self._rng, key = jax.random.split(self._rng)
+                self.state, m = self._update(self.state, jb, key)
+                metrics = {k: float(v) for k, v in m.items()}
+        return metrics
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic (scaled) policy actions for evaluation."""
+        a_norm = self.module.deterministic_action(
+            self.state["params"], jnp.asarray(obs)
+        )
+        return np.asarray(self.module.scale_action(a_norm))

@@ -20,22 +20,30 @@ import numpy as np
 import ray_tpu as rt
 from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
-from ray_tpu.rl.core.rl_module import QNetworkModule, RLModuleSpec
+from ray_tpu.rl.core.rl_module import (
+    DuelingQNetworkModule,
+    QNetworkModule,
+    RLModuleSpec,
+)
 from ray_tpu.rl.env_runner import TransitionEnvRunner
-from ray_tpu.rl.replay import ReplayBuffer
+from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
 
 
 def dqn_loss(params, module, batch):
     """Huber TD loss against precomputed targets (target-network Q-values
     are computed driver-side so the learner stays a pure
-    params+batch -> grads function)."""
+    params+batch -> grads function). With prioritized replay the batch
+    carries importance-sampling ``weights`` applied per sample."""
     q = module.forward(params, batch["obs"])["q_values"]
     q_sa = jnp.take_along_axis(
         q, batch["actions"][:, None].astype(jnp.int32), axis=-1
     )[:, 0]
     td = q_sa - batch["targets"]
     huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2, jnp.abs(td) - 0.5)
-    loss = huber.mean()
+    if "weights" in batch:
+        loss = (batch["weights"] * huber).mean()
+    else:
+        loss = huber.mean()
     return loss, {
         "total_loss": loss,
         "q_mean": q_sa.mean(),
@@ -66,6 +74,15 @@ class DQNConfig(ConfigEvalMixin):
     epsilon_end: float = 0.05
     epsilon_decay_iters: int = 20
     seed: int = 0
+    # Rainbow-style extensions (reference: DQNConfig double_q / dueling /
+    # n_step / replay_buffer_config prioritized fields).
+    double_q: bool = True
+    dueling: bool = False
+    n_step: int = 1
+    prioritized_replay: bool = False
+    per_alpha: float = 0.6
+    per_beta_start: float = 0.4
+    per_beta_iters: int = 50  # iterations to anneal beta -> 1.0
 
     def environment(self, env_creator=None, obs_dim=None, num_actions=None):
         if env_creator is not None:
@@ -89,7 +106,9 @@ class DQNConfig(ConfigEvalMixin):
     def training(self, lr=None, gamma=None, train_batch_size=None,
                  updates_per_iteration=None, target_update_freq=None,
                  buffer_capacity=None, learning_starts=None,
-                 num_learners=None):
+                 num_learners=None, double_q=None, dueling=None, n_step=None,
+                 prioritized_replay=None, per_alpha=None,
+                 per_beta_start=None, per_beta_iters=None):
         for name, val in (
             ("lr", lr), ("gamma", gamma),
             ("train_batch_size", train_batch_size),
@@ -98,6 +117,10 @@ class DQNConfig(ConfigEvalMixin):
             ("buffer_capacity", buffer_capacity),
             ("learning_starts", learning_starts),
             ("num_learners", num_learners),
+            ("double_q", double_q), ("dueling", dueling), ("n_step", n_step),
+            ("prioritized_replay", prioritized_replay),
+            ("per_alpha", per_alpha), ("per_beta_start", per_beta_start),
+            ("per_beta_iters", per_beta_iters),
         ):
             if val is not None:
                 setattr(self, name, val)
@@ -125,7 +148,8 @@ class DQN(AlgorithmBase):
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
         spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
-        module_factory = self._module_factory = lambda: QNetworkModule(spec)  # noqa: E731
+        cls = DuelingQNetworkModule if config.dueling else QNetworkModule
+        module_factory = self._module_factory = lambda: cls(spec)  # noqa: E731
         self.module = module_factory()
 
         self.learner_group = LearnerGroup(
@@ -135,8 +159,15 @@ class DQN(AlgorithmBase):
             seed=config.seed,
             lr=config.lr,
         )
-        self.buffer = ReplayBuffer(
-            config.buffer_capacity, config.obs_dim, seed=config.seed
+        buffer_cls = (
+            PrioritizedReplayBuffer if config.prioritized_replay
+            else ReplayBuffer
+        )
+        buffer_kwargs = dict(seed=config.seed, store_discounts=True)
+        if config.prioritized_replay:
+            buffer_kwargs["alpha"] = config.per_alpha
+        self.buffer = buffer_cls(
+            config.buffer_capacity, config.obs_dim, **buffer_kwargs
         )
         self.env_runners = [
             TransitionEnvRunner.options(num_cpus=0.5).remote(
@@ -144,6 +175,8 @@ class DQN(AlgorithmBase):
                 module_factory,
                 seed=config.seed + 1 + i,
                 rollout_length=config.rollout_length,
+                gamma=config.gamma,
+                n_step=config.n_step,
                 connectors=(
                     config.connectors_factory()
                     if config.connectors_factory else None
@@ -151,7 +184,12 @@ class DQN(AlgorithmBase):
             )
             for i in range(config.num_env_runners)
         ]
+        # Driver-side copies: target net + the online params used for
+        # double-DQN argmax and PER priority refresh (synced once per
+        # iteration — the same one-iteration staleness the reference's
+        # async variants accept).
         self.target_params = self.learner_group.get_weights()
+        self._online_params = self.target_params
         self._target_q = jax.jit(
             lambda p, obs: self.module.forward(p, obs)["q_values"]
         )
@@ -165,16 +203,26 @@ class DQN(AlgorithmBase):
                timeout=300)
 
     def _checkpoint_extra_state(self):
-        return {"target_params": jax.device_get(self.target_params)}
+        return {
+            "target_params": jax.device_get(self.target_params),
+            "online_params": jax.device_get(self._online_params),
+        }
 
     def _restore_extra_state(self, extra):
         if "target_params" in extra:
             self.target_params = extra["target_params"]
+        if "online_params" in extra:
+            self._online_params = extra["online_params"]
 
     def _epsilon(self) -> float:
         cfg = self.config
         frac = min(1.0, self._iteration / max(cfg.epsilon_decay_iters, 1))
         return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def _per_beta(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._iteration / max(cfg.per_beta_iters, 1))
+        return cfg.per_beta_start + frac * (1.0 - cfg.per_beta_start)
 
     def train(self) -> Dict[str, Any]:
         cfg = self.config
@@ -188,25 +236,68 @@ class DQN(AlgorithmBase):
         metrics: Dict[str, float] = {}
         # 2. TD updates once the buffer warms up
         if len(self.buffer) >= cfg.learning_starts:
-            for _ in range(cfg.updates_per_iteration):
-                mb = self.buffer.sample(cfg.train_batch_size)
-                next_q = np.asarray(
+            beta = self._per_beta()
+            # Hard target sync BEFORE the update loop, from the pre-loop
+            # online snapshot; _online_params then refreshes from the
+            # learner mid-loop so the double-DQN argmax net trains away
+            # from the frozen target instead of mirroring it all
+            # iteration.
+            if self._iteration % cfg.target_update_freq == 0:
+                self.target_params = self._online_params
+            refresh = max(1, cfg.updates_per_iteration // 4)
+            for u in range(cfg.updates_per_iteration):
+                if u and u % refresh == 0 and (
+                    cfg.double_q or cfg.prioritized_replay
+                ):
+                    self._online_params = self.learner_group.get_weights()
+                if cfg.prioritized_replay:
+                    mb = self.buffer.sample(cfg.train_batch_size, beta=beta)
+                else:
+                    mb = self.buffer.sample(cfg.train_batch_size)
+                B = len(mb["obs"])
+                next_q_t = np.asarray(
                     self._target_q(self.target_params, mb["next_obs"])
                 )
-                targets = mb["rewards"] + cfg.gamma * (
+                # One fused online-net forward serves both the double-DQN
+                # argmax (next_obs half) and the PER priority refresh
+                # (obs half).
+                if cfg.double_q or cfg.prioritized_replay:
+                    q_on = np.asarray(self._target_q(
+                        self._online_params,
+                        np.concatenate([mb["obs"], mb["next_obs"]]),
+                    ))
+                    q_on_obs, q_on_next = q_on[:B], q_on[B:]
+                if cfg.double_q:
+                    # Double DQN: online net picks the action, target net
+                    # evaluates it (van Hasselt 2016).
+                    a_star = q_on_next.argmax(axis=-1)
+                    next_val = np.take_along_axis(
+                        next_q_t, a_star[:, None], axis=-1
+                    )[:, 0]
+                else:
+                    next_val = next_q_t.max(axis=-1)
+                targets = mb["rewards"] + mb["discounts"] * (
                     1.0 - mb["dones"]
-                ) * next_q.max(axis=-1)
+                ) * next_val
                 batch = {
                     "obs": mb["obs"],
                     "actions": mb["actions"],
                     "targets": targets.astype(np.float32),
                 }
+                if cfg.prioritized_replay:
+                    batch["weights"] = mb["weights"]
+                    q_sa = np.take_along_axis(
+                        q_on_obs,
+                        mb["actions"][:, None].astype(np.int64), axis=-1,
+                    )[:, 0]
+                    self.buffer.update_priorities(
+                        mb["indices"], np.abs(q_sa - targets)
+                    )
                 metrics = self.learner_group.update_from_batch(batch)
-            # 3. periodic target-network sync + runner weight broadcast
-            # (one weights fetch serves both).
+            # 3. runner weight broadcast (the fetch also refreshes the
+            # online snapshot for the next iteration's sync).
             weights = self.learner_group.get_weights()
-            if self._iteration % cfg.target_update_freq == 0:
-                self.target_params = weights
+            self._online_params = weights
             self._broadcast_weights(weights)
         self._iteration += 1
         stats = rt.get(

@@ -87,6 +87,39 @@ class QNetworkModule:
         return jnp.where(explore, random_a, greedy)
 
 
+class DuelingQNetworkModule(QNetworkModule):
+    """Dueling Q-network (Wang et al. 2016): a shared trunk feeding
+    separate value and advantage streams, combined as
+    Q = V + A - mean(A) for identifiability.
+
+    Reference analog: the dueling heads rllib's DQN builds when
+    ``DQNConfig.dueling`` is set (rllib/algorithms/dqn/).
+    Epsilon-greedy sampling is inherited — it only consumes q_values.
+    """
+
+    def init(self, rng: jax.Array) -> Dict:
+        if not self.spec.hidden:
+            raise ValueError(
+                "DuelingQNetworkModule needs at least one hidden layer "
+                "(the value/advantage streams branch off the trunk)"
+            )
+        k1, k2, k3 = jax.random.split(rng, 3)
+        trunk_sizes = [self.spec.obs_dim, *self.spec.hidden]
+        width = self.spec.hidden[-1]
+        return {
+            "trunk": init_mlp(k1, trunk_sizes),
+            "v": init_mlp(k2, [width, 1]),
+            "a": init_mlp(k3, [width, self.spec.num_actions]),
+        }
+
+    def forward(self, params: Dict, obs: jax.Array) -> Dict[str, jax.Array]:
+        h = jax.nn.relu(mlp_forward(params["trunk"], obs))
+        v = mlp_forward(params["v"], h)
+        a = mlp_forward(params["a"], h)
+        q = v + a - a.mean(axis=-1, keepdims=True)
+        return {"q_values": q}
+
+
 @dataclass(frozen=True)
 class ContinuousModuleSpec:
     """Spec for continuous-control modules (SAC family)."""

@@ -242,14 +242,28 @@ class TransitionEnvRunner(_EnvRunnerBase):
     needs no special handling here: next_obs is the true successor and
     dones records terminated-only, so Q targets bootstrap correctly
     through time limits.
+
+    With n_step > 1 the rollout is collapsed into n-step transitions
+    before shipping (windows cut at episode ends, per-transition
+    bootstrap ``discounts`` = gamma**m) — the reference applies the same
+    transform learner-side via its n-step connector.
     """
+
+    def __init__(self, env_creator, module_factory, seed: int = 0,
+                 rollout_length: int = 200, connectors=None,
+                 gamma: float = 0.99, n_step: int = 1):
+        super().__init__(env_creator, module_factory, seed=seed,
+                         rollout_length=rollout_length,
+                         connectors=connectors, gamma=gamma)
+        self.n_step = n_step
 
     def sample(self, epsilon: float = 0.1) -> Dict[str, np.ndarray]:
         import jax
 
         self._begin_rollout()
         T = self.rollout_length
-        obs_buf, act_buf, rew_buf, next_buf, done_buf = [], [], [], [], []
+        obs_buf, act_buf, rew_buf, next_buf = [], [], [], []
+        done_buf, end_buf = [], []
         for _ in range(T):
             self.rng, key = jax.random.split(self.rng)
             obs = self._obs_conn
@@ -261,17 +275,23 @@ class TransitionEnvRunner(_EnvRunnerBase):
             act_buf.append(action)
             rew_buf.append(self._reward(reward))
             done_buf.append(bool(terminated))
+            end_buf.append(bool(terminated or truncated))
             # next_obs passes the same connector pipeline as obs (Q targets
             # would otherwise mix distributions); _advance connects each
             # successor state exactly once.
             next_buf.append(self._advance(nxt, reward, terminated, truncated))
-        return {
+        batch = {
             "obs": np.stack(obs_buf),
             "actions": np.asarray(act_buf, dtype=np.int32),
             "rewards": np.asarray(rew_buf, dtype=np.float32),
             "next_obs": np.stack(next_buf),
             "dones": np.asarray(done_buf, dtype=np.float32),
         }
+        from ray_tpu.rl.replay import n_step_transitions
+
+        return n_step_transitions(
+            batch, np.asarray(end_buf, dtype=bool), self.n_step, self.gamma
+        )
 
 
 @rt.remote

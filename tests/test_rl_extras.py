@@ -394,3 +394,197 @@ def test_ddpg_preset_trains(rt_start):
         assert np.isfinite(r2["learner/q_loss"])
     finally:
         algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# Rainbow-style DQN extensions: n-step, PER, dueling, double-Q
+# (reference: DQNConfig double_q/dueling/n_step + prioritized replay,
+# rllib/algorithms/dqn/)
+# ---------------------------------------------------------------------------
+
+
+def test_n_step_transitions_math():
+    """3-step windows: discounted reward sums, episode cuts, gamma**m."""
+    from ray_tpu.rl import n_step_transitions
+
+    obs = np.arange(5, dtype=np.float32)[:, None]
+    nxt = obs + 1
+    batch = {
+        "obs": obs,
+        "next_obs": nxt,
+        "actions": np.zeros(5, dtype=np.int32),
+        "rewards": np.array([1, 2, 4, 8, 16], dtype=np.float32),
+        # step 2 terminates an episode; steps 3-4 are a fresh episode
+        "dones": np.array([0, 0, 1, 0, 0], dtype=np.float32),
+    }
+    ep_ends = np.array([False, False, True, False, False])
+    out = n_step_transitions(batch, ep_ends, n=3, gamma=0.5)
+    # t=0: r0 + g*r1 + g^2*r2 = 1 + 1 + 1 = 3, window hits the episode
+    # end at step 2 -> done=1, next_obs = nxt[2], discount = 0.5**3
+    assert out["rewards"][0] == pytest.approx(3.0)
+    assert out["dones"][0] == 1.0
+    assert out["next_obs"][0] == pytest.approx(nxt[2])
+    assert out["discounts"][0] == pytest.approx(0.125)
+    # t=1: r1 + g*r2 = 4, cut by episode end after 2 steps
+    assert out["rewards"][1] == pytest.approx(4.0)
+    assert out["discounts"][1] == pytest.approx(0.25)
+    # t=3: full-length window never crosses into nothing: r3 + g*r4 = 16
+    # (window truncated by rollout end after 2 steps, not an episode end)
+    assert out["rewards"][3] == pytest.approx(16.0)
+    assert out["dones"][3] == 0.0
+    assert out["discounts"][3] == pytest.approx(0.25)
+    # t=4: single-step tail window
+    assert out["rewards"][4] == pytest.approx(16.0)
+    assert out["discounts"][4] == pytest.approx(0.5)
+
+
+def test_prioritized_replay_bias_and_weights():
+    from ray_tpu.rl import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=64, obs_dim=1, seed=0, alpha=1.0)
+    buf.add_batch({
+        "obs": np.arange(64, dtype=np.float32)[:, None],
+        "next_obs": np.zeros((64, 1), dtype=np.float32),
+        "actions": np.zeros(64, dtype=np.int32),
+        "rewards": np.zeros(64, dtype=np.float32),
+        "dones": np.zeros(64, dtype=np.float32),
+    })
+    # Give one transition 100x the priority of the rest: it should
+    # dominate samples, and its IS weight should be the smallest.
+    buf.update_priorities(np.arange(64), np.ones(64))
+    buf.update_priorities(np.array([7]), np.array([100.0]))
+    mb = buf.sample(512, beta=1.0)
+    counts = np.bincount(mb["indices"], minlength=64)
+    assert counts[7] > 0.4 * 512
+    assert mb["weights"].max() == pytest.approx(1.0)
+    hot = mb["weights"][mb["indices"] == 7]
+    cold = mb["weights"][mb["indices"] != 7]
+    assert len(hot) and len(cold)
+    assert hot.max() < cold.min()
+
+
+def test_dueling_module_identifiable_and_samples():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import DuelingQNetworkModule, RLModuleSpec
+
+    mod = DuelingQNetworkModule(RLModuleSpec(obs_dim=3, num_actions=4))
+    params = mod.init(jax.random.PRNGKey(0))
+    obs = jax.random.normal(jax.random.PRNGKey(1), (5, 3))
+    q = mod.forward(params, obs)["q_values"]
+    assert q.shape == (5, 4)
+    # Identifiability: shifting every advantage by a constant must leave
+    # Q unchanged (the mean-advantage subtraction).
+    shifted = jax.tree.map(lambda x: x, params)
+    shifted["a"][-1]["b"] = shifted["a"][-1]["b"] + 3.7
+    q2 = mod.forward(shifted, obs)["q_values"]
+    assert jnp.allclose(q, q2, atol=1e-5)
+    a = mod.sample_action(params, obs, jax.random.PRNGKey(2), epsilon=0.0)
+    assert a.shape == (5,)
+
+
+@pytest.mark.slow
+def test_rainbow_dqn_cartpole_improves(rt_start):
+    """All four extensions on together must still learn CartPole."""
+    import gymnasium as gym
+
+    from ray_tpu.rl import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment(lambda: gym.make("CartPole-v1"), obs_dim=4, num_actions=2)
+        .env_runners(num_env_runners=2, rollout_length=200)
+        .training(lr=1e-3, train_batch_size=64, updates_per_iteration=64,
+                  learning_starts=400, target_update_freq=2,
+                  double_q=True, dueling=True, n_step=3,
+                  prioritized_replay=True)
+        .exploration(epsilon_start=1.0, epsilon_end=0.05,
+                     epsilon_decay_iters=6)
+        .build()
+    )
+    try:
+        best = -1.0
+        for _ in range(30):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 75.0:
+                break
+        assert best >= 75.0, f"rainbow DQN failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# CQL: offline conservative Q-learning (reference: rllib/algorithms/cql/)
+# ---------------------------------------------------------------------------
+
+
+def _bandit_transitions(n=2048, seed=0):
+    """Offline 1-D contextual bandit: reward 1 - (a - 0.5*s)^2, episodes
+    of length one. Uniform behavior policy gives full action coverage,
+    so the optimal in-distribution policy is a = 0.5*s."""
+    rng = np.random.default_rng(seed)
+    s = rng.uniform(-1, 1, (n, 1)).astype(np.float32)
+    a = rng.uniform(-1, 1, (n, 1)).astype(np.float32)
+    r = (1.0 - (a[:, 0] - 0.5 * s[:, 0]) ** 2).astype(np.float32)
+    return {
+        "obs": s,
+        "actions": a,
+        "rewards": r,
+        "next_obs": s,
+        "dones": np.ones(n, dtype=np.float32),
+    }
+
+
+@pytest.mark.slow
+def test_cql_learns_offline_bandit():
+    from ray_tpu.rl import CQLConfig
+
+    algo = (
+        CQLConfig()
+        .module(obs_dim=1, action_dim=1)
+        .training(lr=3e-3, cql_alpha=1.0, minibatch_size=256)
+        .build()
+    )
+    batch = _bandit_transitions()
+    obs = np.linspace(-1, 1, 21, dtype=np.float32)[:, None]
+    before = np.abs(algo.compute_actions(obs)[:, 0] - 0.5 * obs[:, 0]).mean()
+    metrics = algo.train_on_batch(batch, num_epochs=40)
+    after = np.abs(algo.compute_actions(obs)[:, 0] - 0.5 * obs[:, 0]).mean()
+    assert np.isfinite(metrics["q_loss"])
+    assert "cql_loss" in metrics
+    assert after < before and after < 0.25, (before, after)
+
+
+@pytest.mark.slow
+def test_cql_penalizes_out_of_distribution_actions():
+    """Train on a dataset whose behavior policy only covers a < 0; the
+    conservative penalty must keep learned Q for (unseen) a > 0 below
+    Q for the covered region even though rewards there would be high."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import CQLConfig
+
+    rng = np.random.default_rng(1)
+    n = 2048
+    s = rng.uniform(-1, 1, (n, 1)).astype(np.float32)
+    a = rng.uniform(-1, 0.0, (n, 1)).astype(np.float32)  # only a<0 seen
+    r = (1.0 + a[:, 0]).astype(np.float32)  # best covered reward at a=0
+    batch = {"obs": s, "actions": a, "rewards": r, "next_obs": s,
+             "dones": np.ones(n, dtype=np.float32)}
+    algo = (
+        CQLConfig()
+        .module(obs_dim=1, action_dim=1)
+        .training(lr=3e-3, cql_alpha=5.0, minibatch_size=256)
+        .build()
+    )
+    algo.train_on_batch(batch, num_epochs=30)
+    obs = jnp.zeros((64, 1))
+    q_in, _ = algo.module.q_values(
+        algo.state["params"], obs, jnp.full((64, 1), -0.1)
+    )
+    q_ood, _ = algo.module.q_values(
+        algo.state["params"], obs, jnp.full((64, 1), 0.9)
+    )
+    assert float(q_ood.mean()) < float(q_in.mean()) + 0.5

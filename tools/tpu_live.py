@@ -81,6 +81,7 @@ def commit(result: dict) -> None:
     result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     with open(OUT, "w") as f:
         json.dump(result, f, indent=1)
+    subprocess.run(["git", "add", "--", "BENCH_TPU_LIVE.json"], cwd=REPO)
     subprocess.run(
         ["git", "commit", "-m", "Capture live TPU flagship bench artifact",
          "--only", "--", "BENCH_TPU_LIVE.json"],
