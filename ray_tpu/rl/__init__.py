@@ -12,6 +12,7 @@ from ray_tpu.rl.core.rl_module import (
     ContinuousModuleSpec,
     ContinuousPolicyModule,
     DiscretePolicyModule,
+    C51QNetworkModule,
     DuelingQNetworkModule,
     RLModuleSpec,
 )
@@ -22,7 +23,13 @@ from ray_tpu.rl.env_runner import (
     compute_gae,
 )
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig, appo_loss
-from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, dqn_loss
+from ray_tpu.rl.algorithms.dqn import (
+    DQN,
+    DQNConfig,
+    c51_loss,
+    categorical_projection,
+    dqn_loss,
+)
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rl.algorithms.td3 import DDPGConfig, TD3, TD3Config
@@ -32,7 +39,13 @@ from ray_tpu.rl.algorithms.impala import (
     impala_loss,
     vtrace,
 )
-from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, ppo_loss
+from ray_tpu.rl.algorithms.ppo import (
+    A2CConfig,
+    PPO,
+    PPOConfig,
+    a2c_loss,
+    ppo_loss,
+)
 from ray_tpu.rl.connectors import (
     ClipReward,
     Connector,
@@ -95,6 +108,11 @@ __all__ = [
     "PPO",
     "PPOConfig",
     "ppo_loss",
+    "A2CConfig",
+    "a2c_loss",
+    "c51_loss",
+    "categorical_projection",
+    "C51QNetworkModule",
     "IMPALA",
     "IMPALAConfig",
     "impala_loss",

@@ -21,6 +21,7 @@ import ray_tpu as rt
 from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import (
+    C51QNetworkModule,
     DuelingQNetworkModule,
     QNetworkModule,
     RLModuleSpec,
@@ -49,6 +50,55 @@ def dqn_loss(params, module, batch):
         "q_mean": q_sa.mean(),
         "td_abs_mean": jnp.abs(td).mean(),
     }
+
+
+def c51_loss(params, module, batch):
+    """Categorical cross-entropy against the driver-projected target
+    distribution (Bellemare et al. 2017; reference: num_atoms>1 DQN)."""
+    logits = module.forward(params, batch["obs"])["q_logits"]
+    la = jnp.take_along_axis(
+        logits,
+        batch["actions"][:, None, None].astype(jnp.int32).repeat(
+            logits.shape[-1], axis=-1
+        ),
+        axis=1,
+    )[:, 0]
+    logp = jax.nn.log_softmax(la, axis=-1)
+    ce = -(batch["target_probs"] * logp).sum(-1)
+    if "weights" in batch:
+        loss = (batch["weights"] * ce).mean()
+    else:
+        loss = ce.mean()
+    return loss, {"total_loss": loss, "ce_mean": ce.mean()}
+
+
+def categorical_projection(next_probs: np.ndarray, support: np.ndarray,
+                           rewards: np.ndarray, discounts: np.ndarray,
+                           dones: np.ndarray) -> np.ndarray:
+    """Project the bootstrapped distribution r + disc*(1-d)*z onto the
+    fixed support (the C51 projection step, computed driver-side so the
+    learner loss stays a pure params+batch function)."""
+    v_min, v_max = float(support[0]), float(support[-1])
+    dz = (v_max - v_min) / (len(support) - 1)
+    B, N = next_probs.shape
+    tz = np.clip(
+        rewards[:, None]
+        + discounts[:, None] * (1.0 - dones[:, None]) * support[None],
+        v_min, v_max,
+    )
+    b = (tz - v_min) / dz
+    # Clamp: float rounding can push b past N-1 when tz clips to v_max
+    # (e.g. (v_max - v_min)/dz = 94.000000001 -> ceil = 95).
+    lo = np.clip(np.floor(b).astype(np.int64), 0, N - 1)
+    hi = np.clip(np.ceil(b).astype(np.int64), 0, N - 1)
+    # When b lands exactly on an atom (lo == hi) give it the full mass.
+    frac_hi = b - lo
+    frac_lo = np.where(lo == hi, 1.0, 1.0 - frac_hi)
+    out = np.zeros_like(next_probs)
+    rows = np.repeat(np.arange(B), N)
+    np.add.at(out, (rows, lo.ravel()), (next_probs * frac_lo).ravel())
+    np.add.at(out, (rows, hi.ravel()), (next_probs * frac_hi).ravel())
+    return out.astype(np.float32)
 
 
 @dataclass
@@ -83,6 +133,11 @@ class DQNConfig(ConfigEvalMixin):
     per_alpha: float = 0.6
     per_beta_start: float = 0.4
     per_beta_iters: int = 50  # iterations to anneal beta -> 1.0
+    # C51 distributional head (reference: DQNConfig.num_atoms/v_min/v_max).
+    distributional: bool = False
+    num_atoms: int = 51
+    v_min: float = -10.0
+    v_max: float = 10.0
 
     def environment(self, env_creator=None, obs_dim=None, num_actions=None):
         if env_creator is not None:
@@ -108,7 +163,9 @@ class DQNConfig(ConfigEvalMixin):
                  buffer_capacity=None, learning_starts=None,
                  num_learners=None, double_q=None, dueling=None, n_step=None,
                  prioritized_replay=None, per_alpha=None,
-                 per_beta_start=None, per_beta_iters=None):
+                 per_beta_start=None, per_beta_iters=None,
+                 distributional=None, num_atoms=None, v_min=None,
+                 v_max=None):
         for name, val in (
             ("lr", lr), ("gamma", gamma),
             ("train_batch_size", train_batch_size),
@@ -121,6 +178,8 @@ class DQNConfig(ConfigEvalMixin):
             ("prioritized_replay", prioritized_replay),
             ("per_alpha", per_alpha), ("per_beta_start", per_beta_start),
             ("per_beta_iters", per_beta_iters),
+            ("distributional", distributional), ("num_atoms", num_atoms),
+            ("v_min", v_min), ("v_max", v_max),
         ):
             if val is not None:
                 setattr(self, name, val)
@@ -148,13 +207,27 @@ class DQN(AlgorithmBase):
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
         spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
-        cls = DuelingQNetworkModule if config.dueling else QNetworkModule
-        module_factory = self._module_factory = lambda: cls(spec)  # noqa: E731
+        if config.distributional:
+            if config.dueling:
+                raise ValueError(
+                    "distributional + dueling heads are not composed; "
+                    "pick one head structure"
+                )
+            if config.num_atoms < 2:
+                raise ValueError("distributional DQN needs num_atoms >= 2")
+            module_factory = self._module_factory = (  # noqa: E731
+                lambda: C51QNetworkModule(
+                    spec, config.num_atoms, config.v_min, config.v_max
+                )
+            )
+        else:
+            cls = DuelingQNetworkModule if config.dueling else QNetworkModule
+            module_factory = self._module_factory = lambda: cls(spec)  # noqa: E731
         self.module = module_factory()
 
         self.learner_group = LearnerGroup(
             module_factory,
-            dqn_loss,
+            c51_loss if config.distributional else dqn_loss,
             num_learners=config.num_learners,
             seed=config.seed,
             lr=config.lr,
@@ -190,9 +263,8 @@ class DQN(AlgorithmBase):
         # async variants accept).
         self.target_params = self.learner_group.get_weights()
         self._online_params = self.target_params
-        self._target_q = jax.jit(
-            lambda p, obs: self.module.forward(p, obs)["q_values"]
-        )
+        self._fwd = jax.jit(lambda p, obs: self.module.forward(p, obs))
+        self._target_q = lambda p, obs: self._fwd(p, obs)["q_values"]
         self._iteration = 0
         self._broadcast_weights()
 
@@ -255,9 +327,8 @@ class DQN(AlgorithmBase):
                 else:
                     mb = self.buffer.sample(cfg.train_batch_size)
                 B = len(mb["obs"])
-                next_q_t = np.asarray(
-                    self._target_q(self.target_params, mb["next_obs"])
-                )
+                out_t = self._fwd(self.target_params, mb["next_obs"])
+                next_q_t = np.asarray(out_t["q_values"])
                 # One fused online-net forward serves both the double-DQN
                 # argmax (next_obs half) and the PER priority refresh
                 # (obs half).
@@ -271,19 +342,38 @@ class DQN(AlgorithmBase):
                     # Double DQN: online net picks the action, target net
                     # evaluates it (van Hasselt 2016).
                     a_star = q_on_next.argmax(axis=-1)
+                else:
+                    a_star = next_q_t.argmax(axis=-1)
+                if cfg.distributional:
+                    # C51: project the bootstrapped distribution of the
+                    # chosen next action onto the fixed support.
+                    next_probs = np.asarray(out_t["q_probs"])[
+                        np.arange(B), a_star
+                    ]
+                    target_probs = categorical_projection(
+                        next_probs, np.asarray(self.module.support),
+                        mb["rewards"], mb["discounts"], mb["dones"],
+                    )
+                    targets = (
+                        target_probs * np.asarray(self.module.support)
+                    ).sum(-1)  # scalar expectations, for PER priorities
+                    batch = {
+                        "obs": mb["obs"],
+                        "actions": mb["actions"],
+                        "target_probs": target_probs,
+                    }
+                else:
                     next_val = np.take_along_axis(
                         next_q_t, a_star[:, None], axis=-1
                     )[:, 0]
-                else:
-                    next_val = next_q_t.max(axis=-1)
-                targets = mb["rewards"] + mb["discounts"] * (
-                    1.0 - mb["dones"]
-                ) * next_val
-                batch = {
-                    "obs": mb["obs"],
-                    "actions": mb["actions"],
-                    "targets": targets.astype(np.float32),
-                }
+                    targets = mb["rewards"] + mb["discounts"] * (
+                        1.0 - mb["dones"]
+                    ) * next_val
+                    batch = {
+                        "obs": mb["obs"],
+                        "actions": mb["actions"],
+                        "targets": targets.astype(np.float32),
+                    }
                 if cfg.prioritized_replay:
                     batch["weights"] = mb["weights"]
                     q_sa = np.take_along_axis(

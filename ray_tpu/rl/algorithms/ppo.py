@@ -50,9 +50,37 @@ def ppo_loss(params, module, batch):
     return loss, metrics
 
 
+def a2c_loss(params, module, batch):
+    """Vanilla advantage actor-critic loss (reference:
+    rllib/algorithms/a2c/ — synchronous A2C): plain policy gradient on
+    normalized GAE advantages, no ratio clipping (the batch is exactly
+    on-policy: a single pass over fresh rollouts)."""
+    out = module.forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(out["action_logits"])
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    adv = batch["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    policy_loss = -(logp * adv).mean()
+    value_loss = ((out["value"] - batch["returns"]) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    loss = policy_loss + 0.5 * value_loss - 0.01 * entropy
+    return loss, {
+        "total_loss": loss,
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+    }
+
+
 @dataclass
 class PPOConfig(ConfigEvalMixin):
     """Builder-style config (reference: AlgorithmConfig/PPOConfig)."""
+
+    # The surrogate loss the learner optimizes; A2CConfig swaps in
+    # a2c_loss (the DDPG-over-TD3 preset pattern).
+    loss_fn: Callable = None  # resolved to ppo_loss in build()
 
     env_creator: Optional[Callable] = None
     obs_dim: int = 4
@@ -120,7 +148,7 @@ class PPO(AlgorithmBase):
 
         self.learner_group = LearnerGroup(
             module_factory,
-            ppo_loss,
+            config.loss_fn or ppo_loss,
             num_learners=config.num_learners,
             seed=config.seed,
             lr=config.lr,
@@ -190,3 +218,17 @@ class PPO(AlgorithmBase):
                 rt.kill(r)
             except Exception:
                 pass
+
+
+@dataclass
+class A2CConfig(PPOConfig):
+    """Synchronous advantage actor-critic (reference:
+    rllib/algorithms/a2c/): the PPO machinery — parallel env runners,
+    GAE, learner group — driven by the unclipped policy-gradient loss
+    for exactly one pass over each fresh on-policy batch."""
+
+    num_epochs: int = 1  # on-policy: a single pass per batch
+
+    def __post_init__(self):
+        if self.loss_fn is None:
+            self.loss_fn = a2c_loss

@@ -120,6 +120,41 @@ class DuelingQNetworkModule(QNetworkModule):
         return {"q_values": q}
 
 
+class C51QNetworkModule(QNetworkModule):
+    """Categorical distributional Q-network (Bellemare et al. 2017).
+
+    Reference analog: the distributional heads rllib's DQN builds with
+    ``DQNConfig.num_atoms > 1``. The net emits logits over num_atoms
+    fixed support atoms per action; q_values (driving the inherited
+    epsilon-greedy sampling) are the expected values under the softmax
+    distribution.
+    """
+
+    def __init__(self, spec: RLModuleSpec, num_atoms: int = 51,
+                 v_min: float = -10.0, v_max: float = 10.0):
+        super().__init__(spec)
+        self.num_atoms = num_atoms
+        self.support = jnp.linspace(v_min, v_max, num_atoms)
+
+    def init(self, rng: jax.Array) -> Dict:
+        sizes = [self.spec.obs_dim, *self.spec.hidden]
+        return {
+            "q": init_mlp(rng, sizes + [self.spec.num_actions * self.num_atoms])
+        }
+
+    def forward(self, params: Dict, obs: jax.Array) -> Dict[str, jax.Array]:
+        flat = mlp_forward(params["q"], obs)
+        logits = flat.reshape(
+            *flat.shape[:-1], self.spec.num_actions, self.num_atoms
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        return {
+            "q_logits": logits,
+            "q_probs": probs,
+            "q_values": (probs * self.support).sum(-1),
+        }
+
+
 @dataclass(frozen=True)
 class ContinuousModuleSpec:
     """Spec for continuous-control modules (SAC family)."""

@@ -588,3 +588,157 @@ def test_cql_penalizes_out_of_distribution_actions():
         algo.state["params"], obs, jnp.full((64, 1), 0.9)
     )
     assert float(q_ood.mean()) < float(q_in.mean()) + 0.5
+
+
+# ---------------------------------------------------------------------------
+# A2C preset + C51 distributional DQN
+# ---------------------------------------------------------------------------
+
+
+def test_categorical_projection_math():
+    from ray_tpu.rl import categorical_projection
+
+    support = np.linspace(-1.0, 1.0, 5)  # dz = 0.5
+    # Terminal transition with reward 0.25: all mass lands split between
+    # atoms 2 (0.0) and 3 (0.5) at ratio 0.5/0.5.
+    probs = np.full((1, 5), 0.2, dtype=np.float32)
+    out = categorical_projection(
+        probs, support, np.array([0.25], dtype=np.float32),
+        np.array([0.9], dtype=np.float32), np.array([1.0], dtype=np.float32),
+    )
+    assert out.shape == (1, 5)
+    assert out.sum() == pytest.approx(1.0, abs=1e-5)
+    assert out[0, 2] == pytest.approx(0.5, abs=1e-5)
+    assert out[0, 3] == pytest.approx(0.5, abs=1e-5)
+    # Non-terminal identity: reward 0, discount 1 -> distribution unchanged.
+    eye = np.zeros((1, 5), dtype=np.float32)
+    eye[0, 1] = 1.0
+    out2 = categorical_projection(
+        eye, support, np.zeros(1, dtype=np.float32),
+        np.ones(1, dtype=np.float32), np.zeros(1, dtype=np.float32),
+    )
+    assert np.allclose(out2, eye, atol=1e-6)
+    # Out-of-range targets clip to the support edge.
+    out3 = categorical_projection(
+        eye, support, np.array([50.0], dtype=np.float32),
+        np.ones(1, dtype=np.float32), np.array([1.0], dtype=np.float32),
+    )
+    assert out3[0, -1] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_c51_module_expected_values():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import C51QNetworkModule, RLModuleSpec
+
+    mod = C51QNetworkModule(RLModuleSpec(obs_dim=3, num_actions=2),
+                            num_atoms=11, v_min=-2.0, v_max=2.0)
+    params = mod.init(jax.random.PRNGKey(0))
+    obs = jax.random.normal(jax.random.PRNGKey(1), (4, 3))
+    out = mod.forward(params, obs)
+    assert out["q_logits"].shape == (4, 2, 11)
+    assert out["q_probs"].shape == (4, 2, 11)
+    assert jnp.allclose(out["q_probs"].sum(-1), 1.0, atol=1e-5)
+    expect = (out["q_probs"] * mod.support).sum(-1)
+    assert jnp.allclose(out["q_values"], expect, atol=1e-5)
+    a = mod.sample_action(params, obs, jax.random.PRNGKey(2), epsilon=0.0)
+    assert a.shape == (4,)
+
+
+@pytest.mark.slow
+def test_a2c_cartpole_improves(rt_start):
+    import gymnasium as gym
+
+    from ray_tpu.rl import A2CConfig
+
+    algo = (
+        A2CConfig()
+        .environment(lambda: gym.make("CartPole-v1"), obs_dim=4, num_actions=2)
+        .env_runners(num_env_runners=2, rollout_length=256)
+        .training(lr=3e-3)
+        .build()
+    )
+    assert algo.config.num_epochs == 1
+    try:
+        first = algo.train()
+        best = 0.0
+        for _ in range(15):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 60.0:
+                break
+        assert best > first["episode_return_mean"] or best >= 50.0, (
+            f"A2C failed to improve: first={first['episode_return_mean']} "
+            f"best={best}"
+        )
+    finally:
+        algo.stop()
+
+
+@pytest.mark.slow
+def test_c51_dqn_cartpole_improves(rt_start):
+    import gymnasium as gym
+
+    from ray_tpu.rl import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment(lambda: gym.make("CartPole-v1"), obs_dim=4, num_actions=2)
+        .env_runners(num_env_runners=2, rollout_length=200)
+        .training(lr=1e-3, train_batch_size=64, updates_per_iteration=64,
+                  learning_starts=400, distributional=True, num_atoms=51,
+                  v_min=0.0, v_max=100.0, n_step=3)
+        .exploration(epsilon_start=1.0, epsilon_end=0.05,
+                     epsilon_decay_iters=6)
+        .build()
+    )
+    try:
+        best = -1.0
+        for _ in range(30):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 75.0:
+                break
+        assert best >= 75.0, f"C51 DQN failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_distributional_plus_dueling_rejected():
+    from ray_tpu.rl import DQNConfig
+
+    cfg = (
+        DQNConfig()
+        .environment(lambda: None, obs_dim=2, num_actions=2)
+        .training(distributional=True, dueling=True)
+    )
+    with pytest.raises(ValueError, match="distributional"):
+        cfg.build()
+
+
+def test_categorical_projection_edge_rounding():
+    """Support grids whose dz is inexact must not index past the last
+    atom when targets clip to v_max (regression: hi = ceil(b) = N)."""
+    from ray_tpu.rl import categorical_projection
+
+    support = np.linspace(42.57, 71.49, 95)
+    probs = np.full((4, 95), 1.0 / 95, dtype=np.float32)
+    out = categorical_projection(
+        probs, support, np.full(4, 1e6, dtype=np.float32),
+        np.ones(4, dtype=np.float32), np.zeros(4, dtype=np.float32),
+    )
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-4)
+    assert out[:, -1] == pytest.approx(np.ones(4), abs=1e-4)
+
+
+def test_distributional_single_atom_rejected():
+    from ray_tpu.rl import DQNConfig
+
+    cfg = (
+        DQNConfig()
+        .environment(lambda: None, obs_dim=2, num_actions=2)
+        .training(distributional=True, num_atoms=1)
+    )
+    with pytest.raises(ValueError, match="num_atoms"):
+        cfg.build()
