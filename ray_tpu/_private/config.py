@@ -224,6 +224,22 @@ class Config:
     serve_reconcile_interval_s: float = 0.5
     # Consecutive failed health probes before a replica is replaced.
     serve_health_fail_threshold: int = 3
+    # Data-plane replica call timeout (handle dispatch, streaming chunk
+    # pulls, proxy-side gets).
+    serve_rpc_timeout_s: float = 60.0
+    # Replica/proxy readiness probes during deploys and reconciles.
+    serve_ready_timeout_s: float = 30.0
+    # serve.run() end-to-end deploy timeout (controller reports ready).
+    serve_deploy_timeout_s: float = 300.0
+    # serve.call()/.result() default completion timeout.
+    serve_result_timeout_s: float = 120.0
+    # Control-plane admin calls (status/delete/shutdown/proxy listing).
+    serve_admin_timeout_s: float = 60.0
+    # Short liveness/queue-length probes in the reconcile + autoscale loop.
+    serve_probe_timeout_s: float = 5.0
+    # Upper bound on each app's collective replica health-check wait per
+    # reconcile pass (one rt.wait over all replicas' health probes).
+    serve_health_wait_s: float = 10.0
 
     # -- data -------------------------------------------------------------
     # Undelivered blocks buffered per streaming_split consumer before the
@@ -234,6 +250,11 @@ class Config:
 
     # -- collective -----------------------------------------------------
     collective_rendezvous_timeout_s: float = 60.0
+
+    # -- core worker ------------------------------------------------------
+    # Owner-side object-directory lookups (location gets during restart
+    # waits and lineage probes).
+    object_directory_rpc_timeout_s: float = 30.0
 
     def __post_init__(self):
         for f in fields(self):

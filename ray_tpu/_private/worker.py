@@ -160,7 +160,7 @@ class ObjectRefGenerator:
             try:
                 known = c._run(
                     c.gcs.call("object_location_get", {"object_id": oid}),
-                    timeout=30,
+                    timeout=get_config().object_directory_rpc_timeout_s,
                 )
                 if known.get("nodes") or known.get("spilled"):
                     break

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Optional
 
 import ray_tpu as rt
+from ray_tpu._private.config import get_config
 from ray_tpu.serve.controller import CONTROLLER_NAME, get_or_create_controller
 from ray_tpu.serve.deployment import (
     Application,
@@ -37,7 +38,7 @@ def run(app: Application, name: Optional[str] = None,
         controller.deploy.remote(
             app_name, app.deployment, app.init_args, app.init_kwargs
         ),
-        timeout=300,
+        timeout=get_config().serve_deploy_timeout_s,
     )
     return DeploymentHandle(app_name)
 
@@ -55,7 +56,9 @@ def call(app_name: str, *args, method: str = "__call__", **kwargs):
     handle = get_app_handle(app_name)
     if method != "__call__":
         handle = handle.options(method_name=method)
-    return handle.remote(*args, **kwargs).result(timeout=120)
+    return handle.remote(*args, **kwargs).result(
+        timeout=get_config().serve_result_timeout_s
+    )
 
 
 def get_app_handle(name: str) -> DeploymentHandle:
@@ -64,12 +67,14 @@ def get_app_handle(name: str) -> DeploymentHandle:
 
 def delete(name: str):
     controller = get_or_create_controller()
-    rt.get(controller.delete.remote(name), timeout=60)
+    rt.get(controller.delete.remote(name),
+           timeout=get_config().serve_admin_timeout_s)
 
 
 def status() -> dict:
     controller = get_or_create_controller()
-    return rt.get(controller.status.remote(), timeout=60)
+    return rt.get(controller.status.remote(),
+                  timeout=get_config().serve_admin_timeout_s)
 
 
 def shutdown():
@@ -79,7 +84,8 @@ def shutdown():
     except ValueError:
         return
     try:
-        rt.get(controller.shutdown.remote(), timeout=60)
+        rt.get(controller.shutdown.remote(),
+               timeout=get_config().serve_admin_timeout_s)
         rt.kill(controller)
     except Exception:
         pass
@@ -91,8 +97,10 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
     global _proxy
     if _proxy is None:
         _proxy = ProxyActor.options(num_cpus=0.1).remote(host, port)
-        rt.get(_proxy.ready.remote(), timeout=30)
-    return rt.get(_proxy.address.remote(), timeout=30)
+        rt.get(_proxy.ready.remote(),
+               timeout=get_config().serve_ready_timeout_s)
+    return rt.get(_proxy.address.remote(),
+                  timeout=get_config().serve_ready_timeout_s)
 
 
 def start(proxy_location: str = "HeadOnly", host: str = "127.0.0.1",
@@ -106,15 +114,18 @@ def start(proxy_location: str = "HeadOnly", host: str = "127.0.0.1",
     node_id -> address map ({"http": ..., "binary": [host, port]})."""
     controller = get_or_create_controller()
     if proxy_location == "EveryNode":
-        rt.get(controller.start_proxies.remote(), timeout=120)
-        return rt.get(controller.proxy_addresses.remote(), timeout=60)
+        rt.get(controller.start_proxies.remote(),
+               timeout=get_config().serve_deploy_timeout_s)
+        return rt.get(controller.proxy_addresses.remote(),
+                      timeout=get_config().serve_admin_timeout_s)
     return {"head": {"http": start_http_proxy(host, port), "binary": None}}
 
 
 def proxy_addresses() -> dict:
     """Live per-node proxy addresses (EveryNode mode)."""
     controller = get_or_create_controller()
-    return rt.get(controller.proxy_addresses.remote(), timeout=60)
+    return rt.get(controller.proxy_addresses.remote(),
+                  timeout=get_config().serve_admin_timeout_s)
 
 
 __all__ = [

@@ -11,11 +11,13 @@ version number and long-poll-style refresh on change
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu as rt
+from ray_tpu._private.config import get_config
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment
 from ray_tpu.serve.replica import ReplicaActor
 
@@ -244,8 +246,6 @@ class ServeController:
             pass
 
     def _reconcile_loop(self):
-        from ray_tpu._private.config import get_config
-
         while not self._stop:
             time.sleep(get_config().serve_reconcile_interval_s)
             try:
@@ -257,8 +257,12 @@ class ServeController:
                     self._reconcile_once(name)
                 if self._proxy_every_node:
                     self._reconcile_proxies()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — keep reconciling; next
+                # tick retries. Logged, not swallowed: a persistent error
+                # here silently freezes replica replacement (it did once).
+                logging.getLogger("ray_tpu.serve").exception(
+                    "serve controller reconcile tick failed"
+                )
 
     # -- proxy state manager ---------------------------------------------
     def start_proxies(self) -> int:
@@ -298,7 +302,8 @@ class ServeController:
                 dead = node_id not in alive
                 if not dead:
                     try:
-                        rt.get(entry["actor"].ready.remote(), timeout=5)
+                        rt.get(entry["actor"].ready.remote(),
+                               timeout=get_config().serve_probe_timeout_s)
                     except Exception:  # noqa: BLE001 — proxy died
                         dead = True
                 if dead:
@@ -316,12 +321,15 @@ class ServeController:
                             node_id=node_id
                         ),
                     ).remote("127.0.0.1", 0)
-                    rt.get(actor.ready.remote(), timeout=30)
+                    rt.get(actor.ready.remote(),
+                           timeout=get_config().serve_ready_timeout_s)
                     entry = {
                         "actor": actor,
-                        "http": rt.get(actor.address.remote(), timeout=10),
+                        "http": rt.get(actor.address.remote(),
+                                       timeout=get_config().serve_probe_timeout_s),
                         "binary": rt.get(
-                            actor.binary_address.remote(), timeout=10
+                            actor.binary_address.remote(),
+                            timeout=get_config().serve_probe_timeout_s,
                         ),
                     }
                     with self._lock:
@@ -360,9 +368,10 @@ class ServeController:
         if not replicas:
             return
         refs = [r.health_check.remote() for r in replicas]
-        # One collective wait bounds the whole pass at ~10s regardless of
-        # how many replicas are hung.
-        ready, _not_ready = rt.wait(refs, num_returns=len(refs), timeout=10.0)
+        # One collective wait bounds the whole pass (serve_health_wait_s)
+        # regardless of how many replicas are hung.
+        ready, _not_ready = rt.wait(refs, num_returns=len(refs),
+                                    timeout=get_config().serve_health_wait_s)
         ready_set = set(ready)
         dead = []
         for r, ref in zip(replicas, refs):
@@ -370,7 +379,7 @@ class ServeController:
             healthy = False
             if ref in ready_set:
                 try:
-                    rt.get(ref, timeout=5)
+                    rt.get(ref, timeout=get_config().serve_probe_timeout_s)
                     healthy = True
                 except Exception:  # noqa: BLE001 — call errored: unhealthy
                     pass
@@ -379,8 +388,6 @@ class ServeController:
                 continue
             fails = self._health_fails.get(key, 0) + 1
             self._health_fails[key] = fails
-            from ray_tpu._private.config import get_config
-
             if fails >= get_config().serve_health_fail_threshold:
                 dead.append(r)
         if not dead:
@@ -413,7 +420,8 @@ class ServeController:
         if cfg is None or not replicas:
             return
         try:
-            qlens = rt.get([r.queue_len.remote() for r in replicas], timeout=5)
+            qlens = rt.get([r.queue_len.remote() for r in replicas],
+                           timeout=get_config().serve_probe_timeout_s)
         except Exception:
             return
         avg = sum(qlens) / len(qlens)

@@ -20,6 +20,7 @@ import zlib
 from typing import Any, Dict, List, Optional
 
 import ray_tpu as rt
+from ray_tpu._private.config import get_config
 
 
 class DeploymentResponse:
@@ -126,8 +127,10 @@ class DeploymentHandle:
             lr0 = s["last_refresh"]
             if not force and s["replicas"] and now - lr0 < 1.0:
                 return
+        # Request-dispatch path: rides the data-plane rpc timeout, NOT the
+        # deploy-readiness knob (tuning deploys must not break dispatch).
         info = rt.get(self._controller().get_replicas.remote(self.app_name),
-                      timeout=30)
+                      timeout=get_config().serve_rpc_timeout_s)
         with s["lock"]:
             if info["version"] >= s["version"]:
                 s["version"] = info["version"]
@@ -231,14 +234,15 @@ class DeploymentHandle:
             replica.start_stream.remote(
                 self.method, args, kwargs, self.multiplexed_model_id
             ),
-            timeout=60,
+            timeout=get_config().serve_rpc_timeout_s,
         )
 
         def gen():
             start = 0
             while True:
                 out = rt.get(
-                    replica.next_chunks.remote(sid, start), timeout=60
+                    replica.next_chunks.remote(sid, start),
+                    timeout=get_config().serve_rpc_timeout_s,
                 )
                 for c in out["chunks"]:
                     yield c

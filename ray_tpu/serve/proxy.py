@@ -17,6 +17,7 @@ import threading
 from typing import Dict, Optional
 
 import ray_tpu as rt
+from ray_tpu._private.config import get_config
 
 
 @rt.remote
@@ -53,7 +54,8 @@ class ProxyActor:
                 if value is not _IN_STORE:
                     return value
             return await loop.run_in_executor(
-                None, lambda: rt.get(ref, timeout=60)
+                None,
+                lambda: rt.get(ref, timeout=get_config().serve_rpc_timeout_s),
             )
 
         async def handle_request(request: web.Request):
@@ -182,7 +184,7 @@ class ProxyActor:
 
         self._thread = threading.Thread(target=run_server, daemon=True)
         self._thread.start()
-        self._ready.wait(timeout=10)
+        self._ready.wait(timeout=get_config().serve_ready_timeout_s)
 
     def address(self):
         return f"http://{self.host}:{self.port}"

@@ -140,3 +140,23 @@ def test_http_proxy(serve_session):
     # Health endpoint
     with urllib.request.urlopen(addr + "/-/healthz", timeout=30) as resp:
         assert json.loads(resp.read())["status"] == "ok"
+
+
+def test_serve_timeout_knobs_registered_and_env_overridable(monkeypatch):
+    """The serve data/control-plane timeouts ride the RT_* config registry
+    (reference: RAY_CONFIG env-overridable entries, ray_config_def.h)."""
+    import ray_tpu._private.config as config_mod
+
+    for name, default in (
+        ("serve_rpc_timeout_s", 60.0),
+        ("serve_ready_timeout_s", 30.0),
+        ("serve_deploy_timeout_s", 300.0),
+        ("serve_result_timeout_s", 120.0),
+        ("serve_admin_timeout_s", 60.0),
+        ("serve_probe_timeout_s", 5.0),
+        ("serve_health_wait_s", 10.0),
+        ("object_directory_rpc_timeout_s", 30.0),
+    ):
+        assert getattr(config_mod.Config(), name) == default
+    monkeypatch.setenv("RT_SERVE_RPC_TIMEOUT_S", "7.5")
+    assert config_mod.Config().serve_rpc_timeout_s == 7.5
