@@ -127,7 +127,10 @@ def main():
     if not quick:
         curve: dict = {"tasks": [], "actors": [], "placement_groups": []}
 
-        for n in (10_000, 30_000, 100_000):
+        # The final point IS the reference's headline single-node envelope
+        # (1,000,000 queued tasks, release/benchmarks/README.md:30) — run
+        # here on 1 core vs the reference's 64-core measurement box.
+        for n in (10_000, 30_000, 100_000, 300_000, 1_000_000):
             t0 = time.perf_counter()
             rt.get([noop.remote() for _ in range(n)], timeout=3600)
             dt = time.perf_counter() - t0

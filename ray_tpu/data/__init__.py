@@ -23,6 +23,8 @@ from ray_tpu.data.dataset import (
     from_pandas,
     range_dataset as range,  # noqa: A001 — mirrors ray.data.range
     read_binary_files,
+    read_images,
+    read_numpy,
     read_csv,
     read_datasource,
     read_json,
@@ -45,6 +47,8 @@ __all__ = [
     "from_pandas",
     "range",
     "read_binary_files",
+    "read_images",
+    "read_numpy",
     "read_datasource",
     "read_parquet",
     "read_text",

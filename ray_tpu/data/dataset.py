@@ -973,6 +973,24 @@ def read_binary_files(path: str, parallelism: int = 4, filesystem=None) -> Datas
     return read_datasource(BinaryDatasource(path, filesystem), parallelism)
 
 
+def read_images(path: str, parallelism: int = 4, filesystem=None,
+                size=None, mode=None) -> Dataset:
+    """Decoded image rows {"path", "image"} (reference: read_images);
+    size=(h, w) resizes, mode converts (e.g. "RGB") in the read tasks."""
+    from ray_tpu.data.datasource import ImageDatasource
+
+    return read_datasource(
+        ImageDatasource(path, filesystem, size=size, mode=mode), parallelism
+    )
+
+
+def read_numpy(path: str, parallelism: int = 4, filesystem=None) -> Dataset:
+    """One row per .npy file: {"path", "data"} (reference: read_numpy)."""
+    from ray_tpu.data.datasource import NpyDatasource
+
+    return read_datasource(NpyDatasource(path, filesystem), parallelism)
+
+
 def read_text(path: str, parallelism: int = 4) -> Dataset:
     """One row per line: {"text": line} (reference: data read_text)."""
     from ray_tpu.data.datasource import TextDatasource

@@ -234,6 +234,64 @@ class BinaryDatasource(FileBasedDatasource):
             return B.block_from_rows([{"path": path, "bytes": f.read()}])
 
 
+class ImageDatasource(FileBasedDatasource):
+    """Decoded image rows: {"path", "image"} with the image as an HWC
+    uint8 numpy array (reference: data/datasource/image_datasource.py).
+    Optional size=(h, w) resizes at read time and mode (e.g. "RGB", "L")
+    converts — decode happens IN the read tasks, so a directory of
+    images streams through the executor without driver-side decoding."""
+
+    _GLOB = "*"
+    _EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, path: str, filesystem=None, size=None, mode=None):
+        super().__init__(path, filesystem)
+        self.size = size
+        self.mode = mode
+
+    def _paths(self):
+        all_paths = super()._paths()
+        paths = [p for p in all_paths if p.lower().endswith(self._EXTS)]
+        if not paths:
+            raise FileNotFoundError(
+                f"no image files ({', '.join(self._EXTS)}) under "
+                f"{self.path!r}"
+            )
+        return paths
+
+    def _read_file(self, path: str):
+        import io
+
+        import numpy as np
+        from PIL import Image
+
+        with self._open(path) as f:
+            img = Image.open(io.BytesIO(f.read()))
+            if self.mode is not None:
+                img = img.convert(self.mode)
+            if self.size is not None:
+                img = img.resize((self.size[1], self.size[0]))
+            return B.block_from_rows(
+                [{"path": path, "image": np.asarray(img)}]
+            )
+
+
+class NpyDatasource(FileBasedDatasource):
+    """One row per .npy file: {"path", "data"} (reference:
+    numpy_datasource.py reading .npy files)."""
+
+    _GLOB = "*.npy"
+
+    def _read_file(self, path: str):
+        import io
+
+        import numpy as np
+
+        with self._open(path) as f:
+            arr = np.load(io.BytesIO(f.read()), allow_pickle=False)
+        return B.block_from_rows([{"path": path, "data": arr}])
+
+
 # ---------------------------------------------------------------------------
 # Synthetic / in-memory sources
 # ---------------------------------------------------------------------------

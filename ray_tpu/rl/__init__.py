@@ -31,6 +31,11 @@ from ray_tpu.rl.algorithms.dqn import (
     dqn_loss,
 )
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
+from ray_tpu.rl.algorithms.apex import (
+    APEX,
+    APEXConfig,
+    ReplayShardActor,
+)
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rl.algorithms.td3 import DDPGConfig, TD3, TD3Config
 from ray_tpu.rl.algorithms.impala import (
@@ -83,6 +88,9 @@ __all__ = [
     "TD3Config",
     "CQL",
     "CQLConfig",
+    "APEX",
+    "APEXConfig",
+    "ReplayShardActor",
     "DDPGConfig",
     "ContinuousModuleSpec",
     "ContinuousPolicyModule",

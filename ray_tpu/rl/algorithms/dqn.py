@@ -232,16 +232,7 @@ class DQN(AlgorithmBase):
             seed=config.seed,
             lr=config.lr,
         )
-        buffer_cls = (
-            PrioritizedReplayBuffer if config.prioritized_replay
-            else ReplayBuffer
-        )
-        buffer_kwargs = dict(seed=config.seed, store_discounts=True)
-        if config.prioritized_replay:
-            buffer_kwargs["alpha"] = config.per_alpha
-        self.buffer = buffer_cls(
-            config.buffer_capacity, config.obs_dim, **buffer_kwargs
-        )
+        self.buffer = self._make_buffer()
         self.env_runners = [
             TransitionEnvRunner.options(num_cpus=0.5).remote(
                 config.env_creator,
@@ -296,18 +287,46 @@ class DQN(AlgorithmBase):
         frac = min(1.0, self._iteration / max(cfg.per_beta_iters, 1))
         return cfg.per_beta_start + frac * (1.0 - cfg.per_beta_start)
 
-    def train(self) -> Dict[str, Any]:
-        cfg = self.config
-        eps = self._epsilon()
-        # 1. parallel epsilon-greedy collection into the replay buffer
+    # -- replay interface (overridden by APEX's sharded replay actors) ----
+    def _make_buffer(self):
+        config = self.config
+        buffer_cls = (
+            PrioritizedReplayBuffer if config.prioritized_replay
+            else ReplayBuffer
+        )
+        buffer_kwargs = dict(seed=config.seed, store_discounts=True)
+        if config.prioritized_replay:
+            buffer_kwargs["alpha"] = config.per_alpha
+        return buffer_cls(
+            config.buffer_capacity, config.obs_dim, **buffer_kwargs
+        )
+
+    def _collect(self, eps: float):
         rollouts = rt.get(
             [r.sample.remote(eps) for r in self.env_runners], timeout=600
         )
         for b in rollouts:
             self.buffer.add_batch(b)
+
+    def _buffer_size(self) -> int:
+        return len(self.buffer)
+
+    def _sample_minibatch(self, beta: float):
+        if self.config.prioritized_replay:
+            return self.buffer.sample(self.config.train_batch_size, beta=beta)
+        return self.buffer.sample(self.config.train_batch_size)
+
+    def _update_priorities(self, mb, td_abs: np.ndarray):
+        self.buffer.update_priorities(mb["indices"], td_abs)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        eps = self._epsilon()
+        # 1. parallel epsilon-greedy collection into the replay buffer
+        self._collect(eps)
         metrics: Dict[str, float] = {}
         # 2. TD updates once the buffer warms up
-        if len(self.buffer) >= cfg.learning_starts:
+        if self._buffer_size() >= cfg.learning_starts:
             beta = self._per_beta()
             # Hard target sync BEFORE the update loop, from the pre-loop
             # online snapshot; _online_params then refreshes from the
@@ -322,10 +341,9 @@ class DQN(AlgorithmBase):
                     cfg.double_q or cfg.prioritized_replay
                 ):
                     self._online_params = self.learner_group.get_weights()
-                if cfg.prioritized_replay:
-                    mb = self.buffer.sample(cfg.train_batch_size, beta=beta)
-                else:
-                    mb = self.buffer.sample(cfg.train_batch_size)
+                mb = self._sample_minibatch(beta)
+                if mb is None:  # sharded replay still warming up
+                    continue
                 B = len(mb["obs"])
                 out_t = self._fwd(self.target_params, mb["next_obs"])
                 next_q_t = np.asarray(out_t["q_values"])
@@ -380,9 +398,7 @@ class DQN(AlgorithmBase):
                         q_on_obs,
                         mb["actions"][:, None].astype(np.int64), axis=-1,
                     )[:, 0]
-                    self.buffer.update_priorities(
-                        mb["indices"], np.abs(q_sa - targets)
-                    )
+                    self._update_priorities(mb, np.abs(q_sa - targets))
                 metrics = self.learner_group.update_from_batch(batch)
             # 3. runner weight broadcast (the fetch also refreshes the
             # online snapshot for the next iteration's sync).
@@ -399,7 +415,7 @@ class DQN(AlgorithmBase):
             "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
             "episodes_total": sum(s["episodes"] for s in stats),
             "epsilon": eps,
-            "buffer_size": len(self.buffer),
+            "buffer_size": self._buffer_size(),
             **{f"learner/{k}": v for k, v in metrics.items()},
         })
 

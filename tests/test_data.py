@@ -352,3 +352,37 @@ def test_cloud_shaped_io_through_fake_fs(rt_start, tmp_path):
     assert local
     back3 = rtd.read_csv(str(tmp_path / "csvs"))
     assert back3.count() == 20
+
+
+def test_read_images_decodes_and_resizes(rt_start, tmp_path):
+    """read_images decodes in the read tasks: {"path", "image"} HWC uint8
+    rows, with resize + mode conversion (reference: read_images)."""
+    from PIL import Image
+
+    from ray_tpu import data as rt_data
+
+    for i, color in enumerate([(255, 0, 0), (0, 255, 0), (0, 0, 255)]):
+        Image.new("RGB", (8, 6), color).save(tmp_path / f"im{i}.png")
+    (tmp_path / "notes.txt").write_text("not an image")
+
+    ds = rt_data.read_images(str(tmp_path), size=(4, 4), mode="RGB")
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert len(rows) == 3  # the .txt is filtered out
+    for r, color in zip(rows, [(255, 0, 0), (0, 255, 0), (0, 0, 255)]):
+        img = np.asarray(r["image"])
+        assert img.shape == (4, 4, 3) and img.dtype == np.uint8
+        assert tuple(img[0, 0]) == color
+
+
+def test_read_numpy_roundtrip(rt_start, tmp_path):
+    from ray_tpu import data as rt_data
+
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.ones((2, 2), dtype=np.int64)
+    np.save(tmp_path / "a.npy", a)
+    np.save(tmp_path / "b.npy", b)
+
+    ds = rt_data.read_numpy(str(tmp_path))
+    rows = {r["path"].split("/")[-1]: r["data"] for r in ds.take_all()}
+    assert np.array_equal(np.asarray(rows["a.npy"]), a)
+    assert np.array_equal(np.asarray(rows["b.npy"]), b)
