@@ -974,9 +974,10 @@ def read_binary_files(path: str, parallelism: int = 4, filesystem=None) -> Datas
 
 
 def read_images(path: str, parallelism: int = 4, filesystem=None,
-                size=None, mode=None) -> Dataset:
+                size=None, mode="RGB") -> Dataset:
     """Decoded image rows {"path", "image"} (reference: read_images);
-    size=(h, w) resizes, mode converts (e.g. "RGB") in the read tasks."""
+    size=(h, w) resizes; mode="RGB" (default) makes every row (H, W, 3)
+    uint8, mode="L" grayscale, mode=None keeps native per-file modes."""
     from ray_tpu.data.datasource import ImageDatasource
 
     return read_datasource(

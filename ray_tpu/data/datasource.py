@@ -235,16 +235,19 @@ class BinaryDatasource(FileBasedDatasource):
 
 
 class ImageDatasource(FileBasedDatasource):
-    """Decoded image rows: {"path", "image"} with the image as an HWC
-    uint8 numpy array (reference: data/datasource/image_datasource.py).
-    Optional size=(h, w) resizes at read time and mode (e.g. "RGB", "L")
-    converts — decode happens IN the read tasks, so a directory of
-    images streams through the executor without driver-side decoding."""
+    """Decoded image rows: {"path", "image"} as uint8 numpy arrays
+    (reference: data/datasource/image_datasource.py). Default mode="RGB"
+    so every row is (H, W, 3) regardless of source format (palette GIFs,
+    grayscale PNGs, RGBA) — batches stack cleanly; pass mode="L" for
+    (H, W) grayscale or mode=None to keep each file's native mode.
+    Optional size=(h, w) resizes at read time. Decode happens IN the
+    read tasks, so a directory of images streams through the executor
+    without driver-side decoding."""
 
     _GLOB = "*"
     _EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
 
-    def __init__(self, path: str, filesystem=None, size=None, mode=None):
+    def __init__(self, path: str, filesystem=None, size=None, mode="RGB"):
         super().__init__(path, filesystem)
         self.size = size
         self.mode = mode

@@ -99,6 +99,10 @@ class APEX(DQN):
         self._shard_sizes = [0] * config.num_replay_shards
         self._next_shard = 0
         self._rng = np.random.default_rng(config.seed + 7)
+        # Pipelined episode-stats probes + last-known stats per runner
+        # (train() never barriers on a rollout to read stats).
+        self._stats_refs: Dict[int, object] = {}
+        self._stats_cache: Dict[int, dict] = {}
 
     # -- buffer interface over the shard actors ---------------------------
     def _collect(self, eps: float):
@@ -106,13 +110,8 @@ class APEX(DQN):
         and immediately resubmit those runners; never barriers on the
         slowest runner (the iteration's epsilon argument is ignored —
         each runner keeps its ladder epsilon)."""
-        if not self._pending:
-            # Every runner died mid-run; resubmit against the survivors
-            # (actor restart policy brings them back if configured).
-            self._pending = {
-                r.sample.remote(self._runner_eps[i]): (r, i)
-                for i, r in enumerate(self.env_runners)
-            }
+        # Invariant: every pop below resubmits, so one sample() per
+        # runner is always outstanding.
         ready, _ = rt.wait(
             list(self._pending), num_returns=1, timeout=60.0
         )
@@ -140,6 +139,10 @@ class APEX(DQN):
             shard = self._next_shard % len(self.shards)
             self._next_shard += 1
             adds.append((shard, self.shards[shard].add_batch.remote(batch)))
+            # Queue the stats probe BEFORE the next rollout so it runs
+            # right away on the serial actor instead of waiting a full
+            # rollout; train() reads whichever probes resolved.
+            self._stats_refs[idx] = runner.episode_stats.remote()
             self._pending[
                 runner.sample.remote(self._runner_eps[idx])
             ] = (runner, idx)
@@ -169,6 +172,43 @@ class APEX(DQN):
         self.shards[mb["_shard"]].update_priorities.remote(
             mb["indices"], td_abs
         )
+
+    def _episode_stats(self):
+        """Non-blocking: harvest whichever pipelined stats probes
+        resolved; runners mid-rollout report their last-known stats."""
+        refs = list(self._stats_refs.items())
+        if refs:
+            ready, _ = rt.wait(
+                [r for _, r in refs], num_returns=len(refs), timeout=1.0
+            )
+            ready_set = set(ready)
+            for idx, ref in refs:
+                if ref in ready_set:
+                    try:
+                        self._stats_cache[idx] = rt.get(ref, timeout=30)
+                    except Exception:  # noqa: BLE001 — runner died
+                        pass
+                    self._stats_refs.pop(idx, None)
+        return list(self._stats_cache.values()) or [
+            {"episodes": 0, "mean_return": 0.0}
+        ]
+
+    def _report_epsilon(self, eps: float):
+        # Fixed per-runner ladder, not the DQN decay schedule.
+        return [round(e, 4) for e in self._runner_eps]
+
+    def _broadcast_weights(self, weights=None):
+        """Fire-and-forget: the new weights queue behind each runner's
+        in-flight rollout and apply to its NEXT one (apex's async weight
+        update), without train() blocking on the slowest runner."""
+        if not hasattr(self, "_runner_eps"):
+            # Called from DQN.__init__ before apex state exists: the
+            # blocking broadcast is fine there (no rollouts in flight).
+            return super()._broadcast_weights(weights)
+        if weights is None:
+            weights = self.learner_group.get_weights()
+        for r in self.env_runners:
+            r.set_weights.remote(weights)
 
     # Note: shard CONTENTS are not checkpointed (fresh shard actors start
     # empty on restore), so _shard_sizes deliberately restarts at 0 — the

@@ -319,6 +319,16 @@ class DQN(AlgorithmBase):
     def _update_priorities(self, mb, td_abs: np.ndarray):
         self.buffer.update_priorities(mb["indices"], td_abs)
 
+    def _episode_stats(self):
+        return rt.get(
+            [r.episode_stats.remote() for r in self.env_runners], timeout=300
+        )
+
+    def _report_epsilon(self, eps: float):
+        """What the 'epsilon' result key reports (APEX overrides: its
+        runners keep a fixed exploration ladder, not this schedule)."""
+        return eps
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         eps = self._epsilon()
@@ -406,15 +416,13 @@ class DQN(AlgorithmBase):
             self._online_params = weights
             self._broadcast_weights(weights)
         self._iteration += 1
-        stats = rt.get(
-            [r.episode_stats.remote() for r in self.env_runners], timeout=300
-        )
+        stats = self._episode_stats()
         returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
         return self._finish_iteration({
             "training_iteration": self._iteration,
             "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
             "episodes_total": sum(s["episodes"] for s in stats),
-            "epsilon": eps,
+            "epsilon": self._report_epsilon(eps),
             "buffer_size": self._buffer_size(),
             **{f"learner/{k}": v for k, v in metrics.items()},
         })

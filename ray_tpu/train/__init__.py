@@ -33,6 +33,7 @@ from ray_tpu.train.session import (
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from ray_tpu.train.data_config import DataConfig
 from ray_tpu.train import torch  # noqa: F401 — train.torch.TorchTrainer
+from ray_tpu.train.sklearn import SklearnTrainer
 
 __all__ = [
     "Backend",
@@ -50,6 +51,7 @@ __all__ = [
     "BaseTrainer",
     "DataParallelTrainer",
     "JaxTrainer",
+    "SklearnTrainer",
     "DataConfig",
     "torch",
     "report",

@@ -386,3 +386,20 @@ def test_read_numpy_roundtrip(rt_start, tmp_path):
     rows = {r["path"].split("/")[-1]: r["data"] for r in ds.take_all()}
     assert np.array_equal(np.asarray(rows["a.npy"]), a)
     assert np.array_equal(np.asarray(rows["b.npy"]), b)
+
+
+def test_read_images_default_mode_uniform_hwc(rt_start, tmp_path):
+    """Mixed source modes (palette GIF + grayscale + RGB) all come back
+    (H, W, 3) uint8 under the default mode="RGB"."""
+    from PIL import Image
+
+    from ray_tpu import data as rt_data
+
+    Image.new("RGB", (5, 5), (9, 9, 9)).save(tmp_path / "rgb.png")
+    Image.new("L", (5, 5), 100).save(tmp_path / "gray.png")
+    Image.new("P", (5, 5)).save(tmp_path / "pal.gif")
+    rows = rt_data.read_images(str(tmp_path)).take_all()
+    assert len(rows) == 3
+    for r in rows:
+        img = np.asarray(r["image"])
+        assert img.shape == (5, 5, 3) and img.dtype == np.uint8

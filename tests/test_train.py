@@ -357,3 +357,52 @@ def test_torch_trainer_ddp_gloo(tmp_path):
     assert result.error is None
     m = result.metrics
     assert m["last"] < m["first"] * 0.2, m
+
+
+def test_sklearn_trainer_fits_scores_and_checkpoints(rt_start):
+    """SklearnTrainer (reference: train/sklearn/sklearn_trainer.py):
+    remote fit + validation scoring + cv metrics + model checkpoint."""
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4)).astype(np.float64)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(max_iter=200),
+        datasets={"train": (X[:150], y[:150]), "valid": (X[150:], y[150:])},
+        cv=3,
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["train_score"] > 0.9
+    assert result.metrics["valid_score"] > 0.8
+    assert "cv" in result.metrics and "test_score" in result.metrics["cv"]
+    model = SklearnTrainer.get_model(result.checkpoint)
+    assert model.score(X[150:], y[150:]) == result.metrics["valid_score"]
+
+
+def test_sklearn_trainer_dataset_input(rt_start):
+    import numpy as np
+
+    from ray_tpu import data as rt_data
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.default_rng(1)
+    rows = []
+    for _ in range(120):
+        a, b = rng.normal(), rng.normal()
+        rows.append({"a": a, "b": b, "y": int(a - b > 0)})
+    ds = rt_data.from_items(rows)
+    from sklearn.tree import DecisionTreeClassifier
+
+    trainer = SklearnTrainer(
+        estimator=DecisionTreeClassifier(max_depth=4),
+        datasets={"train": ds},
+        label_column="y",
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["train_score"] > 0.85
