@@ -15,6 +15,8 @@ from __future__ import annotations
 import os
 import random as _random
 import zlib
+
+import numpy as np
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import ray_tpu as rt
@@ -144,6 +146,56 @@ class Dataset:
                 out.append(slice_fn.remote(ref, 0, remaining))
                 remaining = 0
         return Dataset(out if out else [rt.put(B.block_from_rows([]))])
+
+    def train_test_split(self, test_size, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        """Split into (train, test) Datasets (reference:
+        Dataset.train_test_split). test_size: float fraction of rows or
+        absolute int count; shuffle applies a random_shuffle first.
+        Formed from block refs like limit(): whole blocks pass by
+        reference, boundary blocks slice in remote tasks."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        refs = ds._executed_refs()
+        count_fn = rt.remote(_block_count).options(max_retries=-1)
+        counts = rt.get([count_fn.remote(r) for r in refs])
+        total = sum(counts)
+        if isinstance(test_size, (float, np.floating)):
+            if not 0.0 < test_size < 1.0:
+                raise ValueError("float test_size must be in (0, 1)")
+            test_n = int(total * float(test_size))
+        elif isinstance(test_size, (int, np.integer)) and not isinstance(
+            test_size, bool
+        ):
+            test_n = int(test_size)
+        else:
+            raise TypeError(
+                f"test_size must be a float fraction or int count, "
+                f"got {type(test_size).__name__}"
+            )
+        if not 0 <= test_n <= total:
+            raise ValueError(
+                f"test_size {test_size} out of range for {total} rows"
+            )
+        train_n = total - test_n
+        slice_fn = rt.remote(_slice_block).options(max_retries=-1)
+        train_refs: List = []
+        test_refs: List = []
+        seen = 0
+        for ref, c in zip(refs, counts):
+            lo, hi = seen, seen + c
+            seen = hi
+            if hi <= train_n:
+                train_refs.append(ref)
+            elif lo >= train_n:
+                test_refs.append(ref)
+            else:  # boundary block straddles the split point
+                train_refs.append(slice_fn.remote(ref, 0, train_n - lo))
+                test_refs.append(slice_fn.remote(ref, train_n - lo, c))
+        empty = lambda: [rt.put(B.block_from_rows([]))]  # noqa: E731
+        return (
+            Dataset(train_refs or empty()),
+            Dataset(test_refs or empty()),
+        )
 
     def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
         """Row -> value for a new column (reference: Dataset.add_column)."""

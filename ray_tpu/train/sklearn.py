@@ -29,6 +29,8 @@ def _to_xy(ds, label_column: str):
         return np.asarray(ds[0]), np.asarray(ds[1])
     if hasattr(ds, "take_all"):  # Dataset
         rows = ds.take_all()
+        if not rows:
+            raise ValueError("dataset split is empty")
         y = np.asarray([r[label_column] for r in rows])
         feats = [
             {k: v for k, v in r.items() if k != label_column} for r in rows
@@ -39,6 +41,8 @@ def _to_xy(ds, label_column: str):
     if isinstance(ds, dict):
         y = np.asarray(ds[label_column])
         keys = sorted(k for k in ds if k != label_column)
+        if not keys or not len(y):
+            raise ValueError("dataset split is empty")
         X = np.column_stack([np.asarray(ds[k]) for k in keys])
         return X, y
     raise TypeError(f"unsupported dataset type: {type(ds)}")
@@ -71,6 +75,14 @@ def _fit_task(estimator, datasets, label_column, cv, scoring):
             continue
         Xv, yv = _to_xy(ds, label_column)
         metrics[f"{name}_score"] = float(estimator.score(Xv, yv))
+        # Requested scoring metrics apply to every validation split too
+        # (not only under cv — the reference scores splits with them).
+        for sc in scoring or []:
+            from sklearn.metrics import get_scorer
+
+            metrics[f"{name}_{sc}"] = float(
+                get_scorer(sc)(estimator, Xv, yv)
+            )
     metrics["train_score"] = float(estimator.score(X, y))
     return pickle.dumps(estimator), metrics
 

@@ -1,4 +1,5 @@
 from ray_tpu.util import debug
+from ray_tpu.util.check_serialize import inspect_serializability
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
@@ -7,6 +8,7 @@ from ray_tpu.util.placement_group import (
 )
 
 __all__ = [
+    "inspect_serializability",
     "ActorPool",
     "debug",
     "PlacementGroup",

@@ -403,3 +403,26 @@ def test_read_images_default_mode_uniform_hwc(rt_start, tmp_path):
     for r in rows:
         img = np.asarray(r["image"])
         assert img.shape == (5, 5, 3) and img.dtype == np.uint8
+
+
+def test_train_test_split(rt_start):
+    """(train, test) split from block refs with boundary slicing and
+    optional shuffle (reference: Dataset.train_test_split)."""
+    from ray_tpu import data as rt_data
+
+    ds = rt_data.range(100)
+    train, test = ds.train_test_split(0.2)
+    assert train.count() == 80 and test.count() == 20
+    # Unshuffled: order preserved, test takes the tail.
+    assert [r["id"] for r in test.take_all()] == list(range(80, 100))
+    # Shuffled split covers all rows exactly once.
+    train_s, test_s = ds.train_test_split(30, shuffle=True, seed=1)
+    ids = [r["id"] for r in train_s.take_all()] + [
+        r["id"] for r in test_s.take_all()
+    ]
+    assert sorted(ids) == list(range(100))
+    assert test_s.count() == 30
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        ds.train_test_split(1.5)

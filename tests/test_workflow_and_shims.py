@@ -234,3 +234,35 @@ def test_util_debug_log_gates():
         assert not debug.log_once("t-debug-disabled")
     finally:
         debug.enable_periodic_logging()
+
+
+def test_inspect_serializability_blames_nested_member():
+    """inspect_serializability pinpoints the unpicklable leaf (reference:
+    ray.util.inspect_serializability, util/check_serialize.py)."""
+    import threading
+
+    from ray_tpu.util import inspect_serializability
+
+    lines = []
+    ok, failures = inspect_serializability(
+        {"fine": 1, "bad": threading.Lock()}, name="payload",
+        _print=lines.append,
+    )
+    assert not ok
+    assert any("bad" in f for f in failures)
+
+    lock = threading.Lock()
+
+    def closure_fn():
+        return lock
+
+    ok2, failures2 = inspect_serializability(
+        closure_fn, name="closure_fn", _print=lines.append
+    )
+    assert not ok2
+    assert any("closure" in f for f in failures2)
+
+    ok3, failures3 = inspect_serializability(
+        lambda: 42, name="clean", _print=lines.append
+    )
+    assert ok3 and not failures3
