@@ -150,6 +150,36 @@ mixtral_8x7b = TransformerConfig(
     experts_per_token=2,
 )
 
+tiny_qwen = TransformerConfig(
+    vocab_size=256,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=256,
+    max_seq=128,
+    dtype=jnp.float32,
+    remat=False,
+    qk_norm=True,
+    custom_head_dim=32,  # wider than d_model/n_heads, the Qwen3 shape
+)
+
+# Qwen3-4B architecture (arXiv:2505.09388): GQA with fixed 128-wide
+# heads, per-head-dim QK-norm instead of QKV bias, SwiGLU, 1M rope theta.
+qwen3_4b = TransformerConfig(
+    vocab_size=151936,
+    d_model=2560,
+    n_layers=36,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=9728,
+    max_seq=32768,
+    rope_theta=1000000.0,
+    qk_norm=True,
+    custom_head_dim=128,
+    tie_embeddings=True,
+)
+
 NAMED_CONFIGS = {
     "tiny": tiny,
     "tiny_gqa": tiny_gqa,
@@ -162,6 +192,8 @@ NAMED_CONFIGS = {
     "tiny_gemma": tiny_gemma,
     "gemma-2b": gemma_2b,
     "mixtral-8x7b": mixtral_8x7b,
+    "tiny_qwen": tiny_qwen,
+    "qwen3-4b": qwen3_4b,
 }
 
 

@@ -90,6 +90,9 @@ def _forward_with_cache(params, tokens, cache, cfg: TransformerConfig):
         q = (h @ lp["wq"]).reshape(b, lq, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"]).reshape(b, lq, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(b, lq, cfg.n_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:
+            q = rmsnorm(q, lp["q_norm"], cfg.norm_eps, use_pallas=False)
+            k = rmsnorm(k, lp["k_norm"], cfg.norm_eps, use_pallas=False)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         k_cache_l = jax.lax.dynamic_update_slice(

@@ -75,10 +75,17 @@ class TransformerConfig:
     final_logit_softcap: float = 0.0
     tie_embeddings: bool = False
     scale_embeddings: bool = False
+    # Qwen3-style QK-norm (arXiv:2505.09388): learned per-head-dim
+    # RMSNorm on q and k before RoPE, stabilizing attention logits at
+    # scale (replaces Qwen2's QKV bias).
+    qk_norm: bool = False
+    # Explicit head dim when it differs from d_model/n_heads (Qwen3
+    # uses 128-wide heads at every scale). 0 = derive from d_model.
+    custom_head_dim: int = 0
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.custom_head_dim or self.d_model // self.n_heads
 
 
 def _dense_init(key, shape, scale, dtype):
@@ -106,6 +113,9 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict:
         "wo": stack(keys[3], (h * hd, d), scale * (2 * L) ** -0.5),
         "mlp_norm": jnp.ones((L, d), dtype=cfg.dtype),
     }
+    if cfg.qk_norm:
+        layer["q_norm"] = jnp.ones((L, hd), dtype=cfg.dtype)
+        layer["k_norm"] = jnp.ones((L, hd), dtype=cfg.dtype)
     if cfg.num_experts == 0:
         layer.update(
             {
@@ -151,6 +161,9 @@ def param_logical_axes(cfg: TransformerConfig) -> Dict:
         "wo": ("stage", "heads", "embed"),
         "mlp_norm": ("stage", None),
     }
+    if cfg.qk_norm:
+        layer["q_norm"] = ("stage", None)
+        layer["k_norm"] = ("stage", None)
     if cfg.num_experts == 0:
         layer.update(
             {
@@ -237,6 +250,11 @@ def _layer_fn(cfg: TransformerConfig, mesh, cos, sin, positions):
         q = (h @ lp["wq"]).reshape(b, l, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"]).reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:
+            # Elementwise over head_dim: XLA fuses it into the rope/attn
+            # pipeline (the pallas rmsnorm kernel targets [.., D] rows).
+            q = rmsnorm(q, lp["q_norm"], cfg.norm_eps, use_pallas=False)
+            k = rmsnorm(k, lp["k_norm"], cfg.norm_eps, use_pallas=False)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         attn = _attention(cfg, q, k, v, mesh, positions)
