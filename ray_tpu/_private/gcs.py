@@ -120,6 +120,8 @@ class GcsServer:
         r("get_nodes", self.h_get_nodes)
         r("resource_update", self.h_resource_update)
         r("drain_node", self.h_drain_node)
+        r("cordon_node", self.h_cordon_node)
+        r("node_drain_status", self.h_node_drain_status)
         # actors
         r("register_actor", self.h_register_actor)
         r("actor_ready", self.h_actor_ready)
@@ -595,10 +597,15 @@ class GcsServer:
             info["proc_stats"] = d["proc_stats"]
         ver = d.get("version")
         full = "available" in d
+        # need_full replies still carry the draining flag — a version gap
+        # must not silently un-cordon the raylet for a beat.
+        drain_flag = (
+            {"draining": True} if info.get("draining") else {}
+        )
         if ver is not None and not full:
             expected = info.get("sync_version")
             if expected is None or ver != expected + 1:
-                return {"ok": False, "need_full": True}
+                return {"ok": False, "need_full": True, **drain_flag}
         if full:
             info["resources_available"] = dict(d["available"])
         else:
@@ -613,11 +620,40 @@ class GcsServer:
         if "demand_bundles" in d:
             info["demand_bundles"] = d["demand_bundles"]
         info["last_heartbeat"] = time.monotonic()
-        return {"ok": True}
+        return {"ok": True, **drain_flag}
 
     async def h_drain_node(self, d, conn):
         await self._mark_node_dead(d["node_id"], "drained")
         return {"ok": True}
+
+    async def h_cordon_node(self, d, conn):
+        """Graceful drain step 1 (reference: `ray drain-node`,
+        autoscaler.proto DrainNode): mark the node draining — every
+        placement path skips it, its raylet stops keeping new work local
+        (heartbeat replies carry the flag) — while running work finishes.
+        Step 2 is polling drain_status until idle, then drain_node."""
+        info = self.nodes.get(d["node_id"])
+        if not info or info["state"] != "ALIVE":
+            return {"ok": False, "error": "node not alive"}
+        info["draining"] = not d.get("undo", False)
+        return {"ok": True}
+
+    async def h_node_drain_status(self, d, conn):
+        """idle = every resource fully available again (tasks done,
+        actors gone, PG bundles returned) and no queued demand."""
+        info = self.nodes.get(d["node_id"])
+        if not info:
+            return {"ok": False, "error": "unknown node"}
+        avail, total = info["resources_available"], info["resources_total"]
+        idle = all(
+            avail.get(k, 0.0) + 1e-6 >= v for k, v in total.items()
+        ) and not info.get("demand_bundles")
+        return {
+            "ok": True,
+            "draining": bool(info.get("draining")),
+            "idle": idle,
+            "state": info["state"],
+        }
 
     # -- jobs -----------------------------------------------------------
     async def h_register_job(self, d, conn):
@@ -743,7 +779,8 @@ class GcsServer:
         """
         best, best_score = None, None
         for node_id, info in self.nodes.items():
-            if info["state"] != "ALIVE" or node_id in exclude:
+            if (info["state"] != "ALIVE" or node_id in exclude
+                    or info.get("draining")):
                 continue
             avail, total = info["resources_available"], info["resources_total"]
             if not all(total.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()):
@@ -807,9 +844,16 @@ class GcsServer:
         if sched.get("type") == "node_affinity":
             nid = sched["node_id"]
             info = self.nodes.get(nid)
-            if info and info["state"] == "ALIVE":
+            placeable = (
+                info and info["state"] == "ALIVE"
+                and not info.get("draining")
+            )
+            if placeable:
                 node_id = nid
             elif not sched.get("soft", False):
+                # Hard affinity to a dead/draining node: stay pending
+                # (retried each reconcile; resolves when the drain is
+                # undone or the node comes back).
                 return False
         if node_id is None and sched.get("type") == "placement_group":
             pg = self.placement_groups.get(sched["pg_id"])
@@ -820,7 +864,7 @@ class GcsServer:
             hard, soft = sched.get("hard", {}), sched.get("soft", {})
             best, best_soft = None, -1
             for nid, info in self.nodes.items():
-                if info["state"] != "ALIVE":
+                if info["state"] != "ALIVE" or info.get("draining"):
                     continue
                 labels = info.get("labels") or {}
                 if not all(labels.get(k) == v for k, v in hard.items()):
@@ -1195,7 +1239,7 @@ class GcsServer:
         alive = {
             nid: dict(info["resources_available"])
             for nid, info in self.nodes.items()
-            if info["state"] == "ALIVE"
+            if info["state"] == "ALIVE" and not info.get("draining")
         }
 
         def fits(avail, b):

@@ -213,6 +213,9 @@ class Raylet:
         # across a dispatch pass (items in the pass-local requeue list are
         # still here), so heartbeats report true demand.
         self._queued_specs: Dict[bytes, Dict[str, float]] = {}
+        # Graceful drain: set from heartbeat replies once the GCS cordons
+        # this node (h_cordon_node); new work then spills remote.
+        self._draining = False
         # ray_syncer-style delta sync state (_sync_resources).
         self._sync_version = 0
         self._synced_resources: Optional[Dict[str, float]] = None
@@ -1213,7 +1216,7 @@ class Raylet:
         resp = await self.gcs.call("get_nodes", {})
         best, best_soft = None, -1
         for n in resp["nodes"]:
-            if n["state"] != "ALIVE":
+            if n["state"] != "ALIVE" or n.get("draining"):
                 continue
             labels = n.get("labels") or {}
             if not all(labels.get(k) == v for k, v in hard.items()):
@@ -1227,7 +1230,8 @@ class Raylet:
         """Best remote node by lowest utilization (hybrid policy tail)."""
         best, best_util = None, None
         for n in nodes:
-            if n["state"] != "ALIVE" or n["node_id"] == self.node_id.binary():
+            if (n["state"] != "ALIVE" or n.get("draining")
+                    or n["node_id"] == self.node_id.binary()):
                 continue
             avail, total = n["resources_available"], n["resources_total"]
             if not all(avail.get(k, 0) + 1e-9 >= v for k, v in resources.items()):
@@ -1293,7 +1297,8 @@ class Raylet:
             # pinned here (single spillback, like the reference's lease
             # spillback counting).
             cfg = get_config()
-            if not self._feasible_locally(resources) or not self._available_for_new_work(resources):
+            if (self._draining or not self._feasible_locally(resources)
+                    or not self._available_for_new_work(resources)):
                 node = await self._pick_remote_node(resources)
                 if node is not None:
                     result = await self._forward_task(spec, node["node_id"])
@@ -1515,11 +1520,15 @@ class Raylet:
                          "error": "placement group bundle was removed"}
                     )
                 continue
-            if not self._feasible_locally(resources) and not spec.get("forwarded"):
-                # Infeasible here: hand off once a feasible node joins
-                # (autoscaled nodes register with the GCS). One cluster
-                # snapshot per 0.5s pass serves ALL infeasible classes —
-                # a poison class must not starve placeable ones.
+            if (self._draining or not self._feasible_locally(resources)) \
+                    and not spec.get("forwarded"):
+                # Infeasible here (or this node is draining): hand off
+                # once a feasible node joins (autoscaled nodes register
+                # with the GCS). One cluster snapshot per 0.5s pass
+                # serves ALL infeasible classes — a poison class must not
+                # starve placeable ones. While draining, queued demand
+                # keeps the node's drain_status non-idle, so the drain
+                # waits rather than stranding these tasks.
                 now = time.monotonic()
                 if ctx["nodes"] is None and now - self._last_infeasible_check >= 0.5:
                     self._last_infeasible_check = now
@@ -2512,6 +2521,10 @@ class Raylet:
         else:
             self._synced_resources = avail
             self._synced_demand_sig = demand_sig
+        # Graceful drain (cordon): once the GCS flags this node draining,
+        # the hybrid policy stops keeping new work local (see h_submit's
+        # draining check) and placement everywhere else skips us.
+        self._draining = bool(r.get("draining"))
 
     async def _heartbeat_loop(self):
         cfg = get_config()

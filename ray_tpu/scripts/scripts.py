@@ -273,6 +273,26 @@ def cmd_memory(args):
         print(f"  {o['object_id'][:16]}  {o['size']:>12} B  on [{locs}]")
 
 
+def cmd_drain(args):
+    """Graceful drain (reference: `ray drain-node`): cordon -> wait for
+    running work to finish -> remove from the cluster."""
+    from ray_tpu.util.state import drain_node
+
+    r = drain_node(args.node_id, timeout=args.timeout, undo=args.undo,
+                   address=_resolve_address(args))
+    if args.undo:
+        if r.get("ok"):
+            print("cordon lifted")
+            return
+        print(f"failed: {r.get('error')}")
+        raise SystemExit(1)
+    if r.get("ok"):
+        print(f"node {args.node_id[:12]} drained and removed")
+    else:
+        print(f"drain failed: {r.get('error')}")
+        raise SystemExit(1)
+
+
 def cmd_stack(args):
     """Live thread stacks of every worker (reference: dashboard py-spy
     on-demand dumps)."""
@@ -409,6 +429,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("status", help="cluster resource overview")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "drain", help="gracefully drain a node (cordon, wait idle, remove)"
+    )
+    sp.add_argument("node_id", help="node id (hex, from `rt list nodes`)")
+    sp.add_argument("--timeout", type=float, default=300.0)
+    sp.add_argument("--undo", action="store_true",
+                    help="lift the cordon instead of draining")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("list", help="list cluster entities")
     sp.add_argument(

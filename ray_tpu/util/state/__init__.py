@@ -12,6 +12,7 @@ from ray_tpu.util.state.api import (  # noqa: F401
     get_worker_stacks,
     list_actors,
     list_jobs,
+    drain_node,
     list_nodes,
     list_objects,
     list_placement_groups,

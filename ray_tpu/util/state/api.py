@@ -308,3 +308,39 @@ def summarize_tasks(c):
         by_state = summary.setdefault(t["name"] or "<anonymous>", {})
         by_state[t.get("state", "?")] = by_state.get(t.get("state", "?"), 0) + 1
     return summary
+
+
+@_with_client
+def drain_node(c, node_id: str, timeout: float = 300.0, undo: bool = False,
+               poll_s: float = 1.0):
+    """Graceful node drain (reference: `ray drain-node` / autoscaler.proto
+    DrainNode): cordon the node so every placement path skips it, wait
+    for running work to finish (resources fully returned, no queued
+    demand), then remove it. undo=True lifts a cordon instead."""
+    import time as _time
+
+    try:
+        nid = bytes.fromhex(node_id)
+    except ValueError:
+        return {"ok": False,
+                "error": f"invalid node id {node_id!r} (expected hex)"}
+    if undo:
+        return c.call("cordon_node", {"node_id": nid, "undo": True})
+    r = c.call("cordon_node", {"node_id": nid})
+    if not r.get("ok"):
+        return r
+    deadline = _time.monotonic() + timeout
+    st: dict = {}
+    while _time.monotonic() < deadline:
+        st = c.call("node_drain_status", {"node_id": nid})
+        if not st.get("ok"):
+            return st
+        if st.get("state") != "ALIVE":
+            # Died (or was removed) mid-drain: nothing left to wait for.
+            return {"ok": True, "drained": True, "already_dead": True}
+        if st.get("idle"):
+            c.call("drain_node", {"node_id": nid})
+            return {"ok": True, "drained": True}
+        _time.sleep(poll_s)
+    return {"ok": False, "error": "drain timed out (node still busy; "
+            "cordon stays in effect)", "status": st}

@@ -234,3 +234,68 @@ def test_gang_tasks_spread_not_pipelined(rt_cluster):
     ]
     hosts = set(rt.get(refs, timeout=120))
     assert len(hosts) == 2, f"gang tasks serialized on one host: {hosts}"
+
+
+@pytest.mark.slow
+def test_graceful_node_drain(rt_cluster):
+    """rt drain semantics (reference: `ray drain-node`): cordon a node ->
+    new work avoids it while running work finishes -> once idle it is
+    removed from the cluster."""
+    import time as _t
+
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    @rt.remote
+    def where(sleep_s=0.0):
+        import os
+        import time as _tt
+
+        _tt.sleep(sleep_s)
+        return os.environ["RT_NODE_ID"]
+
+    # Place one long task on n2 by affinity, then cordon n2 mid-flight.
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    n2_id = n2.node_id.binary()
+    busy = where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=n2_id),
+    ).remote(4.0)
+    _t.sleep(0.5)
+
+    from ray_tpu.util.state import drain_node
+
+    hexid = n2_id.hex()
+    # Kick off the drain in a thread: it must wait for `busy` to finish.
+    import threading
+
+    result = {}
+
+    def run_drain():
+        result["r"] = drain_node(hexid, timeout=60, poll_s=0.3)
+
+    th = threading.Thread(target=run_drain)
+    th.start()
+
+    _t.sleep(1.0)  # cordon has propagated via heartbeat by now
+    # New tasks land on the OTHER node even though n2 has free CPU.
+    spots = set(rt.get([where.remote() for _ in range(6)], timeout=60))
+    assert hexid not in spots, "cordoned node still received work"
+    # The long task is still running on n2 (drain waits).
+    assert th.is_alive()
+
+    assert rt.get(busy, timeout=60) == hexid  # ran to completion
+    th.join(timeout=60)
+    assert result["r"].get("ok"), result["r"]
+
+    # Node removed from the cluster view.
+    from ray_tpu.util.state import list_nodes
+
+    states = {n["node_id"]: n["state"] for n in list_nodes()}
+    assert states.get(hexid) == "DEAD"
+    # The survivors still run work.
+    assert rt.get(where.remote(), timeout=60) != hexid
