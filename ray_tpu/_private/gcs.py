@@ -623,6 +623,23 @@ class GcsServer:
         return {"ok": True, **drain_flag}
 
     async def h_drain_node(self, d, conn):
+        # Actors still pending on a hard affinity to this node can never
+        # place once it is gone: fail them with a clear cause instead of
+        # leaving their creators waiting forever.
+        for actor_id in list(self.pending_actors):
+            a = self.actors.get(actor_id)
+            sched = (a or {}).get("scheduling") or {}
+            if (
+                sched.get("type") == "node_affinity"
+                and sched.get("node_id") == d["node_id"]
+                and not sched.get("soft", False)
+            ):
+                self.pending_actors.discard(actor_id)
+                a["state"] = "DEAD"
+                a["death_cause"] = "hard-affinity node was drained"
+                await self.publish(
+                    "actor_update:" + actor_id.hex(), self._actor_view(a)
+                )
         await self._mark_node_dead(d["node_id"], "drained")
         return {"ok": True}
 
@@ -635,6 +652,11 @@ class GcsServer:
         info = self.nodes.get(d["node_id"])
         if not info or info["state"] != "ALIVE":
             return {"ok": False, "error": "node not alive"}
+        if info.get("is_head") and not d.get("undo"):
+            # Draining the head would fail every supervised job and
+            # leave the cluster headless; the reference's DrainNode is
+            # a worker-node operation too.
+            return {"ok": False, "error": "refusing to drain the head node"}
         info["draining"] = not d.get("undo", False)
         return {"ok": True}
 
@@ -645,14 +667,30 @@ class GcsServer:
         if not info:
             return {"ok": False, "error": "unknown node"}
         avail, total = info["resources_available"], info["resources_total"]
-        idle = all(
-            avail.get(k, 0.0) + 1e-6 >= v for k, v in total.items()
-        ) and not info.get("demand_bundles")
+        # GCS-pending actors hard-affined here block the drain: once the
+        # node is removed they could never place (the operator must undo
+        # the cordon, or the removal path fails them explicitly).
+        blocked_actors = 0
+        for actor_id in self.pending_actors:
+            a = self.actors.get(actor_id)
+            sched = (a or {}).get("scheduling") or {}
+            if (
+                sched.get("type") == "node_affinity"
+                and sched.get("node_id") == d["node_id"]
+                and not sched.get("soft", False)
+            ):
+                blocked_actors += 1
+        idle = (
+            all(avail.get(k, 0.0) + 1e-6 >= v for k, v in total.items())
+            and not info.get("demand_bundles")
+            and blocked_actors == 0
+        )
         return {
             "ok": True,
             "draining": bool(info.get("draining")),
             "idle": idle,
             "state": info["state"],
+            "pending_affinity_actors": blocked_actors,
         }
 
     # -- jobs -----------------------------------------------------------

@@ -1263,8 +1263,28 @@ class Raylet:
         resources = spec.get("resources", {})
         target_node: Optional[bytes] = None
 
+        hard_here = spec.get("hard_affinity") or (
+            sched.get("type") == "node_affinity"
+            and sched.get("node_id") == self.node_id.binary()
+            and not sched.get("soft", False)
+        )
+        if self._draining and hard_here:
+            # Hard affinity to a draining node can never be honored
+            # (PG-scheduled work is exempt: its bundle holds resources
+            # and the drain waits for the group's removal).
+            return {
+                "status": "error",
+                "error": "node is draining: hard node affinity cannot "
+                         "be honored",
+            }
+
         if sched.get("type") == "node_affinity":
             target_node = sched["node_id"]
+            if not sched.get("soft", False):
+                # Survives the forward's scheduling strip so a draining
+                # target can tell pinned-affinity work (reject) from
+                # ordinary spillover (accept: it pre-dates the cordon).
+                spec["hard_affinity"] = True
         elif sched.get("type") == "placement_group":
             pg = await self.gcs.call("get_placement_group", {"pg_id": sched["pg_id"]})
             if not pg["pg"] or pg["pg"]["state"] != "CREATED":

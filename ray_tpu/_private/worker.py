@@ -1946,7 +1946,13 @@ def _rebuild_task_error(result) -> TaskError:
             cause = cloudpickle.loads(result["data"])
         except Exception:  # noqa: BLE001
             cause = None
-    return TaskError(result.get("cls", "Exception"), result.get("tb", ""), cause)
+    # Raylet-originated errors carry a plain "error" string rather than a
+    # worker traceback — surface it instead of an empty message.
+    return TaskError(
+        result.get("cls", "Exception"),
+        result.get("tb") or result.get("error", ""),
+        cause,
+    )
 
 
 async def connect_coro(loop, host, port):

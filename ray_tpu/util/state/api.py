@@ -331,16 +331,31 @@ def drain_node(c, node_id: str, timeout: float = 300.0, undo: bool = False,
         return r
     deadline = _time.monotonic() + timeout
     st: dict = {}
+    idle_streak = 0
+    # Two consecutive idle polls ≥0.6s apart must both pass: the GCS
+    # availability view lags the raylet by one heartbeat (~0.5s), so a
+    # single idle reading can predate a just-dispatched task or the
+    # raylet even learning of the cordon.
+    gap = max(poll_s, 0.6)
     while _time.monotonic() < deadline:
         st = c.call("node_drain_status", {"node_id": nid})
         if not st.get("ok"):
             return st
+        if not st.get("draining"):
+            # Cordon lifted mid-drain (rt drain --undo elsewhere, or a
+            # GCS restart dropped the volatile flag): abort rather than
+            # removing a node that is accepting work again.
+            return {"ok": False, "error": "cordon was lifted mid-drain"}
         if st.get("state") != "ALIVE":
             # Died (or was removed) mid-drain: nothing left to wait for.
             return {"ok": True, "drained": True, "already_dead": True}
         if st.get("idle"):
-            c.call("drain_node", {"node_id": nid})
-            return {"ok": True, "drained": True}
-        _time.sleep(poll_s)
+            idle_streak += 1
+            if idle_streak >= 2:
+                c.call("drain_node", {"node_id": nid})
+                return {"ok": True, "drained": True}
+        else:
+            idle_streak = 0
+        _time.sleep(gap)
     return {"ok": False, "error": "drain timed out (node still busy; "
             "cordon stays in effect)", "status": st}

@@ -299,3 +299,62 @@ def test_graceful_node_drain(rt_cluster):
     assert states.get(hexid) == "DEAD"
     # The survivors still run work.
     assert rt.get(where.remote(), timeout=60) != hexid
+
+
+@pytest.mark.slow
+def test_drain_guards(rt_cluster):
+    """Drain edge semantics: the head node refuses to drain; hard
+    node-affinity work aimed at a draining node fails fast instead of
+    landing on it; --undo mid-drain aborts the removal."""
+    import time as _t
+
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    from ray_tpu.util.state import StateApiClient, drain_node
+
+    head_id = cluster.head.node_id.binary().hex()
+    r = drain_node(head_id, timeout=5)
+    assert not r.get("ok") and "head" in r.get("error", "")
+
+    # Cordon n2 (no removal yet), wait for its raylet to learn of it.
+    c = StateApiClient()
+    n2_id = n2.node_id.binary()
+    assert c.call("cordon_node", {"node_id": n2_id}).get("ok")
+    _t.sleep(1.2)
+
+    @rt.remote
+    def where():
+        import os
+
+        return os.environ["RT_NODE_ID"]
+
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    with pytest.raises(Exception, match="draining"):
+        rt.get(
+            where.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=n2_id
+                ),
+            ).remote(),
+            timeout=30,
+        )
+
+    # Lift the cordon: affinity works again (drain aborted cleanly).
+    assert c.call("cordon_node", {"node_id": n2_id, "undo": True}).get("ok")
+    _t.sleep(1.2)
+    out = rt.get(
+        where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=n2_id
+            ),
+        ).remote(),
+        timeout=30,
+    )
+    assert out == n2_id.hex()
+    c.close()

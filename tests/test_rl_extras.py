@@ -663,7 +663,9 @@ def test_a2c_cartpole_improves(rt_start):
     try:
         first = algo.train()
         best = 0.0
-        for _ in range(15):
+        # Unclipped on-policy PG is the noisiest learner here (and env
+        # resets are unseeded): generous budget, modest bar.
+        for _ in range(30):
             result = algo.train()
             best = max(best, result["episode_return_mean"])
             if best >= 60.0:
