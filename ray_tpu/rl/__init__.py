@@ -14,6 +14,7 @@ from ray_tpu.rl.core.rl_module import (
     DiscretePolicyModule,
     C51QNetworkModule,
     DuelingQNetworkModule,
+    NoisyQNetworkModule,
     RLModuleSpec,
 )
 from ray_tpu.rl.env_runner import (
@@ -29,6 +30,7 @@ from ray_tpu.rl.algorithms.dqn import (
     c51_loss,
     categorical_projection,
     dqn_loss,
+    noisy_dqn_loss,
 )
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.algorithms.apex import (
@@ -121,6 +123,8 @@ __all__ = [
     "c51_loss",
     "categorical_projection",
     "C51QNetworkModule",
+    "NoisyQNetworkModule",
+    "noisy_dqn_loss",
     "IMPALA",
     "IMPALAConfig",
     "impala_loss",

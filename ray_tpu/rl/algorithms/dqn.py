@@ -23,6 +23,7 @@ from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import (
     C51QNetworkModule,
     DuelingQNetworkModule,
+    NoisyQNetworkModule,
     QNetworkModule,
     RLModuleSpec,
 )
@@ -30,12 +31,7 @@ from ray_tpu.rl.env_runner import TransitionEnvRunner
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
 
 
-def dqn_loss(params, module, batch):
-    """Huber TD loss against precomputed targets (target-network Q-values
-    are computed driver-side so the learner stays a pure
-    params+batch -> grads function). With prioritized replay the batch
-    carries importance-sampling ``weights`` applied per sample."""
-    q = module.forward(params, batch["obs"])["q_values"]
+def _huber_td(q, batch):
     q_sa = jnp.take_along_axis(
         q, batch["actions"][:, None].astype(jnp.int32), axis=-1
     )[:, 0]
@@ -50,6 +46,25 @@ def dqn_loss(params, module, batch):
         "q_mean": q_sa.mean(),
         "td_abs_mean": jnp.abs(td).mean(),
     }
+
+
+def dqn_loss(params, module, batch):
+    """Huber TD loss against precomputed targets (target-network Q-values
+    are computed driver-side so the learner stays a pure
+    params+batch -> grads function). With prioritized replay the batch
+    carries importance-sampling ``weights`` applied per sample."""
+    q = module.forward(params, batch["obs"])["q_values"]
+    return _huber_td(q, batch)
+
+
+def noisy_dqn_loss(params, module, batch):
+    """NoisyNet variant: the batch carries one factorized noise draw
+    (eps_in/eps_out), so sigma trains through the same pure
+    params+batch plumbing (Fortunato et al. 2017)."""
+    q = module.forward(
+        params, batch["obs"], noise=(batch["eps_in"], batch["eps_out"])
+    )["q_values"]
+    return _huber_td(q, batch)
 
 
 def c51_loss(params, module, batch):
@@ -138,6 +153,9 @@ class DQNConfig(ConfigEvalMixin):
     num_atoms: int = 51
     v_min: float = -10.0
     v_max: float = 10.0
+    # NoisyNet exploration (reference: DQNConfig.noisy): learned
+    # parametric noise on the head replaces epsilon-greedy.
+    noisy: bool = False
 
     def environment(self, env_creator=None, obs_dim=None, num_actions=None):
         if env_creator is not None:
@@ -165,7 +183,7 @@ class DQNConfig(ConfigEvalMixin):
                  prioritized_replay=None, per_alpha=None,
                  per_beta_start=None, per_beta_iters=None,
                  distributional=None, num_atoms=None, v_min=None,
-                 v_max=None):
+                 v_max=None, noisy=None):
         for name, val in (
             ("lr", lr), ("gamma", gamma),
             ("train_batch_size", train_batch_size),
@@ -179,7 +197,7 @@ class DQNConfig(ConfigEvalMixin):
             ("per_alpha", per_alpha), ("per_beta_start", per_beta_start),
             ("per_beta_iters", per_beta_iters),
             ("distributional", distributional), ("num_atoms", num_atoms),
-            ("v_min", v_min), ("v_max", v_max),
+            ("v_min", v_min), ("v_max", v_max), ("noisy", noisy),
         ):
             if val is not None:
                 setattr(self, name, val)
@@ -207,12 +225,12 @@ class DQN(AlgorithmBase):
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
         spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
+        if sum((config.distributional, config.dueling, config.noisy)) > 1:
+            raise ValueError(
+                "distributional / dueling / noisy heads are not composed; "
+                "pick one head structure"
+            )
         if config.distributional:
-            if config.dueling:
-                raise ValueError(
-                    "distributional + dueling heads are not composed; "
-                    "pick one head structure"
-                )
             if config.num_atoms < 2:
                 raise ValueError("distributional DQN needs num_atoms >= 2")
             module_factory = self._module_factory = (  # noqa: E731
@@ -220,14 +238,21 @@ class DQN(AlgorithmBase):
                     spec, config.num_atoms, config.v_min, config.v_max
                 )
             )
+            loss = c51_loss
+        elif config.noisy:
+            module_factory = self._module_factory = (  # noqa: E731
+                lambda: NoisyQNetworkModule(spec)
+            )
+            loss = noisy_dqn_loss
         else:
             cls = DuelingQNetworkModule if config.dueling else QNetworkModule
             module_factory = self._module_factory = lambda: cls(spec)  # noqa: E731
+            loss = dqn_loss
         self.module = module_factory()
 
         self.learner_group = LearnerGroup(
             module_factory,
-            c51_loss if config.distributional else dqn_loss,
+            loss,
             num_learners=config.num_learners,
             seed=config.seed,
             lr=config.lr,
@@ -256,6 +281,7 @@ class DQN(AlgorithmBase):
         self._online_params = self.target_params
         self._fwd = jax.jit(lambda p, obs: self.module.forward(p, obs))
         self._target_q = lambda p, obs: self._fwd(p, obs)["q_values"]
+        self._np_rng = np.random.default_rng(config.seed + 31)
         self._iteration = 0
         self._broadcast_weights()
 
@@ -279,6 +305,8 @@ class DQN(AlgorithmBase):
 
     def _epsilon(self) -> float:
         cfg = self.config
+        if cfg.noisy:
+            return 0.0  # exploration is the head's learned noise
         frac = min(1.0, self._iteration / max(cfg.epsilon_decay_iters, 1))
         return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
 
@@ -402,6 +430,17 @@ class DQN(AlgorithmBase):
                         "actions": mb["actions"],
                         "targets": targets.astype(np.float32),
                     }
+                if cfg.noisy:
+                    # One fresh factorized draw per update: sigma trains
+                    # against real noise, actions decorrelate per batch.
+                    from ray_tpu.rl.core.rl_module import (
+                        factorized_noise_np,
+                    )
+
+                    width = self._online_params["mu_w"].shape[0]
+                    batch["eps_in"], batch["eps_out"] = factorized_noise_np(
+                        self._np_rng, width, cfg.num_actions
+                    )
                 if cfg.prioritized_replay:
                     batch["weights"] = mb["weights"]
                     q_sa = np.take_along_axis(

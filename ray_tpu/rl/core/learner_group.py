@@ -132,10 +132,24 @@ class LearnerGroup:
                 pass
 
 
+# Batch entries that are shared per-update state rather than per-sample
+# rows (NoisyNet's factorized noise vectors): replicated to every
+# learner shard instead of sliced. Explicit by name — a length
+# heuristic would misfire when a vector width coincides with the
+# batch size.
+SHARED_BATCH_KEYS = frozenset({"eps_in", "eps_out"})
+
+
 def _split_batch(batch: Dict[str, np.ndarray], n: int) -> List[Dict]:
-    keys = list(batch.keys())
-    size = len(batch[keys[0]])
+    size = len(next(
+        v for k, v in batch.items() if k not in SHARED_BATCH_KEYS
+    ))
     per = size // n
     return [
-        {k: batch[k][i * per : (i + 1) * per] for k in keys} for i in range(n)
+        {
+            k: (v if k in SHARED_BATCH_KEYS
+                else v[i * per: (i + 1) * per])
+            for k, v in batch.items()
+        }
+        for i in range(n)
     ]

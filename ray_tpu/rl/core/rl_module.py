@@ -120,6 +120,86 @@ class DuelingQNetworkModule(QNetworkModule):
         return {"q_values": q}
 
 
+def factorized_noise(rng: jax.Array, n_in: int, n_out: int):
+    """Factorized Gaussian noise (Fortunato et al. 2017): two vectors
+    through f(x) = sign(x)*sqrt(|x|) outer-product into a weight-noise
+    matrix at O(n_in + n_out) sampling cost."""
+    k1, k2 = jax.random.split(rng)
+    f = lambda x: jnp.sign(x) * jnp.sqrt(jnp.abs(x))  # noqa: E731
+    return f(jax.random.normal(k1, (n_in,))), f(
+        jax.random.normal(k2, (n_out,))
+    )
+
+
+def factorized_noise_np(rng, n_in: int, n_out: int):
+    """Numpy twin of factorized_noise for driver-side batch assembly
+    (same transform; keep the two in lockstep)."""
+    import numpy as np
+
+    f = lambda x: np.sign(x) * np.sqrt(np.abs(x))  # noqa: E731
+    return (
+        f(rng.standard_normal(n_in)).astype(np.float32),
+        f(rng.standard_normal(n_out)).astype(np.float32),
+    )
+
+
+class NoisyQNetworkModule(QNetworkModule):
+    """Q-network with a NoisyNet output layer (Fortunato et al. 2017;
+    reference: DQNConfig.noisy). Exploration comes from learned
+    parametric noise on the head weights instead of epsilon-greedy:
+    w = mu + sigma * (eps_out ⊗ eps_in). The noise vectors are inputs
+    (sampled by the caller), so the module stays a pure function and the
+    learner's loss trains sigma through the same batch dict plumbing.
+    """
+
+    SIGMA0 = 0.5
+
+    def init(self, rng: jax.Array) -> Dict:
+        if not self.spec.hidden:
+            raise ValueError(
+                "NoisyQNetworkModule needs at least one hidden layer "
+                "(the noisy head sits atop the trunk)"
+            )
+        k1, k2, k3 = jax.random.split(rng, 3)
+        sizes = [self.spec.obs_dim, *self.spec.hidden]
+        width = sizes[-1]
+        A = self.spec.num_actions
+        bound = width ** -0.5
+        return {
+            "trunk": init_mlp(k1, sizes),
+            "mu_w": jax.random.uniform(
+                k2, (width, A), minval=-bound, maxval=bound
+            ),
+            "mu_b": jax.random.uniform(
+                k3, (A,), minval=-bound, maxval=bound
+            ),
+            "sigma_w": jnp.full((width, A), self.SIGMA0 * bound),
+            "sigma_b": jnp.full((A,), self.SIGMA0 * bound),
+        }
+
+    def forward(self, params: Dict, obs: jax.Array,
+                noise=None) -> Dict[str, jax.Array]:
+        """noise = (eps_in (width,), eps_out (A,)) or None for the
+        deterministic mu-only head (target computation, evaluation)."""
+        h = jax.nn.relu(mlp_forward(params["trunk"], obs))
+        w, b = params["mu_w"], params["mu_b"]
+        if noise is not None:
+            eps_in, eps_out = noise
+            w = w + params["sigma_w"] * (eps_in[:, None] * eps_out[None, :])
+            b = b + params["sigma_b"] * eps_out
+        return {"q_values": h @ w + b}
+
+    def sample_action(self, params: Dict, obs: jax.Array, rng: jax.Array,
+                      epsilon: float = 0.0):
+        """Noise-driven exploration: one fresh factorized draw per call;
+        epsilon is ignored (the reference also zeroes epsilon when noisy
+        is on)."""
+        width = params["mu_w"].shape[0]
+        noise = factorized_noise(rng, width, self.spec.num_actions)
+        q = self.forward(params, obs, noise=noise)["q_values"]
+        return jnp.argmax(q, axis=-1)
+
+
 class C51QNetworkModule(QNetworkModule):
     """Categorical distributional Q-network (Bellemare et al. 2017).
 

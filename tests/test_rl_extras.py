@@ -799,3 +799,100 @@ def test_apex_dqn_learns_with_sharded_replay(rt_start):
         assert best >= 75.0, f"APEX failed to learn: best={best}"
     finally:
         algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# NoisyNet DQN (the last Rainbow component; reference: DQNConfig.noisy)
+# ---------------------------------------------------------------------------
+
+
+def test_noisy_module_math():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import NoisyQNetworkModule, RLModuleSpec
+    from ray_tpu.rl.core.rl_module import factorized_noise
+
+    mod = NoisyQNetworkModule(RLModuleSpec(obs_dim=3, num_actions=4))
+    params = mod.init(jax.random.PRNGKey(0))
+    obs = jax.random.normal(jax.random.PRNGKey(1), (6, 3))
+    # mu-only forward is deterministic.
+    q1 = mod.forward(params, obs)["q_values"]
+    q2 = mod.forward(params, obs)["q_values"]
+    assert jnp.allclose(q1, q2) and q1.shape == (6, 4)
+    # Noise perturbs the outputs; different draws differ.
+    n1 = factorized_noise(jax.random.PRNGKey(2), 64, 4)
+    n2 = factorized_noise(jax.random.PRNGKey(3), 64, 4)
+    qa = mod.forward(params, obs, noise=n1)["q_values"]
+    qb = mod.forward(params, obs, noise=n2)["q_values"]
+    assert not jnp.allclose(qa, q1)
+    assert not jnp.allclose(qa, qb)
+    # Sigma receives gradient through the noisy loss path.
+    from ray_tpu.rl import noisy_dqn_loss
+
+    batch = {
+        "obs": obs,
+        "actions": jnp.zeros(6, dtype=jnp.int32),
+        "targets": jnp.ones(6),
+        "eps_in": n1[0],
+        "eps_out": n1[1],
+    }
+    grads = jax.grad(lambda p: noisy_dqn_loss(p, mod, batch)[0])(params)
+    assert float(jnp.abs(grads["sigma_w"]).sum()) > 0
+    assert float(jnp.abs(grads["sigma_b"]).sum()) > 0
+    # Actions vary across rng draws on the same observation (exploration
+    # without epsilon).
+    acts = {
+        int(mod.sample_action(params, obs[:1], jax.random.PRNGKey(k))[0])
+        for k in range(40)
+    }
+    assert len(acts) > 1
+
+
+@pytest.mark.slow
+def test_noisy_dqn_cartpole_improves(rt_start):
+    import gymnasium as gym
+
+    from ray_tpu.rl import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment(lambda: gym.make("CartPole-v1"), obs_dim=4, num_actions=2)
+        .env_runners(num_env_runners=2, rollout_length=200)
+        .training(lr=1e-3, train_batch_size=64, updates_per_iteration=64,
+                  learning_starts=400, noisy=True, n_step=3)
+        .build()
+    )
+    try:
+        best = -1.0
+        for _ in range(30):
+            result = algo.train()
+            assert result["epsilon"] == 0.0  # exploration is the noise
+            best = max(best, result["episode_return_mean"])
+            if best >= 75.0:
+                break
+        assert best >= 75.0, f"noisy DQN failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_noisy_multi_learner_split_replicates_noise():
+    """_split_batch replicates shared noise vectors instead of slicing
+    them (regression: num_learners>1 corrupted eps_in/eps_out)."""
+    from ray_tpu.rl.core.learner_group import _split_batch
+
+    batch = {
+        "obs": np.zeros((64, 4), dtype=np.float32),
+        "actions": np.zeros(64, dtype=np.int32),
+        "targets": np.zeros(64, dtype=np.float32),
+        # Width chosen == batch size to prove the split is by NAME, not
+        # by a length heuristic.
+        "eps_in": np.arange(64, dtype=np.float32),
+        "eps_out": np.arange(2, dtype=np.float32),
+    }
+    shards = _split_batch(batch, 2)
+    assert len(shards) == 2
+    for s in shards:
+        assert s["obs"].shape == (32, 4)
+        assert np.array_equal(s["eps_in"], batch["eps_in"])
+        assert np.array_equal(s["eps_out"], batch["eps_out"])
