@@ -131,6 +131,9 @@ class WorkerHandle:
         # straight to this worker (direct_task_transport.cc OnWorkerIdle).
         self.lease_resources: Optional[Dict[str, float]] = None
         self.leased_by = None  # owner ServerConnection while leased
+        # max_calls retirement: excluded from dispatch/leases, killed
+        # shortly after (the worker announced it is done).
+        self.retired = False
         # Set when the worker registers (or is forgotten): actor creation
         # waits on this instead of a 50ms poll.
         self.registered = asyncio.Event()
@@ -288,6 +291,7 @@ class Raylet:
         r("worker_stacks", self.h_worker_stacks)
         r("lease_worker", self.h_lease_worker)
         r("release_lease", self.h_release_lease)
+        r("retire_worker", self.h_retire_worker)
         # A crashed owner must not leak its leased workers' resources.
         self.rpc.on_disconnect = self._on_client_disconnect
 
@@ -1415,6 +1419,29 @@ class Raylet:
             self._release_lease_of(w)
         return {"ok": True}
 
+    async def h_retire_worker(self, d, conn):
+        """A worker crossed its max_calls threshold: stop dispatching to
+        it and kill it shortly (reference: the worker exits after the
+        task when @ray.remote(max_calls=N) is hit; here the raylet owns
+        the removal so there is no window where a doomed worker still
+        receives work)."""
+        w = self.workers.get(d["worker_id"])
+        if w is None:
+            return {"ok": False}
+        w.retired = True
+        w.idle = False
+
+        async def _kill_soon():
+            await asyncio.sleep(0.3)  # let the final replies flush
+            try:
+                if w.proc is not None and w.proc.poll() is None:
+                    w.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+
+        spawn(_kill_soon())
+        return {"ok": True}
+
     async def _on_client_disconnect(self, conn):
         """An owner connection died: return every lease it held (the
         reference's lease lifetime is likewise bounded by the owner,
@@ -1684,6 +1711,7 @@ class Raylet:
         for w in self.workers.values():
             if (
                 w.idle
+                and not w.retired
                 and w.conn is not None
                 and w.actor_id is None
                 and w.runtime_env_hash == renv_hash

@@ -1155,6 +1155,7 @@ class CoreClient:
         scheduling=None,
         max_retries: Optional[int] = None,
         runtime_env=None,
+        max_calls: Optional[int] = None,
     ) -> List[ObjectRef]:
         cfg = get_config()
         fn_key = self.fn_manager.export(fn)
@@ -1174,6 +1175,11 @@ class CoreClient:
             "runtime_env": resolved_env,
             "runtime_env_hash": resolved_env["hash"] if resolved_env else None,
         }
+        if max_calls:
+            # Worker retires after this many executions of the function
+            # (reference: @ray.remote(max_calls=N), remote_function.py —
+            # the leak mitigation for tasks wrapping leaky native code).
+            spec["max_calls"] = int(max_calls)
         retries = cfg.task_max_retries if max_retries is None else max_retries
         # The raylet's OOM policy prefers killing retriable tasks
         # (worker_killing_policy.cc retriable-FIFO). max_retries=-1 means
@@ -1324,7 +1330,15 @@ class CoreClient:
         finally:
             entry["outstanding"] -= len(chunk)
             entry["last_used"] = time.monotonic()
-        for (spec, futures, _), result in zip(chunk, results):
+        for (spec, futures, retries), result in zip(chunk, results):
+            if result.get("status") == "worker_crashed" and result.get(
+                "not_executed"
+            ):
+                # The worker refused before running (retiring under
+                # max_calls): safe to resubmit even at max_retries=0 —
+                # nothing executed.
+                spawn(self._submit_with_retries(spec, futures, retries))
+                continue
             self._complete_task(spec, result, futures)
 
     @staticmethod
@@ -1454,12 +1468,21 @@ class CoreClient:
 
     async def _submit_with_retries(self, spec, futures, retries):
         attempt = 0
+        refusals = 0
         while True:
             try:
                 result = await self.raylet.call("submit_task", spec, timeout=None)
             except ConnectionLost:
                 result = {"status": "worker_crashed", "error": "raylet connection lost"}
             status = result.get("status")
+            if result.get("not_executed") and refusals < 100:
+                # Refused before running (a worker retiring under
+                # max_calls): resubmission is free — nothing executed —
+                # so it does not consume a retry (separate counter; the
+                # cap only bounds a pathological refuse-forever loop).
+                refusals += 1
+                await asyncio.sleep(min(0.05 * refusals, 0.5))
+                continue
             # max_retries=-1 = retry worker crashes forever (reference
             # semantics; data tasks are idempotent and use it).
             if status == "worker_crashed" and (

@@ -84,6 +84,11 @@ def ensure_tpu_backend():
         _TPU_ATTACHED = True
 
 
+def _retired_result() -> dict:
+    return {"status": "worker_crashed", "not_executed": True,
+            "error": "worker retired (max_calls)"}
+
+
 class _RawObject:
     """Pre-framed bytes (RTX1 cross-language objects) presented with the
     SerializedObject store interface (total_size / write_into / to_bytes)."""
@@ -163,6 +168,13 @@ class WorkerProcess:
         # recycle this worker back into the pool when zero — a thread
         # mid-call cannot be stopped, only the process can.
         self._active_actor_calls = 0
+        # max_calls (reference: @ray.remote(max_calls=N), the leak
+        # mitigation for tasks wrapping leaky native code): per-function
+        # execution counts; crossing a task's threshold retires this
+        # worker — later pushes are refused (the owner retries on a
+        # fresh worker) and the process exits once replies flush.
+        self._fn_calls: Dict[bytes, int] = {}
+        self._retiring = False
 
     async def h_dump_stacks(self, d, conn):
         """Live thread stacks of this worker (the on-demand profiling
@@ -287,8 +299,14 @@ class WorkerProcess:
             spawn(self._create_actor(payload))
 
     async def _run_task(self, spec):
+        if self._retiring:
+            await self.raylet_conn.call(
+                "task_done",
+                {"task_id": spec["task_id"], "result": _retired_result()},
+            )
+            return
         result = await self.loop.run_in_executor(
-            self.executor, self._execute_task, spec
+            self.executor, self._execute_accounted, spec
         )
         await self.raylet_conn.call(
             "task_done", {"task_id": spec["task_id"], "result": result}
@@ -303,23 +321,66 @@ class WorkerProcess:
         shape, so pipelined pushes queue here rather than running
         concurrently in the executor (which would oversubscribe the
         node's accounting)."""
+        if self._retiring:
+            return _retired_result()
         async with self._direct_lock:
+            # _execute_accounted re-checks _retiring inside (a push may
+            # have queued on the lock behind the call that crossed the
+            # threshold — it must refuse, not run-and-be-killed).
             return await self.loop.run_in_executor(
-                self.executor, self._execute_task, d
+                self.executor, self._execute_accounted, d
             )
 
     async def h_run_tasks_batch(self, d, conn):
         """Batched direct transport: a burst of leased tasks executes in
         ONE executor hop, serially (the lease holds resources for one task
         shape — same contract as run_task_direct)."""
+        if self._retiring:
+            return {"results": [_retired_result() for _ in d["specs"]]}
         specs = d["specs"]
 
         def run_all():
-            return [self._execute_task(s) for s in specs]
+            # Per-spec accounting: once the threshold is crossed the
+            # REST of the batch is refused (not_executed -> the owner
+            # resubmits it on a fresh worker), so the worker never
+            # exceeds max_calls by the batch size.
+            return [self._execute_accounted(s) for s in specs]
 
         async with self._direct_lock:
             results = await self.loop.run_in_executor(self.executor, run_all)
         return {"results": results}
+
+    def _execute_accounted(self, spec) -> dict:
+        """Execute a task with max_calls bookkeeping. Runs on an
+        executor thread; the GIL covers the counter dict, and the retire
+        coroutine is handed to the event loop thread-safely."""
+        if self._retiring:
+            return _retired_result()
+        result = self._execute_task(spec)
+        limit = spec.get("max_calls") or 0
+        key = spec.get("fn_key")
+        if limit and key is not None:
+            n = self._fn_calls.get(key, 0) + 1
+            self._fn_calls[key] = n
+            if n >= limit and not self._retiring:
+                self._retiring = True
+                self.loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(self._retire())
+                )
+        return result
+
+    async def _retire(self):
+        # Tell the raylet first so it stops dispatching here and owns
+        # the kill; then exit defensively in case it never follows
+        # through.
+        try:
+            await self.raylet_conn.call(
+                "retire_worker", {"worker_id": self.worker_id}, timeout=5
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        await asyncio.sleep(1.0)
+        os._exit(0)
 
     def _execute_task(self, spec) -> dict:
         from ray_tpu.util import tracing

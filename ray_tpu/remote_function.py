@@ -62,6 +62,7 @@ class RemoteFunction:
             scheduling=_scheduling_from_options(opts),
             max_retries=opts.get("max_retries"),
             runtime_env=opts.get("runtime_env"),
+            max_calls=opts.get("max_calls"),
         )
         return refs[0] if num_returns in (1, "dynamic") else refs
 

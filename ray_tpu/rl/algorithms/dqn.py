@@ -26,6 +26,7 @@ from ray_tpu.rl.core.rl_module import (
     NoisyQNetworkModule,
     QNetworkModule,
     RLModuleSpec,
+    factorized_noise_np,
 )
 from ray_tpu.rl.env_runner import TransitionEnvRunner
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
@@ -433,10 +434,6 @@ class DQN(AlgorithmBase):
                 if cfg.noisy:
                     # One fresh factorized draw per update: sigma trains
                     # against real noise, actions decorrelate per batch.
-                    from ray_tpu.rl.core.rl_module import (
-                        factorized_noise_np,
-                    )
-
                     width = self._online_params["mu_w"].shape[0]
                     batch["eps_in"], batch["eps_out"] = factorized_noise_np(
                         self._np_rng, width, cfg.num_actions

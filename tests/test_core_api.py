@@ -336,3 +336,35 @@ def test_dynamic_generator_actor_method():
     assert isinstance(gen, rt.ObjectRefGenerator)
     out = [rt.get(r, timeout=30) for r in gen]
     assert out == ["tok-0", "tok-1", "tok-2", "tok-3"]
+
+
+@pytest.mark.slow
+def test_max_calls_retires_worker(rt_start):
+    """@rt.remote(max_calls=N): the worker process exits after N
+    executions and the pool replaces it — tasks keep completing on fresh
+    pids (reference: remote_function.py max_calls leak mitigation)."""
+    import os as _os
+
+    @rt.remote(max_calls=3)
+    def pid():
+        import os
+
+        return os.getpid()
+
+    # Serialize calls so the per-worker counter is deterministic.
+    pids = [rt.get(pid.remote(), timeout=120) for _ in range(9)]
+    # Every worker served at most 3 calls.
+    from collections import Counter
+
+    counts = Counter(pids)
+    assert all(c <= 3 for c in counts.values()), counts
+    assert len(counts) >= 3  # at least three generations of workers
+    # And an unlimited function on the same cluster is unaffected.
+    @rt.remote
+    def pid2():
+        import os
+
+        return os.getpid()
+
+    pids2 = {rt.get(pid2.remote(), timeout=120) for _ in range(4)}
+    assert len(pids2) >= 1
