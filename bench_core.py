@@ -10,9 +10,9 @@ Run: python bench_core.py [--quick]
 ## Throughput analysis (round 4)
 
 Measured on this image's single-core host (results in BENCH_CORE.json,
-median of 2 runs): ~1.8k trivial tasks/s sync, ~13.9k tasks/s pipelined,
-~2k/14k actor calls/s sync/async, ~22k small puts/s, actor
-register+ready+call ~170/s, ~8 GB/s large-object put+get (shared-memory
+median of 2 runs): ~2.2k trivial tasks/s sync, ~14.5k tasks/s pipelined,
+~2.2k/14.3k actor calls/s sync/async, ~21k small puts/s, actor
+register+ready+call ~95/s, ~5 GB/s large-object put+get (shared-memory
 zero-copy). Round-4 changes that moved these numbers (r3: 3.4k async
 tasks/s, 1.6k async actor calls/s, 3.6k puts/s, 42.5 actors/s):
   * Batched direct transport (worker.py _submit_direct_group -> worker
