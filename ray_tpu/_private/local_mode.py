@@ -15,6 +15,27 @@ from ray_tpu._private.worker import ActorHandle, ObjectRef, make_task_error, _re
 from ray_tpu.exceptions import ActorDiedError
 
 
+class _LocalRefGenerator:
+    """Local-mode stand-in for ObjectRefGenerator: the task already ran
+    eagerly, so iteration just walks the stored item refs."""
+
+    def __init__(self, refs: List[ObjectRef]):
+        self._refs = refs
+        self._i = 0
+
+    def __iter__(self) -> "_LocalRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        if self._i >= len(self._refs):
+            raise StopIteration
+        self._i += 1
+        return self._refs[self._i - 1]
+
+    def __len__(self) -> int:
+        return len(self._refs)
+
+
 class LocalClient:
     """Implements the CoreClient surface with synchronous local execution."""
 
@@ -51,24 +72,53 @@ class LocalClient:
     def wait(self, refs, num_returns, timeout, fetch_local=True):
         return refs[:num_returns], refs[num_returns:]
 
+    def _error_refs(self, err, num_returns):
+        refs = []
+        for _ in range(1 if num_returns == "dynamic" else num_returns):
+            fut = concurrent.futures.Future()
+            fut.set_exception(err)
+            refs.append(ObjectRef(ObjectID.from_random(), fut))
+        if num_returns == "dynamic":
+            return [_LocalRefGenerator(refs)]
+        return refs
+
+    def _result_refs(self, value, num_returns):
+        if num_returns == "dynamic":
+            import inspect as _inspect
+
+            # Consume incrementally: a generator body that raises midway
+            # yields its produced items plus one error-carrying ref (the
+            # real path's per-item store behaves the same way).
+            refs = []
+            try:
+                if _inspect.isgenerator(value):
+                    for v in value:
+                        refs.append(self._store(v))
+                else:
+                    refs.append(self._store(value))
+            except BaseException as e:  # noqa: BLE001
+                fut = concurrent.futures.Future()
+                fut.set_exception(
+                    _rebuild_task_error(make_task_error(e))
+                )
+                refs.append(ObjectRef(ObjectID.from_random(), fut))
+            return [_LocalRefGenerator(refs)]
+        values = [value] if num_returns == 1 else list(value)
+        return [self._store(v) for v in values]
+
     # -- tasks -----------------------------------------------------------
     def submit_task(self, fn, args, kwargs, name="", num_returns=1,
                     resources=None, scheduling=None, max_retries=None,
-                    runtime_env=None):
+                    runtime_env=None, max_calls=None):
+        # max_calls is a no-op in local mode: there is no worker process
+        # to retire (everything runs in the driver).
         try:
             value = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
-            err = _rebuild_task_error(make_task_error(e))
-            refs = []
-            for _ in range(num_returns):
-                fut = concurrent.futures.Future()
-                fut.set_exception(err)
-                oid = ObjectID.from_random()
-                r = ObjectRef(oid, fut)
-                refs.append(r)
-            return refs
-        values = [value] if num_returns == 1 else list(value)
-        return [self._store(v) for v in values]
+            return self._error_refs(
+                _rebuild_task_error(make_task_error(e)), num_returns
+            )
+        return self._result_refs(value, num_returns)
 
     # -- actors ----------------------------------------------------------
     def create_actor(self, cls, args, kwargs, name=None, namespace="",
@@ -98,15 +148,10 @@ class LocalClient:
             else:
                 value = m(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
-            err = _rebuild_task_error(make_task_error(e))
-            refs = []
-            for _ in range(num_returns):
-                fut = concurrent.futures.Future()
-                fut.set_exception(err)
-                refs.append(ObjectRef(ObjectID.from_random(), fut))
-            return refs
-        values = [value] if num_returns == 1 else list(value)
-        return [self._store(v) for v in values]
+            return self._error_refs(
+                _rebuild_task_error(make_task_error(e)), num_returns
+            )
+        return self._result_refs(value, num_returns)
 
     def kill_actor(self, actor_id, no_restart=True):
         self.actors.pop(actor_id.binary(), None)

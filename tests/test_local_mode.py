@@ -47,3 +47,28 @@ def test_local_put_get(rt_local):
     rt = rt_local
     ref = rt.put([1, 2, 3])
     assert rt.get(ref) == [1, 2, 3]
+
+
+def test_local_dynamic_generator(rt_local):
+    """num_returns='dynamic' works in local mode: iteration yields item
+    refs (regression: returned a bare ObjectRef, dropping later items)."""
+    rt = rt_local
+
+    @rt.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [rt.get(ref) for ref in gen.remote(3)]
+    assert out == [0, 10, 20]
+
+    @rt.remote(num_returns="dynamic")
+    def boom():
+        raise ValueError("nope")
+        yield  # pragma: no cover — makes it a generator
+
+    import pytest as _pytest
+
+    refs = list(boom.remote())
+    with _pytest.raises(Exception, match="nope"):
+        rt.get(refs[0])
