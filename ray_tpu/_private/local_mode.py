@@ -17,10 +17,14 @@ from ray_tpu.exceptions import ActorDiedError
 
 class _LocalRefGenerator:
     """Local-mode stand-in for ObjectRefGenerator: the task already ran
-    eagerly, so iteration just walks the stored item refs."""
+    eagerly, so iteration walks the stored item refs. A generator body
+    that raised mid-way surfaces its error FROM ITERATION after the
+    produced items — matching the real path, where the task future's
+    error re-raises out of ObjectRefGenerator.__next__."""
 
-    def __init__(self, refs: List[ObjectRef]):
+    def __init__(self, refs: List[ObjectRef], error=None):
         self._refs = refs
+        self._error = error
         self._i = 0
 
     def __iter__(self) -> "_LocalRefGenerator":
@@ -28,6 +32,8 @@ class _LocalRefGenerator:
 
     def __next__(self) -> ObjectRef:
         if self._i >= len(self._refs):
+            if self._error is not None:
+                raise self._error
             raise StopIteration
         self._i += 1
         return self._refs[self._i - 1]
@@ -79,7 +85,7 @@ class LocalClient:
             fut.set_exception(err)
             refs.append(ObjectRef(ObjectID.from_random(), fut))
         if num_returns == "dynamic":
-            return [_LocalRefGenerator(refs)]
+            return [_LocalRefGenerator([], error=refs[0]._future.exception())]
         return refs
 
     def _result_refs(self, value, num_returns):
@@ -87,9 +93,10 @@ class LocalClient:
             import inspect as _inspect
 
             # Consume incrementally: a generator body that raises midway
-            # yields its produced items plus one error-carrying ref (the
-            # real path's per-item store behaves the same way).
+            # keeps its produced items; the error re-raises from
+            # iteration after them (real-path semantics).
             refs = []
+            err = None
             try:
                 if _inspect.isgenerator(value):
                     for v in value:
@@ -97,12 +104,8 @@ class LocalClient:
                 else:
                     refs.append(self._store(value))
             except BaseException as e:  # noqa: BLE001
-                fut = concurrent.futures.Future()
-                fut.set_exception(
-                    _rebuild_task_error(make_task_error(e))
-                )
-                refs.append(ObjectRef(ObjectID.from_random(), fut))
-            return [_LocalRefGenerator(refs)]
+                err = _rebuild_task_error(make_task_error(e))
+            return [_LocalRefGenerator(refs, error=err)]
         values = [value] if num_returns == 1 else list(value)
         return [self._store(v) for v in values]
 

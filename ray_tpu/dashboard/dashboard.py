@@ -185,7 +185,7 @@ async function render() {
     spark("cpuspark", hist.cpu);
   } else if (tab === "nodes") {
     el("main").innerHTML = rows(await api("nodes"),
-      ["node_id","state","address","is_head","resources_total",
+      ["node_id","state","draining","address","is_head","resources_total",
        "resources_available","proc_stats"], "state");
   } else if (tab === "actors") {
     el("main").innerHTML = rows(await api("actors"),
@@ -388,6 +388,7 @@ class Dashboard:
                 {
                     "node_id": _hex(n["node_id"]),
                     "state": n["state"],
+                    "draining": bool(n.get("draining", False)),
                     "address": f"{n['address']}:{n['port']}",
                     "is_head": n.get("is_head", False),
                     "resources_total": n.get("resources_total", {}),

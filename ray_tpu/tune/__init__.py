@@ -51,6 +51,29 @@ def with_parameters(trainable, **large_objects):
         return trainable(config, **resolved)
 
     wrapped.__name__ = getattr(trainable, "__name__", "trainable")
+    if hasattr(trainable, "_tune_resources"):
+        # Compose with with_resources in either order.
+        wrapped._tune_resources = trainable._tune_resources
+    return wrapped
+
+
+def with_resources(trainable, resources: Dict[str, float]):
+    """Attach per-trial resource requests to a trainable (reference:
+    tune.with_resources): every trial actor of this trainable requests
+    them, overriding TuneConfig.trial_resources.
+
+        tuner = Tuner(tune.with_resources(train_fn, {"CPU": 2}), ...)
+    """
+
+    import functools
+
+    # functools.wraps sets __wrapped__, so the trial runner's signature
+    # inspection sees the original arity — no dispatch duplication here.
+    @functools.wraps(trainable)
+    def wrapped(*args, **kwargs):
+        return trainable(*args, **kwargs)
+
+    wrapped._tune_resources = dict(resources)
     return wrapped
 
 
@@ -75,6 +98,7 @@ __all__ = [
     "report",
     "get_checkpoint",
     "with_parameters",
+    "with_resources",
     "uniform",
     "loguniform",
     "choice",

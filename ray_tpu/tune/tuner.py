@@ -220,7 +220,13 @@ class Tuner:
         os.makedirs(exp_dir, exist_ok=True)
 
         max_concurrent = tc.max_concurrent_trials or 4
-        resources = tc.trial_resources or {"CPU": 1.0}
+        # Per-trainable resources (tune.with_resources) win over the
+        # TuneConfig default (matching the reference's precedence).
+        resources = (
+            getattr(self.trainable, "_tune_resources", None)
+            or tc.trial_resources
+            or {"CPU": 1.0}
+        )
 
         trials: List[Trial] = []
         live: List[Trial] = []

@@ -69,6 +69,17 @@ def test_local_dynamic_generator(rt_local):
 
     import pytest as _pytest
 
-    refs = list(boom.remote())
+    # Real-path semantics: the error raises FROM ITERATION after any
+    # produced items (here: none).
     with _pytest.raises(Exception, match="nope"):
-        rt.get(refs[0])
+        list(boom.remote())
+
+    @rt.remote(num_returns="dynamic")
+    def partial():
+        yield 1
+        raise ValueError("late")
+
+    gen = partial.remote()
+    assert rt.get(next(gen)) == 1
+    with _pytest.raises(Exception, match="late"):
+        next(gen)

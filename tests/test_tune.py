@@ -547,3 +547,24 @@ def test_pb2_gp_guided_explore():
     # The acquisition should concentrate proposals toward the
     # high-improvement region rather than uniformly.
     assert sum(1 for p in proposals if p > 0.4) >= 5, proposals
+
+
+def test_with_resources_overrides_trial_resources(rt_start):
+    """tune.with_resources pins per-trial resources on the trainable,
+    winning over TuneConfig.trial_resources (reference precedence)."""
+    from ray_tpu import tune
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    def train_fn(config):
+        tune.report({"score": config["x"] * 2})
+
+    wrapped = tune.with_resources(train_fn, {"CPU": 2})
+    assert wrapped._tune_resources == {"CPU": 2}
+    tuner = Tuner(
+        wrapped,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(trial_resources={"CPU": 0.5}),
+    )
+    grid = tuner.fit()
+    scores = sorted(r.metrics["score"] for r in grid)
+    assert scores == [2, 4]
