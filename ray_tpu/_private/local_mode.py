@@ -79,13 +79,13 @@ class LocalClient:
         return refs[:num_returns], refs[num_returns:]
 
     def _error_refs(self, err, num_returns):
+        if num_returns == "dynamic":
+            return [_LocalRefGenerator([], error=err)]
         refs = []
-        for _ in range(1 if num_returns == "dynamic" else num_returns):
+        for _ in range(num_returns):
             fut = concurrent.futures.Future()
             fut.set_exception(err)
             refs.append(ObjectRef(ObjectID.from_random(), fut))
-        if num_returns == "dynamic":
-            return [_LocalRefGenerator([], error=refs[0]._future.exception())]
         return refs
 
     def _result_refs(self, value, num_returns):

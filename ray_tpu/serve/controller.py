@@ -120,7 +120,81 @@ class ServeController:
             self._proxies[nid] = dict(e)
 
     # -- API -------------------------------------------------------------
+    @staticmethod
+    def _only_user_config_changed(old_app, deployment, init_args,
+                                  init_kwargs) -> bool:
+        """True when a redeploy differs from the running app ONLY in
+        user_config — the lightweight-update case the reference handles
+        by reconfigure()ing live replicas instead of restarting them
+        (deployment_state.py: user_config-only version changes)."""
+        od: Deployment = old_app["deployment"]
+
+        def ident(obj):
+            return (getattr(obj, "__module__", None),
+                    getattr(obj, "__qualname__", None))
+
+        return (
+            ident(od.func_or_class) == ident(deployment.func_or_class)
+            and od.num_replicas == deployment.num_replicas
+            and od.ray_actor_options == deployment.ray_actor_options
+            and od.autoscaling_config == deployment.autoscaling_config
+            and od.max_ongoing_requests == deployment.max_ongoing_requests
+            and old_app["init_args"] == init_args
+            and old_app["init_kwargs"] == init_kwargs
+            and od.user_config != deployment.user_config
+        )
+
+    def _reconfigure_in_place(self, name: str, deployment: Deployment) -> bool:
+        """Push the new user_config to every live replica. Re-snapshots
+        until stable: a replica the reconcile/autoscale thread spawned
+        mid-pass (constructed with the old config) gets picked up on the
+        next sweep. Any failure aborts -> the caller falls back to a
+        full replace (the reference marks the deployment unhealthy on
+        reconfigure errors; replacing is our recovery)."""
+        done: set = set()
+        for _ in range(3):
+            with self._lock:
+                app = self.apps.get(name)
+                if app is None:
+                    return False
+                todo = [r for r in app["replicas"]
+                        if r._actor_id.binary() not in done]
+            if not todo:
+                return True
+            refs = [r.reconfigure.remote(deployment.user_config)
+                    for r in todo]
+            ready, not_ready = rt.wait(
+                refs, num_returns=len(refs),
+                timeout=get_config().serve_ready_timeout_s,
+            )
+            if not_ready:
+                return False
+            for r, ref in zip(todo, refs):
+                try:
+                    rt.get(ref, timeout=1)
+                except Exception:  # noqa: BLE001 — user code rejected it
+                    return False
+                done.add(r._actor_id.binary())
+        return False  # still churning after 3 sweeps: replace instead
+
     def deploy(self, name: str, deployment: Deployment, init_args, init_kwargs):
+        with self._lock:
+            old = self.apps.get(name)
+            lightweight = bool(
+                old and old["replicas"] and self._only_user_config_changed(
+                    old, deployment, init_args, init_kwargs
+                )
+            )
+            if lightweight:
+                old["deployment"] = deployment
+        if lightweight:
+            # In-place reconfigure: replicas keep serving (and their
+            # caches/connections) through the config change.
+            if self._reconfigure_in_place(name, deployment):
+                self._checkpoint()
+                return True
+            # Reconfigure failed somewhere: fall through to the full
+            # replace below so state and replicas cannot diverge.
         with self._lock:
             old = self.apps.get(name)
             if old:

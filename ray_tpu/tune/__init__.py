@@ -65,7 +65,15 @@ def with_resources(trainable, resources: Dict[str, float]):
         tuner = Tuner(tune.with_resources(train_fn, {"CPU": 2}), ...)
     """
 
+    import copy
     import functools
+
+    if hasattr(trainable, "as_trainable"):
+        # Trainer objects keep their as_trainable dispatch: pin the
+        # resources on a copy instead of wrapping.
+        t = copy.copy(trainable)
+        t._tune_resources = dict(resources)
+        return t
 
     # functools.wraps sets __wrapped__, so the trial runner's signature
     # inspection sees the original arity — no dispatch duplication here.

@@ -506,3 +506,49 @@ def test_autoscaling_reacts_to_replica_queue_depth(rt_serve):
             return
         time.sleep(0.5)
     pytest.fail("idle deployment did not scale back down")
+
+
+def test_user_config_redeploy_reconfigures_in_place(rt_serve):
+    """A redeploy that changes ONLY user_config reconfigure()s the live
+    replicas instead of restarting them: same pids keep serving, with
+    the new config applied (reference: lightweight config updates,
+    deployment_state.py user_config-only versions)."""
+    import os
+
+    @serve.deployment(num_replicas=2, user_config={"factor": 2})
+    class Scaler:
+        def __init__(self):
+            self.factor = 1
+            self.pid = os.getpid()
+
+        def reconfigure(self, cfg):
+            self.factor = cfg["factor"]
+
+        def __call__(self, x):
+            return {"y": x * self.factor, "pid": self.pid}
+
+    handle = serve.run(Scaler.bind(), name="scaler")
+    outs = [handle.remote(5).result(timeout=60) for _ in range(6)]
+    assert all(o["y"] == 10 for o in outs)
+    pids_before = {o["pid"] for o in outs}
+
+    # Redeploy with ONLY user_config changed.
+    serve.run(Scaler.options(user_config={"factor": 7}).bind(),
+              name="scaler")
+    deadline = __import__("time").monotonic() + 30
+    outs2 = []
+    while __import__("time").monotonic() < deadline:
+        outs2 = [handle.remote(5).result(timeout=60) for _ in range(6)]
+        if all(o["y"] == 35 for o in outs2):
+            break
+    assert all(o["y"] == 35 for o in outs2), outs2
+    # Same replica processes — no restart happened.
+    assert {o["pid"] for o in outs2} <= pids_before
+
+    # A redeploy changing num_replicas DOES replace/reconcile normally.
+    serve.run(
+        Scaler.options(num_replicas=1, user_config={"factor": 7}).bind(),
+        name="scaler",
+    )
+    out3 = handle.remote(3).result(timeout=60)
+    assert out3["y"] == 21
