@@ -31,12 +31,30 @@ _proxy = None
 
 def run(app: Application, name: Optional[str] = None,
         _blocking: bool = True) -> DeploymentHandle:
-    """Deploy an application (reference: serve.run, serve/api.py:429)."""
+    """Deploy an application (reference: serve.run, serve/api.py:429).
+
+    Bound applications nested in init args/kwargs deploy first and
+    arrive as DeploymentHandles — the reference's composition idiom:
+
+        handle = serve.run(Pipeline.bind(Preprocess.bind()))
+    """
     controller = get_or_create_controller()
     app_name = name or app.deployment.name
+
+    def resolve(obj):
+        if isinstance(obj, Application):
+            return run(obj)  # recursive deploy under its own name
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(resolve(v) for v in obj)
+        if isinstance(obj, dict):
+            return {k: resolve(v) for k, v in obj.items()}
+        return obj
+
+    init_args = tuple(resolve(a) for a in app.init_args)
+    init_kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
     rt.get(
         controller.deploy.remote(
-            app_name, app.deployment, app.init_args, app.init_kwargs
+            app_name, app.deployment, init_args, init_kwargs
         ),
         timeout=get_config().serve_deploy_timeout_s,
     )

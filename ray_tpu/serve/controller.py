@@ -133,15 +133,24 @@ class ServeController:
             return (getattr(obj, "__module__", None),
                     getattr(obj, "__qualname__", None))
 
+        def safe_eq(a, b):
+            # Array-like args make == elementwise; any ambiguity (or
+            # raising comparison) counts as "changed" -> full replace,
+            # never a crash.
+            try:
+                return bool(a == b)
+            except Exception:  # noqa: BLE001
+                return False
+
         return (
             ident(od.func_or_class) == ident(deployment.func_or_class)
             and od.num_replicas == deployment.num_replicas
             and od.ray_actor_options == deployment.ray_actor_options
             and od.autoscaling_config == deployment.autoscaling_config
             and od.max_ongoing_requests == deployment.max_ongoing_requests
-            and old_app["init_args"] == init_args
-            and old_app["init_kwargs"] == init_kwargs
-            and od.user_config != deployment.user_config
+            and safe_eq(old_app["init_args"], init_args)
+            and safe_eq(old_app["init_kwargs"], init_kwargs)
+            and not safe_eq(od.user_config, deployment.user_config)
         )
 
     def _reconfigure_in_place(self, name: str, deployment: Deployment) -> bool:

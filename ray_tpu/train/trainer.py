@@ -64,6 +64,10 @@ class BaseTrainer:
                 raise result.error
             session.report(result.metrics, checkpoint=result.checkpoint)
 
+        if hasattr(trainer, "_tune_resources"):
+            # tune.with_resources pinned per-trial resources on the
+            # trainer; carry them onto the closure the Tuner consumes.
+            trainable._tune_resources = trainer._tune_resources
         return trainable
 
 

@@ -160,3 +160,83 @@ def test_serve_timeout_knobs_registered_and_env_overridable(monkeypatch):
         assert getattr(config_mod.Config(), name) == default
     monkeypatch.setenv("RT_SERVE_RPC_TIMEOUT_S", "7.5")
     assert config_mod.Config().serve_rpc_timeout_s == 7.5
+
+
+def test_bind_composition_injects_handles(serve_session):
+    """The reference composition idiom: nested .bind() applications
+    deploy automatically and arrive as DeploymentHandles
+    (serve.run(Pipeline.bind(Preprocess.bind())))."""
+
+    @serve.deployment
+    class Embed:
+        def __call__(self, x):
+            return x * 10
+
+    @serve.deployment
+    class Rank:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, embed, rank):
+            self.embed = embed  # DeploymentHandles, injected
+            self.rank = rank
+
+        def __call__(self, x):
+            e = self.embed.remote(x).result(timeout=30)
+            return self.rank.remote(e).result(timeout=30)
+
+    handle = serve.run(
+        Pipeline.bind(Embed.bind(), Rank.bind()), name="pipe2"
+    )
+    assert rt.get(handle.remote(4), timeout=60) == 41
+    # The nested apps are live, individually addressable deployments.
+    st = serve.status()
+    assert "Embed" in st and "Rank" in st
+
+
+def test_bind_composition_nested_containers(serve_session):
+    """Bound apps inside lists/dicts resolve to handles too (the
+    reference's DAG scanner traverses containers)."""
+
+    @serve.deployment
+    class M1:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class M2:
+        def __call__(self, x):
+            return x + 2
+
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, models):
+            self.models = models
+
+        def __call__(self, x):
+            return sum(
+                m.remote(x).result(timeout=30) for m in self.models
+            )
+
+    handle = serve.run(Ensemble.bind([M1.bind(), M2.bind()]), name="ens")
+    assert rt.get(handle.remote(10), timeout=60) == 23  # 11 + 12
+
+
+def test_redeploy_with_array_init_args(serve_session):
+    """Redeploying an app bound with numpy args must not crash the
+    user_config-comparison path (regression: ambiguous array ==)."""
+    import numpy as np
+
+    @serve.deployment
+    class Weighted:
+        def __init__(self, w):
+            self.w = w
+
+        def __call__(self, x):
+            return float((self.w * x).sum())
+
+    serve.run(Weighted.bind(np.ones(4)), name="warr")
+    h = serve.run(Weighted.bind(np.ones(4) * 2), name="warr")
+    assert rt.get(h.remote(3), timeout=60) == 24.0

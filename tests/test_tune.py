@@ -568,3 +568,13 @@ def test_with_resources_overrides_trial_resources(rt_start):
     grid = tuner.fit()
     scores = sorted(r.metrics["score"] for r in grid)
     assert scores == [2, 4]
+
+
+def test_with_resources_propagates_through_as_trainable():
+    """Trainer objects keep their pinned resources through as_trainable
+    (regression: the closure dropped _tune_resources)."""
+    from ray_tpu import tune
+    from ray_tpu.train.trainer import BaseTrainer
+
+    t = tune.with_resources(BaseTrainer(), {"CPU": 3})
+    assert t.as_trainable()._tune_resources == {"CPU": 3}
