@@ -1161,6 +1161,10 @@ class GcsServer:
             entry.get("partial", {}).pop(d["node_id"], None)
             if not d.get("partial_only"):
                 entry["nodes"].discard(d["node_id"])
+            if d.get("clear_spilled"):
+                # Loss injection / spill-file reclaim: the spilled copy
+                # is gone too, so restores must not be offered.
+                entry.pop("spilled", None)
         return {"ok": True}
 
     async def h_objects_freed(self, d, conn):

@@ -35,19 +35,22 @@ def _raylet():
 
 
 def _force_delete(raylet, oid):
-    """Forcibly remove an object's local copy for loss-injection tests.
-    Under full-suite load the async primary-copy registration can re-pin
-    between release and delete, so retry; an entry that vanished on its
-    own (LRU eviction won the race) already satisfies the goal."""
+    """Forcibly remove EVERY local copy of an object for loss-injection
+    tests. Under full-suite load the async primary-copy registration can
+    re-pin between release and delete (retry), and the spill loop can
+    win the race and spill the copy instead — a spilled copy is
+    restorable, so its record must go too or the "loss" silently fails
+    to inject."""
     deadline = time.monotonic() + 10
     while raylet.store.contains(ObjectID(oid)):
         if oid in raylet._primary_pins:
             raylet.store.release(ObjectID(oid))
             raylet._primary_pins.pop(oid)
         if raylet.store.delete(ObjectID(oid)):
-            return
+            break
         assert time.monotonic() < deadline, "store delete never succeeded"
         time.sleep(0.1)
+    raylet._spilled.pop(oid, None)
 
 def test_put_beyond_capacity_spills(rt_small_store):
     """Total puts exceed the store; older primaries spill and restore."""
@@ -114,7 +117,8 @@ def test_lineage_reconstruction(rt_start):
     client._run(
         client.gcs.call(
             "object_location_remove",
-            {"object_id": oid, "node_id": raylet.node_id.binary()},
+            {"object_id": oid, "node_id": raylet.node_id.binary(),
+             "clear_spilled": True},
         )
     )
 
@@ -136,7 +140,8 @@ def test_put_objects_not_reconstructable(rt_start):
     client._run(
         client.gcs.call(
             "object_location_remove",
-            {"object_id": oid, "node_id": raylet.node_id.binary()},
+            {"object_id": oid, "node_id": raylet.node_id.binary(),
+             "clear_spilled": True},
         )
     )
     with pytest.raises(rt.exceptions.ObjectLostError):
