@@ -26,6 +26,7 @@ from ray_tpu.train.session import (
     get_local_rank,
     get_session,
     get_trial_dir,
+    get_context,
     get_world_rank,
     get_world_size,
     report,
@@ -57,6 +58,7 @@ __all__ = [
     "report",
     "get_checkpoint",
     "get_dataset_shard",
+    "get_context",
     "get_world_rank",
     "get_world_size",
     "get_local_rank",

@@ -105,3 +105,32 @@ def get_local_rank() -> int:
 
 def get_trial_dir() -> str:
     return get_session().trial_dir
+
+
+class TrainContext:
+    """Per-worker training context (reference: ray.train.get_context() ->
+    TrainContext, train/context.py) — the method-style facade over the
+    session's rank/size/dir accessors."""
+
+    def get_world_rank(self) -> int:
+        return get_world_rank()
+
+    def get_world_size(self) -> int:
+        return get_world_size()
+
+    def get_local_rank(self) -> int:
+        return get_local_rank()
+
+    def get_trial_dir(self) -> str:
+        return get_trial_dir()
+
+    def get_node_rank(self) -> int:
+        # One worker per TPU host (the SPMD layout; worker groups never
+        # set local_rank today): node rank == world rank.
+        return get_session().world_rank
+
+
+def get_context() -> TrainContext:
+    """The reference's accessor: usable only inside a training worker."""
+    get_session()  # raises outside a worker, matching the reference
+    return TrainContext()

@@ -406,3 +406,25 @@ def test_sklearn_trainer_dataset_input(rt_start):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["train_score"] > 0.85
+
+
+def test_get_context_facade(rt_start):
+    """train.get_context() (reference: TrainContext) inside workers."""
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        ctx = train.get_context()
+        train.report({
+            "rank": ctx.get_world_rank(),
+            "size": ctx.get_world_size(),
+            "local": ctx.get_local_rank(),
+        })
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2)
+    ).fit()
+    assert result.error is None
+    assert result.metrics["size"] == 2
+    with pytest.raises(RuntimeError):
+        train.get_context()  # outside a worker: raises like the reference
