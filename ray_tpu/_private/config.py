@@ -262,6 +262,14 @@ class Config:
             setattr(self, f.name, _env(f.name, cur, type(cur)))
 
 
+def session_log_dir() -> str:
+    """The session's per-process log directory — single definition shared
+    by `rt start` (writer) and the raylet's log-serving RPCs (reader)."""
+    return os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "logs"
+    )
+
+
 _config: Config | None = None
 
 

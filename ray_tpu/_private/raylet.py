@@ -292,6 +292,8 @@ class Raylet:
         r("lease_worker", self.h_lease_worker)
         r("release_lease", self.h_release_lease)
         r("retire_worker", self.h_retire_worker)
+        r("list_logs", self.h_list_logs)
+        r("read_log", self.h_read_log)
         # A crashed owner must not leak its leased workers' resources.
         self.rpc.on_disconnect = self._on_client_disconnect
 
@@ -1418,6 +1420,50 @@ class Raylet:
         if w is not None:
             self._release_lease_of(w)
         return {"ok": True}
+
+    @staticmethod
+    def _log_dir() -> str:
+        from ray_tpu._private.config import session_log_dir
+
+        return session_log_dir()
+
+    async def h_list_logs(self, d, conn):
+        """This node's session log files (reference: the `ray logs` list
+        served by per-node log agents, dashboard/modules/log)."""
+        out = []
+        base = self._log_dir()
+        try:
+            names = sorted(os.listdir(base))
+        except FileNotFoundError:
+            names = []
+        for name in names:
+            path = os.path.join(base, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # rotated/deleted mid-listing: skip just it
+            if os.path.isfile(path):
+                out.append({"name": name, "size": st.st_size,
+                            "mtime": st.st_mtime})
+        return {"logs": out}
+
+    async def h_read_log(self, d, conn):
+        """Tail of one named log file; the name is basename-sanitized so
+        callers cannot escape the log directory."""
+        name = os.path.basename(d.get("name", ""))
+        if not name:
+            return {"ok": False, "error": "missing log name"}
+        path = os.path.join(self._log_dir(), name)  # basename: no escape
+        n = int(d.get("tail_bytes", 64 * 1024))
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - n))
+                data = f.read(n)
+        except OSError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True, "data": data, "size": size}
 
     async def h_retire_worker(self, d, conn):
         """A worker crossed its max_calls threshold: stop dispatching to

@@ -49,7 +49,9 @@ def _write_session(data: dict):
 
 
 def _log_dir() -> str:
-    d = os.path.join(os.path.dirname(SESSION_FILE), "logs")
+    from ray_tpu._private.config import session_log_dir
+
+    d = session_log_dir()
     os.makedirs(d, exist_ok=True)
     return d
 
@@ -293,6 +295,22 @@ def cmd_drain(args):
         raise SystemExit(1)
 
 
+def cmd_logs(args):
+    """List session log files, or tail one (reference: `ray logs`)."""
+    from ray_tpu.util.state import get_log, list_logs
+
+    addr = _resolve_address(args)
+    if not args.filename:
+        for e in list_logs(node_id=args.node, address=addr):
+            if "error" in e:
+                print(f"{e['node_id'][:12]}  <error: {e['error']}>")
+            else:
+                print(f"{e['node_id'][:12]}  {e['size']:>10}  {e['name']}")
+        return
+    print(get_log(args.filename, node_id=args.node,
+                  tail_bytes=args.tail, address=addr), end="")
+
+
 def cmd_stack(args):
     """Live thread stacks of every worker (reference: dashboard py-spy
     on-demand dumps)."""
@@ -458,6 +476,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", "-o")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("logs", help="list or tail session log files")
+    sp.add_argument("filename", nargs="?", help="log file to tail")
+    sp.add_argument("--node", help="node id (hex) to query")
+    sp.add_argument("--tail", type=int, default=64 * 1024,
+                    help="bytes from the end of the file")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("stack", help="dump live worker thread stacks")
     sp.add_argument("--address")

@@ -13,6 +13,8 @@ from ray_tpu.util.state.api import (  # noqa: F401
     list_actors,
     list_jobs,
     drain_node,
+    get_log,
+    list_logs,
     list_nodes,
     list_objects,
     list_placement_groups,

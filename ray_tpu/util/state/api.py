@@ -67,6 +67,7 @@ class StateApiClient:
                 {
                     "node_id": _hex(n["node_id"]),
                     "state": n["state"],
+                    "draining": bool(n.get("draining", False)),
                     "address": f"{n['address']}:{n['port']}",
                     "is_head": n.get("is_head", False),
                     "resources_total": n.get("resources_total", {}),
@@ -359,3 +360,76 @@ def drain_node(c, node_id: str, timeout: float = 300.0, undo: bool = False,
         _time.sleep(gap)
     return {"ok": False, "error": "drain timed out (node still busy; "
             "cordon stays in effect)", "status": st}
+
+
+def _dial_raylet(c, node_hex, method, payload, timeout=30,
+                 stop_on_ok=False):
+    """Call one raylet (or every ALIVE one when node_hex is None; a hex
+    PREFIX selects, so ids copied from truncated CLI output work).
+    Returns [(node_hex, reply-or-error-dict)]; with stop_on_ok the dials
+    stop at the first ok reply (no redundant transfers, and unreachable
+    later nodes cost nothing). Raises if a requested node matches no
+    ALIVE node."""
+    import asyncio
+
+    from ray_tpu._private.protocol import connect as _connect
+
+    out = []
+    matched = False
+    for n in c.call("get_nodes")["nodes"]:
+        nid = _hex(n["node_id"])
+        if n["state"] != "ALIVE":
+            continue
+        if node_hex is not None and not nid.startswith(node_hex):
+            continue
+        matched = True
+
+        async def _one(addr=n["address"], port=n["port"]):
+            conn = await _connect(addr, port, timeout=5)
+            try:
+                return await asyncio.wait_for(
+                    conn.call(method, payload), timeout
+                )
+            finally:
+                await conn.close()
+
+        try:
+            reply = c._run_new(_one(), timeout=timeout + 10)
+        except Exception as e:  # noqa: BLE001 — node unreachable
+            reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        out.append((nid, reply))
+        if stop_on_ok and reply.get("ok"):
+            break
+    if node_hex is not None and not matched:
+        raise ValueError(f"no ALIVE node matches id prefix {node_hex!r}")
+    return out
+
+
+@_with_client
+def list_logs(c, node_id: str = None):
+    """Per-node session log files (reference: `ray logs` listing)."""
+    out = []
+    for nid, r in _dial_raylet(c, node_id, "list_logs", {}):
+        for entry in r.get("logs", []):
+            out.append({"node_id": nid, **entry})
+        if "error" in r:
+            out.append({"node_id": nid, "error": r["error"]})
+    return out
+
+
+@_with_client
+def get_log(c, filename: str, node_id: str = None,
+            tail_bytes: int = 64 * 1024) -> str:
+    """Tail of one log file (reference: `ray logs <file>`); node_id
+    defaults to the first ALIVE node holding it."""
+    errors = []
+    for nid, r in _dial_raylet(
+        c, node_id, "read_log", {"name": filename, "tail_bytes": tail_bytes},
+        stop_on_ok=True,
+    ):
+        if r.get("ok"):
+            return r["data"].decode(errors="replace")
+        errors.append(f"{nid}: {r.get('error')}")
+    raise FileNotFoundError(
+        f"log {filename!r} not found on any node ({'; '.join(errors)})"
+    )

@@ -252,3 +252,33 @@ def test_worker_stacks(rt_start):
     assert "busy" in blob
     assert all("pid" in w for w in workers)
     rt.get(ref, timeout=120)
+
+
+def test_list_and_get_logs(rt_start):
+    """Per-node log listing + tail through the state API (reference:
+    `ray logs` via the per-node log agents)."""
+    import os
+    import tempfile
+
+    from ray_tpu.util.state import get_log, list_logs
+
+    logdir = os.path.join(tempfile.gettempdir(), "ray_tpu", "logs")
+    os.makedirs(logdir, exist_ok=True)
+    marker = os.path.join(logdir, "rt-logs-test.log")
+    with open(marker, "w") as f:
+        f.write("alpha\n" * 100 + "OMEGA-LINE\n")
+    try:
+        entries = list_logs()
+        names = {e.get("name") for e in entries}
+        assert "rt-logs-test.log" in names
+        tail = get_log("rt-logs-test.log", tail_bytes=32)
+        assert tail.endswith("OMEGA-LINE\n")
+        assert len(tail) <= 32
+        import pytest as _p
+
+        with _p.raises(FileNotFoundError):
+            get_log("no-such-file.log")
+        with _p.raises(FileNotFoundError):
+            get_log("../../../etc/passwd")  # path traversal sanitized
+    finally:
+        os.remove(marker)
