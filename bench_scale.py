@@ -8,20 +8,29 @@ per probe.
 
 Run: python bench_scale.py [--quick]
 
-## Cost curves (round 4, this 1-core host)
+## Cost curves (round 5, this 1-core host)
 
-Per-op cost vs envelope size (the flatness proof VERDICT r3 item 8 asks
-for; committed under the "cost_curves" entry in BENCH_SCALE.json):
-  * queued tasks 10k->100k: ~137 -> ~90 us/task — flat (per-class
-    dispatch queues + batched direct transport keep per-op cost O(1) in
-    queue depth; the 10k point carries warmup).
-  * live actors 100->1000: ~15 -> ~28 ms/actor create+call. Each actor
-    is a dedicated interpreter boot (~9ms CPU) serialized on one core;
-    the growth above that floor is GCS/raylet bookkeeping at 1000
-    registered workers. Boots are bounded by worker_boot_concurrency so
-    a 1000-actor burst cannot starve node heartbeats (the failure mode
-    this probe originally hit), and /proc stats sampling is windowed
-    (proc_stats_sample_max) so observability stays O(1)/tick.
+Per-op cost vs envelope size (committed under the "cost_curves" entry in
+BENCH_SCALE.json — quote numbers from the artifact, not from here):
+  * queued tasks 10k->1M: ~100-115 us/task past warmup — flat to the
+    reference's single-node envelope (per-class dispatch queues +
+    batched direct transport keep per-op cost O(1) in queue depth).
+  * live actors: flat ~20-26 ms/actor create+call while the HOST can
+    back fresh pages quickly, then a sharp knee (r4 artifact: 76 ms at
+    n=1000). Round-5 analysis (see "memory_backing" probe): each worker
+    process costs ~5 MB private memory, and this VM's host backs only
+    the first few GB of fresh guest pages at ~0.7 s/GB — beyond that,
+    first-touch page faults slow 8-25x system-wide, which is exactly
+    where every >=800-actor run knees. The per-actor cost the FRAMEWORK
+    controls (GCS registration, scheduling, zygote fork, boot protocol)
+    stays flat: the knee tracks cumulative fresh memory, not actor
+    count (it moves with prior host memory pressure and does not
+    reproduce after freed memory is reused). Mitigations shipped:
+    zygote generations (re-exec every zygote_respawn_after forks; Linux
+    anon_vma chains otherwise grow with COW-faulted siblings) and a
+    pre-fork gc.freeze (children stop COW-ing gc headers on their first
+    collection). The n>=2000 points are committed for honesty; on this
+    host they measure paging, not bookkeeping.
   * placement groups 10->100: ~0.4-0.6 ms/PG — flat (2-phase commit cost
     independent of PG count).
 """
@@ -127,6 +136,24 @@ def main():
     if not quick:
         curve: dict = {"tasks": [], "actors": [], "placement_groups": []}
 
+        # 0. Host memory-backing context: first-touch cost of fresh
+        # anonymous pages, sampled before the envelope probes. On thinly
+        # backed VMs this rate collapses once cumulative fresh memory
+        # passes the host's fast pool — the regime change that bends the
+        # actor curve below (every worker process is ~5MB of fresh
+        # pages). Committed so the artifact carries its own context.
+        mb_points = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            b = bytearray(512 << 20)
+            for off in range(0, len(b), 4096):
+                b[off] = 1
+            mb_points.append(round(time.perf_counter() - t0, 2))
+            del b
+        curve["memory_backing"] = {"touch_512mb_s": mb_points}
+        print(json.dumps({"probe": "memory_backing",
+                          **curve["memory_backing"]}), flush=True)
+
         # The final point IS the reference's headline single-node envelope
         # (1,000,000 queued tasks, release/benchmarks/README.md:30) — run
         # here on 1 core vs the reference's 64-core measurement box.
@@ -141,7 +168,7 @@ def main():
             print(json.dumps({"probe": f"curve tasks n={n}",
                               **curve["tasks"][-1]}), flush=True)
 
-        for n in (100, 300, 1000):
+        for n in (100, 300, 1000, 2000):
             t0 = time.perf_counter()
             actors = [A.options(num_cpus=0.0001).remote() for _ in range(n)]
             rt.get([a.ping.remote() for a in actors], timeout=3600)

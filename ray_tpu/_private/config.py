@@ -176,6 +176,13 @@ class Config:
     # Worker fork server (zygote.py). Off -> every spawn is a fresh
     # interpreter (RT_DISABLE_ZYGOTE also works per-spawn).
     zygote_enabled: bool = True
+    # Re-exec the zygote after this many forks. Linux rmap (anon_vma)
+    # chains grow with the number of COW-faulted siblings forked from
+    # one parent, making every later child's page faults tens of ms
+    # slower (measured: ~5ms -> ~27ms sys/boot by ~900 live workers).
+    # A fresh zygote resets the chains; the next generation pre-warms in
+    # the background so rotation never stalls a spawn.
+    zygote_respawn_after: int = 150
     # Registered default-env workers kept warm once the node has seen
     # demand; actor creations and leases adopt them instead of forking
     # on the critical path (worker_pool.h:347 prestart role).

@@ -1373,6 +1373,13 @@ class Raylet:
         lease holds the requested resources until release_lease, worker
         death, or owner disconnect; the owner streams run_task_direct
         calls straight to the worker, skipping this raylet per task."""
+        if self._draining:
+            # A cordoned node must not grant NEW leases: the lease path
+            # bypasses h_submit's drain spill, so a colocated driver
+            # would keep streaming work here and rt drain could only
+            # time out. "none" pushes owners onto the submit path,
+            # which spills remote.
+            return {"status": "none"}
         resources = d.get("resources") or {}
         renv_hash = d.get("runtime_env_hash")
         worker = self._idle_worker(renv_hash)
@@ -1401,6 +1408,20 @@ class Raylet:
             "host": self.host,
             "port": worker.port,
         }
+
+    def _revoke_direct_leases(self):
+        """Drain must also cover leases granted BEFORE the cordon: tell
+        each lease's owner to stop streaming direct tasks here and hand
+        the worker back (in-flight calls finish first, owner-side).
+        Without this a colocated driver keeps the node busy via the
+        lease path — which bypasses h_submit's drain spill — and
+        rt drain can only time out."""
+        for w in self.workers.values():
+            conn = getattr(w, "leased_by", None)
+            if w.lease_resources is not None and conn is not None \
+                    and not conn.closed:
+                spawn(conn.push("lease_revoked",
+                                {"worker_id": w.worker_id}))
 
     def _release_lease_of(self, w: WorkerHandle):
         if w.lease_resources is None:
@@ -1477,15 +1498,20 @@ class Raylet:
         w.retired = True
         w.idle = False
 
-        async def _kill_soon():
-            await asyncio.sleep(0.3)  # let the final replies flush
+        async def _kill_late():
+            # Late fallback only: the worker flushes its in-flight
+            # replies and self-exits (worker_main._retire). SIGTERM
+            # here must not race the threshold-crossing task's reply
+            # onto the worker->owner connection, so the grace period
+            # is generous.
+            await asyncio.sleep(3.0)
             try:
                 if w.proc is not None and w.proc.poll() is None:
                     w.proc.terminate()
             except Exception:  # noqa: BLE001
                 pass
 
-        spawn(_kill_soon())
+        spawn(_kill_late())
         return {"ok": True}
 
     async def _on_client_disconnect(self, conn):
@@ -2618,7 +2644,10 @@ class Raylet:
         # Graceful drain (cordon): once the GCS flags this node draining,
         # the hybrid policy stops keeping new work local (see h_submit's
         # draining check) and placement everywhere else skips us.
+        was_draining = self._draining
         self._draining = bool(r.get("draining"))
+        if self._draining and not was_draining:
+            self._revoke_direct_leases()
 
     async def _heartbeat_loop(self):
         cfg = get_config()

@@ -389,7 +389,17 @@ class CoreClient:
         self.gcs = await connect(*self.gcs_addr, push_handler=self._on_push)
         # Workers already hold a raylet connection (push channel); reuse it
         # rather than paying a second TCP connect on the boot path.
-        self.raylet = raylet_conn or await connect(*self.raylet_addr)
+        if raylet_conn is not None:
+            # Worker process: the conn belongs to worker_main, whose
+            # push handler (run_task/create_actor) must stay installed;
+            # it forwards lease_revoked here.
+            self.raylet = raylet_conn
+        else:
+            # Driver: raylet-initiated notifications (drain-time lease
+            # revocation) arrive as pushes on this connection.
+            self.raylet = await connect(
+                *self.raylet_addr, push_handler=self._on_raylet_push
+            )
 
     async def _gcs_call(self, method, payload=None, timeout=None):
         """GCS call that survives a GCS restart: on a dead connection,
@@ -1446,6 +1456,29 @@ class CoreClient:
                         await self._release_lease(w)
         except asyncio.CancelledError:
             pass
+
+    def _on_raylet_push(self, channel: str, payload):
+        if channel == "lease_revoked":
+            wid = (payload or {}).get("worker_id")
+            for pool in self._leases.values():
+                for w in list(pool["workers"]):
+                    if w["worker_id"] == wid:
+                        # Out of the pool first so no new task can pick
+                        # it; in-flight calls on it finish normally.
+                        pool["workers"].remove(w)
+                        spawn(self._return_revoked_lease(w))
+
+    async def _return_revoked_lease(self, w):
+        """A draining raylet revoked this lease: the worker is already
+        out of the pool (no new tasks route to it); wait out its
+        outstanding direct calls, then hand it back so the node can
+        empty. Resubmissions go through the raylet submit path, which
+        spills off the draining node."""
+        deadline = time.monotonic() + 60.0
+        while (w["outstanding"] > 0 and not w["conn"]._closed
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        await self._release_lease(w)
 
     async def _release_lease(self, w):
         try:

@@ -297,6 +297,10 @@ class WorkerProcess:
             spawn(self._run_task(payload))
         elif channel == "create_actor":
             spawn(self._create_actor(payload))
+        elif channel == "lease_revoked" and self.client is not None:
+            # Workers own leases too (nested tasks): forward drain-time
+            # revocations to the embedded client.
+            self.client._on_raylet_push(channel, payload)
 
     async def _run_task(self, spec):
         if self._retiring:
@@ -370,16 +374,32 @@ class WorkerProcess:
         return result
 
     async def _retire(self):
-        # Tell the raylet first so it stops dispatching here and owns
-        # the kill; then exit defensively in case it never follows
-        # through.
+        # Tell the raylet first so it stops dispatching here; it only
+        # terminate()s as a late fallback — this worker owns its exit
+        # once every in-flight reply is on the wire.
         try:
             await self.raylet_conn.call(
                 "retire_worker", {"worker_id": self.worker_id}, timeout=5
             )
         except Exception:  # noqa: BLE001
             pass
-        await asyncio.sleep(1.0)
+        # The threshold-crossing task's reply travels on a direct
+        # worker->owner connection; exiting before it flushes would
+        # surface as worker_crashed on an already-executed task. Wait
+        # out any running batch, give its respond() coroutine a tick to
+        # write, then drain every server connection.
+        try:
+            async with self._direct_lock:
+                pass
+            await asyncio.sleep(0.05)
+            for conn in list(self.rpc.connections):
+                try:
+                    conn._sender.flush()
+                    await conn.writer.drain()
+                except Exception:  # noqa: BLE001
+                    pass
+        except Exception:  # noqa: BLE001
+            pass
         os._exit(0)
 
     def _execute_task(self, spec) -> dict:

@@ -124,6 +124,17 @@ def main() -> None:
     from ray_tpu._private import worker_main  # noqa: F401
 
     _prewarm()
+    # Freeze the warm heap into the GC's permanent generation: a child's
+    # first collection otherwise WRITES the gc header of every inherited
+    # object, COW-copying nearly the whole heap (the Instagram prefork
+    # lesson). With the freeze, children dirty only what they actually
+    # mutate — measured ~5.1MB -> ~2MB private-dirty per idle worker,
+    # which is what bounds actor density per host (thinly-backed VMs
+    # penalize every fresh page touched).
+    import gc
+
+    gc.collect()
+    gc.freeze()
     _emit({"op": "ready", "pid": os.getpid()})
     fd = sys.stdin.fileno()
     sel = selectors.DefaultSelector()

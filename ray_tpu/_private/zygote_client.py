@@ -7,12 +7,25 @@ subprocess.Popen. ZygoteProc mirrors the Popen surface the raylet uses
 the reap loop are agnostic to how the worker was started.
 
 The manager is deliberately loop-agnostic (plain threading, one daemon
-reader thread, a mutex around shared state): one PROCESS-LEVEL zygote
-serves every raylet/session in the process (`get_shared_manager`).
-Children receive their complete environment per spawn request, so the
-zygote has no per-cluster state — sharing it across rt.init cycles saves
-the warm-interpreter cost on every session (a large win for test suites
-and notebooks that init/shutdown repeatedly).
+reader thread per zygote generation, a mutex around shared state): one
+PROCESS-LEVEL zygote serves every raylet/session in the process
+(`get_shared_manager`). Children receive their complete environment per
+spawn request, so the zygote has no per-cluster state — sharing it
+across rt.init cycles saves the warm-interpreter cost on every session
+(a large win for test suites and notebooks that init/shutdown
+repeatedly).
+
+Generational rotation: Linux reverse-map (anon_vma) chains grow with
+the number of COW-faulted siblings forked from one parent, so page
+faults in the Nth child slow superlinearly (measured on this kernel:
+fork+touch-20MB goes ~24ms -> ~500ms+ with 250+ touched siblings; in
+the runtime, worker boots went ~5ms -> ~27ms sys each by ~900 live
+workers). The manager therefore retires a zygote after
+`zygote_respawn_after` forks and re-execs a fresh one — fresh parent,
+fresh chains. A retired generation stays alive (stdin open) purely to
+reap and report its remaining children, and is closed once the last of
+them exits. The next generation pre-warms in the background so rotation
+never stalls a spawn.
 """
 
 from __future__ import annotations
@@ -97,6 +110,32 @@ class ZygoteProc:
         return self.returncode  # type: ignore[return-value]
 
 
+class _Generation:
+    """One zygote process plus its in-flight and live children."""
+
+    __slots__ = ("proc", "pending", "spawned", "live", "retiring")
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.pending: deque[ZygoteProc] = deque()
+        self.spawned = 0  # forks requested of this zygote
+        self.live = 0  # children forked and not yet reported dead
+        self.retiring = False  # no new spawns; close when live hits 0
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def close(self) -> None:
+        try:
+            self.proc.stdin.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.proc.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 class ZygoteManager:
     def __init__(self, base_env: Optional[dict] = None):
         # The zygote itself must not import jax: strip the TPU tunnel
@@ -105,23 +144,26 @@ class ZygoteManager:
         env = dict(base_env if base_env is not None else os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         self._base_env = env
-        self.proc: Optional[subprocess.Popen] = None
-        self._pending: deque[ZygoteProc] = deque()
+        self._gen: Optional[_Generation] = None
+        self._next: Optional[_Generation] = None  # pre-warming successor
+        self._old: list[_Generation] = []  # retired, still reaping
         self._dead: Dict[int, int] = {}
-        self._reader: Optional[threading.Thread] = None
         self._lock = threading.Lock()
-        self._deaths = 0  # zygote process deaths; disable after 3
+        self._deaths = 0  # unexpected zygote deaths; disable after 3
+
+    # Kept for tests / introspection.
+    @property
+    def proc(self) -> Optional[subprocess.Popen]:
+        return self._gen.proc if self._gen is not None else None
 
     def alive(self) -> bool:
-        return self.proc is not None and self.proc.poll() is None
+        return self._gen is not None and self._gen.alive()
 
-    def start(self) -> bool:
-        """Start the zygote process (sync, cheap — the import cost is paid
-        inside the zygote, not here)."""
-        if self.alive():
-            return True
+    def _start_generation(self) -> Optional[_Generation]:
+        """Exec a fresh zygote (sync, cheap — the import cost is paid
+        inside the zygote, not here) and attach its reader thread."""
         try:
-            self.proc = subprocess.Popen(
+            proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.zygote"],
                 stdin=subprocess.PIPE,
                 stdout=subprocess.PIPE,
@@ -131,20 +173,27 @@ class ZygoteManager:
                 bufsize=1,
             )
         except Exception:  # noqa: BLE001 — caller falls back to Popen spawns
-            self.proc = None
-            return False
+            return None
+        gen = _Generation(proc)
         # A dedicated DAEMON thread, not run_in_executor: a blocked
         # readline in a loop's default executor is a non-daemon thread
         # that keeps the interpreter alive at exit.
-        self._reader = threading.Thread(
-            target=self._read_loop, args=(self.proc,),
+        threading.Thread(
+            target=self._read_loop, args=(gen,),
             name="zygote-reader", daemon=True,
-        )
-        self._reader.start()
-        return True
+        ).start()
+        return gen
 
-    def _read_loop(self, proc: subprocess.Popen) -> None:
-        """Daemon thread: reads zygote replies, applies them under lock."""
+    def start(self) -> bool:
+        if self.alive():
+            return True
+        self._gen = self._start_generation()
+        return self._gen is not None
+
+    def _read_loop(self, gen: _Generation) -> None:
+        """Daemon thread: reads one zygote's replies, applies them under
+        the manager lock."""
+        proc = gen.proc
         while True:
             try:
                 line = proc.stdout.readline()
@@ -152,10 +201,16 @@ class ZygoteManager:
                 line = ""
             if not line:
                 with self._lock:
-                    # Pending forks never happened.
-                    self._deaths += 1
-                    while self._pending:
-                        self._pending.popleft()._fail(-1)
+                    if not gen.retiring:
+                        self._deaths += 1
+                    # Pending forks never happened (retiring or not):
+                    # their handles must resolve or callers poll forever.
+                    while gen.pending:
+                        gen.pending.popleft()._fail(-1)
+                    if self._gen is gen:
+                        self._gen = None
+                    if gen in self._old:
+                        self._old.remove(gen)
                 return
             try:
                 msg = json.loads(line)
@@ -163,12 +218,36 @@ class ZygoteManager:
                 continue
             with self._lock:
                 op = msg.get("op")
-                if op == "spawned" and self._pending:
-                    self._pending.popleft()._assign(msg["pid"])
+                if op == "spawned" and gen.pending:
+                    gen.pending.popleft()._assign(msg["pid"])
+                    gen.live += 1
                 elif op == "dead":
                     if len(self._dead) > 4096:  # unconsumed-notice backstop
                         self._dead.clear()
                     self._dead[msg["pid"]] = msg["rc"]
+                    gen.live -= 1
+                    if gen.retiring and gen.live <= 0 and not gen.pending:
+                        # Last child reaped and no fork reply in flight:
+                        # the retired zygote's only remaining job is done.
+                        gen.close()
+                        if gen in self._old:
+                            self._old.remove(gen)
+
+    def _rotate_locked(self) -> None:
+        """Retire the current generation and promote the pre-warmed
+        successor (or start one). Called under the lock."""
+        gen = self._gen
+        if gen is not None:
+            gen.retiring = True
+            if gen.live <= 0 and not gen.pending:
+                gen.close()
+            else:
+                self._old.append(gen)
+        nxt, self._next = self._next, None
+        if nxt is not None and nxt.alive():
+            self._gen = nxt
+        else:
+            self._gen = self._start_generation()
 
     def spawn(self, env: dict) -> Optional[ZygoteProc]:
         """Queue a fork request; returns None when the zygote can't serve
@@ -179,38 +258,45 @@ class ZygoteManager:
         threads spawning concurrently must observe the same FIFO order in
         _pending as on the pipe (else the reader assigns pids to the
         wrong handles), and must not double-start the zygote."""
+        from ray_tpu._private.config import get_config
+
+        limit = max(1, get_config().zygote_respawn_after)
         with self._lock:
             if self._deaths >= 3:
                 return None  # repeatedly crashing: stick to Popen spawns
-            if not self.alive() and not self.start():
+            if self._gen is not None and self._gen.spawned >= limit:
+                self._rotate_locked()
+            if (self._gen is None or not self._gen.alive()) and not self.start():
                 return None
+            gen = self._gen
+            # Pre-warm the successor while the current zygote still has
+            # headroom: by rotation time its interpreter boot is done.
+            if gen.spawned >= int(limit * 0.7) and self._next is None:
+                self._next = self._start_generation()
             zp = ZygoteProc(self)
-            self._pending.append(zp)
+            gen.pending.append(zp)
             try:
-                self.proc.stdin.write(
+                gen.proc.stdin.write(
                     json.dumps({"op": "spawn", "env": env}) + "\n"
                 )
-                self.proc.stdin.flush()
+                gen.proc.stdin.flush()
             except Exception:  # noqa: BLE001 — zygote just died
                 try:
-                    self._pending.remove(zp)
+                    gen.pending.remove(zp)
                 except ValueError:
                     pass
                 return None
+            gen.spawned += 1
             return zp
 
     def stop(self) -> None:
-        if self.proc is not None:
-            try:
-                self.proc.stdin.close()
-            except Exception:  # noqa: BLE001
-                pass
-            try:
-                self.proc.terminate()
-            except Exception:  # noqa: BLE001
-                pass
-            self.proc = None
-        self._reader = None  # daemon thread exits on pipe EOF
+        with self._lock:
+            gens = [g for g in (self._gen, self._next, *self._old) if g]
+            self._gen = None
+            self._next = None
+            self._old = []
+        for g in gens:
+            g.close()
 
 
 _shared: Optional[ZygoteManager] = None

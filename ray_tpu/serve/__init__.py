@@ -37,13 +37,35 @@ def run(app: Application, name: Optional[str] = None,
     arrive as DeploymentHandles — the reference's composition idiom:
 
         handle = serve.run(Pipeline.bind(Preprocess.bind()))
+
+    Duplicate deployment names within one composition pass are
+    uniquified with _1/_2 suffixes (the reference's DAG builder does
+    the same), so two bound instances of the same class route to their
+    own deployments instead of the second silently replacing the first.
+    Suffix assignment is deterministic left-to-right, so re-running the
+    same graph redeploys over the same names.
     """
     controller = get_or_create_controller()
-    app_name = name or app.deployment.name
+    return _run_app(app, name, controller, set(), {})
+
+
+def _run_app(app: Application, name: Optional[str], controller,
+             used_names: set, resolved: dict) -> DeploymentHandle:
+    # The same Application OBJECT appearing twice in a graph (a shared
+    # dependency) stays one deployment; only distinct .bind() calls
+    # with colliding names are uniquified.
+    if id(app) in resolved:
+        return resolved[id(app)]
+    base = name or app.deployment.name
+    app_name, i = base, 1
+    while app_name in used_names:
+        app_name = f"{base}_{i}"
+        i += 1
+    used_names.add(app_name)
 
     def resolve(obj):
         if isinstance(obj, Application):
-            return run(obj)  # recursive deploy under its own name
+            return _run_app(obj, None, controller, used_names, resolved)
         if isinstance(obj, (list, tuple)):
             return type(obj)(resolve(v) for v in obj)
         if isinstance(obj, dict):
@@ -52,13 +74,48 @@ def run(app: Application, name: Optional[str] = None,
 
     init_args = tuple(resolve(a) for a in app.init_args)
     init_kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
+    _reject_buried_applications((init_args, init_kwargs), app_name)
     rt.get(
         controller.deploy.remote(
             app_name, app.deployment, init_args, init_kwargs
         ),
         timeout=get_config().serve_deploy_timeout_s,
     )
-    return DeploymentHandle(app_name)
+    handle = DeploymentHandle(app_name)
+    resolved[id(app)] = handle
+    return handle
+
+
+def _reject_buried_applications(obj, app_name: str, _seen=None, _depth=0):
+    """An Application that survives resolution (e.g. buried in a user
+    object's attributes) would arrive at the replica as a raw graph node
+    and fail there with an opaque error; fail here with a clear one.
+    Containers were already resolved — this walks one extra level into
+    plain-object attributes, bounded by depth and an id-set."""
+    if isinstance(obj, Application):
+        raise ValueError(
+            f"init args of deployment {app_name!r} contain a bound "
+            "Application inside an unsupported container or object "
+            "attribute; pass nested .bind() apps directly, or in "
+            "lists/tuples/dicts, so serve.run can deploy them "
+            "and inject DeploymentHandles."
+        )
+    if _depth > 4:
+        return
+    if _seen is None:
+        _seen = set()
+    if id(obj) in _seen:
+        return
+    _seen.add(id(obj))
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for v in obj:
+            _reject_buried_applications(v, app_name, _seen, _depth + 1)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _reject_buried_applications(v, app_name, _seen, _depth + 1)
+    elif hasattr(obj, "__dict__") and not isinstance(obj, type):
+        for v in vars(obj).values():
+            _reject_buried_applications(v, app_name, _seen, _depth + 1)
 
 
 def call(app_name: str, *args, method: str = "__call__", **kwargs):

@@ -121,36 +121,42 @@ class ServeController:
 
     # -- API -------------------------------------------------------------
     @staticmethod
-    def _only_user_config_changed(old_app, deployment, init_args,
-                                  init_kwargs) -> bool:
-        """True when a redeploy differs from the running app ONLY in
-        user_config — the lightweight-update case the reference handles
-        by reconfigure()ing live replicas instead of restarting them
-        (deployment_state.py: user_config-only version changes)."""
+    def _same_except_user_config(old_app, deployment, init_args,
+                                 init_kwargs) -> bool:
+        """True when a redeploy matches the running app in everything
+        but (possibly) user_config. With user_config also equal it is a
+        no-op redeploy; with it different it is the lightweight-update
+        case the reference handles by reconfigure()ing live replicas
+        instead of restarting them (deployment_state.py: user_config-only
+        version changes)."""
         od: Deployment = old_app["deployment"]
 
         def ident(obj):
             return (getattr(obj, "__module__", None),
                     getattr(obj, "__qualname__", None))
 
-        def safe_eq(a, b):
-            # Array-like args make == elementwise; any ambiguity (or
-            # raising comparison) counts as "changed" -> full replace,
-            # never a crash.
+        import cloudpickle
+
+        def same_code(a, b):
+            # (module, qualname) alone is blind to an edited class body
+            # redeployed under the same name; compare the serialized
+            # bytes too. Any pickling instability reads as "changed" ->
+            # full replace, the safe direction.
+            if ident(a) != ident(b):
+                return False
             try:
-                return bool(a == b)
+                return cloudpickle.dumps(a) == cloudpickle.dumps(b)
             except Exception:  # noqa: BLE001
                 return False
 
         return (
-            ident(od.func_or_class) == ident(deployment.func_or_class)
+            same_code(od.func_or_class, deployment.func_or_class)
             and od.num_replicas == deployment.num_replicas
             and od.ray_actor_options == deployment.ray_actor_options
             and od.autoscaling_config == deployment.autoscaling_config
             and od.max_ongoing_requests == deployment.max_ongoing_requests
-            and safe_eq(old_app["init_args"], init_args)
-            and safe_eq(old_app["init_kwargs"], init_kwargs)
-            and not safe_eq(od.user_config, deployment.user_config)
+            and _safe_eq(old_app["init_args"], init_args)
+            and _safe_eq(old_app["init_kwargs"], init_kwargs)
         )
 
     def _reconfigure_in_place(self, name: str, deployment: Deployment) -> bool:
@@ -189,11 +195,19 @@ class ServeController:
     def deploy(self, name: str, deployment: Deployment, init_args, init_kwargs):
         with self._lock:
             old = self.apps.get(name)
-            lightweight = bool(
-                old and old["replicas"] and self._only_user_config_changed(
+            same_core = bool(
+                old and old["replicas"] and self._same_except_user_config(
                     old, deployment, init_args, init_kwargs
                 )
             )
+            if same_core and _safe_eq(
+                old["deployment"].user_config, deployment.user_config
+            ):
+                # Nothing changed at all: a no-op redeploy must not
+                # restart healthy replicas (reference: same-version
+                # redeploys are no-ops).
+                return True
+            lightweight = same_core
             if lightweight:
                 old["deployment"] = deployment
         if lightweight:
@@ -527,6 +541,15 @@ class ServeController:
                     changed = True
         if changed:
             self._checkpoint()
+
+
+def _safe_eq(a, b) -> bool:
+    # Array-like args make == elementwise; any ambiguity (or raising
+    # comparison) counts as "changed" -> full replace, never a crash.
+    try:
+        return bool(a == b)
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def _kill_quietly(actor):

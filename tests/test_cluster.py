@@ -358,3 +358,62 @@ def test_drain_guards(rt_cluster):
     )
     assert out == n2_id.hex()
     c.close()
+
+
+@pytest.mark.slow
+def test_drain_revokes_direct_leases(rt_cluster):
+    """A driver colocated with a cordoned node must stop streaming
+    direct-transport tasks to it: the lease path bypasses h_submit's
+    drain spill, so the raylet refuses NEW leases while draining and
+    revokes the ones already granted (owners return them and fall back
+    to the submit path, which spills remote)."""
+    import time as _t
+
+    cluster = rt_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.worker import CoreClient
+
+    # Second driver attached to n2 — the colocated-driver scenario.
+    client2 = CoreClient(
+        cluster.io.loop,
+        ("127.0.0.1", cluster.gcs_port),
+        ("127.0.0.1", n2.port),
+        n2.store_name,
+        n2.node_id.binary(),
+        JobID.from_random(),
+        mode="driver",
+    )
+    client2.connect()
+    try:
+        def node_of():
+            import os
+
+            return os.environ["RT_NODE_ID"]
+
+        def run_one(timeout=30):
+            [ref] = client2.submit_task(node_of, (), {})
+            return client2.get([ref], timeout=timeout)[0]
+
+        hexid = n2.node_id.binary().hex()
+        # Warm the direct-lease path on the local (n2) raylet.
+        pre = {run_one() for _ in range(8)}
+        assert hexid in pre, "expected the colocated lease path on n2"
+
+        from ray_tpu.util.state import StateApiClient
+
+        c = StateApiClient()
+        assert c.call(
+            "cordon_node", {"node_id": n2.node_id.binary()}
+        ).get("ok")
+        _t.sleep(1.5)  # cordon propagates via the resource sync
+
+        # The warm lease must be revoked: post-cordon tasks land on the
+        # other nodes even though n2 has free CPU and held a lease.
+        post = {run_one() for _ in range(8)}
+        assert hexid not in post, "cordoned node still served leased tasks"
+    finally:
+        client2.disconnect()

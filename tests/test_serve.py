@@ -240,3 +240,107 @@ def test_redeploy_with_array_init_args(serve_session):
     serve.run(Weighted.bind(np.ones(4)), name="warr")
     h = serve.run(Weighted.bind(np.ones(4) * 2), name="warr")
     assert rt.get(h.remote(3), timeout=60) == 24.0
+
+
+def test_duplicate_bind_names_uniquified(serve_session):
+    """Two bound instances of the same deployment class in one graph
+    must become two deployments (the reference's DAG builder appends
+    _1/_2 on name collisions) — not the second silently replacing the
+    first so both handles route to one instance."""
+
+    @serve.deployment
+    class Scale:
+        def __init__(self, w):
+            self.w = w
+
+        def __call__(self, x):
+            return x * self.w
+
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, models):
+            self.models = models
+
+        def __call__(self, x):
+            return [m.remote(x).result(timeout=30) for m in self.models]
+
+    handle = serve.run(
+        Ensemble.bind([Scale.bind(3), Scale.bind(5)]), name="ens_dup"
+    )
+    assert rt.get(handle.remote(2), timeout=60) == [6, 10]
+    st = serve.status()
+    assert "Scale" in st and "Scale_1" in st
+
+
+def test_noop_redeploy_keeps_replicas(serve_session):
+    """Redeploying with nothing changed must not restart healthy
+    replicas (reference: same-version redeploys are no-ops)."""
+
+    @serve.deployment
+    class P:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self):
+            return self.pid
+
+    h = serve.run(P.bind(), name="noop")
+    pid1 = rt.get(h.remote(), timeout=60)
+    h2 = serve.run(P.bind(), name="noop")
+    pid2 = rt.get(h2.remote(), timeout=60)
+    assert pid1 == pid2
+
+
+def test_buried_application_raises(serve_session):
+    """An Application hidden where resolution cannot inject a handle
+    (an object attribute) fails fast with a clear error instead of
+    shipping a raw graph node to the replica."""
+
+    @serve.deployment
+    class Inner:
+        def __call__(self, x):
+            return x
+
+    class Holder:
+        def __init__(self, app):
+            self.app = app
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, holder):
+            self.holder = holder
+
+    with pytest.raises(Exception, match="Application"):
+        serve.run(Outer.bind(Holder(Inner.bind())), name="buried")
+
+
+def test_shared_application_object_deploys_once(serve_session):
+    """The same bound Application OBJECT used twice in a graph is one
+    shared deployment (a diamond dependency), not two copies — only
+    distinct .bind() calls get uniquified."""
+
+    @serve.deployment
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def __call__(self):
+            self.n += 1
+            return self.n
+
+    @serve.deployment
+    class Pair:
+        def __init__(self, models):
+            self.models = models
+
+        def __call__(self):
+            return [m.remote().result(timeout=30) for m in self.models]
+
+    shared = Counter.bind()
+    handle = serve.run(Pair.bind([shared, shared]), name="pair_shared")
+    # Both handles hit the SAME replica: counts are 1 then 2.
+    assert rt.get(handle.remote(), timeout=60) == [1, 2]
+    st = serve.status()
+    assert "Counter" in st and "Counter_1" not in st
