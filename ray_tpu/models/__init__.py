@@ -7,6 +7,17 @@ MLP/conv models for trainer tests. Everything is plain functional JAX
 the pipeline scheduler.
 """
 
+from ray_tpu.models.conv import (
+    ATARI_FILTERS,
+    ResNetConfig,
+    TINY_FILTERS,
+    cnn_torso_forward,
+    init_cnn_torso,
+    init_resnet,
+    resnet_forward,
+    resnet_loss,
+    resnet_param_logical_axes,
+)
 from ray_tpu.models.transformer import (
     TransformerConfig,
     init_params,
@@ -19,6 +30,15 @@ from ray_tpu.models import configs
 from ray_tpu.models.generate import decode_step, generate, init_kv_cache, prefill
 
 __all__ = [
+    "ResNetConfig",
+    "init_resnet",
+    "resnet_forward",
+    "resnet_loss",
+    "resnet_param_logical_axes",
+    "init_cnn_torso",
+    "cnn_torso_forward",
+    "ATARI_FILTERS",
+    "TINY_FILTERS",
     "TransformerConfig",
     "init_params",
     "forward",

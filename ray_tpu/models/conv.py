@@ -1,0 +1,261 @@
+"""Conv model family: CNN torsos and ResNet classifiers, TPU-first.
+
+Fills the vision slots of the reference's model zoo — the conv nets
+rllib's catalog builds from ``conv_filters``/``conv_activation``
+(reference: rllib/models/catalog.py:105-116) and the ResNet configs the
+vision trainers use (reference: python/ray/train/examples/ — the
+"JaxTrainer ResNet data-parallel" north-star config).
+
+TPU-first choices:
+  * NHWC layout end-to-end — XLA's preferred conv layout on TPU (the
+    MXU consumes (spatial, channel) tiles directly; NCHW forces
+    transposes).
+  * GroupNorm instead of BatchNorm: no mutable running statistics, so
+    the model stays a pure function of (params, batch) — jit/pjit-able
+    with zero state plumbing — and no cross-replica stat sync is needed
+    under data parallelism (BatchNorm's sync is an all-reduce XLA can't
+    fuse into the conv).
+  * Everything is plain functional JAX over a params pytree, like the
+    flagship transformer, so the same code runs under jit, pjit/GSPMD,
+    and inside learner actors.
+  * `resnet_param_logical_axes` annotates channel dims for fsdp/tp
+    sharding through parallel.mesh.DEFAULT_RULES (conv kernels shard
+    their output-channel dim the way dense kernels shard theirs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# NHWC activations x HWIO kernels -> NHWC.
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def init_conv(key, kh: int, kw: int, cin: int, cout: int,
+              dtype=jnp.float32) -> Dict:
+    """He-initialized conv kernel + bias (HWIO)."""
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), dtype=jnp.float32)
+    return {
+        "w": (w * (2.0 / fan_in) ** 0.5).astype(dtype),
+        "b": jnp.zeros((cout,), dtype=dtype),
+    }
+
+
+def conv_forward(p: Dict, x: jax.Array, stride: int = 1,
+                 padding: str = "SAME") -> jax.Array:
+    out = jax.lax.conv_general_dilated(
+        x, p["w"], window_strides=(stride, stride), padding=padding,
+        dimension_numbers=_DN,
+    )
+    return out + p["b"]
+
+
+def init_group_norm(c: int, dtype=jnp.float32) -> Dict:
+    return {"scale": jnp.ones((c,), dtype=dtype),
+            "bias": jnp.zeros((c,), dtype=dtype)}
+
+
+def group_norm(p: Dict, x: jax.Array, groups: int = 8,
+               eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over NHWC (groups divide C; falls back to the largest
+    divisor <= groups so narrow stems still normalize)."""
+    c = x.shape[-1]
+    g = groups
+    while c % g:
+        g -= 1
+    shape = x.shape[:-1] + (g, c // g)
+    xg = x.reshape(shape)
+    mean = xg.mean(axis=(-4, -3, -1), keepdims=True)
+    var = xg.var(axis=(-4, -3, -1), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    return xg.reshape(x.shape) * p["scale"] + p["bias"]
+
+
+# ---------------------------------------------------------------------------
+# CNN torso (the catalog's conv_filters model): feature extractor for RL
+# policies over image observations.
+# ---------------------------------------------------------------------------
+
+# (out_channels, kernel, stride) per layer — the catalog's default shape
+# family for 84x84 Atari frames (reference: catalog.py conv_filters).
+ATARI_FILTERS: Tuple[Tuple[int, int, int], ...] = (
+    (32, 8, 4), (64, 4, 2), (64, 3, 1),
+)
+# A small family for tiny test envs (12x12-ish frames).
+TINY_FILTERS: Tuple[Tuple[int, int, int], ...] = ((16, 3, 2), (32, 3, 2))
+
+
+def init_cnn_torso(key, obs_shape: Tuple[int, int, int],
+                   conv_filters: Sequence[Tuple[int, int, int]],
+                   out_dim: int = 256, dtype=jnp.float32) -> Dict:
+    """Conv stack + flatten + dense projection to a feature vector."""
+    h, w, cin = obs_shape
+    keys = jax.random.split(key, len(conv_filters) + 1)
+    convs = []
+    for k, (cout, kern, stride) in zip(keys, conv_filters):
+        convs.append(init_conv(k, kern, kern, cin, cout, dtype))
+        h = -(-h // stride)  # ceil-div: SAME padding
+        w = -(-w // stride)
+        cin = cout
+    flat = h * w * cin
+    proj = jax.random.normal(keys[-1], (flat, out_dim), dtype=jnp.float32)
+    return {
+        "convs": convs,
+        "proj_w": (proj * (2.0 / flat) ** 0.5).astype(dtype),
+        "proj_b": jnp.zeros((out_dim,), dtype=dtype),
+    }
+
+
+def cnn_torso_forward(params: Dict, x: jax.Array,
+                      conv_filters: Sequence[Tuple[int, int, int]]) -> jax.Array:
+    """(B, H, W, C) float obs -> (B, out_dim) features."""
+    for p, (_, _, stride) in zip(params["convs"], conv_filters):
+        x = jax.nn.relu(conv_forward(p, x, stride=stride))
+    x = x.reshape(x.shape[0], -1)
+    return jax.nn.relu(x @ params["proj_w"] + params["proj_b"])
+
+
+# ---------------------------------------------------------------------------
+# ResNet (v2 pre-activation, GroupNorm) classifier.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    """Stage layout. resnet18-style: stage_sizes=(2, 2, 2, 2); cifar
+    tests shrink width/stages. num_groups is the GroupNorm group count.
+    """
+
+    num_classes: int = 10
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)
+    width: int = 64
+    stem_kernel: int = 3  # 7 for ImageNet-scale inputs
+    stem_stride: int = 1  # 2 for ImageNet-scale inputs
+    num_groups: int = 8
+    dtype: object = jnp.float32
+
+
+def _init_block(key, cin: int, cout: int, cfg: ResNetConfig) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    block = {
+        "norm1": init_group_norm(cin, cfg.dtype),
+        "conv1": init_conv(k1, 3, 3, cin, cout, cfg.dtype),
+        "norm2": init_group_norm(cout, cfg.dtype),
+        "conv2": init_conv(k2, 3, 3, cout, cout, cfg.dtype),
+    }
+    if cin != cout:
+        block["proj"] = init_conv(k3, 1, 1, cin, cout, cfg.dtype)
+    return block
+
+
+def init_resnet(key, cfg: ResNetConfig) -> Dict:
+    n_stages = len(cfg.stage_sizes)
+    keys = jax.random.split(key, n_stages + 2)
+    cin = cfg.width
+    params: Dict = {
+        "stem": init_conv(keys[0], cfg.stem_kernel, cfg.stem_kernel, 3,
+                          cfg.width, cfg.dtype),
+        "stages": [],
+    }
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        cout = cfg.width * (2 ** s)
+        bkeys = jax.random.split(keys[s + 1], n_blocks)
+        stage = []
+        for b in range(n_blocks):
+            stage.append(_init_block(bkeys[b], cin, cout, cfg))
+            cin = cout
+        params["stages"].append(stage)
+    params["final_norm"] = init_group_norm(cin, cfg.dtype)
+    head = jax.random.normal(keys[-1], (cin, cfg.num_classes),
+                             dtype=jnp.float32)
+    params["head_w"] = (head * cin ** -0.5).astype(cfg.dtype)
+    params["head_b"] = jnp.zeros((cfg.num_classes,), dtype=cfg.dtype)
+    return params
+
+
+def _block_forward(p: Dict, x: jax.Array, stride: int,
+                   cfg: ResNetConfig) -> jax.Array:
+    """Pre-activation residual block (norm-relu-conv x2)."""
+    h = jax.nn.relu(group_norm(p["norm1"], x, cfg.num_groups))
+    shortcut = x
+    if "proj" in p or stride != 1:
+        # Project the identity path when shape changes (1x1 conv when
+        # channels change; strided slice-free conv handles downsample).
+        if "proj" in p:
+            shortcut = conv_forward(p["proj"], h, stride=stride)
+        else:
+            shortcut = x[:, ::stride, ::stride, :]
+    h = conv_forward(p["conv1"], h, stride=stride)
+    h = jax.nn.relu(group_norm(p["norm2"], h, cfg.num_groups))
+    h = conv_forward(p["conv2"], h, stride=1)
+    return shortcut + h
+
+
+def resnet_forward(params: Dict, x: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """(B, H, W, 3) images -> (B, num_classes) logits."""
+    x = x.astype(cfg.dtype)
+    h = conv_forward(params["stem"], x, stride=cfg.stem_stride)
+    for s, stage in enumerate(params["stages"]):
+        for b, block in enumerate(stage):
+            stride = 2 if (b == 0 and s > 0) else 1
+            h = _block_forward(block, h, stride, cfg)
+    h = jax.nn.relu(group_norm(params["final_norm"], h, cfg.num_groups))
+    h = h.mean(axis=(1, 2))  # global average pool
+    return h @ params["head_w"] + params["head_b"]
+
+
+def resnet_loss(params: Dict, batch: Dict, cfg: ResNetConfig):
+    """Cross-entropy + accuracy over {"x": images NHWC, "y": labels}."""
+    logits = resnet_forward(params, batch["x"], cfg)
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def resnet_param_logical_axes(cfg: ResNetConfig) -> Dict:
+    """Logical sharding axes mirroring init_resnet's tree exactly: conv
+    kernels shard output channels on the tp axis ("heads") and input
+    channels on "embed" (fsdp), the dense head shards classes on
+    "vocab", and GroupNorm scales replicate — the same rule names
+    DEFAULT_RULES maps for the transformer, so the trainer's sharding
+    machinery needs no conv-specific cases."""
+
+    def conv_axes():
+        return {"w": (None, None, "embed", "heads"), "b": ("heads",)}
+
+    def norm_axes():
+        return {"scale": (None,), "bias": (None,)}
+
+    stages = []
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        cout = cfg.width * (2 ** s)
+        stage = []
+        for _ in range(n_blocks):
+            block = {
+                "norm1": norm_axes(),
+                "conv1": conv_axes(),
+                "norm2": norm_axes(),
+                "conv2": conv_axes(),
+            }
+            if cin != cout:
+                block["proj"] = conv_axes()
+            stage.append(block)
+            cin = cout
+        stages.append(stage)
+    return {
+        # RGB input channels (3) are unshardable: the stem shards only
+        # its output channels.
+        "stem": {"w": (None, None, None, "heads"), "b": ("heads",)},
+        "stages": stages,
+        "final_norm": norm_axes(),
+        "head_w": ("embed", "vocab"),
+        "head_b": ("vocab",),
+    }

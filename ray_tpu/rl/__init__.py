@@ -11,6 +11,9 @@ from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import (
     ContinuousModuleSpec,
     ContinuousPolicyModule,
+    ConvModuleSpec,
+    ConvPolicyModule,
+    ConvQNetworkModule,
     DiscretePolicyModule,
     C51QNetworkModule,
     DuelingQNetworkModule,
@@ -104,6 +107,9 @@ __all__ = [
     "Learner",
     "LearnerGroup",
     "RLModuleSpec",
+    "ConvModuleSpec",
+    "ConvPolicyModule",
+    "ConvQNetworkModule",
     "DiscretePolicyModule",
     "DuelingQNetworkModule",
     "EnvRunner",

@@ -80,7 +80,8 @@ class APEX(DQN):
         self.shards = [
             ReplayShardActor.options(num_cpus=0.1).remote(
                 max(1, config.buffer_capacity // config.num_replay_shards),
-                config.obs_dim,
+                config.obs_shape if config.obs_shape is not None
+                else config.obs_dim,
                 config.seed + 1000 + i,
                 config.per_alpha,
             )

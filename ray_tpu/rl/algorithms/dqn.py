@@ -22,11 +22,14 @@ from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import (
     C51QNetworkModule,
+    ConvModuleSpec,
+    ConvQNetworkModule,
     DuelingQNetworkModule,
     NoisyQNetworkModule,
     QNetworkModule,
     RLModuleSpec,
     factorized_noise_np,
+    filters_for,
 )
 from ray_tpu.rl.env_runner import TransitionEnvRunner
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
@@ -123,6 +126,10 @@ class DQNConfig(ConfigEvalMixin):
 
     env_creator: Optional[Callable] = None
     obs_dim: int = 4
+    # Image observations: obs_shape=(H, W, C) -> conv torso Q-network
+    # (reference: pixel DQN via catalog conv_filters).
+    obs_shape: Optional[tuple] = None
+    conv_filters: Optional[tuple] = None
     num_actions: int = 2
     hidden: tuple = (64, 64)
     num_env_runners: int = 2
@@ -158,13 +165,18 @@ class DQNConfig(ConfigEvalMixin):
     # parametric noise on the head replaces epsilon-greedy.
     noisy: bool = False
 
-    def environment(self, env_creator=None, obs_dim=None, num_actions=None):
+    def environment(self, env_creator=None, obs_dim=None, num_actions=None,
+                    obs_shape=None, conv_filters=None):
         if env_creator is not None:
             self.env_creator = env_creator
         if obs_dim is not None:
             self.obs_dim = obs_dim
         if num_actions is not None:
             self.num_actions = num_actions
+        if obs_shape is not None:
+            self.obs_shape = tuple(obs_shape)
+        if conv_filters is not None:
+            self.conv_filters = tuple(conv_filters)
         return self
 
     def env_runners(self, num_env_runners=None, rollout_length=None,
@@ -231,7 +243,23 @@ class DQN(AlgorithmBase):
                 "distributional / dueling / noisy heads are not composed; "
                 "pick one head structure"
             )
-        if config.distributional:
+        if config.obs_shape is not None:
+            if config.distributional or config.dueling or config.noisy:
+                raise ValueError(
+                    "image observations use the conv Q-network; "
+                    "distributional/dueling/noisy heads are MLP-only here"
+                )
+            conv_spec = ConvModuleSpec(
+                config.obs_shape, config.num_actions,
+                conv_filters=filters_for(config.obs_shape,
+                                         config.conv_filters),
+                hidden=config.hidden[-1:] or (64,),
+            )
+            module_factory = self._module_factory = (  # noqa: E731
+                lambda: ConvQNetworkModule(conv_spec)
+            )
+            loss = dqn_loss
+        elif config.distributional:
             if config.num_atoms < 2:
                 raise ValueError("distributional DQN needs num_atoms >= 2")
             module_factory = self._module_factory = (  # noqa: E731
@@ -327,7 +355,10 @@ class DQN(AlgorithmBase):
         if config.prioritized_replay:
             buffer_kwargs["alpha"] = config.per_alpha
         return buffer_cls(
-            config.buffer_capacity, config.obs_dim, **buffer_kwargs
+            config.buffer_capacity,
+            config.obs_shape if config.obs_shape is not None
+            else config.obs_dim,
+            **buffer_kwargs,
         )
 
     def _collect(self, eps: float):

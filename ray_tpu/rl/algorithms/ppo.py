@@ -18,7 +18,13 @@ import numpy as np
 import ray_tpu as rt
 from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
 from ray_tpu.rl.core.learner_group import LearnerGroup
-from ray_tpu.rl.core.rl_module import DiscretePolicyModule, RLModuleSpec
+from ray_tpu.rl.core.rl_module import (
+    ConvModuleSpec,
+    ConvPolicyModule,
+    DiscretePolicyModule,
+    RLModuleSpec,
+    filters_for,
+)
 from ray_tpu.rl.env_runner import EnvRunner, compute_gae
 
 
@@ -84,6 +90,12 @@ class PPOConfig(ConfigEvalMixin):
 
     env_creator: Optional[Callable] = None
     obs_dim: int = 4
+    # Image observations: set obs_shape=(H, W, C) and the policy gets a
+    # conv torso (the catalog's conv_filters path, reference
+    # rllib/models/catalog.py:105; filters auto-sized by resolution
+    # unless given explicitly).
+    obs_shape: Optional[tuple] = None
+    conv_filters: Optional[tuple] = None
     num_actions: int = 2
     hidden: tuple = (64, 64)
     num_env_runners: int = 2
@@ -97,13 +109,18 @@ class PPOConfig(ConfigEvalMixin):
     minibatch_size: int = 128
     seed: int = 0
 
-    def environment(self, env_creator=None, obs_dim=None, num_actions=None):
+    def environment(self, env_creator=None, obs_dim=None, num_actions=None,
+                    obs_shape=None, conv_filters=None):
         if env_creator is not None:
             self.env_creator = env_creator
         if obs_dim is not None:
             self.obs_dim = obs_dim
         if num_actions is not None:
             self.num_actions = num_actions
+        if obs_shape is not None:
+            self.obs_shape = tuple(obs_shape)
+        if conv_filters is not None:
+            self.conv_filters = tuple(conv_filters)
         return self
 
     def env_runners(self, num_env_runners=None, rollout_length=None,
@@ -141,8 +158,22 @@ class PPO(AlgorithmBase):
     def __init__(self, config: PPOConfig):
         assert config.env_creator is not None, "config.environment(...) first"
         self.config = config
-        spec = RLModuleSpec(config.obs_dim, config.num_actions, config.hidden)
-        module_factory = self._module_factory = lambda: DiscretePolicyModule(spec)  # noqa: E731
+        if config.obs_shape is not None:
+            spec = ConvModuleSpec(
+                config.obs_shape, config.num_actions,
+                conv_filters=filters_for(config.obs_shape,
+                                         config.conv_filters),
+                hidden=config.hidden[-1:] or (64,),
+            )
+            module_factory = self._module_factory = (  # noqa: E731
+                lambda: ConvPolicyModule(spec)
+            )
+        else:
+            spec = RLModuleSpec(config.obs_dim, config.num_actions,
+                                config.hidden)
+            module_factory = self._module_factory = (  # noqa: E731
+                lambda: DiscretePolicyModule(spec)
+            )
 
         import optax
 

@@ -15,6 +15,7 @@ from typing import Any, Dict, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.models.conv import TINY_FILTERS, cnn_torso_forward, init_cnn_torso
 from ray_tpu.models.mlp import init_mlp, mlp_forward
 
 
@@ -25,6 +26,20 @@ class RLModuleSpec:
     obs_dim: int
     num_actions: int
     hidden: Tuple[int, ...] = (64, 64)
+
+
+@dataclass(frozen=True)
+class ConvModuleSpec:
+    """Spec for image-observation modules (the conv_filters catalog
+    path, reference rllib/models/catalog.py:105-116): obs are
+    (H, W, C) float frames; conv_filters is (out_ch, kernel, stride)
+    per layer."""
+
+    obs_shape: Tuple[int, int, int]
+    num_actions: int
+    conv_filters: Tuple[Tuple[int, int, int], ...] = TINY_FILTERS
+    feature_dim: int = 128
+    hidden: Tuple[int, ...] = (64,)
 
 
 class DiscretePolicyModule:
@@ -57,6 +72,55 @@ class DiscretePolicyModule:
         return action, chosen_logp, out["value"]
 
 
+def filters_for(obs_shape, conv_filters=None):
+    """Resolution-based default conv filters (the role of the catalog's
+    _get_filter_config, reference rllib/models/catalog.py): explicit
+    filters win; else Atari-scale frames (>=64px) get the 3-layer
+    Nature-CNN stack, tiny test frames the 2-layer stack."""
+    if conv_filters is not None:
+        return tuple(conv_filters)
+    from ray_tpu.models.conv import ATARI_FILTERS, TINY_FILTERS
+
+    return (ATARI_FILTERS
+            if min(obs_shape[0], obs_shape[1]) >= 64 else TINY_FILTERS)
+
+
+class ConvPolicyModule(DiscretePolicyModule):
+    """Conv torso + policy/value heads for image observations.
+
+    The conv analog of DiscretePolicyModule (reference: the vision nets
+    rllib's catalog builds when the obs space is image-shaped,
+    rllib/models/catalog.py:105). One shared torso feeds both heads —
+    the reference's ``vf_share_layers`` default for vision — so the
+    expensive conv features are computed once per step. Sampling and
+    the action distribution are inherited: they only consume forward().
+    """
+
+    def __init__(self, spec: ConvModuleSpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict:
+        kt, kp, kv = jax.random.split(rng, 3)
+        feat = self.spec.feature_dim
+        sizes = [feat, *self.spec.hidden]
+        return {
+            "torso": init_cnn_torso(
+                kt, self.spec.obs_shape, self.spec.conv_filters,
+                out_dim=feat,
+            ),
+            "pi": init_mlp(kp, sizes + [self.spec.num_actions]),
+            "vf": init_mlp(kv, sizes + [1]),
+        }
+
+    def forward(self, params: Dict, obs: jax.Array) -> Dict[str, jax.Array]:
+        feats = cnn_torso_forward(params["torso"], obs,
+                                  self.spec.conv_filters)
+        return {
+            "action_logits": mlp_forward(params["pi"], feats),
+            "value": mlp_forward(params["vf"], feats)[..., 0],
+        }
+
+
 class QNetworkModule:
     """Q-network for value-based algorithms (DQN family).
 
@@ -85,6 +149,32 @@ class QNetworkModule:
         )
         explore = jax.random.uniform(k2, greedy.shape) < epsilon
         return jnp.where(explore, random_a, greedy)
+
+
+class ConvQNetworkModule(QNetworkModule):
+    """Conv torso + Q head for image observations (pixel DQN; the
+    reference's Atari configuration — DQNConfig with conv_filters).
+    Epsilon-greedy sampling is inherited — it only consumes q_values."""
+
+    def __init__(self, spec: ConvModuleSpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict:
+        kt, kq = jax.random.split(rng)
+        feat = self.spec.feature_dim
+        return {
+            "torso": init_cnn_torso(
+                kt, self.spec.obs_shape, self.spec.conv_filters,
+                out_dim=feat,
+            ),
+            "q": init_mlp(kq, [feat, *self.spec.hidden,
+                               self.spec.num_actions]),
+        }
+
+    def forward(self, params: Dict, obs: jax.Array) -> Dict[str, jax.Array]:
+        feats = cnn_torso_forward(params["torso"], obs,
+                                  self.spec.conv_filters)
+        return {"q_values": mlp_forward(params["q"], feats)}
 
 
 class DuelingQNetworkModule(QNetworkModule):

@@ -65,12 +65,14 @@ def n_step_transitions(batch: Dict[str, np.ndarray], ep_ends: np.ndarray,
 class ReplayBuffer:
     def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
                  action_dim: int = 0, store_discounts: bool = False):
-        """action_dim=0 -> discrete int actions; >0 -> float vectors.
+        """obs_dim: flat dim (int) or an obs SHAPE tuple (image envs).
+        action_dim=0 -> discrete int actions; >0 -> float vectors.
         store_discounts: keep a per-transition bootstrap discount
         (gamma**m for m-step windows) alongside the usual fields."""
         self.capacity = capacity
-        self.obs = np.zeros((capacity, obs_dim), dtype=np.float32)
-        self.next_obs = np.zeros((capacity, obs_dim), dtype=np.float32)
+        obs_shape = (obs_dim,) if isinstance(obs_dim, int) else tuple(obs_dim)
+        self.obs = np.zeros((capacity, *obs_shape), dtype=np.float32)
+        self.next_obs = np.zeros((capacity, *obs_shape), dtype=np.float32)
         if action_dim:
             self.actions = np.zeros((capacity, action_dim), dtype=np.float32)
         else:

@@ -358,3 +358,112 @@ def test_ppo_evaluation_and_checkpoint_restore(tmp_path):
         )
     finally:
         algo_b.stop()
+
+
+class PixelSideEnv:
+    """Tiny image-observation env: a bright dot appears on the left or
+    right half of a 12x12 frame; action must name the side (0=left,
+    1=right) for +1. Gymnasium-shaped API (reset/step 5-tuple).
+    Episodes are 16 steps; random policy averages ~8."""
+
+    H = W = 12
+
+    def __init__(self, seed=0):
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._side = 0
+
+    def _obs(self):
+        img = np.zeros((self.H, self.W, 1), dtype=np.float32)
+        row = int(self._rng.integers(2, self.H - 2))
+        col_half = int(self._rng.integers(1, self.W // 2 - 1))
+        col = col_half if self._side == 0 else self.W // 2 + col_half
+        img[row - 1:row + 2, col - 1:col + 2, 0] = 1.0
+        return img
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        self._side = int(self._rng.integers(2))
+        return self._obs(), {}
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._side else 0.0
+        self._t += 1
+        self._side = int(self._rng.integers(2))
+        done = self._t >= 16
+        return self._obs(), reward, done, False, {}
+
+
+def test_conv_module_forward_shapes():
+    import jax
+
+    from ray_tpu.rl.core.rl_module import ConvModuleSpec, ConvPolicyModule
+
+    spec = ConvModuleSpec((12, 12, 1), num_actions=2)
+    mod = ConvPolicyModule(spec)
+    params = mod.init(jax.random.PRNGKey(0))
+    obs = np.zeros((5, 12, 12, 1), dtype=np.float32)
+    out = mod.forward(params, obs)
+    assert out["action_logits"].shape == (5, 2)
+    assert out["value"].shape == (5,)
+    a, logp, v = mod.sample_action(params, obs, jax.random.PRNGKey(1))
+    assert a.shape == (5,) and logp.shape == (5,) and v.shape == (5,)
+
+
+@pytest.mark.usefixtures("rt_start")
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+@pytest.mark.slow
+def test_ppo_pixel_env_improves():
+    """Image-observation PPO (the 'RLlib PPO Atari' north-star shape,
+    BASELINE.json configs: conv torso via obs_shape= instead of
+    obs_dim=). Random policy scores ~8/16 on PixelSideEnv; a learned
+    conv policy must clearly beat it."""
+    config = (
+        PPOConfig()
+        .environment(lambda: PixelSideEnv(), obs_shape=(12, 12, 1),
+                     num_actions=2)
+        .env_runners(num_env_runners=2, rollout_length=128)
+        .training(lr=3e-3, num_epochs=4, minibatch_size=64)
+    )
+    algo = config.build()
+    try:
+        best = 0.0
+        for _ in range(14):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 12.0:
+                break
+        assert best >= 12.0, f"conv policy failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+@pytest.mark.usefixtures("rt_start")
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+@pytest.mark.slow
+def test_dqn_pixel_env_learns():
+    """Pixel DQN smoke: conv Q-network + image replay buffer wire up
+    and improve on PixelSideEnv."""
+    from ray_tpu.rl.algorithms.dqn import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment(lambda: PixelSideEnv(), obs_shape=(12, 12, 1),
+                     num_actions=2)
+        .env_runners(num_env_runners=1, rollout_length=128)
+        .training(lr=3e-3, train_batch_size=64, updates_per_iteration=16,
+                  learning_starts=128, buffer_capacity=4096)
+        .exploration(epsilon_start=1.0, epsilon_end=0.05,
+                     epsilon_decay_iters=4)
+    )
+    algo = config.build()
+    try:
+        best = 0.0
+        for _ in range(8):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 12.0:
+                break
+        assert best >= 12.0, f"pixel DQN failed to learn: best={best}"
+    finally:
+        algo.stop()
