@@ -77,6 +77,64 @@ def main():
         print(json.dumps(entry), flush=True)
         results.append(entry)
 
+    # Continuous batching at mixed arrivals vs static batch=1 (the
+    # serving north-star, BASELINE.json configs[4]): requests join a
+    # running decode loop at step boundaries instead of waiting for the
+    # current batch to finish.
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    n_req = 8 if on_tpu else 6
+    n_tok = 32 if on_tpu else 8
+    cb_prompt_len = min(prompt_len, 64)
+    rng = jax.random.PRNGKey(7)
+    prompts = [
+        list(map(int, jax.device_get(jax.random.randint(
+            jax.random.fold_in(rng, i), (cb_prompt_len,), 0, cfg.vocab_size
+        ))))
+        for i in range(n_req)
+    ]
+    from ray_tpu.models.generate import generate
+
+    # Warm the static path's compilation before timing it (the engine's
+    # warmup request below plays the same role for the continuous path).
+    jax.device_get(generate(
+        params, jnp.asarray([prompts[0]], dtype=jnp.int32), cfg,
+        max_new_tokens=n_tok,
+    ))
+    t0 = time.perf_counter()
+    for p in prompts:
+        jax.device_get(generate(
+            params, jnp.asarray([p], dtype=jnp.int32), cfg,
+            max_new_tokens=n_tok,
+        ))
+    static_s = time.perf_counter() - t0
+
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=4, max_len=cb_prompt_len + n_tok + 1,
+        prefill_buckets=(cb_prompt_len,),
+    )
+    try:
+        eng.submit(prompts[0], max_new_tokens=n_tok).result(timeout=600)
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=n_tok) for p in prompts]
+        for h in handles:
+            h.result(timeout=600)
+        cont_s = time.perf_counter() - t0
+    finally:
+        eng.shutdown()
+    entry = {
+        "metric": "continuous batching tokens/s" + (
+            "/chip" if on_tpu else " (cpu fallback)"
+        ),
+        "requests": n_req,
+        "tokens_per_request": n_tok,
+        "static_batch1_tokens_per_s": round(n_req * n_tok / static_s, 1),
+        "continuous_tokens_per_s": round(n_req * n_tok / cont_s, 1),
+        "speedup_vs_static": round(static_s / cont_s, 2),
+    }
+    print(json.dumps(entry), flush=True)
+    results.append(entry)
+
     with open("BENCH_INFER.json", "w") as f:
         json.dump(results, f, indent=1)
 
