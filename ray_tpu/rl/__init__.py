@@ -18,7 +18,14 @@ from ray_tpu.rl.core.rl_module import (
     C51QNetworkModule,
     DuelingQNetworkModule,
     NoisyQNetworkModule,
+    RecurrentModuleSpec,
+    RecurrentPolicyModule,
     RLModuleSpec,
+)
+from ray_tpu.rl.algorithms.recurrent_ppo import (
+    RecurrentPPO,
+    RecurrentPPOConfig,
+    recurrent_ppo_loss,
 )
 from ray_tpu.rl.env_runner import (
     ContinuousTransitionRunner,
@@ -111,6 +118,11 @@ __all__ = [
     "ConvPolicyModule",
     "ConvQNetworkModule",
     "DiscretePolicyModule",
+    "RecurrentModuleSpec",
+    "RecurrentPolicyModule",
+    "RecurrentPPO",
+    "RecurrentPPOConfig",
+    "recurrent_ppo_loss",
     "DuelingQNetworkModule",
     "EnvRunner",
     "compute_gae",

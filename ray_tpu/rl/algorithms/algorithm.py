@@ -31,6 +31,7 @@ class EvalEnvRunner(_EnvRunnerBase):
         import jax
 
         assert self.params is not None, "set_weights first"
+        stateful = hasattr(self.module, "initial_state")
         if self._sample is None:
             self._sample = jax.jit(self.module.sample_action)
         greedy = None
@@ -44,12 +45,20 @@ class EvalEnvRunner(_EnvRunnerBase):
         for _ in range(num_episodes):
             obs, _ = self.env.reset()
             self._set_obs(obs)
+            state = self.module.initial_state(1) if stateful else None
             total, steps = 0.0, 0
             while steps < max_steps_per_episode:
                 obs_c = self._obs_conn
-                if explore:
+                if explore and stateful:
+                    self.rng, key = jax.random.split(self.rng)
+                    action, _, _, state = self._sample(
+                        self.params, obs_c[None], key, state
+                    )
+                elif explore:
                     self.rng, key = jax.random.split(self.rng)
                     action, _, _ = self._sample(self.params, obs_c[None], key)
+                elif stateful:
+                    action, state = greedy(self.params, obs_c[None], state)
                 else:
                     action = greedy(self.params, obs_c[None])
                 action = np.asarray(action)[0]
@@ -65,9 +74,12 @@ class EvalEnvRunner(_EnvRunnerBase):
             lengths.append(steps)
         return {"returns": returns, "lengths": lengths}
 
-    def _greedy_action(self, params, obs):
+    def _greedy_action(self, params, obs, state=None):
         import jax.numpy as jnp
 
+        if state is not None:  # stateful module: thread the GRU state
+            out, state = self.module.forward_step(params, obs, state)
+            return jnp.argmax(out["action_logits"], axis=-1), state
         if hasattr(self.module, "deterministic_action"):
             return self.module.deterministic_action(params, obs)
         out = self.module.forward(params, obs)

@@ -1,0 +1,153 @@
+"""Recurrent PPO: proximal policy optimization with a stateful policy.
+
+The structural piece the MLP stack cannot express: the policy carries a
+GRU hidden state across steps (reset at episode boundaries), rollouts
+ship the state each window started with, and the learner replays whole
+[B, T] sequences through forward_seq so the recomputed logits/values see
+exactly the states the behavior policy saw.
+
+Reference analog: recurrent-model support + stored-state replay
+(rllib/models/torch/recurrent_net.py, rllib/algorithms/r2d2/ — the
+use_lstm path of PPO's old stack). Minibatching is over SEQUENCES
+(rollout windows), never over shuffled timesteps, which would sever the
+state chain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase
+from ray_tpu.rl.algorithms.ppo import PPOConfig
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.core.rl_module import (
+    RecurrentModuleSpec,
+    RecurrentPolicyModule,
+)
+from ray_tpu.rl.env_runner import RecurrentEnvRunner, compute_gae
+
+
+def recurrent_ppo_loss(params, module, batch):
+    """Clipped-surrogate PPO over [B, T] sequences replayed through the
+    GRU (batch carries state0 [B, H] and dones [B, T])."""
+    out = module.forward_seq(
+        params, batch["obs"], batch["state0"], batch["dones"]
+    )
+    logp_all = jax.nn.log_softmax(out["action_logits"])  # [B, T, A]
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    ratio = jnp.exp(logp - batch["logp"])
+    adv = batch["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    clip = 0.2
+    surr = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+    )
+    policy_loss = -surr.mean()
+    value_loss = ((out["value"] - batch["returns"]) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    loss = policy_loss + 0.5 * value_loss - 0.01 * entropy
+    return loss, {
+        "total_loss": loss,
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+        "kl": (batch["logp"] - logp).mean(),
+    }
+
+
+@dataclass
+class RecurrentPPOConfig(PPOConfig):
+    """PPOConfig plus the recurrent knobs; hidden state size rides
+    state_dim. minibatch_size is ignored (sequence-level batching)."""
+
+    state_dim: int = 32
+
+    def build(self) -> "RecurrentPPO":
+        return RecurrentPPO(self)
+
+
+class RecurrentPPO(AlgorithmBase):
+    def __init__(self, config: RecurrentPPOConfig):
+        assert config.env_creator is not None, "config.environment(...) first"
+        self.config = config
+        spec = RecurrentModuleSpec(
+            config.obs_dim, config.num_actions,
+            state_dim=config.state_dim, hidden=config.hidden[-1:] or (32,),
+        )
+        module_factory = self._module_factory = (  # noqa: E731
+            lambda: RecurrentPolicyModule(spec)
+        )
+        self.learner_group = LearnerGroup(
+            module_factory,
+            recurrent_ppo_loss,
+            num_learners=config.num_learners,
+            seed=config.seed,
+            lr=config.lr,
+        )
+        self.env_runners = [
+            RecurrentEnvRunner.options(num_cpus=0.5).remote(
+                config.env_creator,
+                module_factory,
+                seed=config.seed + 1 + i,
+                rollout_length=config.rollout_length,
+                connectors=(
+                    config.connectors_factory()
+                    if config.connectors_factory else None
+                ),
+                gamma=config.gamma,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self._iteration = 0
+        self._broadcast_weights()
+
+    def _broadcast_weights(self):
+        weights = self.learner_group.get_weights()
+        rt.get([r.set_weights.remote(weights) for r in self.env_runners],
+               timeout=300)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollouts = rt.get(
+            [r.sample.remote() for r in self.env_runners], timeout=600
+        )
+        processed = [compute_gae(b, cfg.gamma, cfg.lambda_) for b in rollouts]
+        # Sequences stay whole: [B, T, ...] with B = rollout windows.
+        batch = {
+            k: np.stack([p[k] for p in processed])
+            for k in ("obs", "actions", "logp", "advantages", "returns",
+                      "dones")
+        }
+        batch["state0"] = np.stack([p["state0"] for p in processed])
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_epochs):
+            metrics = self.learner_group.update_from_batch(batch)
+        self._broadcast_weights()
+        self._iteration += 1
+        stats = rt.get(
+            [r.episode_stats.remote() for r in self.env_runners], timeout=300
+        )
+        returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
+        return self._finish_iteration({
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        })
+
+    def stop(self):
+        self.stop_eval_runners()
+        self.learner_group.shutdown()
+        for r in self.env_runners:
+            try:
+                rt.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

@@ -401,3 +401,101 @@ class ContinuousPolicyModule:
         """EnvRunner-facing: scaled action, logp, dummy value."""
         a, logp = self.sample_with_logp(params, obs, rng)
         return self.scale_action(a), logp, jnp.zeros(obs.shape[0])
+
+
+@dataclass(frozen=True)
+class RecurrentModuleSpec:
+    """Spec for stateful (recurrent) policies. The structural gap the
+    reference fills with recurrent nets + state plumbing
+    (rllib/models/torch/recurrent_net.py; R2D2's stored-state replay):
+    the policy carries a hidden state across steps, reset at episode
+    boundaries, and the learner replays sequences from the state each
+    rollout window started with."""
+
+    obs_dim: int
+    num_actions: int
+    state_dim: int = 32
+    hidden: Tuple[int, ...] = (32,)
+
+
+class RecurrentPolicyModule:
+    """GRU torso + policy/value heads (functional JAX).
+
+    Three entry points: forward_step (one step, rollout time),
+    forward_seq (whole [B, T] window via lax.scan with done-resets,
+    learner time), and sample_action (rollout sampling; returns the new
+    state so the runner can thread it)."""
+
+    def __init__(self, spec: RecurrentModuleSpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict:
+        kw, ku, kp, kv = jax.random.split(rng, 4)
+        d, h = self.spec.obs_dim, self.spec.state_dim
+        sizes = [h, *self.spec.hidden]
+        scale_w = (1.0 / d) ** 0.5
+        scale_u = (1.0 / h) ** 0.5
+        return {
+            # Fused GRU weights: [z | r | candidate].
+            "gru_w": jax.random.normal(kw, (d, 3 * h)) * scale_w,
+            "gru_u": jax.random.normal(ku, (h, 3 * h)) * scale_u,
+            "gru_b": jnp.zeros((3 * h,)),
+            "pi": init_mlp(kp, sizes + [self.spec.num_actions]),
+            "vf": init_mlp(kv, sizes + [1]),
+        }
+
+    def initial_state(self, batch: int = 1) -> jax.Array:
+        return jnp.zeros((batch, self.spec.state_dim))
+
+    def _cell(self, params: Dict, x: jax.Array, h: jax.Array) -> jax.Array:
+        """One GRU step: x [B, D], h [B, H] -> h' [B, H]."""
+        H = self.spec.state_dim
+        gx = x @ params["gru_w"] + params["gru_b"]
+        gh = h @ params["gru_u"]
+        z = jax.nn.sigmoid(gx[:, :H] + gh[:, :H])
+        r = jax.nn.sigmoid(gx[:, H:2 * H] + gh[:, H:2 * H])
+        cand = jnp.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
+        return (1.0 - z) * h + z * cand
+
+    def _heads(self, params: Dict, h: jax.Array) -> Dict[str, jax.Array]:
+        return {
+            "action_logits": mlp_forward(params["pi"], h),
+            "value": mlp_forward(params["vf"], h)[..., 0],
+        }
+
+    def forward_step(self, params: Dict, obs: jax.Array, state: jax.Array):
+        h = self._cell(params, obs, state)
+        return self._heads(params, h), h
+
+    def forward_seq(self, params: Dict, obs: jax.Array, state0: jax.Array,
+                    dones: jax.Array) -> Dict[str, jax.Array]:
+        """Replay a [B, T] window exactly as it was collected: the state
+        enters as state0 (the window's first step) and resets to zero
+        AFTER any step whose done flag is set — matching the runner,
+        which zeroes its state when the env resets."""
+
+        def scan_fn(h, inp):
+            x_t, reset_t = inp
+            h = h * (1.0 - reset_t)[:, None]
+            h = self._cell(params, x_t, h)
+            return h, h
+
+        T = obs.shape[1]
+        # resets[t] = dones[t-1]: state carried INTO step t.
+        resets = jnp.concatenate(
+            [jnp.zeros_like(dones[:, :1]), dones[:, :-1]], axis=1
+        )
+        _, hs = jax.lax.scan(
+            scan_fn, state0,
+            (jnp.swapaxes(obs, 0, 1), jnp.swapaxes(resets, 0, 1)),
+        )
+        hs = jnp.swapaxes(hs, 0, 1)  # [B, T, H]
+        return self._heads(params, hs)
+
+    def sample_action(self, params: Dict, obs: jax.Array, rng: jax.Array,
+                      state: jax.Array):
+        out, h = self.forward_step(params, obs, state)
+        action = jax.random.categorical(rng, out["action_logits"])
+        logp = jax.nn.log_softmax(out["action_logits"])
+        chosen = jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
+        return action, chosen, out["value"], h

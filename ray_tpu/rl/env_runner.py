@@ -493,3 +493,88 @@ class ContinuousTransitionRunner:
 
     def episode_stats(self) -> Dict[str, Any]:
         return self._tracker.stats()
+
+
+@rt.remote
+class RecurrentEnvRunner(_EnvRunnerBase):
+    """On-policy rollouts for stateful policies: the module's hidden
+    state threads through steps, resets with the env, and each window
+    ships the state it STARTED with (plus dones) so the learner can
+    replay the exact sequence (reference analog: the stored-state
+    sequence replay of recurrent nets / R2D2,
+    rllib/models/torch/recurrent_net.py)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._policy_state = None
+
+    def get_runner_state(self) -> Dict[str, Any]:
+        state = super().get_runner_state()
+        # The GRU state is part of "resume sampling bit-exactly": a
+        # zeroed state on a mid-episode observation loses the memory.
+        state["policy_state"] = (
+            None if self._policy_state is None
+            else np.asarray(self._policy_state)
+        )
+        return state
+
+    def set_runner_state(self, state: Dict[str, Any]):
+        super().set_runner_state(state)
+        ps = state.get("policy_state")
+        self._policy_state = None if ps is None else np.asarray(ps)
+        return True
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        import jax
+
+        self._begin_rollout()
+        if self._policy_state is None:
+            self._policy_state = self.module.initial_state(1)
+        T = self.rollout_length
+        state0 = np.asarray(self._policy_state)[0]
+        obs_buf, act_buf, logp_buf, val_buf = [], [], [], []
+        rew_buf, done_buf = [], []
+        for _ in range(T):
+            self.rng, key = jax.random.split(self.rng)
+            obs = self._obs_conn
+            action, logp, value, self._policy_state = self._sample(
+                self.params, obs[None], key, self._policy_state
+            )
+            action = int(np.asarray(action)[0])
+            obs_buf.append(obs)
+            act_buf.append(action)
+            logp_buf.append(float(np.asarray(logp)[0]))
+            val_buf.append(float(np.asarray(value)[0]))
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            rew = self._reward(reward)
+            nxt_conn = self._advance(nxt, reward, terminated, truncated)
+            if truncated and not terminated:
+                # Bootstrap the cut tail under the state the policy
+                # WOULD have had at the final observation.
+                self.rng, key = jax.random.split(self.rng)
+                _, _, v_final, _ = self._sample(
+                    self.params, nxt_conn[None], key, self._policy_state
+                )
+                rew += self.gamma * float(np.asarray(v_final)[0])
+            if terminated or truncated:
+                # The env reset: the policy state resets with it —
+                # exactly what forward_seq's done-driven resets replay.
+                self._policy_state = self.module.initial_state(1)
+            rew_buf.append(rew)
+            done_buf.append(bool(terminated or truncated))
+        obs = self._obs_conn
+        self.rng, key = jax.random.split(self.rng)
+        _, _, last_value, _ = self._sample(
+            self.params, obs[None], key, self._policy_state
+        )
+        return {
+            "obs": np.stack(obs_buf),
+            "actions": np.asarray(act_buf, dtype=np.int32),
+            "logp": np.asarray(logp_buf, dtype=np.float32),
+            "values": np.asarray(val_buf, dtype=np.float32),
+            "rewards": np.asarray(rew_buf, dtype=np.float32),
+            "dones": np.asarray(done_buf, dtype=np.float32),
+            "last_value": float(np.asarray(last_value)[0]),
+            "last_obs": obs,
+            "state0": state0.astype(np.float32),
+        }
