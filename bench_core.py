@@ -163,6 +163,36 @@ def main():
         multiplier=20, duration=duration, results=results,
     )
 
+    # Data -> device feed: zero-copy batching out of the shm store into
+    # a jitted consumer (SURVEY §7 "Plasma<->HBM boundary"; batches are
+    # views over the store until the single host->HBM device_put).
+    import jax
+
+    import ray_tpu.data as rtd
+
+    feed_ds = rtd.from_numpy(
+        {"x": np.arange(256 * 128, dtype=np.float32).reshape(256 * 128)},
+        parallelism=4,
+    )
+
+    @jax.jit
+    def _consume(batch):
+        return batch["x"].sum()
+
+    def feed_batches():
+        n = 0
+        for batch in feed_ds.iter_jax_batches(batch_size=1024):
+            _consume(batch).block_until_ready()
+            n += 1
+        return n
+
+    n_batches = feed_batches()  # warm compile outside the timing window
+    timeit(
+        f"data->device feed ({n_batches} x 1024-row batches, jitted sum)",
+        feed_batches,
+        multiplier=n_batches, duration=duration, results=results,
+    )
+
     if not quick:
         # --quick is a smoke run with 1s windows on a possibly-loaded box;
         # only full runs overwrite the committed artifact.

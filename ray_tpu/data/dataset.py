@@ -402,6 +402,9 @@ class Dataset:
         each batch draws a random permutation from it — cheap
         randomization without a full distributed shuffle.
         """
+        if batch_format == "numpy" and not local_shuffle_buffer_size:
+            yield from self._iter_numpy_batches(batch_size, prefetch_blocks)
+            return
         rng = (
             _random.Random(local_shuffle_seed)
             if local_shuffle_buffer_size else None
@@ -420,6 +423,52 @@ class Dataset:
                 rng.shuffle(carry)
             chunk, carry = carry[:batch_size], carry[batch_size:]
             yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
+
+    def _iter_numpy_batches(self, batch_size: int,
+                            prefetch_blocks: int) -> Iterator:
+        """Zero-copy numpy batching (SURVEY §7 "Plasma<->HBM boundary").
+
+        Arrow blocks come out of the shared-memory store as zero-copy
+        views (pickle5 out-of-band buffers); columns convert to numpy as
+        views over the same buffers, and every batch fully inside one
+        block is a SLICE of those views — no host->host copy anywhere on
+        the path, so a downstream device_put is the feed's only copy
+        (host->HBM). Only batches STRADDLING a block boundary pay one
+        np.concatenate."""
+        import numpy as _np
+
+        carry: Optional[dict] = None
+        carry_rows = 0
+        for block in self._iter_blocks(prefetch_blocks=prefetch_blocks):
+            cols = B.block_to_batch(block, "numpy")
+            if not cols:
+                continue
+            n = len(next(iter(cols.values())))
+            start = 0
+            if carry_rows:
+                need = batch_size - carry_rows
+                if n < need:
+                    carry = {
+                        k: _np.concatenate([carry[k], v])
+                        for k, v in cols.items()
+                    }
+                    carry_rows += n
+                    continue
+                yield {
+                    k: _np.concatenate([carry[k], v[:need]])
+                    for k, v in cols.items()
+                }
+                carry, carry_rows = None, 0
+                start = need
+            while start + batch_size <= n:
+                yield {k: v[start:start + batch_size]
+                       for k, v in cols.items()}
+                start += batch_size
+            if start < n:
+                carry = {k: v[start:] for k, v in cols.items()}
+                carry_rows = n - start
+        if carry_rows:
+            yield carry
 
     def iter_jax_batches(self, batch_size: int = 256, sharding=None,
                          prefetch_blocks: int = 1,

@@ -9,9 +9,12 @@ DataIterator that pulls from its queue. Blocks flow while upstream tasks
 are still running, and every epoch re-executes the pipeline (fresh
 random_shuffle draws etc.).
 
-`equal=True` balances splits by ROW count at block granularity (greedy
-least-loaded dispatch); the reference additionally slices boundary blocks
-for exact row equality.
+`equal=True` is row-EXACT (the reference's semantics): blocks stream to
+the least-loaded split, each split's most recent block is held back, and
+at end of stream the holdbacks are sliced so every split delivers exactly
+total // n rows (up to n-1 remainder rows dropped). Row-exact splits are
+what keeps gang-SPMD training in lockstep — a skewed split means skewed
+worker step counts and a stalled gang.
 """
 
 from __future__ import annotations
@@ -33,6 +36,10 @@ def _split_queue_depth() -> int:
 
 def _block_rows(block) -> int:
     return B.block_num_rows(block)
+
+
+def _block_slice_rows(block, start: int, end: int):
+    return B.block_slice(block, start, end)
 
 
 @rt.remote
@@ -77,40 +84,57 @@ class _SplitCoordinator:
         t.start()
         return True
 
+    def _deliver(self, epoch: int, target: int, ref) -> bool:
+        """Queue one ref for a split with backpressure; False when the
+        epoch was superseded."""
+        with self._cond:
+            while (len(self._queues[target]) >= _split_queue_depth()
+                   and self._epoch == epoch):
+                self._cond.wait(timeout=1.0)
+            if self._epoch != epoch:
+                return False
+            self._queues[target].append(ref)
+            self._cond.notify_all()
+        return True
+
     def _produce(self, epoch: int):
         from ray_tpu.data.executor import StreamingExecutor
 
-        # Fractional CPU: a row count must schedule even on a cluster
-        # whose whole-CPU budget is held by trainer/accumulator actors.
+        # Fractional CPU: row counting / boundary slicing must schedule
+        # even on a cluster whose whole-CPU budget is held by
+        # trainer/accumulator actors.
         count_fn = rt.remote(_block_rows).options(
             max_retries=-1, num_cpus=0.01
         )
         try:
             executor = StreamingExecutor(list(self._stages))
             rr = 0
+            # equal=True state: each split's most recent block stays held
+            # back (ref, nrows) so end-of-stream can slice the boundary.
+            holds: List = [None] * self._n
+            delivered = [0] * self._n
             for ref in executor.execute_iter(self._input_refs):
-                if self._equal:
-                    try:
-                        nrows = rt.get(count_fn.remote(ref), timeout=120)
-                    except Exception:  # noqa: BLE001 — fall back to RR
-                        nrows = 1
-                else:
-                    nrows = 1
-                with self._cond:
-                    if self._equal:
-                        target = min(range(self._n), key=lambda i: self._rows[i])
-                    else:
+                if not self._equal:
+                    with self._cond:
                         target = rr % self._n
                         rr += 1
-                    # Backpressure: stall until the chosen queue drains.
-                    while (len(self._queues[target]) >= _split_queue_depth()
-                           and self._epoch == epoch):
-                        self._cond.wait(timeout=1.0)
-                    if self._epoch != epoch:
-                        return  # superseded (shutdown/restart)
-                    self._queues[target].append(ref)
+                    if not self._deliver(epoch, target, ref):
+                        return
+                    continue
+                nrows = rt.get(count_fn.remote(ref), timeout=120)
+                with self._lock:
+                    target = min(range(self._n), key=lambda i: self._rows[i])
                     self._rows[target] += nrows
-                    self._cond.notify_all()
+                if holds[target] is not None:
+                    prev_ref, prev_rows = holds[target]
+                    if not self._deliver(epoch, target, prev_ref):
+                        return
+                    delivered[target] += prev_rows
+                holds[target] = (ref, nrows)
+            if self._equal and not self._finish_equal(
+                epoch, holds, delivered
+            ):
+                return
         except Exception as e:  # noqa: BLE001 — surface to consumers
             with self._cond:
                 self._producer_error = f"{type(e).__name__}: {e}"
@@ -118,6 +142,52 @@ class _SplitCoordinator:
             with self._cond:
                 self._producer_done = True
                 self._cond.notify_all()
+
+    def _finish_equal(self, epoch: int, holds: List,
+                      delivered: List[int]) -> bool:
+        """End-of-stream equalizer: slice the held-back boundary blocks
+        so every split delivers exactly total // n rows (reference:
+        dataset.py:1161 equal=True semantics; up to n-1 remainder rows
+        drop). The greedy least-loaded invariant guarantees each split's
+        excess over the global share fits inside its own holdback."""
+        slice_fn = rt.remote(_block_slice_rows).options(
+            max_retries=-1, num_cpus=0.01
+        )
+        total = sum(delivered) + sum(h[1] for h in holds if h)
+        share = total // self._n
+        pool: deque = deque()  # (ref, offset, remaining) spare rows
+        plans: List[List] = [[] for _ in range(self._n)]
+        needs = [0] * self._n
+        for i in range(self._n):
+            need = share - delivered[i]
+            if holds[i] is not None:
+                ref, nrows = holds[i]
+                take = min(need, nrows)
+                if take == nrows:
+                    plans[i].append((ref, nrows))
+                elif take > 0:
+                    plans[i].append((slice_fn.remote(ref, 0, take), take))
+                if nrows - take > 0:
+                    pool.append((ref, take, nrows - take))
+                need -= take
+            needs[i] = need
+        for i in range(self._n):
+            while needs[i] > 0:
+                ref, off, rem = pool.popleft()
+                take = min(needs[i], rem)
+                plans[i].append(
+                    (slice_fn.remote(ref, off, off + take), take)
+                )
+                needs[i] -= take
+                if rem - take > 0:
+                    pool.appendleft((ref, off + take, rem - take))
+        for i, plan in enumerate(plans):
+            for ref, nrows in plan:
+                if not self._deliver(epoch, i, ref):
+                    return False
+        with self._lock:
+            self._rows = [share] * self._n
+        return True
 
     def next_blocks(self, epoch: int, split_idx: int, max_blocks: int = 2):
         """Blocking pull: up to max_blocks refs for one split, or

@@ -28,32 +28,38 @@ from ray_tpu.rl.core.rl_module import (
 from ray_tpu.rl.env_runner import EnvRunner, compute_gae
 
 
-def ppo_loss(params, module, batch):
-    """Clipped-surrogate PPO loss (standard formulation)."""
-    out = module.forward(params, batch["obs"])
+def clipped_surrogate(out, batch, clip: float = 0.2, vf_coef: float = 0.5,
+                      ent_coef: float = 0.01):
+    """The PPO clipped-surrogate body shared by the MLP/conv and
+    recurrent variants: callers only differ in how `out` (action_logits
+    + value) was computed. Works on any leading shape — logits
+    [..., A], actions/logp/advantages/returns [...]."""
     logp_all = jax.nn.log_softmax(out["action_logits"])
     logp = jnp.take_along_axis(
-        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
-    )[:, 0]
+        logp_all, batch["actions"][..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
     ratio = jnp.exp(logp - batch["logp"])
     adv = batch["advantages"]
     adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-    clip = 0.2
     surr = jnp.minimum(
         ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv
     )
     policy_loss = -surr.mean()
     value_loss = ((out["value"] - batch["returns"]) ** 2).mean()
     entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-    loss = policy_loss + 0.5 * value_loss - 0.01 * entropy
-    metrics = {
+    loss = policy_loss + vf_coef * value_loss - ent_coef * entropy
+    return loss, {
         "total_loss": loss,
         "policy_loss": policy_loss,
         "vf_loss": value_loss,
         "entropy": entropy,
         "kl": (batch["logp"] - logp).mean(),
     }
-    return loss, metrics
+
+
+def ppo_loss(params, module, batch):
+    """Clipped-surrogate PPO loss (standard formulation)."""
+    return clipped_surrogate(module.forward(params, batch["obs"]), batch)
 
 
 def a2c_loss(params, module, batch):

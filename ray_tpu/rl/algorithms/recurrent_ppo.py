@@ -18,13 +18,11 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu as rt
 from ray_tpu.rl.algorithms.algorithm import AlgorithmBase
-from ray_tpu.rl.algorithms.ppo import PPOConfig
+from ray_tpu.rl.algorithms.ppo import PPOConfig, clipped_surrogate
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import (
     RecurrentModuleSpec,
@@ -35,32 +33,12 @@ from ray_tpu.rl.env_runner import RecurrentEnvRunner, compute_gae
 
 def recurrent_ppo_loss(params, module, batch):
     """Clipped-surrogate PPO over [B, T] sequences replayed through the
-    GRU (batch carries state0 [B, H] and dones [B, T])."""
+    GRU (batch carries state0 [B, H] and dones [B, T]); the surrogate
+    body is shared with plain PPO (ppo.clipped_surrogate)."""
     out = module.forward_seq(
         params, batch["obs"], batch["state0"], batch["dones"]
     )
-    logp_all = jax.nn.log_softmax(out["action_logits"])  # [B, T, A]
-    logp = jnp.take_along_axis(
-        logp_all, batch["actions"][..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
-    ratio = jnp.exp(logp - batch["logp"])
-    adv = batch["advantages"]
-    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-    clip = 0.2
-    surr = jnp.minimum(
-        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv
-    )
-    policy_loss = -surr.mean()
-    value_loss = ((out["value"] - batch["returns"]) ** 2).mean()
-    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-    loss = policy_loss + 0.5 * value_loss - 0.01 * entropy
-    return loss, {
-        "total_loss": loss,
-        "policy_loss": policy_loss,
-        "vf_loss": value_loss,
-        "entropy": entropy,
-        "kl": (batch["logp"] - logp).mean(),
-    }
+    return clipped_surrogate(out, batch)
 
 
 @dataclass
@@ -77,6 +55,20 @@ class RecurrentPPOConfig(PPOConfig):
 class RecurrentPPO(AlgorithmBase):
     def __init__(self, config: RecurrentPPOConfig):
         assert config.env_creator is not None, "config.environment(...) first"
+        if config.obs_shape is not None:
+            raise ValueError(
+                "RecurrentPPO takes vector observations (obs_dim=...); "
+                "a conv+recurrent torso is not composed here"
+            )
+        if config.num_learners > config.num_env_runners:
+            # The recurrent batch axis is SEQUENCES (one per runner
+            # window): more learners than runners would shard to empty
+            # batches and train on NaNs.
+            raise ValueError(
+                f"num_learners={config.num_learners} exceeds "
+                f"num_env_runners={config.num_env_runners}; recurrent "
+                "batches shard by rollout window"
+            )
         self.config = config
         spec = RecurrentModuleSpec(
             config.obs_dim, config.num_actions,
@@ -87,7 +79,7 @@ class RecurrentPPO(AlgorithmBase):
         )
         self.learner_group = LearnerGroup(
             module_factory,
-            recurrent_ppo_loss,
+            config.loss_fn or recurrent_ppo_loss,
             num_learners=config.num_learners,
             seed=config.seed,
             lr=config.lr,

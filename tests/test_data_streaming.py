@@ -325,8 +325,8 @@ def test_read_binary_files(rt_start, tmp_path):
 
 def test_streaming_split_coverage_and_epochs(rt_start):
     """streaming_split(n, equal=True): the n iterators cover every row
-    exactly once per epoch, balanced by rows, and re-execute per epoch
-    (reference: dataset.py:1161)."""
+    exactly once per epoch, ROW-EXACTLY equal (boundary blocks sliced),
+    and re-execute per epoch (reference: dataset.py:1161)."""
     import threading
 
     ds = rtd.range(90, parallelism=9).map(lambda r: {"id": r["id"]})
@@ -341,8 +341,31 @@ def test_streaming_split_coverage_and_epochs(rt_start):
         [t.start() for t in ts]
         [t.join(timeout=120) for t in ts]
         assert sorted(x for p in parts for x in p) == list(range(90))
-        sizes = sorted(len(p) for p in parts)
-        assert sizes[-1] - sizes[0] <= 10, sizes  # row-balanced (~30 each)
+        sizes = [len(p) for p in parts]
+        assert sizes == [30, 30, 30], sizes  # row-EXACT
+
+
+def test_streaming_split_equal_slices_uneven_blocks(rt_start):
+    """Row-exact equality with adversarial block sizes: 100 rows in
+    ragged blocks over 3 splits -> 33/33/33 delivered, 1 remainder row
+    dropped (the reference's equal=True contract)."""
+    import threading
+
+    # Ragged blocks: sizes 1..13 (sum 91) plus a 9-row block = 100 rows.
+    ds = rtd.range(100, parallelism=7)
+    its = ds.streaming_split(3, equal=True)
+    parts = [[] for _ in range(3)]
+
+    def consume(i):
+        parts[i] = [r["id"] for r in its[i].iter_rows()]
+
+    ts = [threading.Thread(target=consume, args=(i,)) for i in range(3)]
+    [t.start() for t in ts]
+    [t.join(timeout=120) for t in ts]
+    sizes = [len(p) for p in parts]
+    assert sizes == [33, 33, 33], sizes
+    seen = sorted(x for p in parts for x in p)
+    assert len(seen) == 99 and len(set(seen)) == 99  # 1 row dropped, no dupes
 
 
 def test_trainer_streaming_ingestion_multi_epoch(tmp_path):
