@@ -381,75 +381,122 @@ def worker(platform: str) -> None:
         cfg = replace(configs.tiny, remat=False)
         batch, seq, steps, warmup = 8, 64, 5, 1
 
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    n_params = sum(x.size for x in jax.tree.leaves(params))
-    optimizer = optax.adamw(1e-4)
-    opt_state = jax.jit(optimizer.init)(params)
-    print(f"[worker] params built: {n_params:,}", file=sys.stderr, flush=True)
+    def _measure(cfg, batch, seq, steps, warmup, tag):
+        """One measured training run. Every step consumes a FRESH random
+        batch (pre-generated on device) so the final loss evidences a
+        working step on unseen data rather than memorization of one
+        batch."""
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        optimizer = optax.adamw(1e-4)
+        opt_state = jax.jit(optimizer.init)(params)
+        print(f"[worker] {tag}: params built: {n_params:,}",
+              file=sys.stderr, flush=True)
 
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
 
-    jstep = jax.jit(step, donate_argnums=(0, 1))
-    tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size
-    )
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        all_tokens = [
+            jax.random.randint(
+                jax.random.fold_in(jax.random.PRNGKey(1), i),
+                (batch, seq + 1), 0, cfg.vocab_size,
+            )
+            for i in range(warmup + steps)
+        ]
 
-    _stage("compile")
-    t0 = time.monotonic()
-    for i in range(warmup):
-        params, opt_state, loss = jstep(params, opt_state, tokens)
-        print(f"[worker] warmup {i + 1}/{warmup}", file=sys.stderr, flush=True)
-    # On remote-tunneled TPU platforms block_until_ready can return before
-    # execution finishes; a device_get of the scalar loss is a true sync.
-    jax.device_get(loss)
-    print(f"[worker] compile+warmup done in {time.monotonic() - t0:.1f}s",
-          file=sys.stderr, flush=True)
-    t0 = time.perf_counter()
-    jax.device_get(loss)
-    round_trip = time.perf_counter() - t0
+        _stage("compile")
+        t0 = time.monotonic()
+        for i in range(warmup):
+            params, opt_state, loss = jstep(params, opt_state, all_tokens[i])
+            print(f"[worker] {tag}: warmup {i + 1}/{warmup}",
+                  file=sys.stderr, flush=True)
+        # On remote-tunneled TPU platforms block_until_ready can return
+        # before execution finishes; a device_get of the scalar loss is a
+        # true sync.
+        jax.device_get(loss)
+        print(
+            f"[worker] {tag}: compile+warmup done in "
+            f"{time.monotonic() - t0:.1f}s",
+            file=sys.stderr, flush=True,
+        )
+        t0 = time.perf_counter()
+        jax.device_get(loss)
+        round_trip = time.perf_counter() - t0
 
-    _stage("run")
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params, opt_state, loss = jstep(params, opt_state, tokens)
-        if (i + 1) % 5 == 0:
-            print(f"[worker] step {i + 1}/{steps}", file=sys.stderr, flush=True)
-    jax.device_get(loss)
-    dt = max(time.perf_counter() - t0 - round_trip, 1e-9)
+        _stage("run")
+        t0 = time.perf_counter()
+        for i in range(steps):
+            params, opt_state, loss = jstep(
+                params, opt_state, all_tokens[warmup + i]
+            )
+            if (i + 1) % 5 == 0:
+                print(f"[worker] {tag}: step {i + 1}/{steps}",
+                      file=sys.stderr, flush=True)
+        jax.device_get(loss)
+        dt = max(time.perf_counter() - t0 - round_trip, 1e-9)
 
-    tokens_per_step = batch * seq
-    tokens_per_sec = tokens_per_step * steps / dt
-    # 6ND training FLOPs convention (fwd 2ND + bwd 4ND), ignoring remat
-    # recompute — the same convention baseline MFU numbers use.
-    flops_per_token = 6.0 * n_params
-    mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
-    vs_baseline = mfu / BASELINE_MFU if on_tpu else 0.0
+        tokens_per_sec = batch * seq * steps / dt
+        # 6ND training FLOPs convention (fwd 2ND + bwd 4ND), ignoring
+        # remat recompute — the same convention baseline MFU numbers use.
+        mfu = tokens_per_sec * 6.0 * n_params / _peak_flops(dev)
+        # 6ND ignores attention's quadratic matmuls, which at long seq
+        # are a real double-digit share of the chip's work: QK^T + PV
+        # fwd ~= 2*seq_avg*2*d_attn per layer-token, x3 for training.
+        d_attn = cfg.n_heads * cfg.head_dim
+        attn_flops_per_token = 6.0 * cfg.n_layers * seq * d_attn / 2 * 2
+        mfu_attn = (
+            tokens_per_sec * (6.0 * n_params + attn_flops_per_token)
+            / _peak_flops(dev)
+        )
+        return {
+            "value": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4),
+            "mfu_with_attention": round(mfu_attn, 4),
+            "batch": batch,
+            "seq": seq,
+            "params": n_params,
+            "loss": float(jax.device_get(loss)),
+        }
 
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "llama2(0.8B) train-step tokens/s/chip"
-                    if on_tpu
-                    else "tiny train-step tokens/s (cpu fallback)"
-                ),
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(vs_baseline, 3),
-                "mfu": round(mfu, 4),
-                "batch": batch,
-                "seq": seq,
-                "params": n_params,
-                "device": str(dev),
-                "loss": float(jax.device_get(loss)),
-            }
+    result = _measure(cfg, batch, seq, steps, warmup, f"seq{seq}")
+
+    long_context = None
+    if on_tpu:
+        # Long-context variant AFTER the headline (its failure must
+        # never cost the headline number): same 0.8B proxy at seq 4096
+        # with the flash-attention kernel in the hot path — the regime
+        # ring attention / flash blocks exist for. batch x seq stays
+        # 8192 tokens/step.
+        try:
+            lc_cfg = replace(cfg, max_seq=4096)
+            long_context = _measure(lc_cfg, 2, 4096, steps, warmup,
+                                    "seq4096")
+            print(f"[worker] long-context: {long_context}",
+                  file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — optional extra point
+            long_context = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[worker] long-context failed: {e}", file=sys.stderr,
+                  flush=True)
+    out = {
+        "metric": (
+            "llama2(0.8B) train-step tokens/s/chip"
+            if on_tpu
+            else "tiny train-step tokens/s (cpu fallback)"
         ),
-        flush=True,
-    )
+        "unit": "tokens/s/chip",
+        "vs_baseline": (
+            round(result["mfu"] / BASELINE_MFU, 3) if on_tpu else 0.0
+        ),
+        "device": str(dev),
+        **result,
+    }
+    if long_context is not None:
+        out["long_context"] = long_context
+    print(json.dumps(out), flush=True)
 
 
 def main() -> int:

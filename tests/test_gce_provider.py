@@ -1,0 +1,190 @@
+"""GCETPUNodeProvider against a recorded/mock Cloud TPU API surface.
+
+The provider's only IO is transport.request(method, url, body); this mock
+models the tpu.googleapis.com v2 node lifecycle (create/delete as async
+operations, list with states + labels) the way the real API answers —
+the same recorded-surface pattern as test_gke_provider.py (reference:
+autoscaler/_private/gcp/node_provider.py drives the identical REST
+surface in production).
+"""
+
+import re
+
+import pytest
+
+from ray_tpu.autoscaler.node_provider import GCETPUNodeProvider
+
+
+class MockTPUAPI:
+    def __init__(self):
+        self.nodes = {}  # node_id -> node dict
+        self._op_counter = 0
+        self._pending = {}  # op name -> (polls_left, error_or_None, finalize)
+        self.calls = []
+        self.quota_denied = False
+
+    def request(self, method, url, body=None):
+        self.calls.append((method, url, body))
+        if method == "POST" and "/nodes?nodeId=" in url:
+            node_id = url.rsplit("nodeId=", 1)[1]
+            if self.quota_denied:
+                return self._op(error={"code": 8, "message":
+                                       "RESOURCE_EXHAUSTED: quota"})
+
+            def finalize():
+                self.nodes[node_id] = {
+                    "name": f"projects/p/locations/z/nodes/{node_id}",
+                    "state": "READY",
+                    "labels": body.get("labels", {}),
+                    "acceleratorType": body["acceleratorType"],
+                    "networkEndpoints": [
+                        {"ipAddress": f"10.0.0.{i}"} for i in range(4)
+                    ],
+                }
+            return self._op(finalize=finalize)
+        if method == "DELETE" and "/nodes/" in url:
+            # The provider fires-and-forgets deletes; model the node
+            # leaving the fleet once the request is accepted.
+            node_id = url.rsplit("/", 1)[1]
+            self.nodes.pop(node_id, None)
+            return self._op()
+        if method == "GET" and url.endswith("/nodes"):
+            return {"nodes": list(self.nodes.values())}
+        if method == "GET" and "/operations/" in url:
+            name = url.split("/projects/", 1)[1]
+            name = "projects/" + name
+            polls, error, finalize = self._pending[name]
+            polls -= 1
+            if polls > 0:
+                self._pending[name] = (polls, error, finalize)
+                return {"name": name, "done": False}
+            if error:
+                return {"name": name, "done": True, "error": error}
+            if finalize:
+                finalize()
+            return {"name": name, "done": True, "response": {}}
+        raise AssertionError(f"unexpected TPU API call: {method} {url}")
+
+    def _op(self, error=None, finalize=None):
+        self._op_counter += 1
+        name = f"projects/p/locations/z/operations/op-{self._op_counter}"
+        self._pending[name] = (2, error, finalize)  # done after 2 polls
+        return {"name": name, "done": False}
+
+
+@pytest.fixture
+def provider():
+    api = MockTPUAPI()
+    p = GCETPUNodeProvider("p", "z", transport=api, poll_interval_s=0.0)
+    return p, api
+
+
+def test_create_is_slice_atomic(provider):
+    p, api = provider
+    ids = p.create_node("tpu_v5e_16", {"accelerator_type": "v5litepod-16"}, 2)
+    assert len(ids) == 2
+    assert set(p.non_terminated_nodes()) == set(ids)
+    # Each created node is one whole slice with its worker endpoints.
+    for nid in ids:
+        tags = p.node_tags(nid)
+        assert tags["rt-node-type"] == "tpu_v5e_16"
+        assert tags["rt-workers"] == "4"
+        assert tags["rt-state"] == "READY"
+
+
+def test_create_passes_config_through(provider):
+    p, api = provider
+    p.create_node(
+        "tpu", {
+            "accelerator_type": "v5litepod-16",
+            "runtime_version": "tpu-vm-v4-base",
+            "network": "projects/p/global/networks/default",
+            "metadata": {"startup-script": "rt start --join"},
+            "labels": {"team": "ml"},
+        }, 1,
+    )
+    method, url, body = api.calls[0]
+    assert body["runtimeVersion"] == "tpu-vm-v4-base"
+    assert body["networkConfig"]["network"].endswith("default")
+    assert body["metadata"]["startup-script"].startswith("rt start")
+    assert body["labels"]["rt-managed"] == "1"
+    assert body["labels"]["team"] == "ml"
+
+
+def test_terminate_removes_slice(provider):
+    p, api = provider
+    (nid,) = p.create_node("tpu", {"accelerator_type": "v5litepod-16"}, 1)
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_restarted_provider_rediscovers_fleet(provider):
+    """Node enumeration comes from the live API + labels, never from
+    in-process memory (a restarted head must still see running slices)."""
+    p, api = provider
+    ids = p.create_node("tpu", {"accelerator_type": "v5litepod-16"}, 2)
+    fresh = GCETPUNodeProvider("p", "z", transport=api, poll_interval_s=0.0)
+    assert set(fresh.non_terminated_nodes()) == set(ids)
+    assert fresh.node_tags(ids[0])["rt-node-type"] == "tpu"
+
+
+def test_unmanaged_and_dead_nodes_excluded(provider):
+    p, api = provider
+    (nid,) = p.create_node("tpu", {"accelerator_type": "v5litepod-16"}, 1)
+    # Someone else's TPU in the same zone: no rt-managed label.
+    api.nodes["other"] = {
+        "name": "projects/p/locations/z/nodes/other",
+        "state": "READY", "labels": {},
+    }
+    # A slice the platform already tore down.
+    api.nodes["dead"] = {
+        "name": "projects/p/locations/z/nodes/dead",
+        "state": "TERMINATED", "labels": {"rt-managed": "1"},
+    }
+    assert p.non_terminated_nodes() == [nid]
+
+
+def test_quota_denial_raises_with_slice_attribution(provider):
+    p, api = provider
+    api.quota_denied = True
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        p.create_node("tpu", {"accelerator_type": "v5litepod-16"}, 3)
+    assert p.non_terminated_nodes() == []
+
+
+def test_op_timeout_raises(provider):
+    p, api = provider
+    p.op_timeout_s = 0.01
+
+    # An op that never completes.
+    def never_done(method, url, body=None):
+        api.calls.append((method, url, body))
+        if method == "POST":
+            return {"name": "projects/p/locations/z/operations/op-hang",
+                    "done": False}
+        return {"name": url, "done": False}
+
+    p.transport = type("T", (), {"request": staticmethod(never_done)})()
+    with pytest.raises(TimeoutError):
+        p.create_node("tpu", {"accelerator_type": "v5litepod-16"}, 1)
+
+
+def test_provider_registry():
+    from ray_tpu.autoscaler.node_provider import (
+        GCETPUNodeProvider as GCE,
+        GKETPUNodeProvider as GKE,
+        make_node_provider,
+    )
+
+    api = MockTPUAPI()
+    p = make_node_provider(
+        {"type": "gce_tpu", "project": "p", "zone": "z", "transport": api}
+    )
+    assert isinstance(p, GCE)
+    g = make_node_provider(
+        {"type": "gke", "project": "p", "zone": "z", "cluster": "c",
+         "transport": api}
+    )
+    assert isinstance(g, GKE)
+    with pytest.raises(ValueError, match="unknown provider"):
+        make_node_provider({"type": "azure"})
