@@ -22,14 +22,19 @@ from ray_tpu.data.dataset import (
     from_numpy,
     from_pandas,
     range_dataset as range,  # noqa: A001 — mirrors ray.data.range
+    read_bigquery,
     read_binary_files,
     read_images,
+    read_mongo,
     read_numpy,
     read_csv,
     read_datasource,
     read_json,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [

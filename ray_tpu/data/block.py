@@ -60,10 +60,18 @@ def block_to_batch(block: Block, batch_format: str = "numpy"):
         )
     if batch_format == "numpy":
         if isinstance(block, pa.Table):
-            return {
-                name: np.asarray(col.to_numpy(zero_copy_only=False))
-                for name, col in zip(block.column_names, block.columns)
-            }
+            from ray_tpu.data.tensor import is_tensor_type, tensor_to_numpy
+
+            out = {}
+            for name, col in zip(block.column_names, block.columns):
+                if is_tensor_type(col.type):
+                    # (N, *shape) view over the storage buffer.
+                    out[name] = tensor_to_numpy(col)
+                else:
+                    out[name] = np.asarray(
+                        col.to_numpy(zero_copy_only=False)
+                    )
+            return out
         rows = block_to_rows(block)
         if rows and isinstance(rows[0], dict):
             keys = rows[0].keys()

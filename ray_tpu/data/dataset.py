@@ -1101,3 +1101,58 @@ def read_text(path: str, parallelism: int = 4) -> Dataset:
         _GLOB = "*.txt"
 
     return read_datasource(_TxtSource(path), parallelism)
+
+
+def read_sql(sql: str, connection_factory, parallelism: int = 1,
+             shard_column: str = None) -> Dataset:
+    """Rows from a SQL query over a DB-API connection factory
+    (reference: ray.data.read_sql). shard_column enables hash-sharded
+    parallel reads."""
+    from ray_tpu.data.connectors import SQLDatasource
+
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_column), parallelism
+    )
+
+
+def read_tfrecords(path: str, parallelism: int = 4, filesystem=None,
+                   raw: bool = False) -> Dataset:
+    """TFRecord files of tf.train.Examples -> feature-dict rows
+    (reference: ray.data.read_tfrecords; no tensorflow needed — the
+    Example wire codec is built in)."""
+    from ray_tpu.data.connectors import TFRecordDatasource
+
+    return read_datasource(
+        TFRecordDatasource(path, filesystem, raw=raw), parallelism
+    )
+
+
+def read_webdataset(path: str, parallelism: int = 4,
+                    filesystem=None) -> Dataset:
+    """WebDataset tar shards -> one row per sample stem (reference:
+    ray.data.read_webdataset)."""
+    from ray_tpu.data.connectors import WebDatasetDatasource
+
+    return read_datasource(
+        WebDatasetDatasource(path, filesystem), parallelism
+    )
+
+
+def read_mongo(db: str, collection: str, client_factory,
+               filter: dict = None,  # noqa: A002 — pymongo name
+               parallelism: int = 1) -> Dataset:
+    """Documents from a MongoDB collection via an injectable pymongo-
+    surface client factory (reference: ray.data.read_mongo)."""
+    from ray_tpu.data.connectors import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(db, collection, client_factory, filter), parallelism
+    )
+
+
+def read_bigquery(sql: str, client, parallelism: int = 1) -> Dataset:
+    """Rows from a BigQuery query via an injectable client (reference:
+    ray.data.read_bigquery)."""
+    from ray_tpu.data.connectors import BigQueryDatasource
+
+    return read_datasource(BigQueryDatasource(sql, client), parallelism)

@@ -339,8 +339,23 @@ class NumpyDatasource(Datasource):
         per = (n + parallelism - 1) // parallelism
         arrays = self.arrays
 
+        import numpy as _np
+
+        multi_dim = any(
+            getattr(_np.asarray(arrays[k]), "ndim", 1) > 1 for k in keys
+        )
+
         def make(lo, hi):
             def read():
+                if multi_dim:
+                    # Tensor columns: the slice stays ONE arrow column
+                    # (FixedSizeList storage) instead of N row objects —
+                    # zero-copy batching then applies to tensors too.
+                    from ray_tpu.data.tensor import table_with_tensors
+
+                    return [table_with_tensors(
+                        {k: arrays[k][lo:hi] for k in keys}
+                    )]
                 rows = [
                     {k: _np_item(arrays[k][i]) for k in keys}
                     for i in range(lo, hi)

@@ -9,6 +9,7 @@ the pipeline scheduler.
 
 from ray_tpu.models.conv import (
     ATARI_FILTERS,
+    RESNET_CONFIGS,
     ResNetConfig,
     TINY_FILTERS,
     cnn_torso_forward,
@@ -31,6 +32,7 @@ from ray_tpu.models.generate import decode_step, generate, init_kv_cache, prefil
 
 __all__ = [
     "ResNetConfig",
+    "RESNET_CONFIGS",
     "init_resnet",
     "resnet_forward",
     "resnet_loss",

@@ -140,6 +140,23 @@ class ResNetConfig:
     dtype: object = jnp.float32
 
 
+# Named presets (the JaxTrainer ResNet north-star shapes). resnet50 here
+# is the 2-conv-per-block (basic, not bottleneck) layout at resnet50's
+# stage depths — same parameter regime, simpler block; documented
+# divergence from torchvision's bottleneck blocks.
+RESNET_CONFIGS = {
+    "resnet18-cifar": ResNetConfig(stage_sizes=(2, 2, 2, 2), width=64),
+    "resnet18": ResNetConfig(
+        num_classes=1000, stage_sizes=(2, 2, 2, 2), width=64,
+        stem_kernel=7, stem_stride=2, dtype=jnp.bfloat16,
+    ),
+    "resnet50": ResNetConfig(
+        num_classes=1000, stage_sizes=(3, 4, 6, 3), width=64,
+        stem_kernel=7, stem_stride=2, dtype=jnp.bfloat16,
+    ),
+}
+
+
 def _init_block(key, cin: int, cout: int, cfg: ResNetConfig) -> Dict:
     k1, k2, k3 = jax.random.split(key, 3)
     block = {

@@ -1,0 +1,291 @@
+"""Connector datasources: SQL (real sqlite3), TFRecords (wire codec),
+WebDataset tar shards, Mongo/BigQuery recorded fakes, tensor columns.
+
+Reference model: python/ray/data/tests per-datasource suites; SQL runs
+against a REAL DB-API driver (stdlib sqlite3), the cloud-shaped sources
+against injected fakes (the GKE-provider recorded-surface pattern).
+"""
+
+import os
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+import ray_tpu.data as rtd
+
+pytestmark = pytest.mark.usefixtures("rt_start")
+
+
+# ---------------------------------------------------------------------------
+# SQL
+# ---------------------------------------------------------------------------
+
+
+def _sqlite_factory(path):
+    def factory():
+        import sqlite3
+
+        return sqlite3.connect(path)
+    return factory
+
+
+def test_read_sql_roundtrip(tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (id INTEGER, name TEXT)")
+    conn.executemany(
+        "INSERT INTO users VALUES (?, ?)",
+        [(i, f"user{i}") for i in range(20)],
+    )
+    conn.commit()
+    conn.close()
+
+    ds = rtd.read_sql("SELECT * FROM users", _sqlite_factory(db))
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert len(rows) == 20
+    assert rows[3] == {"id": 3, "name": "user3"}
+
+
+def test_read_sql_sharded(tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE nums (id INTEGER)")
+    conn.executemany("INSERT INTO nums VALUES (?)", [(i,) for i in range(30)])
+    conn.commit()
+    conn.close()
+
+    ds = rtd.read_sql("SELECT * FROM nums", _sqlite_factory(db),
+                      parallelism=3, shard_column="id")
+    ids = sorted(r["id"] for r in ds.take_all())
+    assert ids == list(range(30))
+
+
+def test_write_sql_datasink(tmp_path):
+    import sqlite3
+
+    from ray_tpu.data.connectors import SQLDatasink
+
+    db = str(tmp_path / "out.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE out (id INTEGER, sq INTEGER)")
+    conn.commit()
+    conn.close()
+
+    ds = rtd.range(10, parallelism=2).map(
+        lambda r: {"id": r["id"], "sq": r["id"] ** 2}
+    )
+    ds.write_datasink(SQLDatasink("out", _sqlite_factory(db)))
+    conn = sqlite3.connect(db)
+    rows = sorted(conn.execute("SELECT id, sq FROM out").fetchall())
+    conn.close()
+    assert rows == [(i, i * i) for i in range(10)]
+
+
+# ---------------------------------------------------------------------------
+# TFRecords
+# ---------------------------------------------------------------------------
+
+
+def test_example_wire_codec_roundtrip():
+    from ray_tpu.data.connectors import decode_example, encode_example
+
+    features = {
+        "label": 7,
+        "name": b"cat",
+        "weights": [0.25, 0.5],
+        "ids": [1, 2, 300000],
+        "neg": -5,
+    }
+    decoded = decode_example(encode_example(features))
+    assert decoded["label"] == [7]
+    assert decoded["name"] == [b"cat"]
+    assert decoded["ids"] == [1, 2, 300000]
+    assert decoded["neg"] == [-5]
+    np.testing.assert_allclose(decoded["weights"], [0.25, 0.5], rtol=1e-6)
+
+
+def test_tfrecords_write_read_roundtrip(tmp_path):
+    from ray_tpu.data.connectors import TFRecordDatasink
+
+    out_dir = str(tmp_path / "records")
+    ds = rtd.range(12, parallelism=3).map(
+        lambda r: {"id": r["id"], "name": f"row{r['id']}"}
+    )
+    ds.write_datasink(TFRecordDatasink(out_dir))
+    assert len(os.listdir(out_dir)) == 3  # one shard per write task
+
+    back = rtd.read_tfrecords(out_dir, parallelism=3)
+    rows = sorted(back.take_all(), key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == list(range(12))
+    assert rows[5]["name"] == b"row5"  # bytes_list: bytes out
+
+
+def test_tfrecords_crc_layout(tmp_path):
+    """The written framing matches the TFRecord spec byte layout
+    (u64 len + masked crc32c(len) + data + masked crc32c(data)) — the
+    compatibility contract with real TF readers."""
+    from ray_tpu.data.connectors import (
+        _masked_crc, encode_example, TFRecordDatasink,
+    )
+
+    out_dir = str(tmp_path / "r")
+    rtd.from_items([{"x": 1}], parallelism=1).write_datasink(
+        TFRecordDatasink(out_dir)
+    )
+    raw = open(os.path.join(out_dir, os.listdir(out_dir)[0]), "rb").read()
+    (length,) = struct.unpack_from("<Q", raw, 0)
+    (len_crc,) = struct.unpack_from("<I", raw, 8)
+    data = raw[12:12 + length]
+    (data_crc,) = struct.unpack_from("<I", raw, 12 + length)
+    assert len_crc == _masked_crc(raw[:8])
+    assert data_crc == _masked_crc(data)
+    assert data == encode_example({"x": 1})
+
+
+# ---------------------------------------------------------------------------
+# WebDataset
+# ---------------------------------------------------------------------------
+
+
+def test_read_webdataset(tmp_path):
+    from PIL import Image
+
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for key in ("a", "b"):
+            img_path = tmp_path / f"{key}.png"
+            Image.fromarray(
+                np.full((4, 4, 3), ord(key), dtype=np.uint8)
+            ).save(img_path)
+            tar.add(img_path, arcname=f"{key}.png")
+            cls_path = tmp_path / f"{key}.cls"
+            cls_path.write_text(str(ord(key)))
+            tar.add(cls_path, arcname=f"{key}.cls")
+
+    ds = rtd.read_webdataset(str(tmp_path), parallelism=1)
+    rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+    assert [r["__key__"] for r in rows] == ["a", "b"]
+    assert rows[0]["cls"] == ord("a")
+    assert rows[0]["png"].shape == (4, 4, 3)
+    assert rows[0]["png"][0, 0, 0] == ord("a")
+
+
+# ---------------------------------------------------------------------------
+# Mongo / BigQuery fakes
+# ---------------------------------------------------------------------------
+
+
+class _FakeMongo:
+    """pymongo surface: client[db][coll].find(filter)."""
+
+    def __init__(self, docs):
+        self._docs = docs
+
+    def __getitem__(self, db):
+        return self
+
+    def find(self, flt):
+        return [
+            d for d in self._docs
+            if all(d.get(k) == v for k, v in flt.items())
+        ]
+
+
+def test_read_mongo_fake():
+    docs = [{"_id": i, "v": i * 10} for i in range(6)]
+    ds = rtd.read_mongo(
+        "db", "coll", lambda: _FakeMongo(docs), filter={"v": 30}
+    )
+    assert ds.take_all() == [{"_id": 3, "v": 30}]
+    ds2 = rtd.read_mongo("db", "coll", lambda: _FakeMongo(docs))
+    assert len(ds2.take_all()) == 6
+
+
+class _FakeBQ:
+    def query(self, sql):
+        class _Job:
+            def result(self):
+                return [{"n": i, "sql_len": len(sql)} for i in range(4)]
+        return _Job()
+
+
+def test_read_bigquery_fake():
+    ds = rtd.read_bigquery("SELECT 1", _FakeBQ())
+    rows = ds.take_all()
+    assert len(rows) == 4 and rows[0]["sql_len"] == len("SELECT 1")
+
+
+# ---------------------------------------------------------------------------
+# Tensor extension
+# ---------------------------------------------------------------------------
+
+
+def test_tensor_columns_zero_copy_batches():
+    """Multi-dim from_numpy columns become arrow tensor columns, survive
+    the store, and batch as zero-copy reshaped views (the image version
+    of the Plasma<->HBM boundary)."""
+    imgs = np.arange(10 * 4 * 4 * 3, dtype=np.float32).reshape(10, 4, 4, 3)
+    labels = np.arange(10, dtype=np.int64)
+    ds = rtd.from_numpy({"img": imgs, "y": labels}, parallelism=2)
+    batches = list(ds.iter_batches(batch_size=5, batch_format="numpy"))
+    got = np.concatenate([b["img"] for b in batches])
+    np.testing.assert_array_equal(np.sort(got.ravel()),
+                                  np.sort(imgs.ravel()))
+    for b in batches:
+        assert b["img"].shape[1:] == (4, 4, 3)
+        assert not b["img"].flags.owndata  # view over the block buffer
+
+
+def test_tensor_table_roundtrip_through_store():
+    from ray_tpu.data.tensor import table_with_tensors, tensor_to_numpy
+
+    arr = np.random.default_rng(0).normal(size=(6, 2, 3)).astype(np.float32)
+    t = table_with_tensors({"x": arr})
+    ref = rt.put(t)
+    out = rt.get(ref)
+    back = tensor_to_numpy(out.column("x"))
+    np.testing.assert_array_equal(back, arr)
+    assert not back.flags.owndata
+
+
+def test_read_sql_sharded_null_keys_not_dropped(tmp_path):
+    """NULL shard keys land in shard 0 instead of vanishing (COALESCE
+    in the shard predicate)."""
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE nums (id INTEGER)")
+    conn.executemany("INSERT INTO nums VALUES (?)",
+                     [(i,) for i in range(10)] + [(None,)] * 3)
+    conn.commit()
+    conn.close()
+    ds = rtd.read_sql("SELECT * FROM nums", _sqlite_factory(db),
+                      parallelism=3, shard_column="id")
+    rows = ds.take_all()
+    assert len(rows) == 13
+    assert sum(1 for r in rows if r["id"] is None) == 3
+
+
+def test_decode_example_unpacked_int64():
+    """Legal unpacked Int64List encoding (one varint field per value,
+    proto2-style writers) decodes like the packed form."""
+    from ray_tpu.data.connectors import (
+        _len_field, _varint, decode_example,
+    )
+
+    # Feature { int64_list { value: 5 value: -2 } } with UNPACKED values
+    # (field 1, wire type 0, one per value).
+    unpacked = (_varint(1 << 3 | 0) + _varint(5)
+                + _varint(1 << 3 | 0) + _varint((-2) & (2 ** 64 - 1)))
+    feature = _len_field(3, unpacked)
+    entry = _len_field(1, b"ids") + _len_field(2, feature)
+    example = _len_field(1, _len_field(1, entry))
+    assert decode_example(example)["ids"] == [5, -2]

@@ -174,3 +174,63 @@ def test_cpu_pool_single_host(provider):
     ids = p.create_node("cpu", {"node_pool": "cpu-pool"}, count=2)
     assert len(ids) == 2
     assert mock.pools["cpu-pool"]["size"] == 3
+
+
+def test_quota_denied_operation_raises(provider):
+    """A setSize whose operation completes with an error (quota denial)
+    must surface as an exception, not silently return zero nodes."""
+    p, mock = provider
+    real_request = mock.request
+
+    def request(method, url, body=None):
+        out = real_request(method, url, body)
+        if "/operations/" in url and out.get("status") == "DONE":
+            out["error"] = {"code": 8, "message":
+                            "RESOURCE_EXHAUSTED: TPU quota exceeded"}
+        return out
+
+    mock.request = request
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        p.create_node(
+            "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, 1
+        )
+
+
+def test_partial_resize_returns_only_new_instances(provider):
+    """A node-pool resize the platform only partially honors (stockout:
+    target 8, delivered 6) must report exactly the instances that exist
+    — the autoscaler re-requests the shortfall next tick rather than
+    double-counting phantom hosts."""
+    p, mock = provider
+    real_request = mock.request
+
+    def request(method, url, body=None):
+        if ":setSize" in url:
+            body = dict(body)
+            body["nodeCount"] = min(body["nodeCount"], 6)  # stockout at 6
+        return real_request(method, url, body)
+
+    mock.request = request
+    ids = p.create_node(
+        "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, 2
+    )
+    assert len(ids) == 6  # what actually exists, not the 8 requested
+    assert len(p.non_terminated_nodes()) >= 6
+
+
+def test_operation_timeout_raises(provider):
+    p, mock = provider
+    p.op_timeout_s = 0.01
+    real_request = mock.request
+
+    def request(method, url, body=None):
+        out = real_request(method, url, body)
+        if "/operations/" in url:
+            out["status"] = "RUNNING"  # never completes
+        return out
+
+    mock.request = request
+    with pytest.raises(TimeoutError):
+        p.create_node(
+            "v5e-16", {"node_pool": "tpu-v5e-16", "slice_hosts": 4}, 1
+        )

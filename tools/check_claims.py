@@ -1,0 +1,158 @@
+"""Doc-vs-artifact claim checker.
+
+Perf numbers quoted in README.md / COMPONENTS.md drift from the
+committed JSON artifacts as rounds iterate (flagged in two consecutive
+verdicts) — and one stale number means a reader can trust none of them.
+This tool pins every quoted number to its artifact: each CLAIM names a
+doc file, a regex whose group(1) captures the quoted value, a getter
+into the artifact JSON, and a tolerance. The test suite runs it
+(test_bench_harness.py), so a doc edit that outruns its artifact — or a
+regenerated artifact that outruns the docs — fails CI.
+
+Run directly for a report:  python tools/check_claims.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Callable, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name: str):
+    with open(os.path.join(REPO, name)) as f:
+        return json.load(f)
+
+
+def _bench_core(metric_sub: str, field: str):
+    def get():
+        for e in _load("BENCH_CORE.json"):
+            if metric_sub in e.get("benchmark", ""):
+                return e[field]
+        raise KeyError(f"no BENCH_CORE entry matching {metric_sub!r}")
+    return get
+
+
+def _bench_scale_broadcast(nodes: int, field: str):
+    def get():
+        for e in _load("BENCH_SCALE.json"):
+            if e.get("probe", "").endswith(f"broadcast to {nodes} nodes"):
+                return e[field]
+        raise KeyError(f"no broadcast-to-{nodes} probe in BENCH_SCALE.json")
+    return get
+
+
+def _bench_scale_tasks(n: int, field: str):
+    def get():
+        for e in _load("BENCH_SCALE.json"):
+            if e.get("probe") == "cost_curves":
+                for pt in e["tasks"]:
+                    if pt["n"] == n:
+                        return pt[field]
+        raise KeyError(f"no tasks curve point n={n} in BENCH_SCALE.json")
+    return get
+
+
+def _bench_r(field: str, sub: str = None):
+    def get():
+        d = _load("BENCH_TPU_LIVE.json")
+        if sub:
+            d = d[sub]
+        return d[field]
+    return get
+
+
+class Claim:
+    def __init__(self, doc: str, pattern: str, getter: Callable,
+                 rel_tol: float = 0.15, scale: float = 1.0,
+                 note: str = ""):
+        self.doc = doc
+        self.pattern = pattern
+        self.getter = getter
+        self.rel_tol = rel_tol
+        self.scale = scale  # doc units -> artifact units (k -> 1000)
+        self.note = note
+
+    def check(self) -> List[str]:
+        """Returns a list of problem strings (empty = ok)."""
+        path = os.path.join(REPO, self.doc)
+        text = open(path).read()
+        matches = re.findall(self.pattern, text)
+        if not matches:
+            return [f"{self.doc}: pattern {self.pattern!r} not found "
+                    f"(doc rewritten? update tools/check_claims.py)"]
+        try:
+            actual = float(self.getter())
+        except (KeyError, FileNotFoundError) as e:
+            return [f"{self.doc}: artifact lookup failed for "
+                    f"{self.pattern!r}: {e}"]
+        problems = []
+        for m in matches:
+            quoted = float(m) * self.scale
+            if actual == 0:
+                ok = quoted == 0
+            else:
+                ok = abs(quoted - actual) / abs(actual) <= self.rel_tol
+            if not ok:
+                problems.append(
+                    f"{self.doc}: quoted {quoted:g} vs artifact "
+                    f"{actual:g} (pattern {self.pattern!r}"
+                    f"{'; ' + self.note if self.note else ''})"
+                )
+        return problems
+
+
+CLAIMS = [
+    # README headline flagship numbers <- live TPU artifact.
+    Claim("README.md", r"MFU (0\.\d+)", _bench_r("mfu"), rel_tol=0.08),
+    Claim("README.md", r"(\d+\.\d+)k tokens/s/chip", _bench_r("value"),
+          scale=1000.0, rel_tol=0.08),
+    # README pipelined throughput <- BENCH_CORE.
+    Claim("README.md", r"~(\d+\.?\d*)k pipelined tasks/s",
+          _bench_core("tasks async", "ops_per_s"), scale=1000.0,
+          rel_tol=0.2),
+    Claim("README.md", r"~(\d+\.?\d*)k pipelined actor calls",
+          _bench_core("actor calls async", "ops_per_s"), scale=1000.0,
+          rel_tol=0.2),
+    Claim("README.md", r"actor register\+ready\+call ~(\d+)/s",
+          _bench_core("register+ready", "ops_per_s"), rel_tol=0.35),
+    # COMPONENTS direct-transport tasks/s <- BENCH_CORE.
+    Claim("COMPONENTS.md", r"~(\d+\.?\d*)k pipelined tasks/s",
+          _bench_core("tasks async", "ops_per_s"), scale=1000.0,
+          rel_tol=0.2),
+    # COMPONENTS broadcast wall clock <- BENCH_SCALE steady-state.
+    Claim("COMPONENTS.md", r"256MB->4 nodes (\d+\.?\d*)s",
+          _bench_scale_broadcast(4, "wall_s"), rel_tol=0.5,
+          note="steady-state broadcast wall"),
+    Claim("README.md", r"\*\*(0\.\d+)s to 4\s*\n?\s*nodes",
+          _bench_scale_broadcast(4, "wall_s"), rel_tol=0.5),
+    Claim("README.md", r"(\d+)µs/task on one core",
+          _bench_scale_tasks(1_000_000, "us_per_task"), rel_tol=0.3),
+    # COMPONENTS flagship MFU <- live TPU artifact.
+    Claim("COMPONENTS.md", r"MFU (0\.\d+)", _bench_r("mfu"), rel_tol=0.08),
+]
+
+
+def check_all() -> List[str]:
+    problems: List[str] = []
+    for claim in CLAIMS:
+        problems.extend(claim.check())
+    return problems
+
+
+def main() -> int:
+    problems = check_all()
+    if problems:
+        for p in problems:
+            print(f"STALE: {p}")
+        return 1
+    print(f"all {len(CLAIMS)} doc claims match their artifacts")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
