@@ -20,6 +20,7 @@ from ray_tpu.rl.core.rl_module import (
     NoisyQNetworkModule,
     RecurrentModuleSpec,
     RecurrentPolicyModule,
+    RecurrentQNetworkModule,
     RLModuleSpec,
 )
 from ray_tpu.rl.algorithms.recurrent_ppo import (
@@ -27,6 +28,7 @@ from ray_tpu.rl.algorithms.recurrent_ppo import (
     RecurrentPPOConfig,
     recurrent_ppo_loss,
 )
+from ray_tpu.rl.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rl.env_runner import (
     ContinuousTransitionRunner,
     EnvRunner,
@@ -120,8 +122,11 @@ __all__ = [
     "DiscretePolicyModule",
     "RecurrentModuleSpec",
     "RecurrentPolicyModule",
+    "RecurrentQNetworkModule",
     "RecurrentPPO",
     "RecurrentPPOConfig",
+    "R2D2",
+    "R2D2Config",
     "recurrent_ppo_loss",
     "DuelingQNetworkModule",
     "EnvRunner",

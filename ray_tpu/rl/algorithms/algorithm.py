@@ -49,14 +49,26 @@ class EvalEnvRunner(_EnvRunnerBase):
             total, steps = 0.0, 0
             while steps < max_steps_per_episode:
                 obs_c = self._obs_conn
-                if explore and stateful:
+                if explore:
                     self.rng, key = jax.random.split(self.rng)
-                    action, _, _, state = self._sample(
-                        self.params, obs_c[None], key, state
-                    )
-                elif explore:
-                    self.rng, key = jax.random.split(self.rng)
-                    action, _, _ = self._sample(self.params, obs_c[None], key)
+                    args = [self.params, obs_c[None], key]
+                    if stateful:
+                        args.append(state)
+                    if self._eval_epsilon() is not None:
+                        # Value modules explore epsilon-greedily; 0.05
+                        # is the conventional eval epsilon (without it
+                        # explore mode would silently equal greedy).
+                        args.append(self._eval_epsilon())
+                    out = self._sample(*args)
+                    # Normalize: policy modules return (a, logp, v[,
+                    # state]); value modules (a[, state]) or a bare
+                    # action array.
+                    if not isinstance(out, (tuple, list)):
+                        action = out
+                    else:
+                        action = out[0]
+                        if stateful:
+                            state = out[-1]
                 elif stateful:
                     action, state = greedy(self.params, obs_c[None], state)
                 else:
@@ -74,12 +86,30 @@ class EvalEnvRunner(_EnvRunnerBase):
             lengths.append(steps)
         return {"returns": returns, "lengths": lengths}
 
+    def _eval_epsilon(self):
+        """0.05 for modules whose sample_action takes an epsilon (the
+        value-based family), None for policy modules."""
+        import inspect
+
+        if not hasattr(self, "_eval_eps_cached"):
+            try:
+                params = inspect.signature(
+                    self.module.sample_action
+                ).parameters
+                self._eval_eps_cached = 0.05 if "epsilon" in params else None
+            except (TypeError, ValueError):
+                self._eval_eps_cached = None
+        return self._eval_eps_cached
+
     def _greedy_action(self, params, obs, state=None):
         import jax.numpy as jnp
 
         if state is not None:  # stateful module: thread the GRU state
             out, state = self.module.forward_step(params, obs, state)
-            return jnp.argmax(out["action_logits"], axis=-1), state
+            logits = out.get("action_logits")
+            if logits is None:  # recurrent Q module
+                logits = out["q_values"]
+            return jnp.argmax(logits, axis=-1), state
         if hasattr(self.module, "deterministic_action"):
             return self.module.deterministic_action(params, obs)
         out = self.module.forward(params, obs)

@@ -1,0 +1,334 @@
+"""R2D2: recurrent replay distributed DQN.
+
+Analog of the reference's R2D2 (rllib/algorithms/r2d2/): value-based
+learning with a recurrent (GRU) Q-network over SEQUENCE replay — the
+buffer stores fixed-length windows with the hidden state each window
+started from (stored-state strategy), the learner replays whole windows
+through the GRU with a burn-in prefix that refreshes the state under
+current weights but takes no gradient, and targets are double-DQN over
+the target network's replay of the same window.
+
+TPU-first: one jitted update consumes a [B, T, ...] window batch; the
+GRU scan, burn-in masking, double-Q argmax, and Huber loss all live in
+one compiled program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rl.algorithms.algorithm import AlgorithmBase, ConfigEvalMixin
+from ray_tpu.rl.core.rl_module import (
+    RecurrentModuleSpec,
+    RecurrentQNetworkModule,
+)
+from ray_tpu.rl.env_runner import _EnvRunnerBase
+
+
+@rt.remote
+class RecurrentWindowRunner(_EnvRunnerBase):
+    """Collects fixed-length windows for sequence replay: each window
+    ships the GRU state it STARTED from plus per-step
+    (obs, action, reward, done) — the stored-state scheme R2D2 uses."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._policy_state = None
+
+    def sample(self, epsilon: float = 0.0) -> Dict[str, np.ndarray]:
+        import jax as _jax
+
+        self._begin_rollout()
+        if self._policy_state is None:
+            self._policy_state = self.module.initial_state(1)
+        T = self.rollout_length
+        state0 = np.asarray(self._policy_state)[0]
+        obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+        for _ in range(T):
+            self.rng, key = _jax.random.split(self.rng)
+            obs = self._obs_conn
+            action, self._policy_state = self._sample(
+                self.params, obs[None], key, self._policy_state, epsilon
+            )
+            action = int(np.asarray(action)[0])
+            obs_buf.append(obs)
+            act_buf.append(action)
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            rew = self._reward(reward)
+            self._advance(nxt, reward, terminated, truncated)
+            if terminated or truncated:
+                self._policy_state = self.module.initial_state(1)
+            rew_buf.append(rew)
+            done_buf.append(bool(terminated or truncated))
+        return {
+            "obs": np.stack(obs_buf).astype(np.float32),
+            "actions": np.asarray(act_buf, dtype=np.int32),
+            "rewards": np.asarray(rew_buf, dtype=np.float32),
+            "dones": np.asarray(done_buf, dtype=np.float32),
+            "state0": state0.astype(np.float32),
+        }
+
+
+class SequenceReplayBuffer:
+    """Uniform ring buffer of windows (reference:
+    rllib/utils/replay_buffers storing SampleBatch sequences)."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._items: list = []
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, window: Dict[str, np.ndarray]):
+        if len(self._items) < self.capacity:
+            self._items.append(window)
+        else:
+            self._items[self._next] = window
+            self._next = (self._next + 1) % self.capacity
+
+    def __len__(self):
+        return len(self._items)
+
+    def sample(self, n: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, len(self._items), size=n)
+        return {
+            k: np.stack([self._items[i][k] for i in idx])
+            for k in self._items[0]
+        }
+
+
+def r2d2_update_fn(module, gamma: float, burn_in: int):
+    """One jitted update over a [B, T] window batch.
+
+    Burn-in: the first `burn_in` steps replay only to refresh the GRU
+    state (their TD terms are masked out of the loss). Targets are
+    within-window double-DQN: a* from the online replay at t+1, value
+    from the target replay at t+1; the window's last step has no
+    in-window successor and is masked too."""
+
+    def loss_fn(params, target_params, batch):
+        q_online = module.forward_seq(
+            params, batch["obs"], batch["state0"], batch["dones"]
+        )["q_values"]                                   # [B, T, A]
+        q_target = module.forward_seq(
+            target_params, batch["obs"], batch["state0"], batch["dones"]
+        )["q_values"]
+        q_taken = jnp.take_along_axis(
+            q_online, batch["actions"][..., None].astype(jnp.int32), -1
+        )[..., 0]                                       # [B, T]
+        a_star = jnp.argmax(q_online[:, 1:], axis=-1)   # [B, T-1]
+        next_v = jnp.take_along_axis(
+            q_target[:, 1:], a_star[..., None], -1
+        )[..., 0]
+        r = batch["rewards"][:, :-1]
+        nonterminal = 1.0 - batch["dones"][:, :-1]
+        td_target = r + gamma * nonterminal * jax.lax.stop_gradient(next_v)
+        td = q_taken[:, :-1] - td_target
+        T = q_taken.shape[1]
+        mask = (jnp.arange(T - 1) >= burn_in).astype(jnp.float32)[None, :]
+        # Huber on the TD error (R2D2 uses clipped/rescaled losses; the
+        # invertible value rescaling is omitted at these reward scales).
+        huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td ** 2,
+                          jnp.abs(td) - 0.5)
+        loss = (huber * mask).sum() / jnp.maximum(mask.sum() * td.shape[0], 1)
+        return loss, {"td_loss": loss,
+                      "q_mean": (q_taken[:, :-1] * mask).mean()}
+
+    return loss_fn
+
+
+@dataclass
+class R2D2Config(ConfigEvalMixin):
+    env_creator: Optional[Callable] = None
+    obs_dim: int = 4
+    num_actions: int = 2
+    state_dim: int = 32
+    hidden: tuple = (32,)
+    num_env_runners: int = 2
+    window_length: int = 16
+    burn_in: int = 2
+    buffer_capacity: int = 2000       # windows
+    learning_starts: int = 32         # windows before updates begin
+    train_batch_size: int = 16        # windows per update
+    updates_per_iteration: int = 16
+    target_update_freq: int = 2       # iterations between target syncs
+    lr: float = 1e-3
+    gamma: float = 0.99
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_iters: int = 10
+    seed: int = 0
+    connectors_factory: Optional[Callable] = None
+
+    def environment(self, env_creator=None, obs_dim=None, num_actions=None):
+        if env_creator is not None:
+            self.env_creator = env_creator
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def env_runners(self, num_env_runners=None, window_length=None,
+                    connectors_factory=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if window_length is not None:
+            self.window_length = window_length
+        if connectors_factory is not None:
+            self.connectors_factory = connectors_factory
+        return self
+
+    def training(self, lr=None, gamma=None, train_batch_size=None,
+                 updates_per_iteration=None, target_update_freq=None,
+                 buffer_capacity=None, learning_starts=None, burn_in=None):
+        for name, val in (
+            ("lr", lr), ("gamma", gamma),
+            ("train_batch_size", train_batch_size),
+            ("updates_per_iteration", updates_per_iteration),
+            ("target_update_freq", target_update_freq),
+            ("buffer_capacity", buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("burn_in", burn_in),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def exploration(self, epsilon_start=None, epsilon_end=None,
+                    epsilon_decay_iters=None):
+        for name, val in (
+            ("epsilon_start", epsilon_start),
+            ("epsilon_end", epsilon_end),
+            ("epsilon_decay_iters", epsilon_decay_iters),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "R2D2":
+        return R2D2(self)
+
+
+class R2D2(AlgorithmBase):
+    def __init__(self, config: R2D2Config):
+        assert config.env_creator is not None, "config.environment(...) first"
+        import optax
+
+        self.config = config
+        spec = RecurrentModuleSpec(
+            config.obs_dim, config.num_actions,
+            state_dim=config.state_dim, hidden=config.hidden,
+        )
+        self.module = RecurrentQNetworkModule(spec)
+        self._module_factory = lambda: RecurrentQNetworkModule(spec)  # noqa: E731
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        self.target_params = self.params
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(10.0), optax.adam(config.lr)
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        loss_fn = r2d2_update_fn(self.module, config.gamma, config.burn_in)
+
+        def update(params, target_params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        self._update = jax.jit(update)
+        self.buffer = SequenceReplayBuffer(config.buffer_capacity,
+                                           seed=config.seed)
+        self.env_runners = [
+            RecurrentWindowRunner.options(num_cpus=0.5).remote(
+                config.env_creator,
+                self._module_factory,
+                seed=config.seed + 1 + i,
+                rollout_length=config.window_length,
+                connectors=(config.connectors_factory()
+                            if config.connectors_factory else None),
+                gamma=config.gamma,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self._iteration = 0
+        self._broadcast_weights()
+
+    # AlgorithmBase state hooks (save/restore without a LearnerGroup).
+    def _get_learner_state(self):
+        return {
+            "params": jax.device_get(self.params),
+            "target_params": jax.device_get(self.target_params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def _set_learner_state(self, state):
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+        self._broadcast_weights()
+
+    def _current_weights(self):
+        return jax.device_get(self.params)
+
+    def _broadcast_weights(self):
+        weights = jax.device_get(self.params)
+        rt.get([r.set_weights.remote(weights) for r in self.env_runners],
+               timeout=300)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._iteration / max(cfg.epsilon_decay_iters, 1))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end -
+                                           cfg.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        eps = self._epsilon()
+        windows = rt.get(
+            [r.sample.remote(eps) for r in self.env_runners], timeout=600
+        )
+        for w in windows:
+            self.buffer.add(w)
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                self.params, self.opt_state, m = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    {k: jnp.asarray(v) for k, v in batch.items()},
+                )
+                metrics = {k: float(v) for k, v in m.items()}
+        if self._iteration % cfg.target_update_freq == 0:
+            self.target_params = self.params
+        self._broadcast_weights()
+        self._iteration += 1
+        stats = rt.get(
+            [r.episode_stats.remote() for r in self.env_runners], timeout=300
+        )
+        returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
+        return self._finish_iteration({
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(returns)) if returns else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "buffer_windows": len(self.buffer),
+            "epsilon": eps,
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        })
+
+    def stop(self):
+        self.stop_eval_runners()
+        for r in self.env_runners:
+            try:
+                rt.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

@@ -499,3 +499,36 @@ class RecurrentPolicyModule:
         logp = jax.nn.log_softmax(out["action_logits"])
         chosen = jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
         return action, chosen, out["value"], h
+
+
+class RecurrentQNetworkModule(RecurrentPolicyModule):
+    """GRU torso + Q head: the value-based stateful module (R2D2's
+    network shape, reference rllib/algorithms/r2d2/). Shares the GRU
+    cell and state plumbing with the policy variant; only the heads
+    differ (Q values instead of policy/value towers)."""
+
+    def init(self, rng: jax.Array) -> Dict:
+        kw, ku, kq = jax.random.split(rng, 3)
+        d, h = self.spec.obs_dim, self.spec.state_dim
+        return {
+            "gru_w": jax.random.normal(kw, (d, 3 * h)) * (1.0 / d) ** 0.5,
+            "gru_u": jax.random.normal(ku, (h, 3 * h)) * (1.0 / h) ** 0.5,
+            "gru_b": jnp.zeros((3 * h,)),
+            "q": init_mlp(kq, [h, *self.spec.hidden,
+                               self.spec.num_actions]),
+        }
+
+    def _heads(self, params: Dict, h: jax.Array) -> Dict[str, jax.Array]:
+        return {"q_values": mlp_forward(params["q"], h)}
+
+    def sample_action(self, params: Dict, obs: jax.Array, rng: jax.Array,
+                      state: jax.Array, epsilon: float = 0.0):
+        out, h = self.forward_step(params, obs, state)
+        q = out["q_values"]
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(rng)
+        random_a = jax.random.randint(
+            k1, greedy.shape, 0, self.spec.num_actions
+        )
+        explore = jax.random.uniform(k2, greedy.shape) < epsilon
+        return jnp.where(explore, random_a, greedy), h

@@ -102,7 +102,38 @@ def _layer_body(x, lp, k_cache_l, v_cache_l, cfg, cos, sin, positions,
     return x, k_cache_l, v_cache_l
 
 
+MAX_TOP_K = 64  # per-slot top-k cap (static shape for lax.top_k)
+
+
+def _pick_tokens(logits, temps, top_ks, top_ps, key):
+    """Per-slot next-token selection on device: greedy where temp == 0,
+    else temperature-scaled sampling with optional per-slot top-k
+    (0 = off, capped at MAX_TOP_K) and top-p (1.0 = off) filtering —
+    generate.py's sampling semantics, vectorized over slots so mixed
+    greedy/sampled requests share one decode batch."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    # top-k: threshold each row at its k-th largest value.
+    topv = jax.lax.top_k(scaled, MAX_TOP_K)[0]  # [S, K] sorted desc
+    idx = jnp.clip(top_ks - 1, 0, MAX_TOP_K - 1)
+    kth = jnp.take_along_axis(topv, idx[:, None], axis=-1)
+    scaled = jnp.where((top_ks > 0)[:, None] & (scaled < kth),
+                       -jnp.inf, scaled)
+    # top-p: smallest prefix of the sorted distribution reaching p.
+    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps[:, None]
+    thr = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                  keepdims=True)
+    scaled = jnp.where(scaled < thr, -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
 def _decode_slots(params, tokens, k_cache, v_cache, lengths, active,
+                  temps, top_ks, top_ps, key,
                   cfg: TransformerConfig):
     """One decode step for every slot at once.
 
@@ -145,10 +176,15 @@ def _decode_slots(params, tokens, k_cache, v_cache, lengths, active,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = project_logits(x[:, -1], params, cfg)
     new_lengths = jnp.where(active, lengths + 1, lengths)
-    # Greedy next token computed ON DEVICE so the engine can feed it
-    # straight into the next dispatched step without a host round trip
-    # (the pipelining that hides host/RTT latency behind decode).
-    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Next token computed ON DEVICE so the engine can feed it straight
+    # into the next dispatched step without a host round trip (the
+    # pipelining that hides host/RTT latency behind decode). temps=None
+    # compiles the greedy-only program: no top-k/sort/softmax work on
+    # the latency-critical all-greedy path.
+    if temps is None:
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_tokens = _pick_tokens(logits, temps, top_ks, top_ps, key)
     return next_tokens, k_new, v_new, new_lengths
 
 
@@ -212,6 +248,10 @@ class GenerationHandle:
         self.max_new_tokens = 0
         self.produced = 0
         self.admitted_at_step = -1
+        # Sampling params (0 temperature = greedy).
+        self.temperature = 0.0
+        self.top_k = 0
+        self.top_p = 1.0
 
     # -- engine side --
     def _push(self, token: int, done: bool):
@@ -266,7 +306,7 @@ class ContinuousBatchingEngine:
     def __init__(self, params, cfg: TransformerConfig, num_slots: int = 4,
                  max_len: int = 256, eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32,
-                 prefill_buckets=(16, 64, 256)):
+                 prefill_buckets=(16, 64, 256), seed: int = 0):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -281,10 +321,19 @@ class ContinuousBatchingEngine:
         cache = init_slotted_cache(cfg, num_slots, max_len)
         self._k, self._v = cache["k"], cache["v"]
         self._lengths = cache["lengths"]
-        self._decode = jax.jit(
-            lambda p, t, k, v, ln, a: _decode_slots(p, t, k, v, ln, a, cfg),
+        self._decode_sampled = jax.jit(
+            lambda p, t, k, v, ln, a, tp, tk, tpp, key: _decode_slots(
+                p, t, k, v, ln, a, tp, tk, tpp, key, cfg
+            ),
             donate_argnums=(2, 3),
         )
+        self._decode_greedy = jax.jit(
+            lambda p, t, k, v, ln, a: _decode_slots(
+                p, t, k, v, ln, a, None, None, None, None, cfg
+            ),
+            donate_argnums=(2, 3),
+        )
+        self._pick = jax.jit(_pick_tokens)
         self._prefill = jax.jit(
             lambda p, t, n, s, k, v, ln: _prefill_slot(p, t, n, s, k, v,
                                                        ln, cfg),
@@ -302,6 +351,11 @@ class ContinuousBatchingEngine:
         # Per-slot admission generation: suppresses the one in-flight
         # token a just-evicted slot still produces under the lag.
         self._gen = np.zeros(num_slots, dtype=np.int64)
+        # Per-slot sampling params, refreshed at admission.
+        self._temps = np.zeros(num_slots, dtype=np.float32)
+        self._top_ks = np.zeros(num_slots, dtype=np.int32)
+        self._top_ps = np.ones(num_slots, dtype=np.float32)
+        self._rng = jax.random.PRNGKey(seed)
         self._next_id = 0
         self._steps = 0  # decode-step counter (observability + tests)
         self._running = True
@@ -311,8 +365,16 @@ class ContinuousBatchingEngine:
         self._thread.start()
 
     # -- public API ------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: Optional[int] = None
-               ) -> GenerationHandle:
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> GenerationHandle:
+        """temperature=0 decodes greedily (the default); >0 samples,
+        optionally filtered by per-request top_k (<= MAX_TOP_K) and
+        top_p — mixed greedy/sampled requests share one decode batch."""
+        if top_k is not None and not 0 < top_k <= MAX_TOP_K:
+            raise ValueError(f"top_k must be in (0, {MAX_TOP_K}]")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -330,6 +392,9 @@ class ContinuousBatchingEngine:
             self._next_id += 1
             h.prompt = prompt
             h.max_new_tokens = int(max_new_tokens)
+            h.temperature = float(temperature)
+            h.top_k = int(top_k or 0)
+            h.top_p = float(1.0 if top_p is None else top_p)
             self._waiting.append(h)
         self._work.set()
         return h
@@ -389,7 +454,20 @@ class ContinuousBatchingEngine:
                 jnp.int32(len(h.prompt)), jnp.int32(slot),
                 self._k, self._v, self._lengths,
             )
-            tok = int(jax.device_get(jnp.argmax(logits, -1))[0])
+            self._temps[slot] = h.temperature
+            self._top_ks[slot] = h.top_k
+            self._top_ps[slot] = h.top_p
+            if h.temperature > 0:
+                self._rng, key = jax.random.split(self._rng)
+                tok = int(jax.device_get(self._pick(
+                    logits,
+                    jnp.full(1, h.temperature, jnp.float32),
+                    jnp.full(1, h.top_k, jnp.int32),
+                    jnp.full(1, h.top_p, jnp.float32),
+                    key,
+                ))[0])
+            else:
+                tok = int(jax.device_get(jnp.argmax(logits, -1))[0])
             h.produced = 1
             h.admitted_at_step = self._steps
             done = (tok == self.eos_id if self.eos_id is not None
@@ -422,10 +500,24 @@ class ContinuousBatchingEngine:
                     active = np.zeros(self.num_slots, dtype=bool)
                     for s, _, _ in snapshot:
                         active[s] = True
-                    next_dev, self._k, self._v, self._lengths = self._decode(
-                        self.params, self._tokens_dev,
-                        self._k, self._v, self._lengths, jnp.asarray(active),
-                    )
+                    if float(self._temps[active].max(initial=0.0)) > 0:
+                        self._rng, step_key = jax.random.split(self._rng)
+                        (next_dev, self._k, self._v,
+                         self._lengths) = self._decode_sampled(
+                            self.params, self._tokens_dev,
+                            self._k, self._v, self._lengths,
+                            jnp.asarray(active),
+                            jnp.asarray(self._temps),
+                            jnp.asarray(self._top_ks),
+                            jnp.asarray(self._top_ps), step_key,
+                        )
+                    else:
+                        (next_dev, self._k, self._v,
+                         self._lengths) = self._decode_greedy(
+                            self.params, self._tokens_dev,
+                            self._k, self._v, self._lengths,
+                            jnp.asarray(active),
+                        )
                     self._tokens_dev = next_dev
                     new_inflight = (snapshot, next_dev, self._lengths)
                 else:
@@ -496,11 +588,21 @@ class LLMReplica:
             eos_id=eos_id, default_max_new_tokens=default_max_new_tokens,
         )
 
-    def __call__(self, prompt, max_new_tokens: Optional[int] = None):
-        return self.engine.submit(prompt, max_new_tokens).result()
+    def __call__(self, prompt, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
+        return self.engine.submit(
+            prompt, max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p,
+        ).result()
 
-    def stream(self, prompt, max_new_tokens: Optional[int] = None):
-        yield from self.engine.submit(prompt, max_new_tokens)
+    def stream(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None):
+        yield from self.engine.submit(
+            prompt, max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p,
+        )
 
     def stats(self):
         return self.engine.stats()

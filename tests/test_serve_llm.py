@@ -259,3 +259,33 @@ def test_llm_deployment_serving(rt_serve):
         handle.options(stream=True, method_name="stream").remote(prompt)
     )
     assert toks == ref
+
+
+def test_continuous_batching_mixed_sampling():
+    """Per-request sampling params: a sampled (temperature/top_k)
+    request shares the decode batch with a greedy one WITHOUT
+    perturbing the greedy request's exact output."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import generate
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(params, cfg, num_slots=3, max_len=64)
+    try:
+        greedy = eng.submit([3, 7, 11, 2], max_new_tokens=6)
+        sampled = eng.submit([5, 1], max_new_tokens=6,
+                             temperature=0.9, top_k=20, top_p=0.95)
+        g = greedy.result(timeout=180)
+        s = sampled.result(timeout=180)
+        ref = np.asarray(
+            generate(params, jnp.asarray([[3, 7, 11, 2]], dtype=jnp.int32),
+                     cfg, max_new_tokens=6)
+        )[0].tolist()
+        assert g == ref
+        assert len(s) == 6
+        assert all(0 <= t < cfg.vocab_size for t in s)
+    finally:
+        eng.shutdown()
+    with pytest.raises(ValueError):
+        eng.submit([1], top_k=10_000)  # beyond MAX_TOP_K
