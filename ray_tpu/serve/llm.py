@@ -306,19 +306,37 @@ class ContinuousBatchingEngine:
     def __init__(self, params, cfg: TransformerConfig, num_slots: int = 4,
                  max_len: int = 256, eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32,
-                 prefill_buckets=(16, 64, 256), seed: int = 0):
+                 prefill_buckets=(16, 64, 256), seed: int = 0,
+                 mesh=None):
+        """mesh: a jax.sharding.Mesh with a "tp" axis for tensor-
+        parallel serving (the pods layout): pass params already sharded
+        via parallel.shard_params and the engine lays the KV cache out
+        with KV heads split over tp — decode collectives then ride ICI
+        inside the compiled step (GSPMD inserts them)."""
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_len = max_len
         self.eos_id = eos_id
         self.default_max_new_tokens = default_max_new_tokens
+        self.mesh = mesh
         # Buckets are clamped to max_len: a prompt that fits max_len
         # must never round up to an update wider than the cache.
         self.prefill_buckets = tuple(sorted(
             {min(int(b), max_len) for b in prefill_buckets}
         ))
-        cache = init_slotted_cache(cfg, num_slots, max_len)
+        if mesh is not None:
+            if "tp" not in mesh.shape:
+                raise ValueError(
+                    "the engine's mesh needs a \"tp\" axis (KV heads "
+                    f"shard over it); got axes {tuple(mesh.shape)}"
+                )
+            if cfg.n_kv_heads % mesh.shape["tp"]:
+                raise ValueError(
+                    f"the mesh's tp={mesh.shape['tp']} must divide "
+                    f"n_kv_heads={cfg.n_kv_heads}"
+                )
+        cache = self._fresh_cache()
         self._k, self._v = cache["k"], cache["v"]
         self._lengths = cache["lengths"]
         self._decode_sampled = jax.jit(
@@ -363,6 +381,23 @@ class ContinuousBatchingEngine:
             target=self._loop, name="llm-engine", daemon=True
         )
         self._thread.start()
+
+    def _fresh_cache(self) -> Dict:
+        cache = init_slotted_cache(self.cfg, self.num_slots, self.max_len)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kv_sharding = NamedSharding(
+                self.mesh, P(None, None, None, "tp", None)
+            )
+            cache = {
+                "k": jax.device_put(cache["k"], kv_sharding),
+                "v": jax.device_put(cache["v"], kv_sharding),
+                "lengths": jax.device_put(
+                    cache["lengths"], NamedSharding(self.mesh, P())
+                ),
+            }
+        return cache
 
     # -- public API ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -560,10 +595,9 @@ class ContinuousBatchingEngine:
                     self._waiting.clear()
                     self._free = deque(range(self.num_slots))
                     # Donated buffers may have been consumed mid-failure:
-                    # rebuild the cache before serving again.
-                    cache = init_slotted_cache(
-                        self.cfg, self.num_slots, self.max_len
-                    )
+                    # rebuild the cache (mesh placement included) before
+                    # serving again.
+                    cache = self._fresh_cache()
                     self._k, self._v = cache["k"], cache["v"]
                     self._lengths = cache["lengths"]
                     self._tokens_dev = jnp.zeros(
@@ -582,10 +616,20 @@ class LLMReplica:
     def __init__(self, model_loader, num_slots: int = 4, max_len: int = 256,
                  eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32):
-        params, cfg = model_loader()
+        # The loader runs IN the replica process and may return
+        # (params, cfg) or (params, cfg, mesh) — a Mesh cannot cross
+        # the actor boundary as an argument, so tensor-parallel serving
+        # builds its mesh (and shards params) inside the loader.
+        loaded = model_loader()
+        mesh = None
+        if len(loaded) == 3:
+            params, cfg, mesh = loaded
+        else:
+            params, cfg = loaded
         self.engine = ContinuousBatchingEngine(
             params, cfg, num_slots=num_slots, max_len=max_len,
             eos_id=eos_id, default_max_new_tokens=default_max_new_tokens,
+            mesh=mesh,
         )
 
     def __call__(self, prompt, max_new_tokens: Optional[int] = None,

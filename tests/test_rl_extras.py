@@ -259,7 +259,10 @@ def test_sac_pendulum_improves(rt_start):
     try:
         first = algo.train()  # mostly warmup/random
         best = -1e9
-        for _ in range(16):
+        # 24 iterations: learning-threshold tests run under whatever
+        # load the rest of the suite left behind; the margin is time,
+        # not a looser bar.
+        for _ in range(24):
             result = algo.train()
             best = max(best, result["episode_return_mean"])
             if best > -400.0:
@@ -351,7 +354,10 @@ def test_td3_pendulum_improves(rt_start):
     try:
         first = algo.train()  # mostly warmup/random
         best = -1e9
-        for _ in range(16):
+        # 24 iterations: learning-threshold tests run under whatever
+        # load the rest of the suite left behind; the margin is time,
+        # not a looser bar.
+        for _ in range(24):
             result = algo.train()
             best = max(best, result["episode_return_mean"])
             if best > -400.0:
@@ -865,7 +871,7 @@ def test_noisy_dqn_cartpole_improves(rt_start):
     )
     try:
         best = -1.0
-        for _ in range(30):
+        for _ in range(45):
             result = algo.train()
             assert result["epsilon"] == 0.0  # exploration is the noise
             best = max(best, result["episode_return_mean"])
