@@ -12,11 +12,33 @@ Run: python bench_infer.py
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 from dataclasses import replace
 
-import jax
-import jax.numpy as jnp
+
+def _ensure_backend():
+    """A dead TPU tunnel hangs jax.devices() forever; probe it in a
+    killable subprocess (bench.py's pattern) and fall back to CPU."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return
+    from bench import _probe_tunnel
+
+    if not _probe_tunnel():
+        print("[bench_infer] TPU tunnel dead; falling back to CPU",
+              file=sys.stderr, flush=True)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+
+_ensure_backend()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 
 def main():
@@ -111,7 +133,7 @@ def main():
 
     eng = ContinuousBatchingEngine(
         params, cfg, num_slots=4, max_len=cb_prompt_len + n_tok + 1,
-        prefill_buckets=(cb_prompt_len,),
+        prefill_chunk=cb_prompt_len,
     )
     try:
         eng.submit(prompts[0], max_new_tokens=n_tok).result(timeout=600)
@@ -135,8 +157,14 @@ def main():
     print(json.dumps(entry), flush=True)
     results.append(entry)
 
-    with open("BENCH_INFER.json", "w") as f:
-        json.dump(results, f, indent=1)
+    if on_tpu:
+        with open("BENCH_INFER.json", "w") as f:
+            json.dump(results, f, indent=1)
+    else:
+        # CPU fallback is a smoke run: never overwrite the committed
+        # TPU artifact with fallback numbers.
+        print("[bench_infer] cpu fallback: BENCH_INFER.json left as-is",
+              file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

@@ -10,9 +10,10 @@ tokens as they are produced, and free their slot the moment they finish
 — the vLLM-style iteration-level scheduling, built TPU-first:
 
   * Static shapes everywhere: the decode step is jitted ONCE for the
-    slot count; prompts pad to a small set of prefill buckets, so the
-    number of compilations is bounded and none happen mid-traffic after
-    warmup.
+    slot count and prompts prefill in fixed-size CHUNKS (one chunk
+    between decode steps — chunked prefill: a long prompt never stalls
+    other slots' decoding for more than a chunk), so compilation count
+    is bounded and none happens mid-traffic after warmup.
   * Per-slot sequence lengths live in device memory; attention masks by
     each slot's own length, so one batched decode serves slots whose
     sequences started at different times.
@@ -188,32 +189,43 @@ def _decode_slots(params, tokens, k_cache, v_cache, lengths, active,
     return next_tokens, k_new, v_new, new_lengths
 
 
-def _prefill_slot(params, tokens, n_valid, slot, k_cache, v_cache, lengths,
-                  cfg: TransformerConfig):
-    """Prefill ONE request's (padded) prompt into slot `slot`.
+def _prefill_chunk(params, tokens, n_valid, slot, offset, k_cache, v_cache,
+                   lengths, cfg: TransformerConfig):
+    """CHUNKED prefill: process one fixed-size chunk of a prompt into
+    slot `slot` at row `offset` — the scheme that lets a long prompt's
+    prefill interleave with other slots' decode steps instead of
+    stalling them for the whole prompt.
 
-    tokens [1, Lpad] int32 (first n_valid real), writes K/V rows
-    [slot, 0:Lpad] and sets lengths[slot] = n_valid. Returns (logits of
-    the last REAL position [1, vocab], caches, lengths).
+    tokens [1, C] int32 (first n_valid real), writes K/V rows
+    [slot, offset:offset+C]; queries attend causally to the slot's
+    whole cache prefix (earlier chunks included). Sets lengths[slot] =
+    offset + n_valid and returns the logits of the chunk's last REAL
+    position [1, vocab] (meaningful on the final chunk).
     """
-    _, lpad = tokens.shape
+    _, c = tokens.shape
     lmax = k_cache.shape[2]
     x = _embed_tokens(params, tokens, cfg)
     cos, sin = rope_frequencies(cfg.head_dim, lmax, cfg.rope_theta)
-    positions = jnp.arange(lpad, dtype=jnp.int32)[None, :]
-    # Causal self-attention within the prompt; padding masked.
-    q_pos = jax.lax.broadcasted_iota(jnp.int32, (1, lpad, lpad), 1)
-    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, lpad, lpad), 2)
-    valid = (k_pos <= q_pos) & (k_pos < n_valid)
+    positions = offset + jnp.arange(c, dtype=jnp.int32)[None, :]
+    # Causal against the slot's full cache: key row j is visible to
+    # chunk query i when j <= offset + i and j is a real row.
+    q_pos = positions[:, :, None]                              # [1, C, 1]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, c, lmax), 2)
+    valid = (k_pos <= q_pos) & (k_pos < offset + n_valid)
+    # Row-indexed scatter with mode="drop": a final chunk whose PADDING
+    # would run past the cache end simply drops those rows.
+    # (dynamic_update_slice would CLAMP the start instead, silently
+    # overwriting earlier chunks' rows.) Real rows always fit: prompts
+    # are bounded by max_len - 2 at submit.
+    rows = offset + jnp.arange(c, dtype=jnp.int32)
 
     def write_kv(kc, vc, k, v):
-        kc = jax.lax.dynamic_update_slice(
-            kc, k.astype(kc.dtype), (slot, 0, 0, 0)
-        )
-        vc = jax.lax.dynamic_update_slice(
-            vc, v.astype(vc.dtype), (slot, 0, 0, 0)
-        )
-        return kc, vc, k, v  # attend within the prompt only
+        kc = kc.at[slot, rows].set(k[0].astype(kc.dtype), mode="drop")
+        vc = vc.at[slot, rows].set(v[0].astype(vc.dtype), mode="drop")
+        # Attend against the slot's whole cache row range (masked).
+        k_att = jax.lax.dynamic_slice_in_dim(kc, slot, 1, axis=0)
+        v_att = jax.lax.dynamic_slice_in_dim(vc, slot, 1, axis=0)
+        return kc, vc, k_att, v_att
 
     def layer(carry, inputs):
         x = carry
@@ -230,7 +242,7 @@ def _prefill_slot(params, tokens, n_valid, slot, k_cache, v_cache, lengths,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     last = jax.lax.dynamic_slice(x, (0, n_valid - 1, 0), (1, 1, x.shape[-1]))
     logits = project_logits(last[:, 0], params, cfg)
-    new_lengths = lengths.at[slot].set(n_valid)
+    new_lengths = lengths.at[slot].set(offset + n_valid)
     return logits, k_new, v_new, new_lengths
 
 
@@ -306,13 +318,19 @@ class ContinuousBatchingEngine:
     def __init__(self, params, cfg: TransformerConfig, num_slots: int = 4,
                  max_len: int = 256, eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32,
-                 prefill_buckets=(16, 64, 256), seed: int = 0,
-                 mesh=None):
+                 prefill_buckets=None, seed: int = 0,
+                 mesh=None, prefill_chunk: int = 64):
         """mesh: a jax.sharding.Mesh with a "tp" axis for tensor-
         parallel serving (the pods layout): pass params already sharded
         via parallel.shard_params and the engine lays the KV cache out
         with KV heads split over tp — decode collectives then ride ICI
-        inside the compiled step (GSPMD inserts them)."""
+        inside the compiled step (GSPMD inserts them).
+
+        prefill_chunk: prompts prefill in fixed chunks of this many
+        tokens, ONE chunk between decode steps — a long prompt never
+        stalls other slots' decoding for more than a chunk (chunked
+        prefill), and prefill compiles exactly once. prefill_buckets is
+        a deprecated no-op (chunking bounds compilation by itself)."""
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -320,11 +338,7 @@ class ContinuousBatchingEngine:
         self.eos_id = eos_id
         self.default_max_new_tokens = default_max_new_tokens
         self.mesh = mesh
-        # Buckets are clamped to max_len: a prompt that fits max_len
-        # must never round up to an update wider than the cache.
-        self.prefill_buckets = tuple(sorted(
-            {min(int(b), max_len) for b in prefill_buckets}
-        ))
+        self.prefill_chunk = max(1, min(int(prefill_chunk), max_len))
         if mesh is not None:
             if "tp" not in mesh.shape:
                 raise ValueError(
@@ -353,14 +367,18 @@ class ContinuousBatchingEngine:
         )
         self._pick = jax.jit(_pick_tokens)
         self._prefill = jax.jit(
-            lambda p, t, n, s, k, v, ln: _prefill_slot(p, t, n, s, k, v,
-                                                       ln, cfg),
-            donate_argnums=(4, 5),
+            lambda p, t, n, s, o, k, v, ln: _prefill_chunk(
+                p, t, n, s, o, k, v, ln, cfg
+            ),
+            donate_argnums=(5, 6),
         )
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._waiting: deque = deque()
         self._slots: Dict[int, GenerationHandle] = {}
+        # Mid-prefill requests: slot -> {"h": handle, "offset": rows
+        # already prefilled}. One chunk advances per loop iteration.
+        self._prefilling: Dict[int, Dict] = {}
         self._free = deque(range(num_slots))
         # Next input token per slot, ON DEVICE: the decode loop feeds
         # each step's argmax straight into the next dispatch and fetches
@@ -413,10 +431,10 @@ class ContinuousBatchingEngine:
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
-        if len(prompt) > self.prefill_buckets[-1]:
+        if len(prompt) > self.max_len - 2:
             raise ValueError(
-                f"prompt length {len(prompt)} exceeds the largest prefill "
-                f"bucket {self.prefill_buckets[-1]}"
+                f"prompt length {len(prompt)} exceeds the engine's "
+                f"max_len - 2 = {self.max_len - 2}"
             )
         if max_new_tokens is None:
             max_new_tokens = self.default_max_new_tokens
@@ -440,6 +458,7 @@ class ContinuousBatchingEngine:
                 "steps": self._steps,
                 "active": len(self._slots),
                 "waiting": len(self._waiting),
+                "prefilling": len(self._prefilling),
                 "free_slots": len(self._free),
             }
 
@@ -451,47 +470,53 @@ class ContinuousBatchingEngine:
         # in __iter__ would otherwise wait forever.
         err = RuntimeError("engine shut down")
         with self._lock:
-            for h in list(self._slots.values()) + list(self._waiting):
+            pending = (list(self._slots.values()) + list(self._waiting)
+                       + [e["h"] for e in self._prefilling.values()])
+            for h in pending:
                 h._fail(err)
             self._slots.clear()
             self._waiting.clear()
+            self._prefilling.clear()
 
     # -- engine loop -----------------------------------------------------
-    def _bucket_for(self, n: int) -> int:
-        for b in self._buckets_le(n):
-            return b
-        raise AssertionError  # guarded in submit()
-
-    def _buckets_le(self, n: int):
-        for b in self.prefill_buckets:
-            if n <= b:
-                yield b
-
     def _admit_locked(self):
-        """Prefill waiting requests into free slots (step boundary)."""
+        """Assign free slots to waiting requests; their prompts then
+        prefill ONE chunk per loop iteration (_advance_prefills), so a
+        long prompt never stalls other slots' decode for more than a
+        chunk."""
         while self._free and self._waiting:
             h = self._waiting.popleft()
             # Deliverable budget: the loop cuts a sequence at lengths >=
             # max_len - 2 (one in-flight pipelined step keeps a margin
-            # row), so a prompt of P rows can emit max_len - 2 - P + 1
-            # tokens. Clamp to what will actually be delivered.
-            budget = self.max_len - 1 - len(h.prompt)
-            if budget < 1:
-                h._fail(ValueError("prompt too long for engine max_len"))
-                continue
-            h.max_new_tokens = min(h.max_new_tokens, budget)
+            # row), so a prompt of P rows can emit max_len - 1 - P
+            # tokens; submit() guarantees that is >= 1. Clamp to what
+            # will actually be delivered.
+            h.max_new_tokens = min(
+                h.max_new_tokens, self.max_len - 1 - len(h.prompt)
+            )
             slot = self._free.popleft()
-            bucket = self._bucket_for(len(h.prompt))
-            padded = np.zeros((1, bucket), dtype=np.int32)
-            padded[0, : len(h.prompt)] = h.prompt
+            self._prefilling[slot] = {"h": h, "offset": 0}
+
+    def _advance_prefills(self):
+        """One prefill chunk for every mid-prefill slot (interleaved
+        between decode dispatches). A request whose final chunk lands
+        emits its first token and joins the decode set."""
+        c = self.prefill_chunk
+        for slot, entry in list(self._prefilling.items()):
+            h, off = entry["h"], entry["offset"]
+            chunk = h.prompt[off:off + c]
+            n = len(chunk)
+            padded = np.zeros((1, c), dtype=np.int32)
+            padded[0, :n] = chunk
             logits, self._k, self._v, self._lengths = self._prefill(
                 self.params, jnp.asarray(padded),
-                jnp.int32(len(h.prompt)), jnp.int32(slot),
+                jnp.int32(n), jnp.int32(slot), jnp.int32(off),
                 self._k, self._v, self._lengths,
             )
-            self._temps[slot] = h.temperature
-            self._top_ks[slot] = h.top_k
-            self._top_ps[slot] = h.top_p
+            entry["offset"] = off + n
+            if entry["offset"] < len(h.prompt):
+                continue
+            # Final chunk: first token under the request's sampling.
             if h.temperature > 0:
                 self._rng, key = jax.random.split(self._rng)
                 tok = int(jax.device_get(self._pick(
@@ -504,16 +529,25 @@ class ContinuousBatchingEngine:
             else:
                 tok = int(jax.device_get(jnp.argmax(logits, -1))[0])
             h.produced = 1
+            # admitted_at_step must be visible before the push wakes a
+            # consumer (a request finishing on its prefill token would
+            # otherwise be observable with the -1 sentinel). _steps is
+            # only written by this thread.
             h.admitted_at_step = self._steps
             done = (tok == self.eos_id if self.eos_id is not None
                     else False) or h.produced >= h.max_new_tokens
             h._push(tok, done)
-            if done:
-                self._free.append(slot)
-            else:
-                self._slots[slot] = h
-                self._gen[slot] += 1
-                self._tokens_dev = self._tokens_dev.at[slot].set(tok)
+            with self._lock:
+                del self._prefilling[slot]
+                if done:
+                    self._free.append(slot)
+                else:
+                    self._slots[slot] = h
+                    self._gen[slot] += 1
+                    self._temps[slot] = h.temperature
+                    self._top_ks[slot] = h.top_k
+                    self._top_ps[slot] = h.top_p
+                    self._tokens_dev = self._tokens_dev.at[slot].set(tok)
 
     def _loop(self):
         """Pipelined decode loop: dispatch step k+1 (inputs taken from
@@ -527,6 +561,8 @@ class ContinuousBatchingEngine:
             try:
                 with self._lock:
                     self._admit_locked()
+                self._advance_prefills()
+                with self._lock:
                     snapshot = [
                         (s, int(self._gen[s]), h)
                         for s, h in self._slots.items()
@@ -584,15 +620,20 @@ class ContinuousBatchingEngine:
                                 self._free.append(s)
                                 self._gen[s] += 1
                 inflight = new_inflight
-                if inflight is None:
+                if inflight is None and not self._prefilling:
                     self._work.wait(timeout=0.5)
                     self._work.clear()
             except BaseException as e:  # noqa: BLE001 — fail all, keep serving
                 with self._lock:
-                    for h in list(self._slots.values()) + list(self._waiting):
+                    pending = (
+                        list(self._slots.values()) + list(self._waiting)
+                        + [en["h"] for en in self._prefilling.values()]
+                    )
+                    for h in pending:
                         h._fail(e)
                     self._slots.clear()
                     self._waiting.clear()
+                    self._prefilling.clear()
                     self._free = deque(range(self.num_slots))
                     # Donated buffers may have been consumed mid-failure:
                     # rebuild the cache (mesh placement included) before
@@ -615,7 +656,8 @@ class LLMReplica:
 
     def __init__(self, model_loader, num_slots: int = 4, max_len: int = 256,
                  eos_id: Optional[int] = None,
-                 default_max_new_tokens: int = 32):
+                 default_max_new_tokens: int = 32,
+                 prefill_chunk: int = 64):
         # The loader runs IN the replica process and may return
         # (params, cfg) or (params, cfg, mesh) — a Mesh cannot cross
         # the actor boundary as an argument, so tensor-parallel serving
@@ -629,7 +671,7 @@ class LLMReplica:
         self.engine = ContinuousBatchingEngine(
             params, cfg, num_slots=num_slots, max_len=max_len,
             eos_id=eos_id, default_max_new_tokens=default_max_new_tokens,
-            mesh=mesh,
+            mesh=mesh, prefill_chunk=prefill_chunk,
         )
 
     def __call__(self, prompt, max_new_tokens: Optional[int] = None,
@@ -662,7 +704,8 @@ def llm_deployment(model_loader, *, num_slots: int = 4, max_len: int = 256,
                    eos_id: Optional[int] = None,
                    default_max_new_tokens: int = 32, num_replicas: int = 1,
                    max_ongoing_requests: int = 64,
-                   ray_actor_options: Optional[dict] = None):
+                   ray_actor_options: Optional[dict] = None,
+                   prefill_chunk: int = 64):
     """A ready-to-run continuous-batching LLM application.
 
         app = llm_deployment(lambda: (params, cfg), num_slots=8)
@@ -685,4 +728,5 @@ def llm_deployment(model_loader, *, num_slots: int = 4, max_len: int = 256,
     return dep.bind(
         model_loader, num_slots=num_slots, max_len=max_len, eos_id=eos_id,
         default_max_new_tokens=default_max_new_tokens,
+        prefill_chunk=prefill_chunk,
     )
