@@ -37,9 +37,6 @@ _ensure_backend()
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
 
 def main():
     from ray_tpu.models import configs, init_params
