@@ -469,3 +469,38 @@ def test_engine_recovers_after_decode_failure():
         assert out == ref
     finally:
         eng.shutdown()
+
+
+@pytest.mark.slow
+def test_llm_replica_killed_and_replaced(rt_serve):
+    """Fault tolerance for the continuous-batching serving path: kill
+    the LLM replica actor; the controller's reconcile replaces it (a
+    fresh engine boots in the new actor) and later requests succeed."""
+    import time as _time
+
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    from ray_tpu.serve.llm import llm_deployment
+
+    app = llm_deployment(_tiny_model, num_slots=2, max_len=48,
+                         default_max_new_tokens=4)
+    handle = serve.run(app, name="killable")
+    first = rt.get(handle.remote([1, 2, 3]), timeout=180)
+    assert len(first) == 4
+
+    ctrl = rt.get_actor(CONTROLLER_NAME)
+    (replica,) = rt.get(
+        ctrl.get_replicas.remote("killable"), timeout=60
+    )["replicas"]
+    rt.kill(replica)
+
+    deadline = _time.monotonic() + 120
+    out = None
+    while _time.monotonic() < deadline:
+        try:
+            out = rt.get(handle.remote([1, 2, 3]), timeout=60)
+            break
+        except Exception:  # noqa: BLE001 — replica still rebooting
+            _time.sleep(0.5)
+    assert out == first, (
+        "replacement replica never served (or served differently)"
+    )
