@@ -145,6 +145,12 @@ class ConfigEvalMixin:
                 setattr(self, name, val)
         return self
 
+    def as_trainable(self, stop_iters: int = 10):
+        """Tune adapter (reference: Algorithm IS a Tune Trainable,
+        rllib/algorithms/algorithm.py:191): Tuner(config.as_trainable(),
+        param_space={"lr": ...}) tunes this algorithm's fields."""
+        return config_as_trainable(self, stop_iters)
+
 
 class AlgorithmBase:
     """Mixin over concrete algorithms (which own `config`,
@@ -292,3 +298,63 @@ class AlgorithmBase:
             except Exception:  # noqa: BLE001
                 pass
         self._eval_runners = None
+
+
+def config_as_trainable(config, stop_iters: int = 10):
+    """Tune adapter (reference: Algorithm IS a Tune Trainable,
+    rllib/algorithms/algorithm.py:191 — Tuner(PPO, param_space=...)).
+
+    Returns a function trainable: each trial deep-copies `config`,
+    applies its sampled params (dataclass fields / non-callable config
+    attributes only — builder METHODS are rejected), builds the
+    algorithm, runs train() iterations reporting each result WITH an
+    Algorithm.save checkpoint — so trial restarts, Tuner.restore, and
+    PBT exploit resume from learned state instead of iteration 0 — and
+    always stops the algorithm's actors.
+    Use: Tuner(config.as_trainable(), param_space={"lr": ...}).
+    """
+    import copy
+    import dataclasses
+
+    def trainable(trial_config):
+        import os
+        import tempfile
+
+        from ray_tpu import tune as _tune
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        cfg = copy.deepcopy(config)
+        field_names = (
+            {f.name for f in dataclasses.fields(cfg)}
+            if dataclasses.is_dataclass(cfg) else set()
+        )
+        for key, value in trial_config.items():
+            settable = key in field_names or (
+                hasattr(cfg, key) and not callable(getattr(cfg, key))
+            )
+            if not settable:
+                raise ValueError(
+                    f"param_space key {key!r} is not a config field of "
+                    f"{type(cfg).__name__}"
+                )
+            setattr(cfg, key, value)
+        algo = cfg.build()
+        try:
+            ckpt = _tune.get_checkpoint()
+            if ckpt is not None:
+                algo.restore(ckpt.path)
+            while algo._iteration < stop_iters:
+                result = algo.train()
+                d = tempfile.mkdtemp(prefix="rl_trial_ckpt_")
+                algo.save(d)
+                _tune.report(result, checkpoint=Checkpoint.from_directory(d))
+        finally:
+            algo.stop()
+
+    # tune.with_resources pins per-trial resources on the CONFIG copy
+    # (the as_trainable dispatch branch); carry them onto the closure
+    # the way trainer.as_trainable does.
+    if getattr(config, "_tune_resources", None) is not None:
+        trainable._tune_resources = dict(config._tune_resources)
+    return trainable
+

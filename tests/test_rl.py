@@ -625,3 +625,75 @@ def test_recurrent_ppo_evaluation_and_runner_state():
         )
     finally:
         algo.stop()
+
+
+@pytest.mark.usefixtures("rt_start")
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+@pytest.mark.slow
+def test_ppo_tuned_via_tuner(tmp_path):
+    """RL algorithms ride Tune like the reference (Algorithm is a Tune
+    Trainable): Tuner grid-searches PPO's lr on CueRecallEnv and the
+    best trial's config is recoverable."""
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    config = (
+        PPOConfig()
+        .environment(lambda: CueRecallEnv(), obs_dim=3, num_actions=2)
+        .env_runners(num_env_runners=1, rollout_length=64)
+        .training(num_epochs=2, minibatch_size=32)
+    )
+    tuner = tune.Tuner(
+        config.as_trainable(stop_iters=2),
+        param_space={"lr": tune.grid_search([1e-3, 3e-3])},
+        tune_config=tune.TuneConfig(
+            metric="episode_return_mean", mode="max"
+        ),
+        run_config=RunConfig(name="rl_tune", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert "episode_return_mean" in best.metrics
+    # Both lr trials ran with their sampled configs.
+    lrs = {row["config/lr"] for row in results.get_dataframe()}
+    assert lrs == {1e-3, 3e-3}
+    # param_space keys are validated against config fields.
+    bad = tune.Tuner(
+        config.as_trainable(stop_iters=1),
+        param_space={"not_a_field": tune.grid_search([1])},
+        run_config=RunConfig(name="rl_bad", storage_path=str(tmp_path)),
+    )
+    bad_results = bad.fit()
+    errs = [r.error for r in bad_results if r.error is not None]
+    assert errs and "not_a_field" in str(errs[0])
+
+
+@pytest.mark.usefixtures("rt_start")
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_rl_trainable_checkpoints_and_resources():
+    """The RL Tune adapter reports Algorithm.save checkpoints (so trial
+    restarts resume from learned state), rejects builder-method keys,
+    and carries with_resources through the config dispatch."""
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    config = (
+        PPOConfig()
+        .environment(lambda: CueRecallEnv(), obs_dim=3, num_actions=2)
+        .env_runners(num_env_runners=1, rollout_length=32)
+        .training(num_epochs=1, minibatch_size=32)
+    )
+    # with_resources rides the config's as_trainable dispatch.
+    pinned = tune.with_resources(config, {"CPU": 0.5})
+    fn = pinned.as_trainable(stop_iters=1)
+    assert fn._tune_resources == {"CPU": 0.5}
+
+    # Builder-method names are rejected as param_space keys.
+    bad = tune.Tuner(
+        config.as_trainable(stop_iters=1),
+        param_space={"training": tune.grid_search([0.1])},
+        run_config=RunConfig(name="rl_bad2",
+                             storage_path="/tmp/rl_bad2_store"),
+    )
+    errs = [r.error for r in bad.fit() if r.error is not None]
+    assert errs and "training" in str(errs[0])
