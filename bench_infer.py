@@ -3,8 +3,12 @@
 Prints one JSON line per batch size: prefill tokens/s and steady-state
 decode tokens/s/chip for the 0.8B Llama config (the serving-side
 counterpart of bench.py's training MFU; decode is memory-bandwidth-bound,
-so tokens/s scales with batch until HBM saturates). Writes
-BENCH_INFER.json. CPU fallback uses the tiny config.
+so tokens/s scales with batch until HBM saturates), then the serving
+probes: continuous batching vs the static path, the engine's stepwise
+breakdown (dispatch/fetch/host per step + compile/upload counts), and
+the engine-vs-raw decode throughput ratio. Writes BENCH_INFER.json; a
+CPU fallback run uses the tiny config and merges its "(cpu fallback)"
+entries into the artifact without touching committed TPU entries.
 
 Run: python bench_infer.py
 """
@@ -36,6 +40,166 @@ _ensure_backend()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+
+
+def _engine_stepwise_probe(params, cfg, on_tpu):
+    """Decompose the continuous-batching engine's steady-state step and
+    compare it with a raw jitted batch=num_slots decode at the same
+    shapes (same cache length, same batch rows).
+
+    Two entries: (1) the per-step breakdown — raw floor, engine step,
+    overhead, and where the overhead goes (dispatch / fetch / host),
+    plus compile and sampling-param-upload counts inside the window
+    (both must be 0: the r5 engine paid per-step host<->device traffic
+    over the TPU tunnel — an implied 78.9 ms engine step against the
+    artifact's 6.93 ms raw batch-8 decode, i.e. ~72 ms/step of pure
+    sync overhead); (2) the engine-vs-raw throughput ratio for an
+    all-greedy full-occupancy run.
+
+    Measured on this box (CPU, tiny config, BENCH_INFER.json): engine
+    step 0.957 ms vs raw floor 1.044 ms — overhead -0.087 ms, i.e.
+    zero within this box's run-to-run noise — with 0 compiles and 0
+    param uploads in the window, and an engine-vs-raw throughput
+    ratio of 0.935. The r5 ~72 ms/step overhead is gone because its
+    causes are gone, not faster: sampling params live on device and
+    re-upload only on admission/eviction, the token fetch is
+    double-buffered (copy_to_host_async overlaps the next dispatch),
+    and the step programs never retrace after warmup.
+
+    Residual gap, by construction: the engine's decode step stays
+    intrinsically heavier than a raw argmax decode — masked per-slot
+    cache writes at per-slot offsets, the on-device pick with
+    per-slot temperature/top-k/top-p gathers, and per-step host
+    bookkeeping (slot table, handle queues, timing) that no amount of
+    device residency removes. On CPU that difference is smaller than
+    measurement noise (hence the ~0 overhead above); on TPU it is
+    bounded by compute, no longer multiplied by tunnel RTT.
+    """
+    from ray_tpu.models.generate import decode_step, init_kv_cache, prefill
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    num_slots = 4
+    plen = 8
+    n_tok = 256 if on_tpu else 192
+    max_len = plen + n_tok + 8
+    raw_steps = 60
+    window = 48
+    rounds = 3  # min-of-N: this box's wall clock is noisy (factor ~2)
+
+    # Raw floor: jitted decode at batch=num_slots over a cache of the
+    # engine's [num_slots, max_len] shape, greedy argmax picks. The
+    # cache is donated (as the engine's decode jit donates its k/v
+    # buffers) so the floor measures in-place appends, not a
+    # copy-the-cache-per-step strawman.
+    jprefill = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))
+
+    def _raw_step(p, t, c):  # decode + greedy pick in ONE program,
+        logits, c = decode_step(p, t, c, cfg)  # like the engine's step
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
+
+    jdecode = jax.jit(_raw_step, donate_argnums=(2,))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(2), (num_slots, plen), 0, cfg.vocab_size
+    )
+    logits, c = jprefill(params, prompt,
+                         init_kv_cache(cfg, num_slots, max_len))
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tok, c = jdecode(params, tok, c)  # warm (donates + replaces c)
+    jax.device_get(tok)
+    raw_step_ms = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(raw_steps):
+            tok, c = jdecode(params, tok, c)
+        jax.device_get(tok)
+        raw_s = time.perf_counter() - t0
+        raw_step_ms = min(raw_step_ms, raw_s / raw_steps * 1e3)
+    raw_tps = num_slots / raw_step_ms * 1e3
+
+    prompts = [
+        list(map(int, jax.device_get(jax.random.randint(
+            jax.random.fold_in(jax.random.PRNGKey(3), i), (plen,),
+            0, cfg.vocab_size
+        ))))
+        for i in range(num_slots)
+    ]
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=num_slots, max_len=max_len,
+        prefill_chunk=plen,
+    )
+    try:
+        t_submit = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=n_tok) for p in prompts]
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:  # full occupancy
+            s0 = eng.stats()
+            if s0["active"] == num_slots and s0["prefilling"] == 0:
+                break
+            time.sleep(0.002)
+        settle = s0["steps"] + 2  # let the last admission's upload land
+        while time.monotonic() < deadline:
+            s0 = eng.stats()
+            if s0["steps"] >= settle:
+                break
+            time.sleep(0.002)
+        t0 = time.perf_counter()
+        windows = []
+        for _ in range(rounds):
+            target = s0["steps"] + window
+            s1 = s0
+            while time.monotonic() < deadline:
+                s1 = eng.stats()
+                if s1["steps"] >= target:
+                    break
+                time.sleep(0.002)
+            t1 = time.perf_counter()
+            windows.append((s0, s1, t0, t1))
+            s0, t0 = s1, t1
+        for h in handles:
+            h.result(timeout=600)
+        t_done = time.perf_counter()
+    finally:
+        eng.shutdown()
+
+    # Best window = the least-preempted one (same min-of-N as raw).
+    s0, s1, t0, t1 = min(
+        windows,
+        key=lambda x: (x[3] - x[2]) / max(x[1]["steps"] - x[0]["steps"], 1),
+    )
+    w = max(s1["steps"] - s0["steps"], 1)
+    wt = max(s1["timing"]["steps_timed"] - s0["timing"]["steps_timed"], 1)
+    engine_step_ms = (t1 - t0) / w * 1e3
+
+    def part(name):
+        key = f"{name}_ms_total"
+        return round((s1["timing"][key] - s0["timing"][key]) / wt, 3)
+
+    suffix = "" if on_tpu else " (cpu fallback)"
+    breakdown = {
+        "metric": "engine step breakdown" + suffix,
+        "num_slots": num_slots,
+        "window_steps": w,
+        "raw_decode_step_ms": round(raw_step_ms, 3),
+        "engine_step_ms": round(engine_step_ms, 3),
+        "engine_overhead_ms": round(engine_step_ms - raw_step_ms, 3),
+        "dispatch_ms": part("dispatch"),
+        "fetch_ms": part("fetch"),
+        "host_ms": part("host"),
+        "compiles_in_window": s1["compiles"] - s0["compiles"],
+        "param_uploads_in_window": (
+            s1["param_uploads"] - s0["param_uploads"]
+        ),
+    }
+    engine_tps = num_slots * n_tok / (t_done - t_submit)
+    ratio = {
+        "metric": "engine vs raw decode throughput" + suffix,
+        "num_slots": num_slots,
+        "tokens_per_request": n_tok,
+        "raw_decode_tokens_per_s": round(raw_tps, 1),
+        "engine_tokens_per_s": round(engine_tps, 1),
+        "engine_vs_raw_throughput_ratio": round(engine_tps / raw_tps, 3),
+    }
+    return [breakdown, ratio]
 
 
 def main():
@@ -154,13 +318,29 @@ def main():
     print(json.dumps(entry), flush=True)
     results.append(entry)
 
+    for entry in _engine_stepwise_probe(params, cfg, on_tpu):
+        print(json.dumps(entry), flush=True)
+        results.append(entry)
+
     if on_tpu:
         with open("BENCH_INFER.json", "w") as f:
             json.dump(results, f, indent=1)
     else:
-        # CPU fallback is a smoke run: never overwrite the committed
-        # TPU artifact with fallback numbers.
-        print("[bench_infer] cpu fallback: BENCH_INFER.json left as-is",
+        # CPU fallback entries are labeled "(cpu fallback)": merge them
+        # into the artifact WITHOUT touching committed TPU entries, so
+        # the stepwise breakdown is pinned even on a CPU-only box.
+        try:
+            with open("BENCH_INFER.json") as f:
+                existing = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            existing = []
+        ours = {e["metric"] for e in results}
+        merged = [e for e in existing if e["metric"] not in ours]
+        merged += results
+        with open("BENCH_INFER.json", "w") as f:
+            json.dump(merged, f, indent=1)
+        print("[bench_infer] cpu fallback: merged cpu-labeled entries "
+              "into BENCH_INFER.json (TPU entries preserved)",
               file=sys.stderr, flush=True)
 
 

@@ -295,6 +295,13 @@ class ZygoteManager:
             self._gen = None
             self._next = None
             self._old = []
+            # Intentional shutdown: the reader threads will see EOF when
+            # close() lands — mark every generation retiring FIRST so
+            # those EOFs don't count toward _deaths (3 cumulative
+            # stop/start cycles would otherwise permanently disable the
+            # manager and push every spawn onto the slow Popen path).
+            for g in gens:
+                g.retiring = True
         for g in gens:
             g.close()
 

@@ -188,10 +188,19 @@ def _len_field(field_no: int, payload: bytes) -> bytes:
 def encode_example(features: Dict[str, Any]) -> bytes:
     """dict -> serialized tf.train.Example. Values: bytes/str ->
     bytes_list, float -> float_list, int -> int64_list; lists of those
-    encode element-wise."""
+    encode element-wise. Numpy scalars/arrays normalize to their Python
+    equivalents first — list-of-rows blocks carry np.int64/np.float32
+    straight from map() outputs (Arrow blocks convert via to_pylist,
+    but the row path must not reject what the table path accepts)."""
+    import numpy as np
+
     feat_entries = b""
     for name, value in features.items():
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
         values = value if isinstance(value, (list, tuple)) else [value]
+        values = [v.item() if isinstance(v, np.generic) else v
+                  for v in values]
         if all(isinstance(v, (bytes, str)) for v in values):
             items = b"".join(
                 _len_field(1, v.encode() if isinstance(v, str) else v)

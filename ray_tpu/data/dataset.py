@@ -446,6 +446,17 @@ class Dataset:
             n = len(next(iter(cols.values())))
             start = 0
             if carry_rows:
+                if set(cols) != set(carry):
+                    # A batch straddling blocks with different column
+                    # sets cannot concatenate; fail with the schemas
+                    # instead of a bare KeyError from the carry merge.
+                    raise ValueError(
+                        "schema mismatch across blocks: a batch "
+                        f"straddles columns {sorted(carry)} vs "
+                        f"{sorted(cols)}; make block schemas "
+                        "consistent (e.g. map() filling missing "
+                        "fields) or use iter_rows()"
+                    )
                 need = batch_size - carry_rows
                 if n < need:
                     carry = {

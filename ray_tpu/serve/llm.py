@@ -19,6 +19,16 @@ tokens as they are produced, and free their slot the moment they finish
     sequences started at different times.
   * Cache buffers are donated through the step, so decode updates the
     KV cache in place (no per-step reallocation of the big buffer).
+  * The steady-state hot loop does ZERO avoidable host<->device traffic
+    per step: sampling params and the active mask are device-resident
+    (re-uploaded only on slot admission/eviction), step outputs come
+    back through an async double-buffered copy (dispatch step k+1,
+    drain step k's already-landed buffer), both decode variants compile
+    at engine construction (greedy<->sampled traffic flips never
+    compile mid-serving), and stats() exposes the per-step breakdown
+    (dispatch/fetch/host ms, compile and upload counters) that proves
+    it — the T3-style overlap discipline (arXiv:2401.16677) applied to
+    decode, with EQuARX-style step decomposition (arXiv:2506.17615).
 
 Reference provenance: serve/batching.py (the mechanism surpassed);
 BASELINE.json configs[4] (the serving north-star).
@@ -44,6 +54,53 @@ from ray_tpu.models.transformer import (
 from ray_tpu.ops import apply_rope, rmsnorm, rope_frequencies
 
 NEG_INF = -1e30
+
+_STEP_MS_BOUNDARIES = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                       100.0, 250.0)
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict] = None
+
+
+def _engine_metrics() -> Dict:
+    """Module-level serving metrics (ray_tpu.util.metrics): one set per
+    process, shared by every engine, flushed to GCS/Prometheus by the
+    metrics flusher. Created lazily so importing llm.py never spins up
+    the flusher thread."""
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Histogram
+
+            _metrics = {
+                "dispatch_ms": Histogram(
+                    "serve_llm_step_dispatch_ms",
+                    "Decode-step dispatch time (enqueue the jitted step)",
+                    boundaries=_STEP_MS_BOUNDARIES,
+                ),
+                "fetch_ms": Histogram(
+                    "serve_llm_step_fetch_ms",
+                    "Blocking time draining the previous step's async "
+                    "device->host token copy",
+                    boundaries=_STEP_MS_BOUNDARIES,
+                ),
+                "host_ms": Histogram(
+                    "serve_llm_step_host_ms",
+                    "Host-side engine work per step (scheduling, token "
+                    "distribution, locking)",
+                    boundaries=_STEP_MS_BOUNDARIES,
+                ),
+                "recompiles": Counter(
+                    "serve_llm_recompiles_total",
+                    "Jit compilations observed AFTER engine warmup "
+                    "(steady-state traffic should never compile)",
+                ),
+                "param_uploads": Counter(
+                    "serve_llm_param_uploads_total",
+                    "Host->device sampling-param/active-mask refreshes "
+                    "(only on slot admission/eviction, never per step)",
+                ),
+            }
+        return _metrics
 
 
 def init_slotted_cache(cfg: TransformerConfig, slots: int, max_len: int) -> Dict:
@@ -115,9 +172,12 @@ def _pick_tokens(logits, temps, top_ks, top_ps, key):
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    # top-k: threshold each row at its k-th largest value.
-    topv = jax.lax.top_k(scaled, MAX_TOP_K)[0]  # [S, K] sorted desc
-    idx = jnp.clip(top_ks - 1, 0, MAX_TOP_K - 1)
+    # top-k: threshold each row at its k-th largest value. The static k
+    # clamps to the vocab so models with vocab_size < MAX_TOP_K don't
+    # crash the jitted step (lax.top_k requires k <= last dim).
+    k = min(MAX_TOP_K, logits.shape[-1])
+    topv = jax.lax.top_k(scaled, k)[0]  # [S, K] sorted desc
+    idx = jnp.clip(top_ks - 1, 0, k - 1)
     kth = jnp.take_along_axis(topv, idx[:, None], axis=-1)
     scaled = jnp.where((top_ks > 0)[:, None] & (scaled < kth),
                        -jnp.inf, scaled)
@@ -387,18 +447,100 @@ class ContinuousBatchingEngine:
         # Per-slot admission generation: suppresses the one in-flight
         # token a just-evicted slot still produces under the lag.
         self._gen = np.zeros(num_slots, dtype=np.int64)
-        # Per-slot sampling params, refreshed at admission.
+        # Per-slot sampling params + active mask: HOST mirrors (written
+        # at admission/eviction) with DEVICE-RESIDENT copies the decode
+        # step reads. The steady-state step touches only the device
+        # copies; _params_dirty triggers ONE host->device refresh when
+        # slot membership changes — never four jnp.asarray uploads per
+        # step, which over a TPU tunnel costs an RTT each.
         self._temps = np.zeros(num_slots, dtype=np.float32)
         self._top_ks = np.zeros(num_slots, dtype=np.int32)
         self._top_ps = np.ones(num_slots, dtype=np.float32)
+        self._active = np.zeros(num_slots, dtype=bool)
+        self._temps_dev = jnp.asarray(self._temps)
+        self._top_ks_dev = jnp.asarray(self._top_ks)
+        self._top_ps_dev = jnp.asarray(self._top_ps)
+        self._active_dev = jnp.asarray(self._active)
+        self._params_dirty = False
+        self._sampled_active = False
+        self._param_uploads = 0  # refresh events (tests pin steady state)
+        # Per-step timing breakdown (loop thread writes, stats() reads).
+        self._t_dispatch = 0.0
+        self._t_fetch = 0.0
+        self._t_host = 0.0
+        self._timed_steps = 0
         self._rng = jax.random.PRNGKey(seed)
         self._next_id = 0
         self._steps = 0  # decode-step counter (observability + tests)
+        self._recompiles = 0  # compilations observed after warmup
+        self._warmup()
+        self._warm_compiles = self._compile_count()
+        self._last_compiles = self._warm_compiles
         self._running = True
         self._thread = threading.Thread(
             target=self._loop, name="llm-engine", daemon=True
         )
         self._thread.start()
+
+    def _warmup(self):
+        """Compile every steady-state program up front — BOTH decode
+        variants (greedy and sampled), the prefill chunk, and the
+        prefill-token picker — so traffic flipping between greedy and
+        sampled never compiles mid-serving. All warmup calls run with
+        `active` all-False: decode writes land in each slot's parking
+        row (lmax - 1, never unmasked) and the prefill rows it touches
+        are re-written by any real occupant before its length exposes
+        them, so cache contents stay semantically untouched."""
+        self._rng, k1, k2 = jax.random.split(self._rng, 3)
+        (_, self._k, self._v, self._lengths) = self._decode_greedy(
+            self.params, self._tokens_dev, self._k, self._v,
+            self._lengths, self._active_dev,
+        )
+        (_, self._k, self._v, self._lengths) = self._decode_sampled(
+            self.params, self._tokens_dev, self._k, self._v,
+            self._lengths, self._active_dev, self._temps_dev,
+            self._top_ks_dev, self._top_ps_dev, k1,
+        )
+        pad = jnp.zeros((1, self.prefill_chunk), dtype=jnp.int32)
+        logits, self._k, self._v, self._lengths = self._prefill(
+            self.params, pad, jnp.int32(1), jnp.int32(0), jnp.int32(0),
+            self._k, self._v, self._lengths,
+        )
+        self._pick(
+            logits, jnp.full(1, 0.5, jnp.float32),
+            jnp.full(1, 1, jnp.int32), jnp.full(1, 1.0, jnp.float32), k2,
+        )
+        # Undo the warmup prefill's lengths[0] = 1 (device-side, keeps
+        # the mesh sharding of the lengths array).
+        self._lengths = self._lengths * 0
+        jax.block_until_ready(self._lengths)
+
+    def _compile_count(self) -> int:
+        """Total compiled-program count across the engine's jitted
+        callables (the wrapper-counter the recompile guard pins: jit
+        cache growth == a recompilation happened)."""
+        n = 0
+        for f in (self._decode_greedy, self._decode_sampled,
+                  self._prefill, self._pick):
+            try:
+                n += f._cache_size()
+            except Exception:  # noqa: BLE001 — cache introspection only
+                pass
+        return n
+
+    def _upload_sampling_state(self):
+        """ONE host->device refresh of sampling params + active mask.
+        Called only when slot membership changed (admission/eviction) —
+        the steady-state decode step reads the device-resident copies
+        and does zero uploads."""
+        self._temps_dev = jnp.asarray(self._temps)
+        self._top_ks_dev = jnp.asarray(self._top_ks)
+        self._top_ps_dev = jnp.asarray(self._top_ps)
+        self._active_dev = jnp.asarray(self._active)
+        self._sampled_active = bool((self._temps[self._active] > 0).any())
+        self._params_dirty = False
+        self._param_uploads += 1
+        _engine_metrics()["param_uploads"].inc(1)
 
     def _fresh_cache(self) -> Dict:
         cache = init_slotted_cache(self.cfg, self.num_slots, self.max_len)
@@ -454,12 +596,33 @@ class ContinuousBatchingEngine:
 
     def stats(self) -> Dict:
         with self._lock:
+            ts = max(self._timed_steps, 1)
             return {
                 "steps": self._steps,
                 "active": len(self._slots),
                 "waiting": len(self._waiting),
                 "prefilling": len(self._prefilling),
                 "free_slots": len(self._free),
+                # Hot-loop hygiene (tests pin these in steady state).
+                "compiles": self._compile_count(),
+                "warm_compiles": self._warm_compiles,
+                "recompiles_post_warm": self._recompiles,
+                "param_uploads": self._param_uploads,
+                # Per-step wall-time decomposition: where an engine step
+                # goes beyond the raw decode step (EQuARX discipline —
+                # you cannot shrink a step you cannot decompose).
+                # _total fields are cumulative: probes delta two stats()
+                # snapshots for a clean steady-state window (the avgs
+                # include admission/prefill-heavy iterations).
+                "timing": {
+                    "steps_timed": self._timed_steps,
+                    "dispatch_ms_avg": self._t_dispatch / ts * 1e3,
+                    "fetch_ms_avg": self._t_fetch / ts * 1e3,
+                    "host_ms_avg": self._t_host / ts * 1e3,
+                    "dispatch_ms_total": self._t_dispatch * 1e3,
+                    "fetch_ms_total": self._t_fetch * 1e3,
+                    "host_ms_total": self._t_host * 1e3,
+                },
             }
 
     def shutdown(self):
@@ -500,8 +663,15 @@ class ContinuousBatchingEngine:
     def _advance_prefills(self):
         """One prefill chunk for every mid-prefill slot (interleaved
         between decode dispatches). A request whose final chunk lands
-        emits its first token and joins the decode set."""
+        emits its first token and joins the decode set.
+
+        First tokens stay ON DEVICE through admission: each finishing
+        slot's pick feeds _tokens_dev device-to-device, and ONE batched
+        fetch (async copy started at dispatch, drained once) delivers
+        all of this round's first tokens to their handles — not one
+        blocking scalar device_get per request."""
         c = self.prefill_chunk
+        finished = []  # (slot, handle, first-token device array [1])
         for slot, entry in list(self._prefilling.items()):
             h, off = entry["h"], entry["offset"]
             chunk = h.prompt[off:off + c]
@@ -519,15 +689,28 @@ class ContinuousBatchingEngine:
             # Final chunk: first token under the request's sampling.
             if h.temperature > 0:
                 self._rng, key = jax.random.split(self._rng)
-                tok = int(jax.device_get(self._pick(
+                tok_dev = self._pick(
                     logits,
                     jnp.full(1, h.temperature, jnp.float32),
                     jnp.full(1, h.top_k, jnp.int32),
                     jnp.full(1, h.top_p, jnp.float32),
                     key,
-                ))[0])
+                )
             else:
-                tok = int(jax.device_get(jnp.argmax(logits, -1))[0])
+                tok_dev = jnp.argmax(logits, -1).astype(jnp.int32)
+            # Feed the decode loop device-side (no host round trip) and
+            # start the non-blocking copy for the handle push below.
+            self._tokens_dev = self._tokens_dev.at[slot].set(tok_dev[0])
+            try:
+                tok_dev.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — sharded layouts fetch below
+                pass
+            finished.append((slot, h, tok_dev))
+        if not finished:
+            return
+        toks_np = jax.device_get([t for _, _, t in finished])
+        for (slot, h, _), tok_arr in zip(finished, toks_np):
+            tok = int(tok_arr[0])
             h.produced = 1
             # admitted_at_step must be visible before the push wakes a
             # consumer (a request finishing on its prefill token would
@@ -547,18 +730,26 @@ class ContinuousBatchingEngine:
                     self._temps[slot] = h.temperature
                     self._top_ks[slot] = h.top_k
                     self._top_ps[slot] = h.top_p
-                    self._tokens_dev = self._tokens_dev.at[slot].set(tok)
+                    self._active[slot] = True
+                    self._params_dirty = True
 
     def _loop(self):
-        """Pipelined decode loop: dispatch step k+1 (inputs taken from
-        step k's ON-DEVICE argmax), then fetch and distribute step k's
-        tokens while k+1 executes. Eviction therefore lags one step —
-        a finished slot rides one extra (suppressed) step before its
-        slot frees, buying max(step, fetch) instead of step + fetch
-        per token."""
+        """Pipelined decode loop with ASYNC double-buffered fetch:
+        dispatch step k+1 (inputs taken from step k's ON-DEVICE pick),
+        start the non-blocking device->host copy of step k+1's outputs,
+        then drain step k's copy — which was started a full iteration
+        ago and has had an entire decode step to complete — and
+        distribute its tokens. Eviction therefore lags one step (a
+        finished slot rides one extra suppressed step before its slot
+        frees), buying max(step, fetch) instead of step + fetch per
+        token; in steady state the drain returns an already-landed
+        buffer and the loop does ZERO avoidable host<->device traffic
+        per step (sampling params device-resident, no per-step
+        uploads)."""
         inflight = None  # (snapshot [(slot, gen, handle)], tokens_dev, lengths_dev)
         while self._running:
             try:
+                t_iter = time.perf_counter()
                 with self._lock:
                     self._admit_locked()
                 self._advance_prefills()
@@ -567,37 +758,49 @@ class ContinuousBatchingEngine:
                         (s, int(self._gen[s]), h)
                         for s, h in self._slots.items()
                     ]
+                dispatch_s = 0.0
                 if snapshot:
-                    active = np.zeros(self.num_slots, dtype=bool)
-                    for s, _, _ in snapshot:
-                        active[s] = True
-                    if float(self._temps[active].max(initial=0.0)) > 0:
+                    if self._params_dirty:
+                        self._upload_sampling_state()
+                    t0 = time.perf_counter()
+                    if self._sampled_active:
                         self._rng, step_key = jax.random.split(self._rng)
                         (next_dev, self._k, self._v,
                          self._lengths) = self._decode_sampled(
                             self.params, self._tokens_dev,
                             self._k, self._v, self._lengths,
-                            jnp.asarray(active),
-                            jnp.asarray(self._temps),
-                            jnp.asarray(self._top_ks),
-                            jnp.asarray(self._top_ps), step_key,
+                            self._active_dev, self._temps_dev,
+                            self._top_ks_dev, self._top_ps_dev, step_key,
                         )
                     else:
                         (next_dev, self._k, self._v,
                          self._lengths) = self._decode_greedy(
                             self.params, self._tokens_dev,
                             self._k, self._v, self._lengths,
-                            jnp.asarray(active),
+                            self._active_dev,
                         )
                     self._tokens_dev = next_dev
+                    # Start the D2H copy NOW: it lands while this thread
+                    # distributes the previous step's tokens and the
+                    # next iteration dispatches — the drain below then
+                    # finds a finished buffer instead of blocking.
+                    try:
+                        next_dev.copy_to_host_async()
+                        self._lengths.copy_to_host_async()
+                    except Exception:  # noqa: BLE001 — device_get covers it
+                        pass
+                    dispatch_s = time.perf_counter() - t0
                     new_inflight = (snapshot, next_dev, self._lengths)
                 else:
                     new_inflight = None
+                fetch_s = 0.0
                 if inflight is not None:
                     prev_snapshot, prev_tokens, prev_lengths = inflight
+                    t0 = time.perf_counter()
                     toks, lengths_np = jax.device_get(
                         (prev_tokens, prev_lengths)
                     )
+                    fetch_s = time.perf_counter() - t0
                     with self._lock:
                         self._steps += 1
                         for s, gen, h in prev_snapshot:
@@ -619,7 +822,33 @@ class ContinuousBatchingEngine:
                                 del self._slots[s]
                                 self._free.append(s)
                                 self._gen[s] += 1
+                                self._active[s] = False
+                                self._temps[s] = 0.0
+                                self._top_ks[s] = 0
+                                self._top_ps[s] = 1.0
+                                self._params_dirty = True
                 inflight = new_inflight
+                if snapshot:
+                    host_s = max(
+                        time.perf_counter() - t_iter - dispatch_s - fetch_s,
+                        0.0,
+                    )
+                    m = _engine_metrics()
+                    m["dispatch_ms"].observe(dispatch_s * 1e3)
+                    m["fetch_ms"].observe(fetch_s * 1e3)
+                    m["host_ms"].observe(host_s * 1e3)
+                    compiles = self._compile_count()
+                    grew = compiles - self._last_compiles
+                    if grew > 0:
+                        self._last_compiles = compiles
+                        m["recompiles"].inc(grew)
+                    with self._lock:
+                        self._t_dispatch += dispatch_s
+                        self._t_fetch += fetch_s
+                        self._t_host += host_s
+                        self._timed_steps += 1
+                        if grew > 0:
+                            self._recompiles += grew
                 if inflight is None and not self._prefilling:
                     self._work.wait(timeout=0.5)
                     self._work.clear()
@@ -645,6 +874,11 @@ class ContinuousBatchingEngine:
                         self.num_slots, dtype=jnp.int32
                     )
                     self._gen += 1  # orphan any in-flight snapshot
+                    self._active[:] = False
+                    self._temps[:] = 0.0
+                    self._top_ks[:] = 0
+                    self._top_ps[:] = 1.0
+                    self._params_dirty = True
                 inflight = None
                 time.sleep(0.1)
 

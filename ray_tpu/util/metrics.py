@@ -143,6 +143,12 @@ class Histogram(Metric):
         self._boundaries = bounds
         # per-tags: [bucket_counts (len boundaries+1), sum, count]
         self._state: Dict[tuple, list] = {}
+        # Lifetime aggregates, NOT cleared by _drain: local observers
+        # (engine stats endpoints) read these without perturbing the
+        # once-a-second GCS/Prometheus flush.
+        self._life_sum = 0.0
+        self._life_count = 0
+        self._life_max = 0.0
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = _tags_key(self._merged(tags))
@@ -158,6 +164,23 @@ class Histogram(Metric):
             st[0][idx] += 1
             st[1] += value
             st[2] += 1
+            self._life_sum += value
+            self._life_count += 1
+            if value > self._life_max:
+                self._life_max = value
+
+    def summary(self) -> Dict:
+        """Lifetime {count, sum, avg, max} across all tag sets — a
+        local, non-draining read (the flusher's _drain keeps its own
+        delta state untouched by this)."""
+        with self._lock:
+            count = self._life_count
+            return {
+                "count": count,
+                "sum": self._life_sum,
+                "avg": self._life_sum / count if count else 0.0,
+                "max": self._life_max,
+            }
 
     def _drain(self):
         with self._lock:

@@ -1,0 +1,77 @@
+"""Store-independent ray_tpu.data unit tests.
+
+test_data.py / test_data_connectors.py pin the rt_start fixture module-
+wide (they exercise the distributed path through the shared-memory
+store). The codec and batching logic below has no runtime dependency at
+all — these tests run even where libray_tpu_store.so cannot load, so
+the pure-Python contracts stay covered on every box.
+"""
+
+import numpy as np
+import pytest
+
+
+def test_encode_example_accepts_numpy_scalars_and_arrays():
+    """map() outputs on the list-of-rows block path carry np.int64 /
+    np.float32 / np.ndarray values straight into the TFRecord sink;
+    encode_example must normalize them to the Python equivalents the
+    Arrow path gets from to_pylist (connectors.py encode_example) —
+    and produce the IDENTICAL wire bytes."""
+    from ray_tpu.data.connectors import decode_example, encode_example
+
+    plain = {
+        "label": 7,
+        "score": 0.25,
+        "ids": [1, 2, 300000],
+        "weights": [0.5, 1.5],
+        "name": b"cat",
+    }
+    numpyed = {
+        "label": np.int64(7),
+        "score": np.float32(0.25),
+        "ids": np.array([1, 2, 300000], dtype=np.int64),
+        "weights": [np.float32(0.5), np.float64(1.5)],
+        "name": np.bytes_(b"cat"),
+    }
+    assert encode_example(numpyed) == encode_example(plain)
+    decoded = decode_example(encode_example(numpyed))
+    assert decoded["label"] == [7]
+    assert decoded["ids"] == [1, 2, 300000]
+    assert decoded["name"] == [b"cat"]
+    np.testing.assert_allclose(decoded["weights"], [0.5, 1.5], rtol=1e-6)
+    # np.bool_ rides the int64 branch like Python bool.
+    assert decode_example(encode_example({"flag": np.bool_(True)}))[
+        "flag"
+    ] == [1]
+    # Unsupported dtypes still fail loudly, post-normalization.
+    with pytest.raises(TypeError):
+        encode_example({"bad": object()})
+
+
+def test_iter_numpy_batches_schema_mismatch_is_diagnosed():
+    """A batch straddling blocks with DIFFERENT column sets must fail
+    with a ValueError naming both schemas, not a bare KeyError from the
+    carry-merge concatenate (dataset.py _iter_numpy_batches). Blocks
+    are injected directly so the straddle is guaranteed: batch_size 8
+    over two 5-row blocks forces a carry across the boundary."""
+    import pyarrow as pa
+
+    from ray_tpu.data.dataset import Dataset
+
+    blocks = [
+        pa.table({"x": list(range(5))}),
+        pa.table({"y": list(range(5))}),
+    ]
+    ds = Dataset.__new__(Dataset)
+    ds._iter_blocks = lambda prefetch_blocks=0: iter(blocks)
+    with pytest.raises(ValueError, match="schema mismatch across blocks"):
+        list(ds._iter_numpy_batches(batch_size=8, prefetch_blocks=0))
+    # Same column sets, same straddle: concatenates fine.
+    ok = [
+        pa.table({"x": list(range(5))}),
+        pa.table({"x": list(range(5, 10))}),
+    ]
+    ds._iter_blocks = lambda prefetch_blocks=0: iter(ok)
+    batches = list(ds._iter_numpy_batches(batch_size=8, prefetch_blocks=0))
+    assert [len(b["x"]) for b in batches] == [8, 2]
+    assert list(batches[0]["x"]) == list(range(8))

@@ -292,6 +292,58 @@ def test_appo_cartpole_improves(rt_start):
         algo.stop()
 
 
+class _PickleCartPole:
+    """Classic cart-pole dynamics on plain numpy with the gymnasium API
+    (reset -> (obs, info), step -> 5-tuple). Env runners cloudpickle the
+    live env — RNG state and all — into checkpoints for exact resume
+    (env_runner.py:140); gym's own envs may hold unpicklable handles
+    depending on build, which used to skip the restore test below. This
+    env always pickles, so the bit-identical-resume assertion always
+    runs."""
+
+    _GRAV, _MASS_CART, _MASS_POLE = 9.8, 1.0, 0.1
+    _HALF_LEN, _FORCE, _DT = 0.5, 10.0, 0.02
+    _X_LIM, _THETA_LIM = 2.4, 12 * np.pi / 180.0
+
+    def __init__(self, seed=0):
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros(4, dtype=np.float64)
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32), {}
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self._state
+        force = self._FORCE if int(action) == 1 else -self._FORCE
+        total_m = self._MASS_CART + self._MASS_POLE
+        pole_ml = self._MASS_POLE * self._HALF_LEN
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        tmp = (force + pole_ml * theta_dot**2 * sin_t) / total_m
+        theta_acc = (self._GRAV * sin_t - cos_t * tmp) / (
+            self._HALF_LEN
+            * (4.0 / 3.0 - self._MASS_POLE * cos_t**2 / total_m)
+        )
+        x_acc = tmp - pole_ml * theta_acc * cos_t / total_m
+        self._state = np.array([
+            x + self._DT * x_dot,
+            x_dot + self._DT * x_acc,
+            theta + self._DT * theta_dot,
+            theta_dot + self._DT * theta_acc,
+        ])
+        self._t += 1
+        terminated = bool(
+            abs(self._state[0]) > self._X_LIM
+            or abs(self._state[2]) > self._THETA_LIM
+        )
+        truncated = self._t >= 200
+        return self._state.astype(np.float32), 1.0, terminated, truncated, {}
+
+
 @pytest.mark.usefixtures("rt_start")
 @pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
 @pytest.mark.slow
@@ -299,19 +351,13 @@ def test_ppo_evaluation_and_checkpoint_restore(tmp_path):
     """VERDICT r3 item 6: periodic evaluation on dedicated runners with
     eval metrics in results (reference: algorithm.py:795 +
     evaluation/worker_set.py:82), and Algorithm.save/restore continuing
-    mid-train with an identical learning curve."""
-    import cloudpickle
-    import gymnasium as gym
-
-    try:
-        cloudpickle.loads(cloudpickle.dumps(gym.make("CartPole-v1")))
-    except Exception:
-        pytest.skip("gym env not picklable; exact-resume path unavailable")
+    mid-train with an identical learning curve. Uses _PickleCartPole so
+    the exact-resume path is always exercised (no picklability skip)."""
 
     def build():
         return (
             PPOConfig()
-            .environment(lambda: gym.make("CartPole-v1"),
+            .environment(lambda: _PickleCartPole(),
                          obs_dim=4, num_actions=2)
             .env_runners(num_env_runners=1, rollout_length=128)
             .training(lr=3e-3, num_epochs=2, minibatch_size=64)

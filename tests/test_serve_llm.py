@@ -291,6 +291,96 @@ def test_continuous_batching_mixed_sampling():
         eng.submit([1], top_k=10_000)  # beyond MAX_TOP_K
 
 
+def test_continuous_batching_steady_state_zero_host_traffic():
+    """PERF CONTRACT for the device-resident hot loop: once all slots
+    are admitted and decoding (mixed greedy + sampled), a >=32-step
+    window must see ZERO recompilations and ZERO host->device
+    sampling-param uploads. Any per-step jnp.asarray of temps/top_k/
+    top_p/active, or a shape/dtype flip that retraces a jitted step,
+    reintroduces the per-step tunnel RTTs this engine was rebuilt to
+    eliminate (ISSUE r6 tentpole; BENCH_INFER r5 showed a ~20x
+    engine-vs-raw throughput hole from exactly this traffic)."""
+    import time
+
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(params, cfg, num_slots=4, max_len=256)
+    try:
+        handles = [
+            eng.submit([3, 7, 11, 2], max_new_tokens=160),
+            eng.submit([5, 1, 8], max_new_tokens=160),
+            eng.submit([2, 9], max_new_tokens=160,
+                       temperature=0.7, top_k=16),
+            eng.submit([4, 4, 6, 1, 3], max_new_tokens=160,
+                       temperature=1.1, top_p=0.9),
+        ]
+        deadline = time.monotonic() + 180
+        # Steady state: every request admitted, prefills drained.
+        while time.monotonic() < deadline:
+            s0 = eng.stats()
+            if s0["active"] == 4 and s0["prefilling"] == 0:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail(f"never reached steady state: {eng.stats()}")
+        # Let the loop take two more steps before opening the window:
+        # the LAST admission's param upload lands at the next snapshot
+        # after stats() can already report active==4.
+        settle = s0["steps"] + 2
+        while time.monotonic() < deadline:
+            s0 = eng.stats()
+            if s0["steps"] >= settle:
+                break
+            time.sleep(0.005)
+        while time.monotonic() < deadline:
+            s1 = eng.stats()
+            if s1["steps"] - s0["steps"] >= 32:
+                break
+            time.sleep(0.01)
+        assert s1["steps"] - s0["steps"] >= 32, (
+            f"window too short: {s1['steps'] - s0['steps']} steps"
+        )
+        assert s1["active"] == 4, "a request finished inside the window"
+        assert s1["compiles"] == s0["compiles"], (
+            f"recompiled mid-decode: {s0['compiles']} -> {s1['compiles']}"
+        )
+        assert s1["param_uploads"] == s0["param_uploads"], (
+            "sampling params re-uploaded during steady-state decode: "
+            f"{s0['param_uploads']} -> {s1['param_uploads']}"
+        )
+        assert s1["recompiles_post_warm"] == 0
+        # Warmup is the ONLY compile site: admission of real traffic
+        # (greedy AND sampled, prefill, pick) hits warmed programs.
+        assert s1["compiles"] == s1["warm_compiles"]
+        for h in handles:
+            out = h.result(timeout=180)
+            assert len(out) == 160
+    finally:
+        eng.shutdown()
+
+
+def test_continuous_batching_step_timing_breakdown():
+    """stats()['timing'] decomposes engine steps into dispatch/fetch/
+    host wall-time; totals are cumulative (probes delta two snapshots)
+    and consistent with the averages."""
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(params, cfg, num_slots=2, max_len=64)
+    try:
+        eng.submit([3, 7, 11], max_new_tokens=12).result(timeout=180)
+        t = eng.stats()["timing"]
+        assert t["steps_timed"] >= 12
+        for part in ("dispatch", "fetch", "host"):
+            total = t[f"{part}_ms_total"]
+            avg = t[f"{part}_ms_avg"]
+            assert total >= 0.0
+            assert avg == pytest.approx(total / t["steps_timed"])
+    finally:
+        eng.shutdown()
+
+
 def test_continuous_batching_tp_sharded():
     """The engine over a tp=8 mesh (KV heads sharded, params via
     shard_params) decodes bit-identically to the single-device engine —

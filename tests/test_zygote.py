@@ -113,3 +113,34 @@ def test_retired_generation_closes_after_children_exit(low_limit):
         assert not mgr._old, "retired zygotes lingered after last child"
     finally:
         mgr.stop()
+
+
+def test_stop_is_not_counted_as_a_zygote_death():
+    """stop() marks every generation retiring BEFORE closing it, so the
+    reader threads' EOFs read as intentional shutdown — NOT unexpected
+    deaths. Without that ordering, 3 stop/start cycles (common across
+    rt.init/shutdown in one process, since the manager is process-
+    shared) hit the _deaths >= 3 breaker and permanently push every
+    spawn onto the slow Popen path."""
+    from ray_tpu._private.zygote_client import ZygoteManager
+
+    mgr = ZygoteManager()
+    try:
+        for _ in range(4):  # one past the 3-death disable threshold
+            assert mgr.start()
+            proc = mgr.proc
+            mgr.stop()
+            # The reader thread sees EOF once the zygote exits; give it
+            # a beat to run its accounting before the next cycle.
+            deadline = time.monotonic() + 15
+            while proc.poll() is None:
+                assert time.monotonic() < deadline, "zygote never exited"
+                time.sleep(0.02)
+            time.sleep(0.1)
+        assert mgr._deaths == 0
+        # The breaker never tripped: the manager still serves forks.
+        zp = mgr.spawn({"PATH": os.environ.get("PATH", ""),
+                        "PYTHONPATH": "/"})
+        assert zp is not None
+    finally:
+        mgr.stop()

@@ -57,6 +57,29 @@ def _bench_scale_tasks(n: int, field: str):
     return get
 
 
+def _bench_infer(metric_sub: str, field: str, **where):
+    def get():
+        for e in _load("BENCH_INFER.json"):
+            if metric_sub in e.get("metric", "") and all(
+                e.get(k) == v for k, v in where.items()
+            ):
+                return e[field]
+        raise KeyError(
+            f"no BENCH_INFER entry matching {metric_sub!r} {where}"
+        )
+    return get
+
+
+def _bench_infer_r5_implied_step_ms():
+    """The r5 TPU continuous-batching probe ran 4 slots; its implied
+    steady-state engine step is slots / throughput."""
+    def get():
+        tps = _bench_infer("continuous batching tokens/s/chip",
+                           "continuous_tokens_per_s")()
+        return 4.0 / tps * 1e3
+    return get
+
+
 def _bench_r(field: str, sub: str = None):
     def get():
         d = _load("BENCH_TPU_LIVE.json")
@@ -134,6 +157,50 @@ CLAIMS = [
           _bench_scale_tasks(1_000_000, "us_per_task"), rel_tol=0.3),
     # COMPONENTS flagship MFU <- live TPU artifact.
     Claim("COMPONENTS.md", r"MFU (0\.\d+)", _bench_r("mfu"), rel_tol=0.08),
+    # Serving-engine hot-loop numbers <- BENCH_INFER stepwise probe.
+    # Quoted in MIGRATION.md and the bench_infer.py probe docstring;
+    # tight tolerance — docs and artifact are committed together.
+    Claim("MIGRATION.md", r"engine step (\d+\.\d+) ms",
+          _bench_infer("engine step breakdown", "engine_step_ms"),
+          rel_tol=0.02),
+    Claim("MIGRATION.md", r"raw decode floor (\d+\.\d+) ms",
+          _bench_infer("engine step breakdown", "raw_decode_step_ms"),
+          rel_tol=0.02),
+    Claim("MIGRATION.md", r"throughput ratio (\d+\.\d+)",
+          _bench_infer("engine vs raw decode throughput",
+                       "engine_vs_raw_throughput_ratio"),
+          rel_tol=0.02),
+    Claim("MIGRATION.md", r"pins (\d+) compiles",
+          _bench_infer("engine step breakdown", "compiles_in_window")),
+    Claim("MIGRATION.md", r"and (\d+) param uploads",
+          _bench_infer("engine step breakdown",
+                       "param_uploads_in_window")),
+    Claim("MIGRATION.md", r"implied (\d+\.\d+) ms/step",
+          _bench_infer_r5_implied_step_ms(), rel_tol=0.02,
+          note="r5 engine step implied by 4 slots / continuous tok/s"),
+    Claim("MIGRATION.md", r"a (\d+\.\d+) ms raw batch-8 decode",
+          _bench_infer("llama2(0.8B) decode", "ms_per_decode_step",
+                       batch=8),
+          rel_tol=0.02),
+    Claim("bench_infer.py", r"step (\d+\.\d+) ms vs raw floor",
+          _bench_infer("engine step breakdown", "engine_step_ms"),
+          rel_tol=0.02),
+    Claim("bench_infer.py", r"vs raw floor (\d+\.\d+) ms",
+          _bench_infer("engine step breakdown", "raw_decode_step_ms"),
+          rel_tol=0.02),
+    Claim("bench_infer.py", r"overhead (-?\d+\.\d+) ms",
+          _bench_infer("engine step breakdown", "engine_overhead_ms"),
+          rel_tol=0.05),
+    Claim("bench_infer.py", r"ratio of (\d+\.\d+)",
+          _bench_infer("engine vs raw decode throughput",
+                       "engine_vs_raw_throughput_ratio"),
+          rel_tol=0.02),
+    Claim("bench_infer.py", r"implied (\d+\.\d+) ms engine step",
+          _bench_infer_r5_implied_step_ms(), rel_tol=0.02),
+    Claim("bench_infer.py", r"artifact's (\d+\.\d+) ms raw batch-8",
+          _bench_infer("llama2(0.8B) decode", "ms_per_decode_step",
+                       batch=8),
+          rel_tol=0.02),
 ]
 
 
