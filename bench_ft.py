@@ -1,0 +1,151 @@
+"""Fault-tolerance probes: failure detection, gang rebuild, timeout trip.
+
+Measures the three latencies the gang fault-tolerance path promises
+(MIGRATION.md "Fault tolerance" quotes these; tools/check_claims.py pins
+the quotes to BENCH_FT.json):
+
+  * kill-to-detection: a rank hard-killed mid-training -> the trainer's
+    poll raises a classified TrainingFailedError. Bounded by the 50ms
+    poll cadence plus actor-death propagation, NOT by rt.get timeouts.
+  * gang rebuild: executor.restart() wall time — kill survivors, release
+    the placement group, re-reserve, respawn workers at the next epoch.
+  * collective timeout trip: a DCN peer that connects then goes silent
+    trips CollectiveTimeoutError one op_timeout after the recv starts.
+
+Run: python bench_ft.py [--quick]
+CPU-gang numbers on the dev image; TPU pods add scheduler/preemption
+latency on top but the detection/rebuild machinery is identical.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _median(f, n: int):
+    vals = [f() for _ in range(n)]
+    return float(np.median(vals))
+
+
+def probe_detection_and_rebuild(results, rounds: int):
+    import ray_tpu as rt
+    from ray_tpu._private import chaos
+    from ray_tpu.train.backend import JaxConfig
+    from ray_tpu.train.backend_executor import (
+        BackendExecutor,
+        TrainingFailedError,
+    )
+    from ray_tpu.train.config import ScalingConfig
+
+    rt.init(num_cpus=4)
+    chaos.enable()
+
+    def idle_loop():
+        import time as _t
+
+        from ray_tpu import train
+
+        while not train.should_stop():
+            _t.sleep(0.02)
+
+    executor = BackendExecutor(
+        JaxConfig(dp_sync="none"), ScalingConfig(num_workers=2)
+    )
+    executor.start()
+    detect_ms, rebuild_s = [], []
+    try:
+        for _ in range(rounds):
+            executor.start_training(idle_loop, {}, None, "/tmp/bench_ft")
+            executor.poll()  # workers up and answering
+            chaos.kill_rank(executor.worker_group, 1)
+            t0 = time.monotonic()
+            while True:
+                try:
+                    executor.poll()
+                    time.sleep(0.05)
+                except TrainingFailedError as e:
+                    assert e.failed_ranks == [1]
+                    detect_ms.append((time.monotonic() - t0) * 1e3)
+                    break
+            t0 = time.monotonic()
+            executor.restart()
+            # restart() returns once actors are submitted (creation is
+            # pipelined); "rebuilt" means every rank answers a probe.
+            while executor.ping(timeout=10):
+                time.sleep(0.01)
+            rebuild_s.append(time.monotonic() - t0)
+    finally:
+        executor.shutdown()
+        chaos.disable()
+        rt.shutdown()
+
+    for entry in (
+        {"metric": "kill-to-detection (2 CPU workers)",
+         "detect_ms": round(float(np.median(detect_ms)), 1)},
+        {"metric": "gang rebuild at next epoch (2 CPU workers)",
+         "rebuild_s": round(float(np.median(rebuild_s)), 2)},
+    ):
+        print(json.dumps(entry))
+        results.append(entry)
+
+
+def probe_collective_timeout(results, rounds: int):
+    from ray_tpu.util.collective.dcn_group import DcnGroup
+
+    class _KV:
+        def __init__(self):
+            self._d = {}
+
+        def kv_put(self, k, v, ns=""):
+            self._d[(ns, k)] = v
+
+        def kv_get(self, k, ns=""):
+            return self._d.get((ns, k))
+
+        def kv_del(self, k, ns=""):
+            self._d.pop((ns, k), None)
+
+    op_timeout = 0.5
+
+    def trip_once():
+        kv = _KV()
+        g0 = DcnGroup(kv, 2, 0, "bench", timeout=5, op_timeout=op_timeout)
+        g1 = DcnGroup(kv, 2, 1, "bench", timeout=5, op_timeout=op_timeout)
+        try:
+            g1._peer_out(0)  # connect + identify, then go silent
+            t0 = time.monotonic()
+            try:
+                g0.recv(1)
+            except Exception:
+                return time.monotonic() - t0
+            raise AssertionError("silent peer did not trip the deadline")
+        finally:
+            g0.destroy()
+            g1.destroy()
+
+    entry = {
+        "metric": "dcn collective timeout trip",
+        "op_timeout_s": op_timeout,
+        "trip_s": round(_median(trip_once, rounds), 3),
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    rounds = 1 if quick else 3
+    results = []
+    probe_detection_and_rebuild(results, rounds)
+    probe_collective_timeout(results, rounds)
+    if not quick:
+        with open("BENCH_FT.json", "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Deterministic fault injection for chaos testing.
+
+Reference analogs: ResourceKillerActor / WorkerKillerActor / RayletKiller
+(python/ray/_private/test_utils.py:1396,1446,1527) — reshaped as a small
+library the train/serve/data layers and their chaos suites share instead
+of each test hand-rolling kill threads.
+
+Everything here is gated on RT_CHAOS=1 (set by `enable()`), so a stray
+import in production code can never inject a fault. Injection is
+deterministic by construction: faults fire at a caller-chosen point
+(`once()` markers on shared storage make "exactly once across restarts"
+trivial), never on a timer.
+
+Driver-side injections (drain, poll delay) live in process-local state;
+worker-side helpers (`die`, `sever_dcn_peer`) execute inside the worker
+that calls them — ship them there with `worker_group.execute*` or call
+them from the training loop itself.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Iterable, Optional, Set
+
+_ENV = "RT_CHAOS"
+
+_lock = threading.Lock()
+# rank -> pretend "this rank's node is draining" (consumed once, like a
+# real preemption notice).
+_injected_drain_ranks: Set[int] = set()
+# Deterministic delay applied to the next executor polls (seconds, count).
+_poll_delay_s: float = 0.0
+_poll_delays_left: int = 0
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV, "").lower() in ("1", "true", "yes")
+
+
+def enable():
+    """Turn fault injection on for this process AND its future children
+    (worker processes inherit the environment)."""
+    os.environ[_ENV] = "1"
+
+
+def disable():
+    os.environ.pop(_ENV, None)
+    clear()
+
+
+def clear():
+    """Drop all pending driver-side injections."""
+    global _poll_delay_s, _poll_delays_left
+    with _lock:
+        _injected_drain_ranks.clear()
+        _poll_delay_s = 0.0
+        _poll_delays_left = 0
+
+
+def _require_enabled(what: str):
+    if not enabled():
+        raise RuntimeError(
+            f"chaos.{what} called without RT_CHAOS=1 — call chaos.enable() "
+            f"first (fault injection is refused in production)"
+        )
+
+
+# -- cross-process / cross-attempt determinism ---------------------------
+def once(marker_dir: str, key: str) -> bool:
+    """True exactly once per (marker_dir, key), across processes and
+    restart attempts — the standard guard so an injected fault fires on
+    attempt 1 and never again. Atomic via O_CREAT|O_EXCL."""
+    path = os.path.join(marker_dir, f".chaos_once_{key}")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    os.close(fd)
+    return True
+
+
+# -- worker-side faults --------------------------------------------------
+def die(exit_code: int = 1):
+    """Kill this process like a preempted host would: immediate, no
+    cleanup handlers, no goodbye to the raylet (os._exit ~ SIGKILL)."""
+    _require_enabled("die")
+    os._exit(exit_code)
+
+
+def sever_dcn_peer(peer_rank: int, group_name: str = "default"):
+    """Cut this process's DCN sockets to/from `peer_rank` — the network
+    analog of a host vanishing: the peer's next op on the link raises
+    (closed) and ours times out instead of hanging."""
+    _require_enabled("sever_dcn_peer")
+    from ray_tpu.util.collective.collective import _manager
+
+    group = _manager.get(group_name)
+    for table in (group._accepted, group._outgoing):
+        peer = table.pop(peer_rank, None)
+        if peer is not None:
+            try:
+                peer.sock.close()
+            except OSError:
+                pass
+
+
+# -- driver-side faults --------------------------------------------------
+def kill_rank(worker_group, rank: int):
+    """Hard-kill one rank's TrainWorker actor (preemption of its host)."""
+    _require_enabled("kill_rank")
+    import ray_tpu as rt
+
+    rt.kill(worker_group.workers[rank])
+
+
+def inject_drain(ranks: Iterable[int]):
+    """Pretend the nodes hosting `ranks` received a preemption notice.
+    Consumed by BackendExecutor.draining_ranks() exactly once (a real
+    drain persists in the GCS node table; the injected one must not
+    re-trigger after the gang restarts elsewhere)."""
+    _require_enabled("inject_drain")
+    with _lock:
+        _injected_drain_ranks.update(int(r) for r in ranks)
+
+
+def take_injected_drain_ranks() -> Set[int]:
+    """Pop all injected drain ranks (empty when chaos is off)."""
+    if not enabled():
+        return set()
+    with _lock:
+        out = set(_injected_drain_ranks)
+        _injected_drain_ranks.clear()
+    return out
+
+
+def delay_polls(seconds: float, count: int = 1):
+    """Deterministically slow down the next `count` executor polls —
+    models a saturated control plane without nondeterministic sleeps
+    scattered through tests."""
+    _require_enabled("delay_polls")
+    global _poll_delay_s, _poll_delays_left
+    with _lock:
+        _poll_delay_s = float(seconds)
+        _poll_delays_left = int(count)
+
+
+def take_poll_delay() -> Optional[float]:
+    """Pop one pending poll delay (None when chaos is off or exhausted)."""
+    if not enabled():
+        return None
+    global _poll_delays_left
+    with _lock:
+        if _poll_delays_left <= 0:
+            return None
+        _poll_delays_left -= 1
+        return _poll_delay_s

@@ -247,6 +247,10 @@ class Config:
     # Upper bound on each app's collective replica health-check wait per
     # reconcile pass (one rt.wait over all replicas' health probes).
     serve_health_wait_s: float = 10.0
+    # Base/cap for the jittered backoff between replica re-dispatches on
+    # ActorError (a flapping replica must not be hammered in a tight loop).
+    serve_redispatch_backoff_s: float = 0.05
+    serve_redispatch_backoff_max_s: float = 2.0
 
     # -- data -------------------------------------------------------------
     # Undelivered blocks buffered per streaming_split consumer before the
@@ -257,6 +261,24 @@ class Config:
 
     # -- collective -----------------------------------------------------
     collective_rendezvous_timeout_s: float = 60.0
+    # Deadline on each blocking send/recv inside an eager DCN collective:
+    # a dead peer raises CollectiveTimeoutError instead of wedging the
+    # surviving ranks (The Big Send-off failure-path-first principle).
+    collective_op_timeout_s: float = 60.0
+
+    # -- train fault tolerance -------------------------------------------
+    # Bound on one poll() round trip to a training worker (detection
+    # latency for a hung rank; replaces the old blanket 600 s get).
+    train_poll_timeout_s: float = 60.0
+    # Bound on launching the training loop on the gang.
+    train_start_timeout_s: float = 600.0
+    # Low-cost liveness probe (ping) timeout per worker.
+    train_probe_timeout_s: float = 10.0
+    # How often the trainer's result loop checks for draining nodes.
+    train_drain_poll_interval_s: float = 0.5
+    # How long a drain-requested gang gets to checkpoint and exit before
+    # the restart proceeds with whatever checkpoint is registered.
+    train_drain_grace_s: float = 30.0
 
     # -- core worker ------------------------------------------------------
     # Owner-side object-directory lookups (location gets during restart

@@ -37,6 +37,21 @@ class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (e.g. restarting)."""
 
 
+class CollectiveTimeoutError(RayTpuError, TimeoutError):
+    """A collective op missed its deadline: a peer is dead or wedged.
+
+    Raised by the eager DCN ring instead of hanging in ``recv`` forever,
+    so one preempted rank converts into a restartable failure for the
+    whole gang. Carries enough context to identify the bad link."""
+
+    def __init__(self, message: str, *, group_name: str = "",
+                 rank=None, peer_rank=None):
+        self.group_name = group_name
+        self.rank = rank
+        self.peer_rank = peer_rank
+        super().__init__(message)
+
+
 class ObjectLostError(RayTpuError):
     """All copies of the object are gone and it cannot be reconstructed."""
 

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu as rt
 from ray_tpu._private.config import get_config
+from ray_tpu.exceptions import ActorError, WorkerCrashedError
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment
 from ray_tpu.serve.replica import ReplicaActor
 
@@ -450,13 +451,32 @@ class ServeController:
             for nid, e in entries.items()
         }
 
+    @staticmethod
+    def _actor_state(actor_id: bytes) -> Optional[str]:
+        """GCS-recorded state of an actor ("ALIVE"/"DEAD"/...), or None
+        when the lookup fails (treat as unknown, fall back to the
+        consecutive-failure threshold)."""
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            client = worker_mod.get_client()
+            info = client._run(
+                client._gcs_call("get_actor", {"actor_id": actor_id})
+            )["actor"]
+            return info["state"] if info else None
+        except Exception:  # noqa: BLE001 — control-plane hiccup
+            return None
+
     def _check_replica_health(self, name: str):
         """Drop dead replicas so reconcile replaces them — the
         DeploymentState failure-recovery role (deployment_state.py:1211).
         Probes run in PARALLEL (one slow app must not stall the reconcile
         loop) and a replica is declared dead only after 3 consecutive
         failed probes, so a replica that is briefly saturated (all
-        concurrency slots busy) or still loading a model is not killed."""
+        concurrency slots busy) or still loading a model is not killed.
+        Exception: a probe that fails with an actor-death error, or whose
+        actor the GCS already marked DEAD, is replaced immediately — the
+        threshold protects slow-but-alive replicas, not corpses."""
         with self._lock:
             app = self.apps.get(name)
             if app is None:
@@ -474,14 +494,32 @@ class ServeController:
         for r, ref in zip(replicas, refs):
             key = r._actor_id.binary()
             healthy = False
+            actor_dead = False
             if ref in ready_set:
                 try:
                     rt.get(ref, timeout=get_config().serve_probe_timeout_s)
                     healthy = True
+                except (ActorError, WorkerCrashedError):
+                    # The probe failed because the actor PROCESS is gone,
+                    # not because the replica was slow — there is nothing
+                    # a second probe could learn.
+                    actor_dead = True
                 except Exception:  # noqa: BLE001 — call errored: unhealthy
                     pass
+            elif self._actor_state(key) == "DEAD":
+                # Probe never completed AND the GCS already declared the
+                # actor dead (its worker lost the raylet connection).
+                actor_dead = True
             if healthy:
                 self._health_fails.pop(key, None)
+                continue
+            if actor_dead:
+                # Confirmed death bypasses the consecutive-failure
+                # threshold: the threshold exists to tolerate saturated-
+                # but-alive replicas, and waiting it out here just leaves
+                # a known-dead replica in the route table for two more
+                # reconcile ticks.
+                dead.append(r)
                 continue
             fails = self._health_fails.get(key, 0) + 1
             self._health_fails[key] = fails

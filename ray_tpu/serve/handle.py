@@ -44,6 +44,7 @@ class DeploymentResponse:
         # will not answer; send the request somewhere else".
         from ray_tpu.exceptions import ActorError, WorkerCrashedError
 
+        attempt = 0
         while True:
             try:
                 return rt.get(self.ref, timeout=timeout)
@@ -51,6 +52,19 @@ class DeploymentResponse:
                 if self._redispatch is None or self._retries_left <= 0:
                     raise
                 self._retries_left -= 1
+                # Capped exponential backoff with jitter before the next
+                # dispatch: when a replica dies under load, every queued
+                # caller retries at once — unjittered they'd stampede the
+                # survivors (and the controller's route table) in
+                # lockstep while self-healing is still replacing it.
+                cfg = get_config()
+                delay = min(
+                    cfg.serve_redispatch_backoff_s * (2 ** attempt),
+                    cfg.serve_redispatch_backoff_max_s,
+                )
+                if delay > 0:
+                    time.sleep(delay * (0.5 + 0.5 * random.random()))
+                attempt += 1
                 self.ref = self._redispatch()
 
 

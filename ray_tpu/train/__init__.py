@@ -30,7 +30,9 @@ from ray_tpu.train.session import (
     get_world_rank,
     get_world_size,
     report,
+    should_stop,
 )
+from ray_tpu.train.backend_executor import TrainingFailedError
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from ray_tpu.train.data_config import DataConfig
 from ray_tpu.train import torch  # noqa: F401 — train.torch.TorchTrainer
@@ -64,4 +66,6 @@ __all__ = [
     "get_local_rank",
     "get_trial_dir",
     "get_session",
+    "should_stop",
+    "TrainingFailedError",
 ]

@@ -70,7 +70,13 @@ class _JaxBackend(Backend):
                 _jax_distributed_init, coordinator=coordinator, world_size=n
             )
         elif backend_config.dp_sync == "dcn" and n > 1:
-            worker_group.execute_with_rank(_init_dcn_group, world_size=n)
+            # The gang epoch stamps the rendezvous keys: a zombie rank
+            # from a torn-down attempt rendezvouses under the old epoch
+            # and can never join (or deadlock) this ring.
+            worker_group.execute_with_rank(
+                _init_dcn_group, world_size=n,
+                epoch=getattr(worker_group, "epoch", 0),
+            )
 
     def on_shutdown(self, worker_group, backend_config: JaxConfig):
         if backend_config.dp_sync == "dcn" and len(worker_group) > 1:
@@ -97,11 +103,11 @@ def _jax_distributed_init(rank: int, coordinator: str, world_size: int):
     return True
 
 
-def _init_dcn_group(rank: int, world_size: int):
+def _init_dcn_group(rank: int, world_size: int, epoch: int = 0):
     from ray_tpu.util import collective as col
 
     col.init_collective_group(world_size, rank, backend="dcn",
-                              group_name="train_dp")
+                              group_name="train_dp", epoch=epoch)
     return True
 
 

@@ -3,23 +3,75 @@
 Analog of the reference's BackendExecutor
 (train/_internal/backend_executor.py:65): start() creates the WorkerGroup
 in a placement group and runs backend.on_start; start_training launches
-the user loop on every worker; get_next_results gathers reports; restarts
-recreate the group from the latest checkpoint (:701 _restart).
+the user loop on every worker; poll() gathers reports and converts actor
+deaths into a classified TrainingFailedError; restart() tears the whole
+gang down and rebuilds it at the next gang epoch (:701 _restart).
+
+Fault model: a TPU gang fails as a unit. Any rank dying (preemption, OOM,
+segfault) or wedging (network partition mid-collective) invalidates the
+collective state of every survivor, so recovery is always
+kill-everything → rebuild → resume-from-checkpoint. The gang `epoch` is
+threaded into DCN rendezvous keys so a zombie rank from attempt N can
+never join the ring built by attempt N+1.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
+import ray_tpu as rt
+from ray_tpu._private import chaos
+from ray_tpu._private.config import get_config
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    PlacementGroupSchedulingError,
+    WorkerCrashedError,
+)
 from ray_tpu.train.backend import BackendConfig
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
 
+# Exceptions on a worker call that mean "this rank's process is gone (or
+# unreachable for longer than we are willing to wait)" — the gang must be
+# torn down and rebuilt.
+_GANG_FATAL = (
+    ActorDiedError,
+    ActorUnavailableError,
+    WorkerCrashedError,
+    GetTimeoutError,
+)
+
 
 class TrainingFailedError(RuntimeError):
-    pass
+    """A training attempt failed (reference: TrainingFailedError in
+    train/base_trainer.py).
+
+    failed_ranks: world ranks whose workers died/wedged (empty when the
+      failure wasn't attributable to specific ranks, e.g. user-code error
+      surfaced through the report channel).
+    retryable: whether a gang restart can plausibly recover (actor death,
+      preemption, collective timeout → True; infeasible placement → False).
+    preempted: the failure was a proactive drain, not a crash — workers
+      were asked to checkpoint before the gang went down.
+    """
+
+    def __init__(self, message: str, *, failed_ranks=None,
+                 retryable: bool = True, preempted: bool = False,
+                 cause: Optional[BaseException] = None):
+        self.failed_ranks: List[int] = sorted(failed_ranks or [])
+        self.retryable = retryable
+        self.preempted = preempted
+        self.cause = cause
+        super().__init__(message)
+
+
+def _classify(rank: int, exc: Exception) -> str:
+    return f"rank {rank}: {type(exc).__name__}: {exc}"
 
 
 class BackendExecutor:
@@ -32,15 +84,52 @@ class BackendExecutor:
         self.scaling_config = scaling_config
         self.backend = backend_config.backend_cls()()
         self.worker_group: Optional[WorkerGroup] = None
+        # Gang attempt number; bumped by restart() and threaded into the
+        # DCN rendezvous so stale ranks can't join the new ring.
+        self.epoch = 0
+        self._last_drain_check = 0.0
 
+    # -- lifecycle -------------------------------------------------------
     def start(self):
-        self.worker_group = WorkerGroup(
-            self.scaling_config.num_workers,
-            self.scaling_config.worker_resources(),
-            self.scaling_config.placement_strategy,
-        )
+        try:
+            self.worker_group = WorkerGroup(
+                self.scaling_config.num_workers,
+                self.scaling_config.worker_resources(),
+                self.scaling_config.placement_strategy,
+                epoch=self.epoch,
+            )
+        except PlacementGroupSchedulingError as e:
+            # Infeasible bundles won't become feasible by retrying the
+            # same request against the same cluster.
+            raise TrainingFailedError(
+                f"worker group placement failed: {e}",
+                retryable=False, cause=e,
+            ) from e
         self.backend.on_start(self.worker_group, self.backend_config)
 
+    def restart(self):
+        """Tear the whole gang down and rebuild it one epoch later
+        (reference: _restart backend_executor.py:701). Survivor actors
+        are killed — after one rank dies the others' collective state is
+        garbage — and the placement group is released so a drained node's
+        resources aren't re-reserved."""
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group, self.backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+        self.epoch += 1
+        self.start()
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group, self.backend_config)
+            self.worker_group.shutdown()
+            self.worker_group = None
+
+    # -- training --------------------------------------------------------
     def start_training(
         self,
         train_fn: Callable,
@@ -51,26 +140,118 @@ class BackendExecutor:
     ):
         self.backend.on_training_start(self.worker_group, self.backend_config)
         refs = []
-        import ray_tpu as rt
-
         for i, w in enumerate(self.worker_group.workers):
             shard = dataset_shards[i] if dataset_shards else None
             refs.append(
                 w.start_training.remote(train_fn, config, checkpoint, trial_dir,
                                         shard)
             )
-        rt.get(refs, timeout=600)
+        self._get_per_rank(refs, get_config().train_start_timeout_s,
+                           what="start_training")
 
     def poll(self) -> List[Dict]:
-        """One poll of every worker: list of per-rank status dicts."""
-        import ray_tpu as rt
+        """One poll of every worker: list of per-rank status dicts.
 
-        return rt.get(
-            [w.poll.remote() for w in self.worker_group.workers], timeout=600
-        )
+        Dead/unreachable ranks raise TrainingFailedError carrying every
+        failed rank, not just the first — the trainer logs them all and
+        the metrics count them all. The timeout is train_poll_timeout_s
+        (dead actors surface immediately on the call; the timeout only
+        bounds hung-but-alive workers), NOT an unbounded get.
+        """
+        delay = chaos.take_poll_delay()
+        if delay:
+            time.sleep(delay)
+        refs = [w.poll.remote() for w in self.worker_group.workers]
+        return self._get_per_rank(refs, get_config().train_poll_timeout_s,
+                                  what="poll")
 
-    def shutdown(self):
-        if self.worker_group is not None:
-            self.backend.on_shutdown(self.worker_group, self.backend_config)
-            self.worker_group.shutdown()
-            self.worker_group = None
+    def _get_per_rank(self, refs, timeout: float, what: str) -> List:
+        results: List = [None] * len(refs)
+        failures: Dict[int, Exception] = {}
+        deadline = time.monotonic() + timeout
+        for i, ref in enumerate(refs):
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                results[i] = rt.get(ref, timeout=remaining)
+            except _GANG_FATAL as e:
+                failures[i] = e
+        if failures:
+            detail = "; ".join(
+                _classify(r, e) for r, e in sorted(failures.items())
+            )
+            raise TrainingFailedError(
+                f"{len(failures)}/{len(refs)} worker(s) failed during "
+                f"{what} (gang epoch {self.epoch}): {detail}",
+                failed_ranks=failures.keys(),
+                retryable=True,
+                cause=next(iter(failures.values())),
+            )
+        return results
+
+    # -- liveness / drain ------------------------------------------------
+    def ping(self, timeout: Optional[float] = None) -> Set[int]:
+        """Low-cost liveness probe: returns the set of unresponsive
+        ranks. Unlike poll() this never raises — it's the cheap periodic
+        check that bounds detection latency for wedged workers."""
+        if self.worker_group is None:
+            return set()
+        timeout = timeout or get_config().train_probe_timeout_s
+        refs = [w.ping.remote() for w in self.worker_group.workers]
+        bad: Set[int] = set()
+        for i, ref in enumerate(refs):
+            try:
+                rt.get(ref, timeout=timeout)
+            except (ActorError, WorkerCrashedError, GetTimeoutError):
+                bad.add(i)
+        return bad
+
+    def draining_ranks(self) -> Set[int]:
+        """Ranks whose nodes are draining (cordoned ahead of preemption).
+
+        Merges chaos-injected drains (deterministic tests) with the GCS
+        node table's `draining` flag, mapped to ranks through the
+        placement group's bundle→node assignment. The GCS lookup is
+        throttled to train_drain_poll_interval_s; injected drains are
+        process-local and always checked.
+        """
+        ranks = set(chaos.take_injected_drain_ranks())
+        cfg = get_config()
+        now = time.monotonic()
+        if now - self._last_drain_check >= cfg.train_drain_poll_interval_s:
+            self._last_drain_check = now
+            try:
+                ranks |= self._gcs_draining_ranks()
+            except Exception:
+                # Control-plane hiccup must not fail training; the next
+                # poll retries.
+                pass
+        return ranks
+
+    def _gcs_draining_ranks(self) -> Set[int]:
+        if self.worker_group is None:
+            return set()
+        draining_nodes = {
+            n["node_id"]
+            for n in rt.nodes()
+            if n.get("draining") and n["state"] == "ALIVE"
+        }
+        if not draining_nodes:
+            return set()
+        return {
+            i
+            for i, nid in enumerate(self.worker_group.node_ids())
+            if nid in draining_nodes
+        }
+
+    def request_stop_all(self):
+        """Ask every rank to checkpoint and return at the next
+        should_stop() check (proactive migration). Best-effort: a rank
+        already dead just stays dead."""
+        if self.worker_group is None:
+            return
+        refs = [w.request_stop.remote() for w in self.worker_group.workers]
+        for ref in refs:
+            try:
+                rt.get(ref, timeout=get_config().train_probe_timeout_s)
+            except Exception:
+                pass

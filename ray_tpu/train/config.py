@@ -42,10 +42,26 @@ class ScalingConfig:
 
 @dataclass
 class FailureConfig:
-    """Trial-level failure handling (reference: air/config.py:377)."""
+    """Trial-level failure handling (reference: air/config.py:377).
+
+    max_failures: gang restarts allowed before fit() gives up.
+    fail_fast: never retry, surface the first failure.
+    backoff_s / backoff_max_s: exponential backoff between restart
+      attempts (attempt k sleeps min(backoff_s * 2**k, backoff_max_s)) —
+      a crash-looping gang must not hammer the scheduler. The first
+      restart after a clean failure is immediate when backoff_s == 0.
+    """
 
     max_failures: int = 0
     fail_fast: bool = False
+    backoff_s: float = 1.0
+    backoff_max_s: float = 30.0
+
+    def backoff_for_attempt(self, attempt: int) -> float:
+        """Seconds to wait before restart attempt `attempt` (0-based)."""
+        if self.backoff_s <= 0:
+            return 0.0
+        return min(self.backoff_s * (2 ** attempt), self.backoff_max_s)
 
 
 @dataclass

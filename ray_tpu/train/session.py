@@ -38,6 +38,7 @@ class TrainSession:
         self._reports: List[Dict] = []
         self._finished = False
         self._error: Optional[BaseException] = None
+        self._stop_requested = threading.Event()
 
     # -- user API --------------------------------------------------------
     def report(self, metrics: Dict, checkpoint: Optional[Checkpoint] = None):
@@ -50,7 +51,17 @@ class TrainSession:
     def get_dataset_shard(self, name: str = "train"):
         return self._dataset_shards.get(name)
 
+    def should_stop(self) -> bool:
+        """True once the trainer asked this worker to stop early (its
+        node is draining ahead of preemption). Loops that check this each
+        step and report a checkpoint before returning migrate with zero
+        lost work; loops that don't are restarted from their last
+        checkpoint like any crash."""
+        return self._stop_requested.is_set()
+
     # -- trainer side ----------------------------------------------------
+    def request_stop(self):
+        self._stop_requested.set()
     def drain(self) -> List[Dict]:
         with self._lock:
             out = self._reports
@@ -105,6 +116,10 @@ def get_local_rank() -> int:
 
 def get_trial_dir() -> str:
     return get_session().trial_dir
+
+
+def should_stop() -> bool:
+    return get_session().should_stop()
 
 
 class TrainContext:

@@ -32,6 +32,28 @@ from ray_tpu.train.config import (
 )
 
 
+def _fault_metrics():
+    """train_restarts_total / train_worker_deaths_total /
+    train_recovery_seconds — created on first fit() (not import) so
+    merely importing the trainer doesn't start the metrics flusher."""
+    from ray_tpu.util import metrics as rt_metrics
+
+    return (
+        rt_metrics.get_or_create(
+            rt_metrics.Counter, "train_restarts_total",
+            "Gang restarts performed by fit() after retryable failures.",
+        ),
+        rt_metrics.get_or_create(
+            rt_metrics.Counter, "train_worker_deaths_total",
+            "Training worker ranks observed dead or unreachable.",
+        ),
+        rt_metrics.get_or_create(
+            rt_metrics.Histogram, "train_recovery_seconds",
+            "Seconds from gang teardown to the rebuilt gang being ready.",
+        ),
+    )
+
+
 class BaseTrainer:
     def __init__(
         self,
@@ -100,28 +122,27 @@ class DataParallelTrainer(BaseTrainer):
         self.dataset_config = dataset_config or DataConfig()
 
     def fit(self) -> Result:
+        """Run training with gang fault tolerance.
+
+        ONE executor lives for the whole fit: a retryable failure
+        (worker death, collective timeout, drain preemption) tears the
+        gang down and rebuilds it via executor.restart() at the next
+        gang epoch, resuming from the newest checkpoint with exponential
+        backoff between attempts (reference: TrainingIterator retry +
+        _restart backend_executor.py:701).
+        """
         failure_config = self.run_config.failure_config
-        attempts = failure_config.max_failures + 1
+        attempts = max(1, failure_config.max_failures + 1)
+        restarts, deaths, recovery = _fault_metrics()
         last_error: Optional[Exception] = None
         checkpoint = self.resume_from_checkpoint
-        for attempt in range(max(1, attempts)):
-            try:
-                return self._run_once(checkpoint)
-            except TrainingFailedError as e:  # worker failure: restart
-                last_error = e
-                if failure_config.fail_fast or attempt + 1 >= attempts:
-                    break
-                # Resume from the newest checkpoint (reference: _restart
-                # backend_executor.py:701).
-                checkpoint = self._latest_checkpoint or checkpoint
-        return Result(metrics={}, checkpoint=self._latest_checkpoint,
-                      error=last_error, path=self._trial_dir)
 
-    def _run_once(self, checkpoint: Optional[Checkpoint]) -> Result:
         trial_dir = self.run_config.resolved_storage_path()
         os.makedirs(trial_dir, exist_ok=True)
         self._trial_dir = trial_dir
         ckpt_config = self.run_config.checkpoint_config
+        # One manager across attempts: restarts must find (and keep
+        # scoring against) the checkpoints earlier attempts registered.
         manager = CheckpointManager(
             os.path.join(trial_dir, "checkpoints"),
             num_to_keep=ckpt_config.num_to_keep,
@@ -130,12 +151,70 @@ class DataParallelTrainer(BaseTrainer):
             storage=self.run_config.storage_context(),
         )
         self._latest_checkpoint = None
+        # Survive across attempts so an exhausted-retries Result still
+        # carries everything that was reported before the last failure.
+        self._final_metrics: Dict = {}
+        self._metrics_history: List[Dict] = []
 
         executor = BackendExecutor(self.backend_config, self.scaling_config)
-        executor.start()
+        try:
+            executor.start()
+            for attempt in range(attempts):
+                try:
+                    return self._run_attempt(
+                        executor, manager, checkpoint, trial_dir
+                    )
+                except TrainingFailedError as e:
+                    last_error = e
+                    if e.failed_ranks:
+                        deaths.inc(len(e.failed_ranks))
+                    if (failure_config.fail_fast or not e.retryable
+                            or attempt + 1 >= attempts):
+                        break
+                    # Resume from the newest checkpoint (reference:
+                    # _restart backend_executor.py:701).
+                    checkpoint = self._latest_checkpoint or checkpoint
+                    backoff = failure_config.backoff_for_attempt(attempt)
+                    if backoff:
+                        time.sleep(backoff)
+                    t0 = time.monotonic()
+                    executor.restart()
+                    restarts.inc()
+                    recovery.observe(time.monotonic() - t0)
+        finally:
+            executor.shutdown()
+        return Result(
+            metrics=self._final_metrics,
+            checkpoint=manager.best_checkpoint() or self._latest_checkpoint,
+            error=last_error,
+            path=self._trial_dir,
+            metrics_history=self._metrics_history,
+        )
+
+    def _ingest(self, statuses, manager: CheckpointManager):
+        """Fold polled worker reports into metrics/checkpoint state.
+        Rank-0 reports carry the canonical metrics (reference:
+        first-worker results in TrainingIterator); every rank's
+        checkpoints are registered (the drain path checkpoints on
+        whichever ranks got the stop request first)."""
+        for rank, st in enumerate(statuses):
+            for rep in st["reports"]:
+                if rank == 0:
+                    self._final_metrics = rep["metrics"]
+                    self._metrics_history.append(rep["metrics"])
+                if rep["checkpoint_path"]:
+                    ckpt = Checkpoint.from_directory(rep["checkpoint_path"])
+                    manager.register(ckpt, rep["metrics"])
+                    self._latest_checkpoint = ckpt
+
+    def _run_attempt(
+        self,
+        executor: BackendExecutor,
+        manager: CheckpointManager,
+        checkpoint: Optional[Checkpoint],
+        trial_dir: str,
+    ) -> Result:
         dataset_shards = self._shard_datasets(self.scaling_config.num_workers)
-        metrics_history: List[Dict] = []
-        final_metrics: Dict = {}
         try:
             executor.start_training(
                 self.train_loop_per_worker,
@@ -146,43 +225,55 @@ class DataParallelTrainer(BaseTrainer):
             )
             while True:
                 statuses = executor.poll()
-                for st in statuses:
+                self._ingest(statuses, manager)
+                for rank, st in enumerate(statuses):
                     if st["error"]:
-                        raise TrainingFailedError(st["error"])
-                # Rank-0 reports carry the canonical metrics (reference:
-                # first-worker results in TrainingIterator).
-                rank0 = statuses[0]["reports"]
-                for rep in rank0:
-                    final_metrics = rep["metrics"]
-                    metrics_history.append(rep["metrics"])
-                    if rep["checkpoint_path"]:
-                        ckpt = Checkpoint.from_directory(rep["checkpoint_path"])
-                        manager.register(ckpt, rep["metrics"])
-                        self._latest_checkpoint = ckpt
+                        raise TrainingFailedError(
+                            st["error"], failed_ranks=[rank], retryable=True
+                        )
                 if all(st["done"] for st in statuses):
-                    # Final drain.
-                    for st in executor.poll():
-                        for rep in st["reports"]:
-                            final_metrics = rep["metrics"]
-                            metrics_history.append(rep["metrics"])
-                            if rep["checkpoint_path"]:
-                                ckpt = Checkpoint.from_directory(
-                                    rep["checkpoint_path"]
-                                )
-                                manager.register(ckpt, rep["metrics"])
-                                self._latest_checkpoint = ckpt
+                    self._ingest(executor.poll(), manager)  # final drain
                     break
+                draining = executor.draining_ranks()
+                if draining:
+                    self._migrate_before_preemption(
+                        executor, manager, draining
+                    )
                 time.sleep(0.05)
         finally:
-            executor.shutdown()
             self._stop_shards(dataset_shards)
-        best = manager.best_checkpoint() or self._latest_checkpoint
         return Result(
-            metrics=final_metrics,
-            checkpoint=best,
+            metrics=self._final_metrics,
+            checkpoint=manager.best_checkpoint() or self._latest_checkpoint,
             error=None,
             path=trial_dir,
-            metrics_history=metrics_history,
+            metrics_history=self._metrics_history,
+        )
+
+    def _migrate_before_preemption(self, executor, manager, draining):
+        """A node hosting part of the gang is draining: ask every rank to
+        checkpoint and stop NOW, harvest what they save within the grace
+        window, then fail the attempt as preempted+retryable so the gang
+        restarts elsewhere — ahead of the kill instead of after it."""
+        from ray_tpu._private.config import get_config
+
+        executor.request_stop_all()
+        deadline = time.monotonic() + get_config().train_drain_grace_s
+        while time.monotonic() < deadline:
+            try:
+                statuses = executor.poll()
+            except TrainingFailedError:
+                break  # preemption beat the grace window
+            self._ingest(statuses, manager)
+            if all(st["done"] for st in statuses):
+                break
+            time.sleep(0.05)
+        raise TrainingFailedError(
+            f"node drain: rank(s) {sorted(draining)} are on draining "
+            f"node(s); gang migrating",
+            failed_ranks=draining,
+            retryable=True,
+            preempted=True,
         )
 
     @staticmethod

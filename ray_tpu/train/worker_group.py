@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu as rt
 from ray_tpu._private import worker as worker_mod
+from ray_tpu.exceptions import PlacementGroupSchedulingError
 from ray_tpu.train.session import TrainSession, get_session, init_session, shutdown_session
 from ray_tpu.util.placement_group import PlacementGroup, placement_group, remove_placement_group
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
@@ -61,6 +62,8 @@ class TrainWorker:
         self._done = False
         self._error = None
 
+        self._error_type = None
+
         def run():
             try:
                 train_fn(config) if _wants_arg(train_fn) else train_fn()
@@ -68,6 +71,7 @@ class TrainWorker:
                 import traceback
 
                 self._error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+                self._error_type = type(e).__name__
             finally:
                 self._done = True
 
@@ -88,7 +92,25 @@ class TrainWorker:
                     "checkpoint_path": ckpt.path if ckpt else None,
                 }
             )
-        return {"reports": out, "done": self._done, "error": self._error}
+        return {
+            "reports": out,
+            "done": self._done,
+            "error": self._error,
+            "error_type": getattr(self, "_error_type", None),
+        }
+
+    def ping(self):
+        """Liveness probe. Training runs in a daemon thread, so this
+        answers promptly even mid-step — a non-answer means the process
+        is gone or the actor event loop is wedged."""
+        return True
+
+    def request_stop(self):
+        """Ask the training loop to checkpoint and return at its next
+        train.should_stop() check (proactive drain migration)."""
+        if self.session is not None:
+            self.session.request_stop()
+        return True
 
     def shutdown(self):
         shutdown_session()
@@ -111,14 +133,21 @@ class WorkerGroup:
         num_workers: int,
         resources_per_worker: Dict[str, float],
         placement_strategy: str = "PACK",
+        epoch: int = 0,
     ):
         self.num_workers = num_workers
+        # Gang attempt number — read by the backend's on_start to stamp
+        # DCN rendezvous keys so stale ranks can't join a rebuilt ring.
+        self.epoch = epoch
         self._pg: Optional[PlacementGroup] = None
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
         self._pg = placement_group(bundles, strategy=placement_strategy)
+        # ready() raises PlacementGroupSchedulingError on INFEASIBLE /
+        # REMOVED; a False return is a still-pending reservation.
         if not self._pg.ready(timeout=120):
-            raise RuntimeError(
-                f"worker group placement group not ready "
+            remove_placement_group(self._pg)
+            raise PlacementGroupSchedulingError(
+                f"worker group placement group not ready within 120s "
                 f"(bundles={bundles}, strategy={placement_strategy})"
             )
         self.workers = [
@@ -134,6 +163,11 @@ class WorkerGroup:
 
     def __len__(self):
         return self.num_workers
+
+    def node_ids(self) -> List:
+        """Per-rank node ids via the placement group's bundle→node map
+        (rank i lives in bundle i)."""
+        return self._pg.bundle_node_ids() if self._pg else []
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
         """Run fn on every worker; returns per-rank results."""

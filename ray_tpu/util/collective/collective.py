@@ -38,18 +38,22 @@ class GroupManager:
         self._meta: Dict[str, dict] = {}
         self._lock = threading.Lock()
 
-    def create(self, backend: Backend, world_size: int, rank: int, group_name: str):
+    def create(self, backend: Backend, world_size: int, rank: int,
+               group_name: str, epoch: int = 0,
+               op_timeout_s: Optional[float] = None):
         with self._lock:
             if group_name in self._groups:
                 raise ValueError(f"collective group {group_name!r} already exists")
         if backend == Backend.DCN:
             client = worker_mod.get_client()
-            group = DcnGroup(client, world_size, rank, group_name)
+            group = DcnGroup(client, world_size, rank, group_name,
+                             epoch=epoch, op_timeout=op_timeout_s)
         elif backend == Backend.XLA:
             group = XlaLocalGroup(world_size if world_size > 0 else None)
         elif backend == Backend.HIER:
             client = worker_mod.get_client()
-            group = HierarchicalGroup(client, world_size, rank, group_name)
+            group = HierarchicalGroup(client, world_size, rank, group_name,
+                                      epoch=epoch, op_timeout_s=op_timeout_s)
         else:
             raise ValueError(backend)
         with self._lock:
@@ -58,6 +62,7 @@ class GroupManager:
                 "backend": backend,
                 "world_size": world_size,
                 "rank": rank,
+                "epoch": epoch,
             }
         return group
 
@@ -89,10 +94,19 @@ def init_collective_group(
     rank: int,
     backend: str = "dcn",
     group_name: str = "default",
+    epoch: int = 0,
+    op_timeout_s: Optional[float] = None,
 ):
-    """Join this process to a collective group (reference :120)."""
+    """Join this process to a collective group (reference :120).
+
+    epoch: gang attempt number — rendezvous is epoch-stamped so members
+    of a torn-down prior attempt cannot join the rebuilt ring.
+    op_timeout_s: per-op socket deadline (DCN); None uses the
+    RT_COLLECTIVE_OP_TIMEOUT_S config default.
+    """
     b = Backend.validate(backend)
-    return _manager.create(b, world_size, rank, group_name)
+    return _manager.create(b, world_size, rank, group_name, epoch=epoch,
+                           op_timeout_s=op_timeout_s)
 
 
 def create_collective_group(

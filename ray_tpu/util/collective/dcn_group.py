@@ -15,6 +15,12 @@ Algorithms:
   * broadcast: ring forward from root
   * barrier: zero-byte ring token
   * send/recv: direct socket between ranks
+
+Fault model (preemption-aware): every socket carries an op deadline, so a
+dead or wedged peer raises a typed CollectiveTimeoutError instead of
+hanging the surviving ranks forever, and rendezvous is stamped with a
+gang *epoch* — a stale member from a torn-down attempt can neither find
+the new ring in the KV nor pass the identification handshake.
 """
 
 from __future__ import annotations
@@ -27,9 +33,13 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.exceptions import CollectiveTimeoutError
 from ray_tpu.util.collective.types import ReduceOp
 
 _LEN = struct.Struct("<Q")
+# Identification frame on every initiated connection: sender rank + the
+# gang epoch it believes it belongs to.
+_IDENT = struct.Struct("<II")
 
 
 def _reduce(op: ReduceOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -45,9 +55,14 @@ def _reduce(op: ReduceOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
 
 
 class _Peer:
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, op_timeout: Optional[float] = None):
         self.sock = sock
         self.lock = threading.Lock()
+        # One deadline per blocking socket op: a peer that stops draining
+        # (or stops sending) trips socket.timeout instead of blocking the
+        # rank forever mid-collective.
+        if op_timeout and op_timeout > 0:
+            sock.settimeout(op_timeout)
 
     def send_bytes(self, data: bytes):
         with self.lock:
@@ -84,15 +99,30 @@ def _recv_array(peer: _Peer) -> np.ndarray:
 
 
 class DcnGroup:
-    """One rank's membership in a TCP collective ring."""
+    """One rank's membership in a TCP collective ring.
+
+    `epoch` is the gang attempt number: a restarted training gang bumps
+    it so rendezvous keys and identification frames from the previous
+    (possibly half-dead) attempt can never splice into the new ring.
+    `op_timeout` bounds every blocking send/recv inside a collective;
+    exceeding it raises CollectiveTimeoutError.
+    """
 
     def __init__(self, kv, world_size: int, rank: int, group_name: str,
-                 timeout: float = 60.0):
+                 timeout: Optional[float] = None, epoch: int = 0,
+                 op_timeout: Optional[float] = None):
+        from ray_tpu._private.config import get_config
+
+        cfg = get_config()
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        self.epoch = int(epoch)
         self._kv = kv
-        self._timeout = timeout
+        self._timeout = (timeout if timeout is not None
+                         else cfg.collective_rendezvous_timeout_s)
+        self._op_timeout = (op_timeout if op_timeout is not None
+                            else cfg.collective_op_timeout_s)
         # Listening socket for incoming peers.
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -107,7 +137,9 @@ class DcnGroup:
 
     # -- rendezvous through the GCS KV ----------------------------------
     def _key(self, rank: int) -> bytes:
-        return f"collective:{self.group_name}:{rank}".encode()
+        # Epoch-stamped: a stale rank from attempt N-1 looks up keys that
+        # the attempt-N gang never wrote, and times out at rendezvous.
+        return f"collective:{self.group_name}:e{self.epoch}:{rank}".encode()
 
     def _register(self):
         self._kv.kv_put(
@@ -126,7 +158,7 @@ class DcnGroup:
             time.sleep(0.02)
         raise TimeoutError(
             f"rendezvous timeout waiting for rank {rank} of group "
-            f"{self.group_name!r}"
+            f"{self.group_name!r} (epoch {self.epoch})"
         )
 
     def _accept_loop(self):
@@ -136,9 +168,24 @@ class DcnGroup:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            peer = _Peer(sock)
-            # First frame on an accepted socket identifies the sender rank.
-            rank = int.from_bytes(peer.recv_bytes(), "little")
+            peer = _Peer(sock, self._op_timeout)
+            # First frame identifies the sender: (rank, epoch). A member
+            # of a different epoch is a zombie from a torn-down attempt —
+            # close the socket so it can never inject into this ring.
+            try:
+                rank, epoch = _IDENT.unpack(peer.recv_bytes())
+            except Exception:  # noqa: BLE001 — malformed/legacy handshake
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            if epoch != self.epoch:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             self._accepted[rank] = peer
 
     def _peer_out(self, rank: int) -> _Peer:
@@ -148,8 +195,8 @@ class DcnGroup:
             host, port = self._lookup(rank)
             sock = socket.create_connection((host, port), timeout=self._timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            peer = _Peer(sock)
-            peer.send_bytes(self.rank.to_bytes(4, "little"))
+            peer = _Peer(sock, self._op_timeout)
+            peer.send_bytes(_IDENT.pack(self.rank, self.epoch))
             self._outgoing[rank] = peer
         return peer
 
@@ -161,7 +208,21 @@ class DcnGroup:
             if peer is not None:
                 return peer
             time.sleep(0.002)
-        raise TimeoutError(f"no inbound connection from rank {rank}")
+        raise CollectiveTimeoutError(
+            f"no inbound connection from rank {rank} of group "
+            f"{self.group_name!r} (epoch {self.epoch}) after "
+            f"{self._timeout:.1f}s",
+            group_name=self.group_name, rank=self.rank, peer_rank=rank,
+        )
+
+    def _timeout_error(self, op: str, peer_rank: int) -> CollectiveTimeoutError:
+        return CollectiveTimeoutError(
+            f"collective {op} in group {self.group_name!r} (rank "
+            f"{self.rank}, epoch {self.epoch}) timed out after "
+            f"{self._op_timeout:.1f}s waiting on rank {peer_rank} — the "
+            f"peer is dead or wedged",
+            group_name=self.group_name, rank=self.rank, peer_rank=peer_rank,
+        )
 
     # -- collectives -----------------------------------------------------
     @property
@@ -179,19 +240,22 @@ class DcnGroup:
         flat = np.ascontiguousarray(arr).reshape(-1)
         chunks: List[np.ndarray] = [c.copy() for c in np.array_split(flat, n)]
         right, left = self._peer_out(self._right), self._peer_in(self._left)
-        # Phase 1: ring reduce-scatter.
-        for step in range(n - 1):
-            send_idx = (self.rank - step) % n
-            recv_idx = (self.rank - step - 1) % n
-            _send_array(right, chunks[send_idx])
-            incoming = _recv_array(left)
-            chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
-        # Phase 2: ring allgather of reduced chunks.
-        for step in range(n - 1):
-            send_idx = (self.rank + 1 - step) % n
-            recv_idx = (self.rank - step) % n
-            _send_array(right, chunks[send_idx])
-            chunks[recv_idx] = _recv_array(left)
+        try:
+            # Phase 1: ring reduce-scatter.
+            for step in range(n - 1):
+                send_idx = (self.rank - step) % n
+                recv_idx = (self.rank - step - 1) % n
+                _send_array(right, chunks[send_idx])
+                incoming = _recv_array(left)
+                chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
+            # Phase 2: ring allgather of reduced chunks.
+            for step in range(n - 1):
+                send_idx = (self.rank + 1 - step) % n
+                recv_idx = (self.rank - step) % n
+                _send_array(right, chunks[send_idx])
+                chunks[recv_idx] = _recv_array(left)
+        except socket.timeout:
+            raise self._timeout_error("allreduce", self._left) from None
         return np.concatenate(chunks).reshape(arr.shape).astype(arr.dtype, copy=False)
 
     def allgather(self, arr: np.ndarray) -> List[np.ndarray]:
@@ -201,11 +265,14 @@ class DcnGroup:
         if n == 1:
             return out  # type: ignore[return-value]
         right, left = self._peer_out(self._right), self._peer_in(self._left)
-        for step in range(n - 1):
-            send_idx = (self.rank - step) % n
-            recv_idx = (self.rank - step - 1) % n
-            _send_array(right, out[send_idx])
-            out[recv_idx] = _recv_array(left)
+        try:
+            for step in range(n - 1):
+                send_idx = (self.rank - step) % n
+                recv_idx = (self.rank - step - 1) % n
+                _send_array(right, out[send_idx])
+                out[recv_idx] = _recv_array(left)
+        except socket.timeout:
+            raise self._timeout_error("allgather", self._left) from None
         return out  # type: ignore[return-value]
 
     def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
@@ -220,12 +287,15 @@ class DcnGroup:
         if n == 1:
             return chunks[0]
         right, left = self._peer_out(self._right), self._peer_in(self._left)
-        for step in range(n - 1):
-            send_idx = (self.rank - step + n - 1) % n
-            recv_idx = (self.rank - step + n - 2) % n
-            _send_array(right, chunks[send_idx])
-            incoming = _recv_array(left)
-            chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
+        try:
+            for step in range(n - 1):
+                send_idx = (self.rank - step + n - 1) % n
+                recv_idx = (self.rank - step + n - 2) % n
+                _send_array(right, chunks[send_idx])
+                incoming = _recv_array(left)
+                chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
+        except socket.timeout:
+            raise self._timeout_error("reducescatter", self._left) from None
         return chunks[self.rank]
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
@@ -233,11 +303,14 @@ class DcnGroup:
             return np.asarray(arr).copy()
         if self.rank == root:
             out = np.asarray(arr).copy()
-        # Forward around the ring, skipping the wrap back to root.
-        if self.rank != root:
-            out = _recv_array(self._peer_in(self._left))
-        if self._right != root:
-            _send_array(self._peer_out(self._right), out)
+        try:
+            # Forward around the ring, skipping the wrap back to root.
+            if self.rank != root:
+                out = _recv_array(self._peer_in(self._left))
+            if self._right != root:
+                _send_array(self._peer_out(self._right), out)
+        except socket.timeout:
+            raise self._timeout_error("broadcast", self._left) from None
         return out
 
     def reduce(self, arr: np.ndarray, root: int = 0,
@@ -250,10 +323,16 @@ class DcnGroup:
         self.allreduce(np.zeros(1, dtype=np.int32))
 
     def send(self, arr: np.ndarray, dst_rank: int):
-        _send_array(self._peer_out(dst_rank), np.asarray(arr))
+        try:
+            _send_array(self._peer_out(dst_rank), np.asarray(arr))
+        except socket.timeout:
+            raise self._timeout_error("send", dst_rank) from None
 
     def recv(self, src_rank: int) -> np.ndarray:
-        return _recv_array(self._peer_in(src_rank))
+        try:
+            return _recv_array(self._peer_in(src_rank))
+        except socket.timeout:
+            raise self._timeout_error("recv", src_rank) from None
 
     def destroy(self):
         # Drop the rendezvous entry so a recreated group with the same name

@@ -36,9 +36,11 @@ class HierarchicalGroup:
     """
 
     def __init__(self, client, world_size: int, rank: int, group_name: str,
-                 num_local_devices=None):
+                 num_local_devices=None, epoch: int = 0,
+                 op_timeout_s=None):
         self.local = XlaLocalGroup(num_local_devices)
-        self.dcn = DcnGroup(client, world_size, rank, group_name + "::dcn")
+        self.dcn = DcnGroup(client, world_size, rank, group_name + "::dcn",
+                            epoch=epoch, op_timeout=op_timeout_s)
         self.world_size = world_size
         self.rank = rank
 

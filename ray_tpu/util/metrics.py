@@ -199,6 +199,19 @@ class Histogram(Metric):
         }
 
 
+def get_or_create(metric_cls, name: str, description: str = "", **kwargs):
+    """Return the already-registered metric called `name` (of the same
+    class) or create it. Module-level metric definitions that can be
+    re-imported/re-executed (trainer restarts, test reruns in one
+    process) must not register duplicates — the flusher would double-
+    report every increment."""
+    with _registry_lock:
+        for m in _registry:
+            if m._name == name and type(m) is metric_cls:
+                return m
+    return metric_cls(name, description, **kwargs)
+
+
 def _flush_once() -> bool:
     """Drain all registered metrics into one GCS report. Returns True if
     anything was sent."""

@@ -1,0 +1,256 @@
+"""Gang fault tolerance tests: elastic restart, epoch fencing, hang-proof
+DCN collectives, proactive drain migration.
+
+Modeled on the reference's train fault-tolerance suites
+(python/ray/train/tests/test_backend.py worker-failure cases +
+test_tune_torch_get_device_gpu restart paths), using the shared
+fault-injection API in ray_tpu._private.chaos instead of hand-rolled kill
+threads. Everything is deterministic: faults fire at caller-chosen steps
+via chaos.once() markers, never on timers.
+"""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from ray_tpu.exceptions import CollectiveTimeoutError
+from ray_tpu.train import (
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+# -- tentpole acceptance: rank death mid-training --------------------------
+def _die_once_loop(config):
+    import os
+    import time
+
+    from ray_tpu import train
+    from ray_tpu._private import chaos
+    from ray_tpu.train import Checkpoint
+
+    with open(os.path.join(config["dir"], "attempts.log"), "a") as f:
+        f.write(f"rank{train.get_world_rank()}\n")
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start = ckpt.to_dict()["step"] + 1
+    for step in range(start, config["steps"]):
+        if train.get_world_rank() == 0:
+            train.report({"step": step},
+                         checkpoint=Checkpoint.from_dict({"step": step}))
+        else:
+            train.report({"step": step})
+        # Give the driver's 50ms poll loop time to drain the report (and
+        # register the checkpoint) before anything can kill this rank.
+        time.sleep(0.12)
+        if (train.get_world_rank() == 0 and step == config["die_at"]
+                and chaos.once(config["dir"], "rank0_death")):
+            chaos.enable()
+            chaos.die()  # SIGKILL-style: no cleanup, no goodbye
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_rank_death_resumes_from_checkpoint(rt_start, tmp_path):
+    """A rank hard-killed mid-training is detected, the gang restarts,
+    and training resumes from the newest checkpoint — not from scratch."""
+    trainer = JaxTrainer(
+        _die_once_loop,
+        train_loop_config={"dir": str(tmp_path), "steps": 6, "die_at": 3},
+        jax_config=JaxConfig(dp_sync="none"),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="ft", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+            failure_config=FailureConfig(max_failures=2, backoff_s=0.05,
+                                         backoff_max_s=0.2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps[-1] == 5
+    # Resumed from the checkpoint, not from zero: step 0 ran exactly once.
+    assert steps.count(0) == 1, steps
+    # The gang actually restarted: both ranks started twice.
+    attempts = (tmp_path / "attempts.log").read_text().splitlines()
+    assert sorted(attempts) == ["rank0", "rank0", "rank1", "rank1"], attempts
+
+
+# -- epoch fencing ---------------------------------------------------------
+class FakeKV:
+    """The kv_put/kv_get/kv_del slice of the core client, in-memory."""
+
+    def __init__(self):
+        self._d = {}
+
+    def kv_put(self, key, value, ns=""):
+        self._d[(ns, key)] = value
+
+    def kv_get(self, key, ns=""):
+        return self._d.get((ns, key))
+
+    def kv_del(self, key, ns=""):
+        self._d.pop((ns, key), None)
+
+
+def test_gang_epoch_rejects_stale_rank():
+    """A zombie rank from a torn-down attempt can neither find the new
+    ring in the KV (epoch-stamped rendezvous keys) nor pass the
+    identification handshake (epoch-stamped ident frame)."""
+    from ray_tpu.util.collective.dcn_group import _IDENT, _LEN, DcnGroup
+
+    kv = FakeKV()
+    fresh = DcnGroup(kv, 2, 0, "fence", timeout=0.5, epoch=1)
+    stale = DcnGroup(kv, 2, 1, "fence", timeout=0.3, epoch=0)
+    try:
+        # Rendezvous fence: the stale rank looks up epoch-0 keys that the
+        # epoch-1 gang never wrote.
+        with pytest.raises(TimeoutError):
+            stale._peer_out(0)
+
+        # Handshake fence: even told the new address out-of-band, the
+        # stale epoch in the ident frame gets the socket closed.
+        s = socket.create_connection(tuple(fresh.addr), timeout=2)
+        ident = _IDENT.pack(1, 0)  # rank 1, stale epoch 0
+        s.sendall(_LEN.pack(len(ident)) + ident)
+        with pytest.raises(CollectiveTimeoutError):
+            fresh._peer_in(1)
+        s.close()
+
+        # Control: the correct epoch is accepted.
+        s2 = socket.create_connection(tuple(fresh.addr), timeout=2)
+        ident = _IDENT.pack(1, 1)
+        s2.sendall(_LEN.pack(len(ident)) + ident)
+        assert fresh._peer_in(1) is not None
+        s2.close()
+    finally:
+        fresh.destroy()
+        stale.destroy()
+
+
+# -- hang-proof collectives ------------------------------------------------
+def test_dcn_recv_timeout_raises_instead_of_hanging():
+    """A peer that connects and then goes silent (preempted host) trips
+    the per-op socket deadline as a typed CollectiveTimeoutError rather
+    than blocking the surviving rank forever."""
+    from ray_tpu.util.collective.dcn_group import DcnGroup
+
+    kv = FakeKV()
+    g0 = DcnGroup(kv, 2, 0, "hang", timeout=2.0, epoch=0, op_timeout=0.5)
+    g1 = DcnGroup(kv, 2, 1, "hang", timeout=2.0, epoch=0, op_timeout=0.5)
+    try:
+        g1._peer_out(0)  # connect + identify, then never send anything
+        t0 = time.monotonic()
+        with pytest.raises(CollectiveTimeoutError) as exc:
+            g0.recv(1)
+        elapsed = time.monotonic() - t0
+        assert 0.3 <= elapsed < 5.0, elapsed
+        assert exc.value.peer_rank == 1
+        assert exc.value.group_name == "hang"
+    finally:
+        g0.destroy()
+        g1.destroy()
+
+
+# -- proactive drain migration ---------------------------------------------
+def _drain_aware_loop(config):
+    import os
+    import time
+
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint
+
+    with open(os.path.join(config["dir"], "attempts.log"), "a") as f:
+        f.write("start\n")
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start = ckpt.to_dict()["step"] + 1
+    for step in range(start, config["steps"]):
+        train.report({"step": step},
+                     checkpoint=Checkpoint.from_dict({"step": step}))
+        if train.should_stop():
+            return  # checkpointed above; migrate with zero lost work
+        time.sleep(0.12)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_drain_triggers_proactive_checkpoint_and_restart(rt_start, tmp_path):
+    """A drain notice makes the trainer request a checkpoint-and-stop,
+    then restart the gang — moving BEFORE preemption kills the host."""
+    from ray_tpu._private import chaos
+
+    chaos.enable()
+    try:
+        chaos.inject_drain([0])
+        trainer = JaxTrainer(
+            _drain_aware_loop,
+            train_loop_config={"dir": str(tmp_path), "steps": 6},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="drain", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1, backoff_s=0.05,
+                                             backoff_max_s=0.2),
+            ),
+        )
+        result = trainer.fit()
+    finally:
+        chaos.disable()
+    assert result.error is None
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps[-1] == 5
+    assert steps.count(0) == 1, steps  # resumed, not restarted from zero
+    # The drain really interrupted attempt 1: the loop started twice.
+    attempts = (tmp_path / "attempts.log").read_text().splitlines()
+    assert len(attempts) == 2, attempts
+
+
+# -- fail-fast + metrics preservation --------------------------------------
+def _report_then_fail_loop(config):
+    from ray_tpu import train
+
+    train.report({"step": 0, "loss": 1.0})
+    train.report({"step": 1, "loss": 0.5})
+    raise RuntimeError("unrecoverable user error")
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 4}], indirect=True)
+def test_max_failures_zero_fails_fast_with_metrics(rt_start, tmp_path):
+    """max_failures=0 surfaces the first failure without restarting, and
+    the Result still carries everything reported before the failure
+    (previously it returned Result(metrics={}))."""
+    from ray_tpu.train import TrainingFailedError
+
+    trainer = JaxTrainer(
+        _report_then_fail_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="fff", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=0),
+        ),
+    )
+    result = trainer.fit()
+    assert isinstance(result.error, TrainingFailedError)
+    assert result.error.failed_ranks == [0]
+    assert "unrecoverable user error" in str(result.error)
+    assert result.metrics == {"step": 1, "loss": 0.5}
+    assert [m["step"] for m in result.metrics_history] == [0, 1]
+    # Only one attempt ran: no restart consumed the failure budget.
+    attempts = (tmp_path / "fff").exists()
+    assert attempts
+
+
+def test_failure_config_backoff_schedule():
+    fc = FailureConfig(backoff_s=0.5, backoff_max_s=4.0)
+    assert [fc.backoff_for_attempt(a) for a in range(5)] == \
+        [0.5, 1.0, 2.0, 4.0, 4.0]
+    assert FailureConfig(backoff_s=0).backoff_for_attempt(3) == 0.0

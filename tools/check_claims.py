@@ -80,6 +80,15 @@ def _bench_infer_r5_implied_step_ms():
     return get
 
 
+def _bench_ft(metric_sub: str, field: str):
+    def get():
+        for e in _load("BENCH_FT.json"):
+            if metric_sub in e.get("metric", ""):
+                return e[field]
+        raise KeyError(f"no BENCH_FT entry matching {metric_sub!r}")
+    return get
+
+
 def _bench_r(field: str, sub: str = None):
     def get():
         d = _load("BENCH_TPU_LIVE.json")
@@ -201,6 +210,17 @@ CLAIMS = [
           _bench_infer("llama2(0.8B) decode", "ms_per_decode_step",
                        batch=8),
           rel_tol=0.02),
+    # Fault-tolerance latencies <- BENCH_FT.json (bench_ft.py). Loose
+    # tolerances: these are wall-clock timings of control-plane paths on
+    # a shared CI box (detection additionally quantizes to the 50ms poll
+    # cadence).
+    Claim("MIGRATION.md", r"kill-to-detection ~(\d+\.?\d*) ms",
+          _bench_ft("kill-to-detection", "detect_ms"), rel_tol=0.5),
+    Claim("MIGRATION.md", r"gang rebuild ~(\d+\.?\d*) ms",
+          _bench_ft("gang rebuild", "rebuild_s"), scale=0.001,
+          rel_tol=1.0, note="pipelined actor respawn; noisy at ~20ms"),
+    Claim("MIGRATION.md", r"deadline trips in (\d+\.\d+) s",
+          _bench_ft("collective timeout trip", "trip_s"), rel_tol=0.1),
 ]
 
 
