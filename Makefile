@@ -1,0 +1,23 @@
+# Repo-level entry points. `make lint` is the pre-merge gate: the
+# rtlint static pass over ray_tpu/ (against the committed baseline)
+# plus the native store's sanitizer stress tests.
+
+PY ?= python
+
+.PHONY: lint rtlint sanitizers test fast-test
+
+lint: rtlint sanitizers
+
+rtlint:
+	$(PY) -m tools.rtlint ray_tpu/
+
+sanitizers:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \
+	  -q -m sanitizer -p no:cacheprovider
+
+fast-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow" \
+	  -p no:cacheprovider
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -p no:cacheprovider

@@ -199,7 +199,9 @@ def encode_example(features: Dict[str, Any]) -> bytes:
         if isinstance(value, np.ndarray):
             value = value.tolist()
         values = value if isinstance(value, (list, tuple)) else [value]
-        values = [v.item() if isinstance(v, np.generic) else v
+        # np.generic scalars are host memory; .item() here is a pure
+        # unboxing (never a device sync).
+        values = [v.item() if isinstance(v, np.generic) else v  # rtlint: disable=RT001
                   for v in values]
         if all(isinstance(v, (bytes, str)) for v in values):
             items = b"".join(

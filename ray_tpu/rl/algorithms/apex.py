@@ -104,6 +104,30 @@ class APEX(DQN):
         # (train() never barriers on a rollout to read stats).
         self._stats_refs: Dict[int, object] = {}
         self._stats_cache: Dict[int, dict] = {}
+        # Leash for fire-and-forget calls: refs are kept (so the store
+        # can release results and errors are observable) and reaped
+        # non-blockingly once enough accumulate.
+        self._async_refs: list = []
+
+    def _track_async(self, ref):
+        """Track a fire-and-forget ref without blocking the train loop.
+        Dropping the ref outright would leak the result in the object
+        store and swallow any error; a zero-timeout reap keeps both
+        bounded while preserving the async design."""
+        self._async_refs.append(ref)
+        if len(self._async_refs) < 64:
+            return
+        ready, pending = rt.wait(
+            self._async_refs, num_returns=len(self._async_refs), timeout=0
+        )
+        for r in ready:
+            try:
+                rt.get(r, timeout=1)
+            except Exception:  # noqa: BLE001
+                # Best-effort op (priority refresh / weight push) failed;
+                # apex tolerates staleness, the next push retries.
+                pass
+        self._async_refs = list(pending)
 
     # -- buffer interface over the shard actors ---------------------------
     def _collect(self, eps: float):
@@ -170,8 +194,10 @@ class APEX(DQN):
 
     def _update_priorities(self, mb, td_abs: np.ndarray):
         # Fire-and-forget: priority freshness is best-effort in apex.
-        self.shards[mb["_shard"]].update_priorities.remote(
-            mb["indices"], td_abs
+        self._track_async(
+            self.shards[mb["_shard"]].update_priorities.remote(
+                mb["indices"], td_abs
+            )
         )
 
     def _episode_stats(self):
@@ -209,7 +235,7 @@ class APEX(DQN):
         if weights is None:
             weights = self.learner_group.get_weights()
         for r in self.env_runners:
-            r.set_weights.remote(weights)
+            self._track_async(r.set_weights.remote(weights))
 
     # Note: shard CONTENTS are not checkpointed (fresh shard actors start
     # empty on restore), so _shard_sizes deliberately restarts at 0 — the

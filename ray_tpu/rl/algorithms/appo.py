@@ -190,8 +190,12 @@ class APPO(AlgorithmBase):
             }
             metrics = self.learner_group.update_from_batch(batch)
             weights = self.learner_group.get_weights()
+            # The harvested runners are idle here, so awaiting the weight
+            # push is cheap (in-memory swap) and surfaces a dead runner
+            # now instead of leaking the error with the dropped ref.
+            rt.get([r.set_weights.remote(weights) for r in runners],
+                   timeout=300)
             for r in runners:
-                r.set_weights.remote(weights)
                 self._pending[r.sample.remote()] = r
         self._iteration += 1
         stats = rt.get(

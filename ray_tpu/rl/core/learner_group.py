@@ -27,10 +27,12 @@ class _LearnerActor:
         self.rank = rank
         self.world_size = world_size
 
-    def init_collective(self, world_size, rank, backend, group_name):
+    def init_collective(self, world_size, rank, backend, group_name,
+                        epoch=0):
         from ray_tpu.util import collective as col
 
-        col.init_collective_group(world_size, rank, backend, group_name)
+        col.init_collective_group(world_size, rank, backend, group_name,
+                                  epoch=epoch)
         self._group = group_name
         return True
 
@@ -87,12 +89,16 @@ class LearnerGroup:
         if self.num_learners > 1:
             from ray_tpu.util import collective as col
 
+            # epoch=0: learner gangs are never rebuilt in place — a
+            # failed LearnerGroup is recreated wholesale (fresh actors,
+            # fresh group name registrations), so no stale rank exists.
             col.create_collective_group(
                 self.actors,
                 self.num_learners,
                 list(range(self.num_learners)),
                 backend="dcn",
                 group_name="learner_group",
+                epoch=0,
             )
 
     def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict:

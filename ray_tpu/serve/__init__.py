@@ -9,6 +9,7 @@ TPU-aware replica placement comes from ray_actor_options resources (e.g.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import ray_tpu as rt
@@ -25,6 +26,8 @@ from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import ProxyActor
 from ray_tpu.serve.schema import run_from_config
+
+logger = logging.getLogger("ray_tpu.serve")
 
 _proxy = None
 
@@ -162,8 +165,9 @@ def shutdown():
         rt.get(controller.shutdown.remote(),
                timeout=get_config().serve_admin_timeout_s)
         rt.kill(controller)
-    except Exception:
-        pass
+    except Exception:  # noqa: BLE001 — teardown is best-effort
+        logger.warning("serve controller shutdown did not complete "
+                       "cleanly; its actors may linger", exc_info=True)
     _proxy = None
 
 

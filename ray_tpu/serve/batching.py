@@ -20,6 +20,8 @@ import concurrent.futures
 import threading
 from typing import Any, Callable, List, Optional
 
+from ray_tpu._private.config import get_config
+
 
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int,
@@ -49,7 +51,10 @@ class _BatchQueue:
                 self.full.notify()
         if is_leader:
             self._lead(instance)
-        return fut.result()
+        # Bounded wait: if the leader wedges (e.g. the batch fn hangs on
+        # a device), followers surface a timeout instead of deadlocking
+        # the replica's whole call slot forever.
+        return fut.result(timeout=get_config().serve_result_timeout_s)
 
     def _lead(self, instance):
         with self.lock:

@@ -18,9 +18,15 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu as rt
 from ray_tpu._private.config import get_config
-from ray_tpu.exceptions import ActorError, WorkerCrashedError
+from ray_tpu.exceptions import (
+    ActorError,
+    GetTimeoutError,
+    WorkerCrashedError,
+)
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment
 from ray_tpu.serve.replica import ReplicaActor
+
+logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
 
@@ -35,7 +41,10 @@ class ServeController:
         self.apps: Dict[str, Dict] = {}
         self._health_fails: Dict[bytes, int] = {}
         self._lock = threading.Lock()
-        self._stop = False
+        # Event, not a bare bool: shutdown() runs on an actor-call thread
+        # while _reconcile_loop reads it — Event gives the cross-thread
+        # visibility guarantee without taking self._lock (RT006).
+        self._stop = threading.Event()
         # ProxyStateManager state (reference: serve/_private/proxy_state.py
         # ProxyStateManager): when enabled, the reconcile loop keeps ONE
         # proxy actor alive on every ALIVE cluster node, pinned there by
@@ -84,7 +93,11 @@ class ServeController:
                 CHECKPOINT_KEY, cloudpickle.dumps(state), ns="serve"
             )
         except Exception:  # noqa: BLE001 — next mutation retries
-            pass
+            logger.warning(
+                "serve controller checkpoint write failed for %d app(s); "
+                "a controller crash before the next mutation loses routes",
+                len(state["apps"]), exc_info=True,
+            )
 
     def _restore(self):
         import cloudpickle
@@ -94,12 +107,21 @@ class ServeController:
         try:
             raw = worker_mod.get_client().kv_get(CHECKPOINT_KEY, ns="serve")
         except Exception:  # noqa: BLE001
+            logger.warning(
+                "serve controller checkpoint read failed; recovering "
+                "with empty state (running replicas will be re-adopted "
+                "only on redeploy)", exc_info=True,
+            )
             raw = None
         if not raw:
             return
         try:
             state = cloudpickle.loads(raw)
         except Exception:  # noqa: BLE001 — corrupt checkpoint: start fresh
+            logger.warning(
+                "serve controller checkpoint is corrupt (%d bytes); "
+                "starting fresh", len(raw), exc_info=True,
+            )
             return
         now = time.monotonic()
         for name, app in state.get("apps", {}).items():
@@ -116,9 +138,13 @@ class ServeController:
                 "last_scale_up": now,
                 "last_scale_down": now,
             }
-        self._proxy_every_node = state.get("proxy_every_node", False)
-        for nid, e in state.get("proxies", {}).items():
-            self._proxies[nid] = dict(e)
+        # _restore runs in __init__ before the reconcile thread starts,
+        # but take the lock anyway so every _proxy_every_node write is
+        # uniformly guarded.
+        with self._lock:
+            self._proxy_every_node = state.get("proxy_every_node", False)
+            for nid, e in state.get("proxies", {}).items():
+                self._proxies[nid] = dict(e)
 
     # -- API -------------------------------------------------------------
     @staticmethod
@@ -147,7 +173,9 @@ class ServeController:
                 return False
             try:
                 return cloudpickle.dumps(a) == cloudpickle.dumps(b)
-            except Exception:  # noqa: BLE001
+            except Exception:  # rtlint: disable=RT007 — by design:
+                # pickling instability reads as "changed" -> full
+                # replace, the safe direction (nothing to handle/log).
                 return False
 
         return (
@@ -189,6 +217,11 @@ class ServeController:
                 try:
                     rt.get(ref, timeout=1)
                 except Exception:  # noqa: BLE001 — user code rejected it
+                    logger.warning(
+                        "replica %s of app %r rejected user_config; "
+                        "falling back to full replace", r._actor_id.hex(),
+                        name, exc_info=True,
+                    )
                     return False
                 done.add(r._actor_id.binary())
         return False  # still churning after 3 sweeps: replace instead
@@ -268,7 +301,7 @@ class ServeController:
             }
 
     def shutdown(self):
-        self._stop = True
+        self._stop.set()
         with self._lock:
             names = list(self.apps)
         for n in names:
@@ -283,7 +316,11 @@ class ServeController:
 
             worker_mod.get_client().kv_del(CHECKPOINT_KEY, ns="serve")
         except Exception:  # noqa: BLE001
-            pass
+            logger.warning(
+                "serve shutdown could not delete the controller "
+                "checkpoint; a restarted controller will re-adopt "
+                "stale state", exc_info=True,
+            )
         return True
 
     # -- reconciliation ---------------------------------------------------
@@ -341,19 +378,22 @@ class ServeController:
                 f"serve_routes:{name}", {"version": version}
             )
         except Exception:  # noqa: BLE001 — handles fall back to polling
-            pass
+            logger.debug("route-invalidation push failed for app %r "
+                         "(handles fall back to polling)", name,
+                         exc_info=True)
 
     def _reconcile_loop(self):
-        while not self._stop:
+        while not self._stop.is_set():
             time.sleep(get_config().serve_reconcile_interval_s)
             try:
                 with self._lock:
                     names = list(self.apps)
+                    proxy_mode = self._proxy_every_node
                 for name in names:
                     self._check_replica_health(name)
                     self._autoscale(name)
                     self._reconcile_once(name)
-                if self._proxy_every_node:
+                if proxy_mode:
                     self._reconcile_proxies()
             except Exception:  # noqa: BLE001 — keep reconciling; next
                 # tick retries. Logged, not swallowed: a persistent error
@@ -366,7 +406,8 @@ class ServeController:
     def start_proxies(self) -> int:
         """Enable one-proxy-per-node mode; returns the current live-node
         count (proxies come up within a reconcile tick)."""
-        self._proxy_every_node = True
+        with self._lock:
+            self._proxy_every_node = True
         self._reconcile_proxies()
         with self._lock:
             return len(self._proxies)
@@ -402,7 +443,11 @@ class ServeController:
                     try:
                         rt.get(entry["actor"].ready.remote(),
                                timeout=get_config().serve_probe_timeout_s)
-                    except Exception:  # noqa: BLE001 — proxy died
+                    except (ActorError, WorkerCrashedError,
+                            GetTimeoutError):
+                        # Only actor-death/unreachable errors mean the
+                        # proxy is gone; anything else (a controller-side
+                        # bug) should surface, not silently kill proxies.
                         dead = True
                 if dead:
                     _kill_quietly(entry["actor"])
@@ -433,7 +478,13 @@ class ServeController:
                     with self._lock:
                         self._proxies[node_id] = entry
                 except Exception:  # noqa: BLE001 — retried next tick
-                    pass
+                    nid = (node_id.hex()
+                           if isinstance(node_id, (bytes, bytearray))
+                           else node_id)
+                    logger.warning(
+                        "proxy spawn failed on node %s; retried next "
+                        "reconcile tick", nid, exc_info=True,
+                    )
             self._checkpoint()
         finally:
             with self._lock:
@@ -465,6 +516,8 @@ class ServeController:
             )["actor"]
             return info["state"] if info else None
         except Exception:  # noqa: BLE001 — control-plane hiccup
+            logger.debug("GCS actor-state lookup failed for %s (treated "
+                         "as unknown)", actor_id.hex(), exc_info=True)
             return None
 
     def _check_replica_health(self, name: str):
@@ -505,7 +558,13 @@ class ServeController:
                     # a second probe could learn.
                     actor_dead = True
                 except Exception:  # noqa: BLE001 — call errored: unhealthy
-                    pass
+                    logger.warning(
+                        "health probe errored for replica %s of app %r "
+                        "(failure %d/%d)", r._actor_id.hex(), name,
+                        self._health_fails.get(key, 0) + 1,
+                        get_config().serve_health_fail_threshold,
+                        exc_info=True,
+                    )
             elif self._actor_state(key) == "DEAD":
                 # Probe never completed AND the GCS already declared the
                 # actor dead (its worker lost the raylet connection).
@@ -557,7 +616,9 @@ class ServeController:
         try:
             qlens = rt.get([r.queue_len.remote() for r in replicas],
                            timeout=get_config().serve_probe_timeout_s)
-        except Exception:
+        except Exception:  # noqa: BLE001 — next tick re-probes
+            logger.debug("autoscale queue-length probe failed for app "
+                         "%r; skipping this tick", name, exc_info=True)
             return
         avg = sum(qlens) / len(qlens)
         now = time.monotonic()
@@ -581,7 +642,7 @@ class ServeController:
             self._checkpoint()
 
 
-def _safe_eq(a, b) -> bool:
+def _safe_eq(a, b) -> bool:  # rtlint: disable=RT007
     # Array-like args make == elementwise; any ambiguity (or raising
     # comparison) counts as "changed" -> full replace, never a crash.
     try:
@@ -590,7 +651,9 @@ def _safe_eq(a, b) -> bool:
         return False
 
 
-def _kill_quietly(actor):
+def _kill_quietly(actor):  # rtlint: disable=RT007
+    # Best-effort teardown of an actor that may already be gone; any
+    # error here means "nothing left to kill".
     try:
         rt.kill(actor)
     except Exception:

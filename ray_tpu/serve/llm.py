@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu._private.config import get_config
 from ray_tpu.models.transformer import (
     TransformerConfig,
     _act,
@@ -476,7 +477,9 @@ class ContinuousBatchingEngine:
         self._warmup()
         self._warm_compiles = self._compile_count()
         self._last_compiles = self._warm_compiles
-        self._running = True
+        # Event, not a bare bool: set by shutdown() on the caller thread,
+        # polled by the engine thread (RT006).
+        self._stop_evt = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="llm-engine", daemon=True
         )
@@ -524,11 +527,16 @@ class ContinuousBatchingEngine:
                   self._prefill, self._pick):
             try:
                 n += f._cache_size()
-            except Exception:  # noqa: BLE001 — cache introspection only
+            except (AttributeError, TypeError):
+                # Introspection-only: a jax version without _cache_size
+                # just disables the recompile guard's counter.
                 pass
         return n
 
-    def _upload_sampling_state(self):
+    # Single-writer: every *_dev array is owned by the engine thread
+    # (this runs on it); submit() only flips _params_dirty under
+    # self._lock.
+    def _upload_sampling_state(self):  # rtlint: disable=RT006
         """ONE host->device refresh of sampling params + active mask.
         Called only when slot membership changed (admission/eviction) —
         the steady-state decode step reads the device-resident copies
@@ -626,7 +634,7 @@ class ContinuousBatchingEngine:
             }
 
     def shutdown(self):
-        self._running = False
+        self._stop_evt.set()
         self._work.set()
         self._thread.join(timeout=10)
         # Outstanding handles must resolve: a streaming consumer blocked
@@ -660,7 +668,9 @@ class ContinuousBatchingEngine:
             slot = self._free.popleft()
             self._prefilling[slot] = {"h": h, "offset": 0}
 
-    def _advance_prefills(self):
+    # Single-writer: KV cache, rng, and token buffers are engine-thread-
+    # owned device state; no other thread touches them after __init__.
+    def _advance_prefills(self):  # rtlint: disable=RT006
         """One prefill chunk for every mid-prefill slot (interleaved
         between decode dispatches). A request whose final chunk lands
         emits its first token and joins the decode set.
@@ -703,7 +713,7 @@ class ContinuousBatchingEngine:
             self._tokens_dev = self._tokens_dev.at[slot].set(tok_dev[0])
             try:
                 tok_dev.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — sharded layouts fetch below
+            except Exception:  # rtlint: disable=RT007 — optional prefetch; sharded layouts fetch below
                 pass
             finished.append((slot, h, tok_dev))
         if not finished:
@@ -747,7 +757,7 @@ class ContinuousBatchingEngine:
         per step (sampling params device-resident, no per-step
         uploads)."""
         inflight = None  # (snapshot [(slot, gen, handle)], tokens_dev, lengths_dev)
-        while self._running:
+        while not self._stop_evt.is_set():
             try:
                 t_iter = time.perf_counter()
                 with self._lock:
@@ -787,7 +797,7 @@ class ContinuousBatchingEngine:
                     try:
                         next_dev.copy_to_host_async()
                         self._lengths.copy_to_host_async()
-                    except Exception:  # noqa: BLE001 — device_get covers it
+                    except Exception:  # rtlint: disable=RT007 — optional prefetch; device_get covers it
                         pass
                     dispatch_s = time.perf_counter() - t0
                     new_inflight = (snapshot, next_dev, self._lengths)
@@ -797,7 +807,10 @@ class ContinuousBatchingEngine:
                 if inflight is not None:
                     prev_snapshot, prev_tokens, prev_lengths = inflight
                     t0 = time.perf_counter()
-                    toks, lengths_np = jax.device_get(
+                    # Intentional single drain: copy_to_host_async above
+                    # started this transfer a full step ago, so this is
+                    # the double-buffered collect, not a per-step sync.
+                    toks, lengths_np = jax.device_get(  # rtlint: disable=RT001
                         (prev_tokens, prev_lengths)
                     )
                     fetch_s = time.perf_counter() - t0
@@ -914,7 +927,7 @@ class LLMReplica:
         return self.engine.submit(
             prompt, max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p,
-        ).result()
+        ).result(timeout=get_config().serve_result_timeout_s)
 
     def stream(self, prompt, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_k: Optional[int] = None,
@@ -927,7 +940,9 @@ class LLMReplica:
     def stats(self):
         return self.engine.stats()
 
-    def __del__(self):
+    def __del__(self):  # rtlint: disable=RT007
+        # Finalizer during interpreter teardown: modules may already be
+        # unloaded, and raising from __del__ only prints noise.
         try:
             self.engine.shutdown()
         except Exception:  # noqa: BLE001

@@ -17,6 +17,7 @@ never join the ring built by attempt N+1.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Callable, Dict, List, Optional, Set
 
@@ -35,6 +36,8 @@ from ray_tpu.train.backend import BackendConfig
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger("ray_tpu.train")
 
 # Exceptions on a worker call that mean "this rank's process is gone (or
 # unreachable for longer than we are willing to wait)" — the gang must be
@@ -116,8 +119,12 @@ class BackendExecutor:
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group, self.backend_config)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — gang is dead; teardown is best-effort
+                logger.warning(
+                    "backend on_shutdown failed during gang restart "
+                    "(epoch %d); proceeding with kill-and-rebuild",
+                    self.epoch, exc_info=True,
+                )
             self.worker_group.shutdown()
             self.worker_group = None
         self.epoch += 1
@@ -221,10 +228,12 @@ class BackendExecutor:
             self._last_drain_check = now
             try:
                 ranks |= self._gcs_draining_ranks()
-            except Exception:
+            except Exception:  # noqa: BLE001
                 # Control-plane hiccup must not fail training; the next
                 # poll retries.
-                pass
+                logger.warning("GCS drain poll failed; retrying in %.1fs",
+                               cfg.train_drain_poll_interval_s,
+                               exc_info=True)
         return ranks
 
     def _gcs_draining_ranks(self) -> Set[int]:
@@ -250,8 +259,13 @@ class BackendExecutor:
         if self.worker_group is None:
             return
         refs = [w.request_stop.remote() for w in self.worker_group.workers]
-        for ref in refs:
+        for rank, ref in enumerate(refs):
             try:
                 rt.get(ref, timeout=get_config().train_probe_timeout_s)
-            except Exception:
-                pass
+            except _GANG_FATAL:
+                # A rank that is already dead (or unreachable) cannot
+                # checkpoint; the coming restart handles it.
+                logger.warning(
+                    "rank %d unreachable during stop-all request; it "
+                    "will be replaced at the next gang epoch", rank,
+                )

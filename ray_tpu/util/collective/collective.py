@@ -115,23 +115,41 @@ def create_collective_group(
     ranks: List[int],
     backend: str = "dcn",
     group_name: str = "default",
+    epoch: int = 0,
+    timeout_s: Optional[float] = None,
 ):
     """Declaratively set up a group across actors (reference :151).
 
     Each actor must expose the reference convention of running
     `init_collective_group` inside itself; here we call a well-known
     method name via an internal task.
+
+    epoch is forwarded to each actor's init so rendezvous keys are
+    gang-epoch-stamped; epoch=0 keeps the legacy 4-arg call so actors
+    written before the epoch parameter existed keep working (a gang
+    that actually restarts must expose an epoch-accepting init).  The
+    gather of init acks is bounded by `timeout_s` (default: the
+    RT_COLLECTIVE_RENDEZVOUS_TIMEOUT_S config) — a member that never
+    reaches rendezvous must surface as GetTimeoutError, not hang the
+    caller forever.
     """
     import ray_tpu as rt
+    from ray_tpu._private.config import get_config
+
+    if timeout_s is None:
+        timeout_s = get_config().collective_rendezvous_timeout_s
 
     refs = []
     for actor, rank in zip(actors, ranks):
-        refs.append(
-            actor._do_init_collective.remote(world_size, rank, backend, group_name)
-            if hasattr(actor, "_do_init_collective")
-            else actor.init_collective.remote(world_size, rank, backend, group_name)
-        )
-    rt.get(refs)
+        method = (actor._do_init_collective
+                  if hasattr(actor, "_do_init_collective")
+                  else actor.init_collective)
+        args = (world_size, rank, backend, group_name)
+        if epoch:
+            refs.append(method.remote(*args, epoch=epoch))
+        else:
+            refs.append(method.remote(*args))
+    rt.get(refs, timeout=timeout_s)
 
 
 def destroy_collective_group(group_name: str = "default"):

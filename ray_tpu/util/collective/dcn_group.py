@@ -33,8 +33,12 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+import logging
+
 from ray_tpu.exceptions import CollectiveTimeoutError
 from ray_tpu.util.collective.types import ReduceOp
+
+logger = logging.getLogger("ray_tpu.collective")
 
 _LEN = struct.Struct("<Q")
 # Identification frame on every initiated connection: sender rank + the
@@ -129,7 +133,11 @@ class DcnGroup:
         self._server.bind(("127.0.0.1", 0))
         self._server.listen(world_size + 2)
         self.addr = self._server.getsockname()
+        # Written by the accept thread, read by collective ops on the
+        # main thread — guard with a lock rather than relying on the
+        # GIL's per-op dict atomicity.
         self._accepted: Dict[int, _Peer] = {}
+        self._accepted_lock = threading.Lock()
         self._outgoing: Dict[int, _Peer] = {}
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
@@ -186,7 +194,8 @@ class DcnGroup:
                 except OSError:
                     pass
                 continue
-            self._accepted[rank] = peer
+            with self._accepted_lock:
+                self._accepted[rank] = peer
 
     def _peer_out(self, rank: int) -> _Peer:
         """Connection this rank initiated (used for sends to `rank`)."""
@@ -204,7 +213,8 @@ class DcnGroup:
         """Connection initiated by `rank` toward us (used for receives)."""
         deadline = time.monotonic() + self._timeout
         while time.monotonic() < deadline:
-            peer = self._accepted.get(rank)
+            with self._accepted_lock:
+                peer = self._accepted.get(rank)
             if peer is not None:
                 return peer
             time.sleep(0.002)
@@ -339,13 +349,22 @@ class DcnGroup:
         # never resolves to this (now dead) listener.
         try:
             self._kv.kv_del(self._key(self.rank), ns="collective")
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            # A stale entry only delays (never corrupts) a future group:
+            # rendezvous keys are epoch-stamped, so leaking one is safe —
+            # but record it, a flood of these means the GCS is sick.
+            logger.warning(
+                "failed to delete rendezvous key for rank %d of group "
+                "%r (epoch %d)", self.rank, self.group_name, self.epoch,
+                exc_info=True,
+            )
         try:
             self._server.close()
         except OSError:
             pass
-        for p in list(self._accepted.values()) + list(self._outgoing.values()):
+        with self._accepted_lock:
+            accepted = list(self._accepted.values())
+        for p in accepted + list(self._outgoing.values()):
             try:
                 p.sock.close()
             except OSError:

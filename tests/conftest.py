@@ -29,6 +29,19 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """`sanitizer` tests compile the native store under TSan/UBSan and
+    run a multithreaded stress binary — minutes of compiler time that
+    the default (and even `slow`) tiers shouldn't pay. They run only
+    when explicitly selected: `-m sanitizer` (what `make lint` does)."""
+    if "sanitizer" in (config.option.markexpr or ""):
+        return
+    skip = pytest.mark.skip(reason="opt-in: select with -m sanitizer")
+    for item in items:
+        if "sanitizer" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture
 def rt_local():
     import ray_tpu as rt

@@ -1,0 +1,48 @@
+"""Native-store sanitizer gate (opt-in: `pytest -m sanitizer`).
+
+Runs the 8-thread create/seal/get/release/delete stress harness
+(store_thread_test.cc) under ThreadSanitizer and UndefinedBehavior-
+Sanitizer via the native Makefile. Any TSan race report or UBSan
+diagnostic makes the binary exit non-zero (-fno-sanitize-recover), so a
+regression in the store's locking or offset arithmetic fails the test
+with the sanitizer report in the assertion message.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ray_tpu", "native")
+
+pytestmark = pytest.mark.sanitizer
+
+needs_toolchain = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="needs g++ and make",
+)
+
+
+def _run(target: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["make", target], cwd=NATIVE, capture_output=True, text=True,
+        timeout=600,
+    )
+
+
+@needs_toolchain
+def test_store_stress_under_tsan():
+    r = _run("tsan_test")
+    assert r.returncode == 0, f"TSan run failed:\n{r.stdout}\n{r.stderr}"
+    assert "STORE THREAD TESTS OK" in r.stdout
+    assert "WARNING: ThreadSanitizer" not in r.stdout + r.stderr
+
+
+@needs_toolchain
+def test_store_stress_under_ubsan():
+    r = _run("ubsan_test")
+    assert r.returncode == 0, f"UBSan run failed:\n{r.stdout}\n{r.stderr}"
+    assert "STORE THREAD TESTS OK" in r.stdout
+    assert "runtime error" not in r.stdout + r.stderr

@@ -1,0 +1,454 @@
+"""rtlint: per-rule fixtures (positive + negative twin + suppression),
+baseline round-trip, and the repo-wide gate.
+
+Each rule's positive fixture is the minimal reproduction of the bug
+class; its negative twin is the same code with the one property that
+makes it safe (a timeout, a lock, an epoch, a hoisted jit). The
+suppression case proves `# rtlint: disable=RTxxx` works at both line
+and def granularity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.rtlint import Baseline, lint_paths, lint_source
+from tools.rtlint.rules import ALL_RULES, rule_by_id
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings(src: str, path: str = "ray_tpu/serve/x.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+def rule_ids(src: str, path: str = "ray_tpu/serve/x.py"):
+    return [f.rule for f in findings(src, path)]
+
+
+# -- RT001: host sync ------------------------------------------------------
+RT001_POS = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return float(x.sum())
+"""
+
+RT001_NEG = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x.sum()
+
+    def report(x):
+        return float(step(x))
+"""
+
+
+def test_rt001_traced_sync():
+    assert "RT001" in rule_ids(RT001_POS)
+
+
+def test_rt001_negative_twin():
+    assert "RT001" not in rule_ids(RT001_NEG)
+
+
+def test_rt001_loop_sync():
+    src = """
+        def drain(xs):
+            out = []
+            for x in xs:
+                out.append(x.item())
+            return out
+    """
+    fs = findings(src)
+    assert [f.rule for f in fs] == ["RT001"]
+    assert fs[0].token == ".item()"
+
+
+def test_rt001_item_outside_loop_ok():
+    assert "RT001" not in rule_ids("def f(x):\n    return x.item()\n")
+
+
+# -- RT002: retrace risk ---------------------------------------------------
+RT002_POS = """
+    import jax
+
+    def train(fns, x):
+        for f in fns:
+            y = jax.jit(f)(x)
+        return y
+"""
+
+RT002_NEG = """
+    import jax
+
+    def train(fns, x):
+        compiled = [jax.jit(f) for f in fns]
+        return [g(x) for g in compiled]
+"""
+
+
+def test_rt002_jit_in_loop():
+    assert "RT002" in rule_ids(RT002_POS)
+
+
+def test_rt002_negative_twin():
+    # List comprehensions build the wrappers once per fn, not per call.
+    assert "RT002" not in rule_ids(
+        "import jax\n\ndef f(g, x):\n    h = jax.jit(g)\n    return h(x)\n"
+    )
+
+
+def test_rt002_mutable_static_argnums():
+    src = """
+        import jax
+
+        def build(f):
+            return jax.jit(f, static_argnums=[0, 1])
+    """
+    fs = findings(src)
+    assert [f.rule for f in fs] == ["RT002"]
+    assert fs[0].token == "static-static_argnums"
+    assert "RT002" not in rule_ids(src.replace("[0, 1]", "(0, 1)"))
+
+
+def test_rt002_jit_def_in_loop():
+    src = """
+        import jax
+
+        def outer(xs):
+            for x in xs:
+                @jax.jit
+                def inner(y):
+                    return y + x
+                inner(x)
+    """
+    assert "jit-def-in-loop" in [f.token for f in findings(src)]
+
+
+# -- RT003: unbounded blocking get ----------------------------------------
+RT003_POS = """
+    import ray_tpu as rt
+
+    @rt.remote
+    class Worker:
+        def run(self, ref):
+            return rt.get(ref)
+"""
+
+RT003_NEG = RT003_POS.replace("rt.get(ref)", "rt.get(ref, timeout=30)")
+
+
+def test_rt003_actor_get_without_timeout():
+    fs = findings(RT003_POS, path="ray_tpu/rl/x.py")
+    assert [f.rule for f in fs] == ["RT003"]
+    assert fs[0].token == "rt.get"
+
+
+def test_rt003_negative_twin():
+    assert "RT003" not in rule_ids(RT003_NEG, path="ray_tpu/rl/x.py")
+
+
+def test_rt003_control_plane_free_function():
+    src = """
+        import ray_tpu as rt
+
+        def bootstrap(refs):
+            rt.get(refs)
+    """
+    assert "RT003" in rule_ids(src, path="ray_tpu/util/collective/x.py")
+    # Same helper outside the control-plane scopes: not flagged.
+    assert "RT003" not in rule_ids(src, path="ray_tpu/rl/x.py")
+
+
+def test_rt003_bare_result():
+    src = """
+        @rt.remote
+        class A:
+            def m(self, fut):
+                return fut.result()
+    """
+    src = "import ray_tpu as rt\n" + textwrap.dedent(src)
+    assert "RT003" in [f.rule for f in lint_source(src, "ray_tpu/rl/x.py")]
+
+
+# -- RT004: discarded ObjectRef -------------------------------------------
+RT004_POS = """
+    def push(workers, w):
+        for r in workers:
+            r.set_weights.remote(w)
+"""
+
+RT004_NEG = """
+    import ray_tpu as rt
+
+    def push(workers, w):
+        refs = [r.set_weights.remote(w) for r in workers]
+        rt.get(refs, timeout=60)
+"""
+
+
+def test_rt004_discarded_ref():
+    fs = findings(RT004_POS, path="ray_tpu/rl/x.py")
+    assert [f.rule for f in fs] == ["RT004"]
+    assert fs[0].token == "set_weights"
+
+
+def test_rt004_negative_twin():
+    assert "RT004" not in rule_ids(RT004_NEG, path="ray_tpu/rl/x.py")
+
+
+# -- RT005: unfenced collective -------------------------------------------
+RT005_POS = """
+    from ray_tpu.util import collective as col
+
+    def setup(ws, rank):
+        col.init_collective_group(ws, rank, "dcn", "g")
+"""
+
+RT005_NEG = RT005_POS.replace('"g")', '"g", epoch=0)')
+
+
+def test_rt005_missing_epoch():
+    fs = findings(RT005_POS, path="ray_tpu/rl/x.py")
+    assert [f.rule for f in fs] == ["RT005"]
+    assert fs[0].token == "init_collective_group"
+
+
+def test_rt005_negative_twin():
+    # Explicit epoch=0 is the call site asserting "never rebuilt".
+    assert "RT005" not in rule_ids(RT005_NEG, path="ray_tpu/rl/x.py")
+
+
+# -- RT006: cross-thread race ---------------------------------------------
+RT006_POS = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._running = True
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            while self._running:
+                pass
+
+        def shutdown(self):
+            self._running = False
+"""
+
+RT006_NEG_LOCK = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._running = True
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    if not self._running:
+                        return
+
+        def shutdown(self):
+            with self._lock:
+                self._running = False
+"""
+
+RT006_NEG_EVENT = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._stop_event = threading.Event()
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            while not self._stop_event.is_set():
+                pass
+
+        def shutdown(self):
+            self._stop_event.set()
+"""
+
+
+def test_rt006_unlocked_flag():
+    fs = findings(RT006_POS, path="ray_tpu/rl/x.py")
+    assert [f.rule for f in fs] == ["RT006"]
+    assert fs[0].token == "_running"
+
+
+def test_rt006_lock_negative_twin():
+    assert "RT006" not in rule_ids(RT006_NEG_LOCK, path="ray_tpu/rl/x.py")
+
+
+def test_rt006_event_negative_twin():
+    assert "RT006" not in rule_ids(RT006_NEG_EVENT, path="ray_tpu/rl/x.py")
+
+
+def test_rt006_init_writes_exempt():
+    # Writes before the thread starts happen-before it; only the
+    # post-start caller-side write races.
+    src = RT006_POS.replace(
+        "def shutdown(self):\n            self._running = False",
+        "def status(self):\n            return True",
+    )
+    assert "RT006" not in rule_ids(src, path="ray_tpu/rl/x.py")
+
+
+# -- RT007: swallowed exception -------------------------------------------
+RT007_POS = """
+    def teardown(group):
+        try:
+            group.destroy()
+        except Exception:
+            pass
+"""
+
+RT007_NEG = """
+    import logging
+
+    def teardown(group):
+        try:
+            group.destroy()
+        except OSError:
+            pass
+"""
+
+
+def test_rt007_swallow_in_control_plane():
+    fs = findings(RT007_POS, path="ray_tpu/train/x.py")
+    assert [f.rule for f in fs] == ["RT007"]
+
+
+def test_rt007_narrow_negative_twin():
+    assert "RT007" not in rule_ids(RT007_NEG, path="ray_tpu/train/x.py")
+
+
+def test_rt007_logging_body_ok():
+    src = """
+        import logging
+
+        def teardown(group):
+            try:
+                group.destroy()
+            except Exception:
+                logging.warning("destroy failed", exc_info=True)
+    """
+    assert "RT007" not in rule_ids(src, path="ray_tpu/train/x.py")
+
+
+def test_rt007_scoped_to_control_plane():
+    assert "RT007" not in rule_ids(RT007_POS, path="ray_tpu/rl/x.py")
+
+
+# -- suppressions ----------------------------------------------------------
+def test_line_suppression():
+    src = RT007_POS.replace("except Exception:",
+                            "except Exception:  # rtlint: disable=RT007")
+    assert "RT007" not in rule_ids(src, path="ray_tpu/train/x.py")
+
+
+def test_def_suppression_covers_body():
+    src = RT006_POS.replace(
+        "def shutdown(self):",
+        "def shutdown(self):  # rtlint: disable=RT006",
+    )
+    assert "RT006" not in rule_ids(src, path="ray_tpu/rl/x.py")
+
+
+def test_suppression_is_rule_specific():
+    # Disabling RT001 does not hide the RT007.
+    src = RT007_POS.replace("except Exception:",
+                            "except Exception:  # rtlint: disable=RT001")
+    assert "RT007" in rule_ids(src, path="ray_tpu/train/x.py")
+
+
+def test_blanket_suppression():
+    src = RT007_POS.replace("except Exception:",
+                            "except Exception:  # rtlint: disable")
+    assert "RT007" not in rule_ids(src, path="ray_tpu/train/x.py")
+
+
+# -- engine behavior -------------------------------------------------------
+def test_syntax_error_yields_rt000():
+    fs = lint_source("def broken(:\n", "ray_tpu/x.py")
+    assert [f.rule for f in fs] == ["RT000"]
+
+
+def test_fingerprint_is_line_independent():
+    fs1 = findings(RT007_POS, path="ray_tpu/train/x.py")
+    fs2 = findings("\n\n\n" + textwrap.dedent(RT007_POS),
+                   path="ray_tpu/train/x.py")
+    assert fs1[0].fingerprint == fs2[0].fingerprint
+    assert fs1[0].line != fs2[0].line
+
+
+def test_baseline_roundtrip(tmp_path):
+    fs = findings(RT007_POS, path="ray_tpu/train/x.py")
+    bl = Baseline.from_findings(fs)
+    p = tmp_path / "baseline.json"
+    bl.save(str(p))
+    loaded = Baseline.load(str(p))
+    assert loaded.counts == bl.counts
+    assert loaded.new_findings(fs) == []
+    # A second identical violation exceeds the baselined count.
+    doubled = fs + fs
+    assert len(loaded.new_findings(doubled)) == len(fs)
+    # JSON on disk is the documented shape.
+    data = json.loads(p.read_text())
+    assert set(data) == {"comment", "findings"}
+
+
+def test_baseline_stale_entries():
+    bl = Baseline({"RT007|gone.py|f|swallow": 1})
+    assert bl.stale_entries([]) == ["RT007|gone.py|f|swallow"]
+
+
+def test_rule_catalog():
+    ids = [r.id for r in ALL_RULES]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    assert ids == [f"RT00{i}" for i in range(1, 8)]
+    assert rule_by_id("rt003").id == "RT003"
+    for r in ALL_RULES:
+        assert r.name and r.__doc__
+
+
+# -- repo-wide gate --------------------------------------------------------
+def test_repo_is_clean_against_baseline():
+    """The tier-1 gate: linting ray_tpu/ yields no findings beyond the
+    committed baseline. New violations fail here, with the finding text
+    in the assertion message."""
+    bl = Baseline.load(os.path.join(REPO, "tools", "rtlint",
+                                    "baseline.json"))
+    fs = lint_paths([os.path.join(REPO, "ray_tpu")], root=REPO)
+    new = bl.new_findings(fs)
+    assert not new, "new rtlint findings:\n" + "\n".join(map(str, new))
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "x.py"
+    bad.write_text(textwrap.dedent(RT004_POS))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    run = lambda *a: subprocess.run(  # noqa: E731
+        [sys.executable, "-m", "tools.rtlint", *a],
+        capture_output=True, text=True, env=env, cwd=REPO,
+    )
+    clean = run("--no-baseline", str(tmp_path / "nothing"))
+    assert clean.returncode == 0
+    dirty = run("--no-baseline", str(bad))
+    assert dirty.returncode == 1
+    assert "RT004" in dirty.stdout
+    assert run("--explain", "RT006").returncode == 0
+    assert run("--explain", "RT999").returncode == 2

@@ -98,6 +98,23 @@ def _bench_r(field: str, sub: str = None):
     return get
 
 
+def _rtlint_rule_count():
+    def get():
+        if REPO not in sys.path:  # direct `python tools/check_claims.py`
+            sys.path.insert(0, REPO)
+        from tools.rtlint.rules import ALL_RULES
+
+        return len(ALL_RULES)
+    return get
+
+
+def _rtlint_baseline_size():
+    def get():
+        data = _load(os.path.join("tools", "rtlint", "baseline.json"))
+        return sum(data["findings"].values())
+    return get
+
+
 class Claim:
     def __init__(self, doc: str, pattern: str, getter: Callable,
                  rel_tol: float = 0.15, scale: float = 1.0,
@@ -221,6 +238,12 @@ CLAIMS = [
           rel_tol=1.0, note="pipelined actor respawn; noisy at ~20ms"),
     Claim("MIGRATION.md", r"deadline trips in (\d+\.\d+) s",
           _bench_ft("collective timeout trip", "trip_s"), rel_tol=0.1),
+    # Static-analysis section <- rtlint itself. Exact pins (rel_tol=0):
+    # adding a rule or regenerating the baseline must update the doc.
+    Claim("MIGRATION.md", r"lint pass\s*\n?\s*with (\d+) rules",
+          _rtlint_rule_count(), rel_tol=0.0),
+    Claim("MIGRATION.md", r"holds (\d+) known findings",
+          _rtlint_baseline_size(), rel_tol=0.0),
 ]
 
 

@@ -1,0 +1,37 @@
+"""rtlint: runtime-aware static analysis for the ray_tpu codebase.
+
+The bug classes the first performance/robustness PRs fixed — silent jit
+retraces, per-step host syncs, unbounded actor-side gets, unfenced DCN
+collectives, exception swallowing in the control plane — are all
+*statically detectable*. This package turns them into pre-merge
+diagnostics: an AST-based rule engine (stdlib ``ast``, zero deps) with
+inline suppressions and a committed baseline so existing debt is
+tracked without blocking CI.
+
+Usage:
+    python -m tools.rtlint ray_tpu/                 # lint against baseline
+    python -m tools.rtlint --list-rules             # rule catalog
+    python -m tools.rtlint --write-baseline ray_tpu/  # re-baseline
+
+Rules are documented in tools/rtlint/RULES.md and in each rule's
+docstring (``--explain RTxxx`` prints it). Suppress a finding inline
+with ``# rtlint: disable=RT001`` (comma-separate for several rules; on a
+``def``/``class`` line the suppression covers the whole body).
+"""
+
+from tools.rtlint.engine import (  # noqa: F401
+    Baseline,
+    Finding,
+    lint_paths,
+    lint_source,
+)
+from tools.rtlint.rules import ALL_RULES, rule_by_id  # noqa: F401
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "Finding",
+    "lint_paths",
+    "lint_source",
+    "rule_by_id",
+]

@@ -1,0 +1,103 @@
+"""rtlint CLI.
+
+    python -m tools.rtlint ray_tpu/              lint against the baseline
+    python -m tools.rtlint --no-baseline PATH    report every finding
+    python -m tools.rtlint --write-baseline PATH regenerate the baseline
+    python -m tools.rtlint --list-rules          one-line rule catalog
+    python -m tools.rtlint --explain RT003       full rule rationale
+
+Exit codes: 0 clean, 1 new findings (or stale-baseline with --strict-
+baseline), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import sys
+
+from tools.rtlint.engine import Baseline, lint_paths
+from tools.rtlint.rules import ALL_RULES, rule_by_id
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rtlint", add_help=True)
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/rtlint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to the baseline file")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="also fail when baselined entries no longer exist "
+                         "(debt paid off: refresh the baseline)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="RTxxx")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            first = (r.__doc__ or "").strip().splitlines()[0]
+            print(f"{r.id}  {r.name:24s} {first}")
+        return 0
+    if args.explain:
+        try:
+            r = rule_by_id(args.explain)
+        except KeyError:
+            print(f"unknown rule {args.explain!r}", file=sys.stderr)
+            return 2
+        print(f"{r.id} ({r.name})\n")
+        print((r.__doc__ or "").strip())
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("rtlint: no paths given", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        try:
+            rules = [rule_by_id(x) for x in args.rules.split(",") if x]
+        except KeyError as e:
+            print(f"unknown rule {e.args[0]!r}", file=sys.stderr)
+            return 2
+
+    findings = lint_paths(args.paths, rules)
+
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(args.baseline)
+        by_rule = collections.Counter(f.rule for f in findings)
+        summary = ", ".join(f"{r}:{n}" for r, n in sorted(by_rule.items()))
+        print(f"wrote {len(findings)} findings to {args.baseline} "
+              f"({summary or 'clean'})")
+        return 0
+
+    baseline = (Baseline() if args.no_baseline
+                else Baseline.load(args.baseline))
+    new = baseline.new_findings(findings)
+    for f in new:
+        print(f)
+    stale = [] if args.no_baseline else baseline.stale_entries(findings)
+    if stale and (args.strict_baseline or not new):
+        print(f"note: {len(stale)} baselined finding(s) no longer exist — "
+              f"debt paid; refresh with --write-baseline", file=sys.stderr)
+    if new:
+        by_rule = collections.Counter(f.rule for f in new)
+        summary = ", ".join(f"{r}:{n}" for r, n in sorted(by_rule.items()))
+        print(f"rtlint: {len(new)} new finding(s) [{summary}] "
+              f"({len(findings) - len(new)} baselined/suppressed absorbed)",
+              file=sys.stderr)
+        return 1
+    print(f"rtlint: clean ({len(findings)} baselined finding(s), "
+          f"{len(ALL_RULES) if rules is None else len(rules)} rules)")
+    return 1 if (stale and args.strict_baseline) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

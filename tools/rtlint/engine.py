@@ -1,0 +1,309 @@
+"""rtlint core: file context, suppression handling, baseline, runner.
+
+The engine is rule-agnostic: it parses each file once, builds the shared
+analysis context (parent links, import aliases, qualified scope names),
+applies every rule, then drops findings that are suppressed inline or
+absorbed by the committed baseline.
+
+Baseline fingerprints are *line-independent* — ``rule|path|scope|token``
+— so unrelated edits above a baselined site do not churn the file. Two
+identical violations in one scope share a fingerprint; the baseline
+stores a count per fingerprint and only a count *increase* is reported.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+_SUPPRESS_RE = re.compile(r"#\s*rtlint:\s*disable(?:=([A-Za-z0-9_,\s]+))?")
+
+
+@dataclass
+class Finding:
+    rule: str          # "RT001"
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    scope: str = "<module>"   # enclosing function qualname
+    token: str = ""           # short stable detail (call/attr name)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.token}"
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.scope}] {self.message}")
+
+
+class FileContext:
+    """Everything a rule needs about one parsed file."""
+
+    def __init__(self, source: str, path: str):
+        self.source = source
+        self.path = path.replace(os.sep, "/")
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._qualnames: Dict[ast.AST, str] = {}
+        self._link(self.tree, None, prefix="")
+        # Module aliases: which local names mean ray_tpu / jax / numpy.
+        self.rt_aliases = {"ray_tpu"}
+        self.jax_aliases = {"jax"}
+        self.np_aliases = {"numpy"}
+        self.from_imports: Dict[str, str] = {}  # local name -> module
+        self._collect_imports()
+
+    # -- tree plumbing ----------------------------------------------------
+    def _link(self, node: ast.AST, parent: Optional[ast.AST], prefix: str):
+        if parent is not None:
+            self._parents[node] = parent
+        name = getattr(node, "name", None)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            prefix = f"{prefix}.{name}" if prefix else name
+            self._qualnames[node] = prefix
+        for child in ast.iter_child_nodes(node):
+            self._link(child, node, prefix)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Qualname of the innermost enclosing function/class."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                return self._qualnames[anc]
+        return "<module>"
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def in_loop(self, node: ast.AST, within: Optional[ast.AST] = None) -> bool:
+        """Is `node` lexically inside a for/while body (not crossing a
+        nested function boundary unless that function is `within`)?"""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and anc is not within:
+                return False
+        return False
+
+    def under_lock(self, node: ast.AST) -> bool:
+        """Is `node` inside a ``with <something lock-ish>:`` block?"""
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if _mentions_lock(item.context_expr):
+                        return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return False
+
+    # -- imports ----------------------------------------------------------
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    if a.name.split(".")[0] == "ray_tpu":
+                        self.rt_aliases.add(local)
+                    elif a.name == "jax" or a.name.startswith("jax."):
+                        self.jax_aliases.add(local)
+                    elif a.name == "numpy":
+                        self.np_aliases.add(local)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = node.module
+
+    def is_module_attr(self, func: ast.AST, aliases: set, attr: str) -> bool:
+        """Match ``<alias>.<attr>`` (e.g. rt.get, jax.jit)."""
+        return (isinstance(func, ast.Attribute) and func.attr == attr
+                and isinstance(func.value, ast.Name)
+                and func.value.id in aliases)
+
+
+def _mentions_lock(expr: ast.AST) -> bool:
+    # A Condition ("cond") wraps a lock; `with self._cond:` acquires it.
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name and any(h in name.lower()
+                        for h in ("lock", "mutex", "cond")):
+            return True
+    return False
+
+
+# -- suppressions ---------------------------------------------------------
+def _suppressed_lines(ctx: FileContext) -> Dict[int, Optional[set]]:
+    """line -> set of disabled rule ids (None = all rules).
+
+    A ``# rtlint: disable`` comment on a ``def``/``class`` (or decorator)
+    line extends over the whole definition body.
+    """
+    per_line: Dict[int, Optional[set]] = {}
+    marked: Dict[int, Optional[set]] = {}
+    for i, text in enumerate(ctx.lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = None
+        if m.group(1):
+            rules = {r.strip().upper() for r in m.group(1).split(",")
+                     if r.strip()}
+        marked[i] = rules
+        per_line[i] = rules
+    if not marked:
+        return per_line
+
+    def merge(line: int, rules: Optional[set]):
+        cur = per_line.get(line, set())
+        if cur is None or rules is None:
+            per_line[line] = None
+        else:
+            per_line[line] = cur | rules
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            continue
+        head_lines = {node.lineno}
+        head_lines.update(d.lineno for d in node.decorator_list)
+        for hl in head_lines:
+            if hl in marked:
+                for line in range(node.lineno, (node.end_lineno or
+                                                node.lineno) + 1):
+                    merge(line, marked[hl])
+    return per_line
+
+
+def _is_suppressed(finding: Finding,
+                   per_line: Dict[int, Optional[set]]) -> bool:
+    rules = per_line.get(finding.line, ...)
+    if rules is ...:
+        return False
+    return rules is None or finding.rule in rules
+
+
+# -- baseline -------------------------------------------------------------
+class Baseline:
+    """Committed ledger of known findings: fingerprint -> count."""
+
+    def __init__(self, counts: Optional[Dict[str, int]] = None):
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("findings", {}))
+
+    def save(self, path: str):
+        payload = {
+            "comment": ("rtlint baseline: known pre-existing findings "
+                        "(fingerprint -> count). Regenerate with "
+                        "`python -m tools.rtlint --write-baseline ray_tpu/` "
+                        "AFTER confirming every new entry is deliberate "
+                        "debt, not a new bug."),
+            "findings": dict(sorted(self.counts.items())),
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=False)
+            f.write("\n")
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding]) -> "Baseline":
+        counts: Dict[str, int] = {}
+        for fd in findings:
+            counts[fd.fingerprint] = counts.get(fd.fingerprint, 0) + 1
+        return cls(counts)
+
+    def new_findings(self, findings: Sequence[Finding]) -> List[Finding]:
+        """Findings beyond the baselined count per fingerprint (stable
+        order: a fingerprint's first N occurrences are absorbed)."""
+        seen: Dict[str, int] = {}
+        out = []
+        for fd in findings:
+            seen[fd.fingerprint] = seen.get(fd.fingerprint, 0) + 1
+            if seen[fd.fingerprint] > self.counts.get(fd.fingerprint, 0):
+                out.append(fd)
+        return out
+
+    def stale_entries(self, findings: Sequence[Finding]) -> List[str]:
+        """Baselined fingerprints no longer present at all (debt paid —
+        candidates for a baseline refresh)."""
+        live = {f.fingerprint for f in findings}
+        return sorted(k for k in self.counts if k not in live)
+
+
+# -- runner ---------------------------------------------------------------
+def lint_source(source: str, path: str,
+                rules: Optional[Sequence] = None) -> List[Finding]:
+    """Lint one in-memory file; returns unsuppressed findings sorted by
+    position. Syntax errors yield a single RT000 finding instead of
+    crashing the whole run."""
+    from tools.rtlint.rules import ALL_RULES
+
+    try:
+        ctx = FileContext(source, path)
+    except SyntaxError as e:
+        return [Finding("RT000", path.replace(os.sep, "/"),
+                        e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}", token="syntax")]
+    per_line = _suppressed_lines(ctx)
+    findings: List[Finding] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        for fd in rule.check(ctx):
+            if not _is_suppressed(fd, per_line):
+                findings.append(fd)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in {"__pycache__", ".git"})
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+
+
+def lint_paths(paths: Sequence[str], rules: Optional[Sequence] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint every .py file under `paths`; finding paths are relative to
+    `root` (default: cwd) so fingerprints are machine-independent."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    for fp in iter_py_files(paths):
+        with open(fp, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(os.path.abspath(fp), root)
+        findings.extend(lint_source(source, rel, rules))
+    return findings

@@ -1,0 +1,592 @@
+"""rtlint rules RT001–RT007.
+
+Each rule is motivated by a bug class this repo has actually shipped and
+later fixed (see RULES.md for the incident references). Rules are
+deliberately *syntactic*: they over-approximate, and intentional
+violations carry an inline ``# rtlint: disable=RTxxx`` with a comment
+explaining why the pattern is safe there — which doubles as
+documentation at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.rtlint.engine import FileContext, Finding
+
+# Names that mean "this code runs under jax.jit tracing".
+_JIT_NAMES = {"jit", "pjit"}
+# Host-sync operations: each forces (or implies) a device->host transfer
+# the TPU pipeline must drain for.
+_SYNC_ATTRS = {"item", "block_until_ready", "copy_to_host"}
+_NP_CONVERTERS = {"asarray", "array"}
+
+
+class Rule:
+    id: str = ""
+    name: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str,
+                token: str, scope: Optional[str] = None) -> Finding:
+        return Finding(
+            self.id, ctx.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), message,
+            scope=scope if scope is not None else ctx.scope_of(node),
+            token=token,
+        )
+
+
+# -- shared jit detection -------------------------------------------------
+def _dotted(func: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('jax.jit', 'rt.get')."""
+    parts: List[str] = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_expr(ctx: FileContext, node: ast.AST) -> bool:
+    """Does this expression denote jax.jit / jit / pjit (possibly through
+    functools.partial)?"""
+    if isinstance(node, ast.Name):
+        return (node.id in _JIT_NAMES
+                and ctx.from_imports.get(node.id, "").startswith("jax"))
+    if isinstance(node, ast.Attribute):
+        return (node.attr in _JIT_NAMES
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ctx.jax_aliases)
+    if isinstance(node, ast.Call):
+        if _is_jit_expr(ctx, node.func):
+            return True
+        # functools.partial(jax.jit, ...) — the partial IS a jit wrapper.
+        if _dotted(node.func) in {"partial", "functools.partial"}:
+            return any(_is_jit_expr(ctx, a) for a in node.args)
+    return False
+
+
+def _jit_call_sites(ctx: FileContext) -> Iterator[ast.Call]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(ctx, node.func):
+            yield node
+
+
+def _traced_bodies(ctx: FileContext) -> List[ast.AST]:
+    """Function/lambda nodes whose bodies run under jit tracing: defs
+    decorated with jit, and callables passed directly to a jit call."""
+    traced: List[ast.AST] = []
+    local_defs: Dict[Tuple[str, str], ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs[(ctx.scope_of(node), node.name)] = node
+            if any(_is_jit_expr(ctx, d) for d in node.decorator_list):
+                traced.append(node)
+    for call in _jit_call_sites(ctx):
+        if not call.args:
+            continue
+        fn = call.args[0]
+        if isinstance(fn, ast.Lambda):
+            traced.append(fn)
+        elif isinstance(fn, ast.Name):
+            target = local_defs.get((ctx.scope_of(call), fn.id))
+            if target is not None:
+                traced.append(target)
+    return traced
+
+
+# -- RT001 ----------------------------------------------------------------
+class HostSyncRule(Rule):
+    """RT001: device->host sync reachable from traced or hot-loop code.
+
+    Inside a jit-traced function, ``.item()`` / ``float()`` / ``int()``
+    on arrays, ``np.asarray``, ``jax.device_get`` and
+    ``block_until_ready`` either fail at trace time or silently force a
+    sync on every call. Outside traced code, the same syncs inside a
+    ``for``/``while`` body are the per-step host round trips that made
+    the serving engine 27x slower than its raw decode floor (PR 1).
+    """
+
+    id = "RT001"
+    name = "host-sync-in-hot-path"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        traced = _traced_bodies(ctx)
+        traced_nodes: Set[int] = set()
+        for t in traced:
+            for node in ast.walk(t):
+                traced_nodes.add(id(node))
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            op = self._sync_op(ctx, node, in_traced=id(node) in traced_nodes)
+            if op is None:
+                continue
+            if id(node) in traced_nodes:
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` inside a jit-traced function forces a "
+                    f"device->host sync (or fails at trace time); hoist "
+                    f"it out of the traced body",
+                    token=op)
+            elif ctx.in_loop(node):
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` inside a loop body syncs host<->device every "
+                    f"iteration — batch it, move it off-step, or fetch "
+                    f"async (copy_to_host_async) and drain once",
+                    token=op)
+
+    @staticmethod
+    def _sync_op(ctx: FileContext, call: ast.Call,
+                 in_traced: bool) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_ATTRS:
+                return f".{func.attr}()"
+            if (isinstance(func.value, ast.Name)
+                    and func.value.id in ctx.jax_aliases
+                    and func.attr in {"device_get", "block_until_ready"}):
+                return f"jax.{func.attr}"
+            # np.asarray/np.array only matter under tracing (outside,
+            # numpy conversions in loops are ordinary host code).
+            if (in_traced and isinstance(func.value, ast.Name)
+                    and func.value.id in ctx.np_aliases
+                    and func.attr in _NP_CONVERTERS):
+                return f"np.{func.attr}"
+        elif (in_traced and isinstance(func, ast.Name)
+                and func.id in {"float", "int", "bool"}
+                and len(call.args) == 1
+                and not isinstance(call.args[0], ast.Constant)):
+            return f"{func.id}()"
+        return None
+
+
+# -- RT002 ----------------------------------------------------------------
+class RetraceRule(Rule):
+    """RT002: jit retrace risk.
+
+    ``jax.jit(...)`` evaluated inside a loop body builds a *fresh*
+    compiled-function cache every iteration — every call recompiles
+    (this, not the math, was most of the serving engine's original 27x
+    gap). A ``@jit`` decorator on a def nested in a loop is the same bug.
+    A mutable (list/set/dict) ``static_argnums``/``static_argnames``
+    spec can be mutated between calls, changing the cache key and
+    silently retracing.
+    """
+
+    id = "RT002"
+    name = "retrace-risk"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for call in _jit_call_sites(ctx):
+            if ctx.in_loop(call):
+                yield self.finding(
+                    ctx, call,
+                    "jax.jit called inside a loop body: each iteration "
+                    "builds a fresh jit wrapper with an empty cache, so "
+                    "every call recompiles — hoist the jit out of the "
+                    "loop",
+                    token="jit-in-loop")
+            for kw in call.keywords:
+                if (kw.arg in {"static_argnums", "static_argnames"}
+                        and isinstance(kw.value,
+                                       (ast.List, ast.Set, ast.Dict))):
+                    yield self.finding(
+                        ctx, kw.value,
+                        f"{kw.arg} given a mutable {type(kw.value).__name__.lower()} "
+                        f"literal — mutation between calls changes the "
+                        f"cache key and silently retraces; pass a tuple",
+                        token=f"static-{kw.arg}")
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and ctx.in_loop(node)
+                    and any(_is_jit_expr(ctx, d)
+                            for d in node.decorator_list)):
+                yield self.finding(
+                    ctx, node,
+                    f"@jit-decorated def `{node.name}` inside a loop body "
+                    f"re-wraps (and re-traces) every iteration — define "
+                    f"it once outside the loop",
+                    token="jit-def-in-loop")
+
+
+# -- RT003 ----------------------------------------------------------------
+class ActorBlockingRule(Rule):
+    """RT003: unbounded blocking get inside an actor method.
+
+    An actor method that calls ``rt.get``/``rt.wait`` (or
+    ``response.result()``) with no ``timeout=`` can deadlock the whole
+    actor: if the awaited task (transitively) needs *this* actor — or
+    its worker died without the GCS noticing yet — the method never
+    returns and every queued caller hangs behind it. The same applies
+    to control-plane helpers (serve/train/collective modules) that run
+    *inside* actors even though they aren't methods of one — e.g. the
+    collective bootstrap. Thread a deadline through
+    (RT_COLLECTIVE_OP_TIMEOUT_S-style config), and handle
+    GetTimeoutError.
+    """
+
+    id = "RT003"
+    name = "actor-blocking-get"
+
+    # Control-plane modules whose free functions execute in actor
+    # context (same scoping as RT007).
+    _SCOPES = ("serve/", "train/", "util/collective/")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        in_control_plane = any(s in ctx.path for s in self._SCOPES)
+        seen: set = set()
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(self._is_remote_decorator(ctx, d)
+                       for d in cls.decorator_list):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Call):
+                    continue
+                op = self._blocking_op(ctx, node)
+                if op is None:
+                    continue
+                seen.add(id(node))
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` without timeout= inside actor "
+                    f"`{cls.name}` — a dead or self-dependent callee "
+                    f"deadlocks this actor and everything queued on it; "
+                    f"pass a deadline and handle GetTimeoutError",
+                    token=op)
+        if not in_control_plane:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            op = self._blocking_op(ctx, node)
+            if op is None:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`{op}` without timeout= in a control-plane module — "
+                f"this helper runs inside actors (collective bootstrap, "
+                f"serve/train plumbing) where an unbounded block "
+                f"deadlocks the caller; pass a deadline and handle "
+                f"GetTimeoutError",
+                token=op)
+
+    @staticmethod
+    def _is_remote_decorator(ctx: FileContext, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        if isinstance(dec, ast.Attribute):
+            return (dec.attr == "remote" and isinstance(dec.value, ast.Name)
+                    and dec.value.id in ctx.rt_aliases)
+        if isinstance(dec, ast.Name):
+            return (dec.id == "remote"
+                    and ctx.from_imports.get(dec.id, "") == "ray_tpu")
+        return False
+
+    @staticmethod
+    def _blocking_op(ctx: FileContext, call: ast.Call) -> Optional[str]:
+        kwarg_names = {kw.arg for kw in call.keywords}
+        if "timeout" in kwarg_names or None in kwarg_names:  # **kwargs
+            return None
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            if func.value.id in ctx.rt_aliases and func.attr in {"get",
+                                                                 "wait"}:
+                return f"rt.{func.attr}"
+        if (isinstance(func, ast.Name) and func.id in {"get", "wait"}
+                and ctx.from_imports.get(func.id, "") == "ray_tpu"):
+            return func.id
+        if (isinstance(func, ast.Attribute) and func.attr == "result"
+                and not call.args):
+            return ".result()"
+        return None
+
+
+# -- RT004 ----------------------------------------------------------------
+class RefLeakRule(Rule):
+    """RT004: ObjectRef created and immediately discarded.
+
+    A bare ``f.remote(...)`` statement creates an ObjectRef nobody will
+    ever get() or store: the task's error (if any) is silently dropped,
+    and until the ref is GC'd its result pins object-store memory. Store
+    the ref, get() it, or — for intentional fire-and-forget — suppress
+    with a comment saying so.
+    """
+
+    id = "RT004"
+    name = "discarded-objectref"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            func = node.value.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr == "remote"):
+                continue
+            target = (func.value.attr
+                      if isinstance(func.value, ast.Attribute)
+                      else _dotted(func.value) or "<call>")
+            yield self.finding(
+                ctx, node,
+                f"ObjectRef from `{target}.remote(...)` is discarded — "
+                f"its error is silently dropped and its result pins "
+                f"store memory until GC; store/get the ref (or suppress "
+                f"if fire-and-forget is intended)",
+                token=target)
+
+
+# -- RT005 ----------------------------------------------------------------
+class CollectiveFenceRule(Rule):
+    """RT005: DCN collective group without a gang-epoch fence.
+
+    Collective rings rebuilt after a gang failure MUST be epoch-stamped:
+    without ``epoch=``, a zombie rank from the torn-down attempt can
+    find the new ring's rendezvous keys and splice into it, corrupting
+    every survivor's collective results (PR 2's fault model). Group
+    constructors default to epoch=0 — correct only for groups that are
+    never rebuilt, which a call site must assert by passing it
+    explicitly.
+    """
+
+    id = "RT005"
+    name = "unfenced-collective"
+
+    _CTORS = {"init_collective_group", "create_collective_group",
+              "DcnGroup", "HierarchicalGroup"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func).rsplit(".", 1)[-1]
+            if name not in self._CTORS:
+                continue
+            kwarg_names = {kw.arg for kw in node.keywords}
+            if "epoch" in kwarg_names or None in kwarg_names:  # **kwargs
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`{name}(...)` without an explicit gang-epoch fence "
+                f"(epoch=...): a stale rank from a torn-down gang can "
+                f"splice into the rebuilt ring — thread the gang epoch "
+                f"through (pass epoch=0 only for never-rebuilt groups)",
+                token=name)
+
+
+# -- RT006 ----------------------------------------------------------------
+class ThreadRaceRule(Rule):
+    """RT006: unlocked cross-thread attribute access.
+
+    For every class that starts a ``threading.Thread`` on one of its own
+    methods, partition methods into thread-side (the target and
+    everything it transitively calls on self) and caller-side. An
+    attribute *written* without a lock on one side and *accessed*
+    without a lock on the other is a data race candidate. ``__init__``
+    writes are exempt (they happen-before the thread start); attributes
+    whose names say lock/event/cond are synchronization primitives, not
+    shared data.
+    """
+
+    id = "RT006"
+    name = "cross-thread-race"
+
+    _SYNC_HINTS = ("lock", "event", "cond", "sem", "mutex")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        targets = self._thread_targets(cls) & set(methods)
+        if not targets:
+            return
+        calls = {name: self._self_calls(node) & set(methods)
+                 for name, node in methods.items()}
+        thread_side = set(targets)
+        frontier = list(targets)
+        while frontier:
+            for callee in calls.get(frontier.pop(), ()):
+                if callee not in thread_side:
+                    thread_side.add(callee)
+                    frontier.append(callee)
+        # attr -> side -> {"write": [(node, locked)], "read": [...]}
+        access: Dict[str, Dict[str, Dict[str, List]]] = {}
+        for name, node in methods.items():
+            if name == "__init__":
+                continue  # happens-before thread start
+            side = "thread" if name in thread_side else "caller"
+            for attr, kind, anode, locked in self._self_accesses(ctx, node):
+                if any(h in attr.lower() for h in self._SYNC_HINTS):
+                    continue
+                access.setdefault(attr, {})[side] = slot = \
+                    access.setdefault(attr, {}).get(side,
+                                                    {"write": [],
+                                                     "read": []})
+                slot[kind].append((anode, locked))
+        for attr in sorted(access):
+            sides = access[attr]
+            if "thread" not in sides or "caller" not in sides:
+                continue
+            for wside, oside in (("thread", "caller"), ("caller", "thread")):
+                writes = [n for n, locked in sides[wside]["write"]
+                          if not locked]
+                others = [n for kind in ("write", "read")
+                          for n, locked in sides[oside][kind] if not locked]
+                if writes and others:
+                    node = min(writes, key=lambda n: n.lineno)
+                    yield self.finding(
+                        ctx, node,
+                        f"`self.{attr}` is written on the "
+                        f"{'thread' if wside == 'thread' else 'caller'} "
+                        f"side and accessed on the other side of "
+                        f"`{cls.name}`'s background thread with no lock "
+                        f"in scope on either access — take the class "
+                        f"lock (or make it an Event/queue)",
+                        token=attr, scope=ctx.scope_of(node))
+                    break  # one finding per attribute
+
+    @staticmethod
+    def _thread_targets(cls: ast.ClassDef) -> Set[str]:
+        targets: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func).rsplit(".", 1)[-1]
+            if name != "Thread":
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "target"
+                        and isinstance(kw.value, ast.Attribute)
+                        and isinstance(kw.value.value, ast.Name)
+                        and kw.value.value.id == "self"):
+                    targets.add(kw.value.attr)
+        return targets
+
+    @staticmethod
+    def _self_calls(method: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(method):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                out.add(node.func.attr)
+        return out
+
+    @staticmethod
+    def _self_accesses(ctx: FileContext, method: ast.AST):
+        """Yields (attr, 'read'|'write', node, locked) for self.X uses.
+        A subscript/augmented store through self.X counts as a write of
+        X's contents."""
+        for node in ast.walk(method):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            kind = "read"
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                kind = "write"
+            else:
+                parent = ctx.parent(node)
+                if (isinstance(parent, ast.Subscript)
+                        and isinstance(parent.ctx, (ast.Store, ast.Del))):
+                    kind = "write"
+                elif isinstance(parent, ast.AugAssign) and \
+                        parent.target is node:
+                    kind = "write"
+            yield node.attr, kind, node, ctx.under_lock(node)
+
+
+# -- RT007 ----------------------------------------------------------------
+class SwallowRule(Rule):
+    """RT007: broad except that swallows control-plane errors.
+
+    In serve/train/collective modules, ``except Exception: pass`` (or a
+    constant-return/constant-assign body) silently eats
+    ``TrainingFailedError``, ``CollectiveTimeoutError``, actor-death
+    errors — exactly the signals fault tolerance is built on. Narrow
+    the type to what the block can actually handle, or log at warning
+    with the rank/replica identity before falling through.
+    """
+
+    id = "RT007"
+    name = "swallowed-exception"
+
+    _SCOPES = ("serve/", "train/", "util/collective/")
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not any(s in ctx.path for s in self._SCOPES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if not all(self._swallows(stmt) for stmt in node.body):
+                continue
+            yield self.finding(
+                ctx, node,
+                "broad except with a swallow-only body: "
+                "TrainingFailedError / CollectiveTimeoutError / actor "
+                "death would vanish here — narrow the exception type or "
+                "log at warning with the rank/replica identity",
+                token="swallow")
+
+    @classmethod
+    def _is_broad(cls, type_node) -> bool:
+        if type_node is None:  # bare except
+            return True
+        nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        return any(isinstance(n, ast.Name) and n.id in cls._BROAD
+                   for n in nodes)
+
+    @staticmethod
+    def _swallows(stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            return True
+        if isinstance(stmt, ast.Return):
+            return stmt.value is None or isinstance(
+                stmt.value, (ast.Constant, ast.Name))
+        if isinstance(stmt, ast.Assign):
+            return isinstance(stmt.value, (ast.Constant, ast.Name,
+                                           ast.List, ast.Dict, ast.Set,
+                                           ast.Tuple))
+        return False
+
+
+ALL_RULES: List[Rule] = [
+    HostSyncRule(),
+    RetraceRule(),
+    ActorBlockingRule(),
+    RefLeakRule(),
+    CollectiveFenceRule(),
+    ThreadRaceRule(),
+    SwallowRule(),
+]
+
+
+def rule_by_id(rule_id: str) -> Rule:
+    for r in ALL_RULES:
+        if r.id == rule_id.upper():
+            return r
+    raise KeyError(rule_id)
