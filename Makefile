@@ -4,12 +4,17 @@
 
 PY ?= python
 
-.PHONY: lint rtlint sanitizers test fast-test
+.PHONY: lint rtlint sanitizers test fast-test bench-data
 
 lint: rtlint sanitizers
 
 rtlint:
 	$(PY) -m tools.rtlint ray_tpu/
+
+# Regenerates BENCH_DATA.json (data->device feed probes); run
+# tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
+bench-data:
+	JAX_PLATFORMS=cpu $(PY) bench_data.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

@@ -242,6 +242,22 @@ def put(value: Any) -> ObjectRef:
     return _worker.get_client().put(value)
 
 
+def prefetch(refs: Union[ObjectRef, Sequence[ObjectRef]]) -> int:
+    """Start pulling remote objects to this node without blocking.
+
+    A later get() on the same refs joins the in-flight pull instead of
+    starting its own probe, so transfer overlaps whatever the caller does
+    in between. Purely advisory: failures are deferred to get(), which
+    re-resolves with full reconstruction semantics. Returns the number of
+    pulls started (already-local refs are skipped)."""
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    return _worker.get_client().prefetch(
+        [r.ref if not isinstance(r, ObjectRef) and hasattr(r, "ref") else r
+         for r in refs]
+    )
+
+
 def wait(
     refs: List[ObjectRef],
     *,
@@ -296,6 +312,7 @@ __all__ = [
     "remote",
     "get",
     "put",
+    "prefetch",
     "wait",
     "kill",
     "cancel",

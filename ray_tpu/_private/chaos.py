@@ -32,6 +32,10 @@ _injected_drain_ranks: Set[int] = set()
 # Deterministic delay applied to the next executor polls (seconds, count).
 _poll_delay_s: float = 0.0
 _poll_delays_left: int = 0
+# Deterministic delay applied to the next raylet object pulls
+# (wait_object_local), modelling slow cross-node transfer.
+_pull_delay_s: float = 0.0
+_pull_delays_left: int = 0
 
 
 def enabled() -> bool:
@@ -52,10 +56,13 @@ def disable():
 def clear():
     """Drop all pending driver-side injections."""
     global _poll_delay_s, _poll_delays_left
+    global _pull_delay_s, _pull_delays_left
     with _lock:
         _injected_drain_ranks.clear()
         _poll_delay_s = 0.0
         _poll_delays_left = 0
+        _pull_delay_s = 0.0
+        _pull_delays_left = 0
 
 
 def _require_enabled(what: str):
@@ -155,3 +162,29 @@ def take_poll_delay() -> Optional[float]:
             return None
         _poll_delays_left -= 1
         return _poll_delay_s
+
+
+def delay_object_pulls(seconds: float, count: int = 1):
+    """Deterministically slow down the next `count` object pulls
+    (raylet wait_object_local) — models slow cross-node transfer, so
+    feed-pipeline tests and benches see a realistic fetch-latency-bound
+    regime without real multi-node network. Driver-process raylets only
+    (cluster_utils nodes share this process's state)."""
+    _require_enabled("delay_object_pulls")
+    global _pull_delay_s, _pull_delays_left
+    with _lock:
+        _pull_delay_s = float(seconds)
+        _pull_delays_left = int(count)
+
+
+def take_pull_delay() -> Optional[float]:
+    """Pop one pending object-pull delay (None when chaos is off or
+    exhausted)."""
+    if not enabled():
+        return None
+    global _pull_delays_left
+    with _lock:
+        if _pull_delays_left <= 0:
+            return None
+        _pull_delays_left -= 1
+        return _pull_delay_s

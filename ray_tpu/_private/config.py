@@ -105,6 +105,10 @@ class Config:
     # Probe period for blocking gets on remote objects (reference:
     # fetch_warn_timeout_milliseconds family).
     get_probe_interval_s: float = 5.0
+    # Cap on one background prefetch() pull: an advisory pull for a
+    # never-produced object must not park a loop task forever (blocking
+    # semantics belong to get(), which re-issues its own pull).
+    prefetch_pull_timeout_s: float = 120.0
     # Timeout resolving a store-argument dependency inside a worker.
     arg_fetch_timeout_s: float = 60.0
     # Timeout for the owner's batched free_objects RPC.
@@ -258,6 +262,13 @@ class Config:
     data_split_queue_depth: int = 4
     # Streaming-executor concurrency budget = cluster CPUs x this factor.
     data_cpu_budget_factor: float = 2.0
+    # Blocks a DataIterator asks its _SplitCoordinator for per round trip
+    # (and prefetches ahead of consumption). Override per-trainer through
+    # train.DataConfig(prefetch_blocks=...).
+    data_iterator_prefetch_blocks: int = 2
+    # Default depth of the background device-feed pipeline for
+    # Dataset.iter_jax_batches (batches staged ahead of the step loop).
+    data_feed_prefetch_batches: int = 2
 
     # -- collective -----------------------------------------------------
     collective_rendezvous_timeout_s: float = 60.0

@@ -78,6 +78,9 @@ class LocalClient:
     def wait(self, refs, num_returns, timeout, fetch_local=True):
         return refs[:num_returns], refs[num_returns:]
 
+    def prefetch(self, refs) -> int:
+        return 0  # everything is already local in local mode
+
     def _error_refs(self, err, num_returns):
         if num_returns == "dynamic":
             return [_LocalRefGenerator([], error=err)]

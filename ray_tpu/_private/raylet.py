@@ -2343,6 +2343,11 @@ class Raylet:
 
     async def h_wait_object_local(self, d, conn):
         """Driver asks: make this object available in the local store."""
+        from ray_tpu._private import chaos
+
+        delay = chaos.take_pull_delay()
+        if delay is not None:  # chaos-only: modelled slow transfer
+            await asyncio.sleep(delay)
         await self._ensure_local(d["object_id"], d.get("timeout", 60.0))
         return {"ok": True}
 

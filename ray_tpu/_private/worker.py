@@ -378,6 +378,10 @@ class CoreClient:
         # GCS-restart survival (client half): see _gcs_call.
         self._subscribed_channels: set = set()
         self._gcs_redial_lock = None
+        # In-flight background pulls started by prefetch(): oid -> loop
+        # task running _pull_object. get() joins an in-flight pull instead
+        # of racing a second probe for the same object. Loop-side only.
+        self._prefetch_pulls: Dict[bytes, asyncio.Task] = {}
 
     # -- bootstrap -------------------------------------------------------
     def connect(self):
@@ -489,6 +493,9 @@ class CoreClient:
         self._pins.clear()
 
         async def _close():
+            for t in list(self._prefetch_pulls.values()):
+                t.cancel()
+            self._prefetch_pulls.clear()
             if self._lease_reaper is not None:
                 self._lease_reaper.cancel()
                 self._lease_reaper = None
@@ -888,10 +895,81 @@ class CoreClient:
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float]):
         deadline = None if timeout is None else time.monotonic() + timeout
-        out = []
-        for ref in refs:
-            out.append(self._get_one(ref, deadline))
+        out: List[Any] = [None] * len(refs)
+        remote: List[Tuple[int, ObjectRef]] = []
+        for i, ref in enumerate(refs):
+            hit, value = self._resolve_local(ref, deadline)
+            if hit:
+                out[i] = value
+            else:
+                remote.append((i, ref))
+        if remote:
+            # One round of concurrent pulls: every remote ref probes in
+            # parallel on the event loop under the shared deadline, instead
+            # of N sequential blocking pulls. Per-ref lost-object detection
+            # and lineage reconstruction live in _pull_object unchanged.
+            results = self._run(
+                self._pull_many([ref.id.binary() for _, ref in remote],
+                                deadline)
+            )
+            for (i, ref), res in zip(remote, results):
+                if isinstance(res, BaseException):
+                    raise res  # first failing ref in list order
+                out[i] = self._read_store(ObjectID(ref.id.binary()))
         return out
+
+    def prefetch(self, refs: List[ObjectRef]) -> int:
+        """Start background pulls for refs not yet local; never blocks.
+
+        Each pull is a fire-and-forget event-loop task, deduplicated per
+        object; a later get() joins the in-flight pull instead of racing a
+        second probe. Failures are advisory — get() re-resolves the ref and
+        surfaces errors with full reconstruction semantics. Returns the
+        number of pulls started.
+        """
+        if not self._connected or self.store is None:
+            return 0
+        started: List[bytes] = []
+        for ref in refs:
+            oid = ref.id.binary()
+            f = ref._future
+            if f is not None:
+                if not f.done():
+                    continue  # still executing locally; nothing to pull yet
+                try:
+                    if f.result() is not _IN_STORE:
+                        continue  # inline value — no store copy to pull
+                except BaseException:
+                    continue  # errored/cancelled; get() will surface it
+            if oid in self.memory_store:
+                continue
+            if self.store.contains_raw(oid):
+                continue
+            started.append(oid)
+        if started:
+            self.loop.call_soon_threadsafe(self._start_prefetch_pulls, started)
+        return len(started)
+
+    def _start_prefetch_pulls(self, oids: List[bytes]) -> None:
+        if not self._connected:
+            return
+        for oid in oids:
+            existing = self._prefetch_pulls.get(oid)
+            if existing is not None and not existing.done():
+                continue
+            self._prefetch_pulls[oid] = spawn(self._prefetch_pull(oid))
+
+    async def _prefetch_pull(self, oid: bytes) -> None:
+        # Bounded deadline: an advisory pull for a never-produced object
+        # must not park a loop task forever (blocking-get semantics belong
+        # to get(), which re-issues its own pull).
+        deadline = time.monotonic() + get_config().prefetch_pull_timeout_s
+        try:
+            await self._pull_object(oid, deadline)
+        except Exception:  # noqa: BLE001 — advisory; get() re-surfaces
+            pass
+        finally:
+            self._prefetch_pulls.pop(oid, None)
 
     def _memory_store_put(self, oid: bytes, value):
         ms = self.memory_store
@@ -900,7 +978,9 @@ class CoreClient:
         while len(ms) > self.memory_store_max_entries:
             ms.popitem(last=False)
 
-    def _get_one(self, ref: ObjectRef, deadline):
+    def _resolve_local(self, ref: ObjectRef, deadline) -> Tuple[bool, Any]:
+        """Resolve a ref from its completion future / memory store / local
+        shm store without touching the network. Returns (hit, value)."""
         oid = ref.id.binary()
         if ref._future is not None:
             remaining = None if deadline is None else max(0, deadline - time.monotonic())
@@ -911,16 +991,48 @@ class CoreClient:
             if completed is not _IN_STORE and oid not in self.memory_store:
                 # Inline result evicted from the LRU cache; the completion
                 # future still holds it.
-                return completed
+                return True, completed
         if oid in self.memory_store:
-            return self.memory_store[oid]
+            return True, self.memory_store[oid]
         if self.store is not None and self.store.contains_raw(oid):
-            return self._read_store(ObjectID(oid))
-        # Remote: ask our raylet to pull it locally. Probes are short so a
-        # vanished object is detected well before the caller's deadline;
-        # with lineage the creating task re-executes
-        # (ObjectRecoveryManager::RecoverObject), otherwise the object is
-        # declared lost after a grace probe.
+            return True, self._read_store(ObjectID(oid))
+        return False, None
+
+    async def _pull_many(self, oids: List[bytes], deadline):
+        return await asyncio.gather(
+            *(self._pull_or_join(oid, deadline) for oid in oids),
+            return_exceptions=True,
+        )
+
+    async def _pull_or_join(self, oid: bytes, deadline) -> None:
+        task = self._prefetch_pulls.get(oid)
+        if task is not None and not task.done():
+            remaining = (
+                None if deadline is None
+                else max(0.05, deadline - time.monotonic())
+            )
+            try:
+                await asyncio.wait_for(asyncio.shield(task), remaining)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for "
+                    f"ObjectRef({ObjectID(oid).hex()})"
+                )
+            except Exception:  # noqa: BLE001 — advisory; re-pull below
+                pass
+        if self.store is not None and self.store.contains_raw(oid):
+            return
+        await self._pull_object(oid, deadline)
+
+    async def _pull_object(self, oid: bytes, deadline) -> None:
+        """Pull one remote object into the local store (event-loop side).
+
+        Ask our raylet to pull it locally. Probes are short so a vanished
+        object is detected well before the caller's deadline; with lineage
+        the creating task re-executes
+        (ObjectRecoveryManager::RecoverObject), otherwise the object is
+        declared lost after a grace probe.
+        """
         recon_left = get_config().task_max_retries
         last_err: Optional[Exception] = None
         while True:
@@ -929,18 +1041,19 @@ class CoreClient:
             )
             probe = min(get_config().get_probe_interval_s, remaining * 0.4)
             try:
-                self._run(
-                    self.raylet.call(
-                        "wait_object_local",
-                        {"object_id": oid, "timeout": probe},
-                        timeout=probe + 5,
-                    )
+                await self.raylet.call(
+                    "wait_object_local",
+                    {"object_id": oid, "timeout": probe},
+                    timeout=probe + 5,
                 )
-                return self._read_store(ObjectID(oid))
+                return
             except Exception as e:  # noqa: BLE001
                 last_err = e
                 if deadline is not None and time.monotonic() >= deadline:
-                    raise GetTimeoutError(f"get() timed out waiting for {ref}")
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for "
+                        f"ObjectRef({ObjectID(oid).hex()})"
+                    )
                 # A probe timeout can just mean a slow transfer. Consult the
                 # object directory first: re-executing the (side-effectful)
                 # creating task while a copy still exists would duplicate it.
@@ -948,10 +1061,8 @@ class CoreClient:
                 # spill) is gone — lost. Unknown means possibly not yet
                 # produced: keep waiting (blocking get semantics).
                 try:
-                    loc = self._run(
-                        self._gcs_call(
-                            "object_location_get", {"object_id": oid}
-                        ),
+                    loc = await self._gcs_call(
+                        "object_location_get", {"object_id": oid},
                         timeout=10,
                     )
                 except Exception:
@@ -970,15 +1081,15 @@ class CoreClient:
                 if recon_left <= 0:
                     break
                 recon_left -= 1
-                result = self._run(
+                result = await asyncio.wait_for(
                     self.raylet.call("submit_task", dict(spec), timeout=None),
-                    timeout=None if deadline is None else remaining,
+                    None if deadline is None else remaining,
                 )
                 if result.get("status") != "ok":
                     break
                 continue
         raise ObjectLostError(
-            f"object {ref.hex()} could not be retrieved: {last_err}"
+            f"object {ObjectID(oid).hex()} could not be retrieved: {last_err}"
         ) from None
 
     def _client_put_remote(self, oid: ObjectID, so) -> bool:

@@ -14,6 +14,7 @@ from ray_tpu.data.datasource import (
     ReadTask,
 )
 from ray_tpu.data.executor import ActorPoolStrategy
+from ray_tpu.data.feed import FeedStats
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.dataset import (
     Dataset,
@@ -43,6 +44,7 @@ __all__ = [
     "Datasink",
     "Datasource",
     "Dataset",
+    "FeedStats",
     "FileBasedDatasink",
     "FileBasedDatasource",
     "ReadTask",

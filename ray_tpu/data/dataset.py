@@ -36,6 +36,7 @@ class Dataset:
         self._stages = list(stages or [])
         self._materialized: Optional[List] = None
         self._stats: List[Dict] = []
+        self._last_feed_stats = None  # FeedStats of the newest feed pipeline
 
     # -- plan building ---------------------------------------------------
     def _with_stage(self, stage) -> "Dataset":
@@ -341,7 +342,17 @@ class Dataset:
             f"Stage {i}: {s['stage']}: {s['blocks']} blocks, {s['wall_s']}s"
             for i, s in enumerate(self._stats)
         ]
+        if self._last_feed_stats is not None:
+            lines.append(self._last_feed_stats.render())
         return "\n".join(lines) if lines else "(no executed stages)"
+
+    def _feed_stats(self):
+        """Fresh FeedStats for a new feed pipeline, kept so stats() can
+        report wait/assemble/h2d/stall numbers for the newest iterator."""
+        from ray_tpu.data.feed import FeedStats
+
+        self._last_feed_stats = FeedStats()
+        return self._last_feed_stats
 
     def _executed_refs(self) -> List:
         if self._materialized is None:
@@ -350,16 +361,16 @@ class Dataset:
             self._stats = m._stats
         return self._materialized
 
-    def _iter_blocks(self, prefetch_blocks: int = 0) -> Iterator:
+    def _iter_blocks(self, prefetch_blocks: int = 1) -> Iterator:
         """Yield blocks; with prefetch_blocks > 0 the next k blocks' pulls
-        are initiated (non-blocking rt.wait) while the current block is
-        consumed — transfer overlaps compute (reference: prefetching block
+        START (rt.prefetch, a real background pull — a zero-timeout
+        rt.wait was only a poll) while the current block is consumed, so
+        cross-node transfer overlaps compute (reference: prefetching block
         iterator, data/iterator.py)."""
         refs = self._executed_refs()
         for i, ref in enumerate(refs):
             if prefetch_blocks > 0 and i + 1 < len(refs):
-                ahead = refs[i + 1 : i + 1 + prefetch_blocks]
-                rt.wait(ahead, num_returns=len(ahead), timeout=0)
+                rt.prefetch(refs[i + 1 : i + 1 + prefetch_blocks])
             yield rt.get(ref)
 
     # -- consumption -----------------------------------------------------
@@ -394,14 +405,41 @@ class Dataset:
                      batch_format: str = "numpy",
                      prefetch_blocks: int = 1,
                      local_shuffle_buffer_size: Optional[int] = None,
-                     local_shuffle_seed: Optional[int] = None) -> Iterator:
+                     local_shuffle_seed: Optional[int] = None,
+                     prefetch_batches: int = 0) -> Iterator:
         """Re-batch across block boundaries (reference: data/iterator.py).
 
         local_shuffle_buffer_size enables the reference's windowed local
         shuffle: rows accumulate in a buffer of at least that size and
-        each batch draws a random permutation from it — cheap
-        randomization without a full distributed shuffle.
+        batches draw from its seeded permutation — cheap randomization
+        without a full distributed shuffle.
+
+        prefetch_batches > 0 moves block pull + batch assembly onto a
+        background producer thread that stays that many ready batches
+        ahead (see data/feed.py), so the consumer's step time and the
+        feed overlap instead of serializing.
         """
+        if prefetch_batches and prefetch_batches > 0:
+            from ray_tpu.data.feed import _DevicePrefetcher
+
+            return _DevicePrefetcher(
+                lambda: self._iter_batches_local(
+                    batch_size, batch_format, prefetch_blocks,
+                    local_shuffle_buffer_size, local_shuffle_seed,
+                ),
+                depth=prefetch_batches,
+                stats=self._feed_stats(),
+            )
+        return self._iter_batches_local(
+            batch_size, batch_format, prefetch_blocks,
+            local_shuffle_buffer_size, local_shuffle_seed,
+        )
+
+    def _iter_batches_local(self, batch_size: int, batch_format: str,
+                            prefetch_blocks: int,
+                            local_shuffle_buffer_size: Optional[int],
+                            local_shuffle_seed: Optional[int]) -> Iterator:
+        """Inline (consumer-thread) batch assembly."""
         if batch_format == "numpy" and not local_shuffle_buffer_size:
             yield from self._iter_numpy_batches(batch_size, prefetch_blocks)
             return
@@ -413,14 +451,18 @@ class Dataset:
         carry: List[Any] = []
         for block in self._iter_blocks(prefetch_blocks=prefetch_blocks):
             carry.extend(B.block_to_rows(block))
+            if rng is not None and len(carry) >= threshold:
+                # One permutation per buffer refill (O(buffer)), then
+                # batches peel off it — not a re-shuffle per batch, which
+                # made the draw loop O(buffer) PER BATCH. Seeded runs stay
+                # deterministic: same seed, same refill sequence.
+                rng.shuffle(carry)
             while len(carry) >= threshold:
-                if rng is not None:
-                    rng.shuffle(carry)
                 chunk, carry = carry[:batch_size], carry[batch_size:]
                 yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
+        if rng is not None and carry:
+            rng.shuffle(carry)
         while carry:
-            if rng is not None:
-                rng.shuffle(carry)
             chunk, carry = carry[:batch_size], carry[batch_size:]
             yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
 
@@ -483,13 +525,20 @@ class Dataset:
 
     def iter_jax_batches(self, batch_size: int = 256, sharding=None,
                          prefetch_blocks: int = 1,
+                         prefetch_batches: Optional[int] = None,
                          **kwargs) -> Iterator:
-        """numpy batches placed onto JAX devices, one batch of device
-        transfer ahead of the consumer (the TPU input-pipeline shape:
-        host->HBM copy of batch i+1 overlaps the step on batch i).
-        Reference analog: iter_torch_batches (data/iterator.py) rebuilt
-        for JAX: pass sharding=NamedSharding(...) to lay each batch out
-        across a mesh."""
+        """numpy batches placed onto JAX devices, staged ahead of the
+        consumer (the TPU input-pipeline shape: host->HBM copy of batch
+        i+1 overlaps the step on batch i). Reference analog:
+        iter_torch_batches (data/iterator.py) rebuilt for JAX: pass
+        sharding=NamedSharding(...) to lay each batch out across a mesh.
+
+        By default (prefetch_batches=config.data_feed_prefetch_batches)
+        the whole feed — block pull, batch assembly AND the device_put
+        dispatch — runs on a background producer thread that stays that
+        many device-resident batches ahead. prefetch_batches=0 falls back
+        to inline assembly with one device transfer in flight.
+        """
         import jax
 
         def put(batch):
@@ -499,6 +548,28 @@ class Dataset:
                 lambda x: jax.device_put(x, sharding), batch
             )
 
+        if prefetch_batches is None:
+            from ray_tpu._private.config import get_config
+
+            prefetch_batches = get_config().data_feed_prefetch_batches
+        if prefetch_batches and prefetch_batches > 0:
+            from ray_tpu.data.feed import _DevicePrefetcher
+
+            return _DevicePrefetcher(
+                lambda: self._iter_batches_local(
+                    batch_size, "numpy", prefetch_blocks,
+                    kwargs.get("local_shuffle_buffer_size"),
+                    kwargs.get("local_shuffle_seed"),
+                ),
+                depth=prefetch_batches,
+                transform=put,
+                stats=self._feed_stats(),
+            )
+        return self._iter_jax_inline(batch_size, put, prefetch_blocks,
+                                     **kwargs)
+
+    def _iter_jax_inline(self, batch_size: int, put, prefetch_blocks: int,
+                         **kwargs) -> Iterator:
         pending = None
         for batch in self.iter_batches(
             batch_size=batch_size, batch_format="numpy",
@@ -588,14 +659,17 @@ class Dataset:
         return ds.to_arrow().to_pandas()
 
     def streaming_split(self, n: int, equal: bool = True,
-                        locality_hints: Optional[List] = None) -> List:
+                        locality_hints: Optional[List] = None,
+                        prefetch_blocks: Optional[int] = None) -> List:
         """n coordinated per-worker iterators over ONE shared streaming
         execution per epoch (reference: dataset.py:1161 streaming_split +
         StreamSplitDataIterator). Each DataIterator's iter_rows /
         iter_batches call consumes one epoch; the pipeline re-executes
         per epoch. equal=True balances splits by rows at block
         granularity. Input blocks are promoted to the shared store up
-        front; pipeline stages stream."""
+        front; pipeline stages stream. prefetch_blocks sets how many
+        blocks each iterator requests (and pulls) ahead of consumption
+        (default: config.data_iterator_prefetch_blocks)."""
         import cloudpickle
 
         from ray_tpu.data.iterator import DataIterator, _SplitCoordinator
@@ -605,7 +679,8 @@ class Dataset:
         ).remote(
             self._input_refs, cloudpickle.dumps(self._stages), n, equal
         )
-        return [DataIterator(coord, i, n) for i in range(n)]
+        return [DataIterator(coord, i, n, prefetch_blocks=prefetch_blocks)
+                for i in range(n)]
 
     # -- output ----------------------------------------------------------
     def write_datasink(self, sink) -> List[Any]:

@@ -1,0 +1,234 @@
+"""Background device-feed pipeline: data blocks -> host batches -> HBM.
+
+The paper's north star is a step loop that never waits on the host. This
+module supplies the host half of that contract for input pipelines: a
+bounded producer thread pulls blocks (rt.prefetch + rt.get overlap the
+cross-node transfer), assembles zero-copy numpy batches, and optionally
+stages `jax.device_put` so batch i+1's H2D transfer is in flight while
+step i computes. The consumer iterates batches off a depth-k queue; when
+the queue is empty on arrival that's a feed stall — counted and timed so
+a starved step loop is diagnosable from Dataset.stats() and the
+`data_feed_*` metrics rather than by profiler archaeology.
+
+Reference analog: ray.data's prefetching block iterator
+(python/ray/data/_internal/block_batching/iter_batches.py) collapsed to
+one thread + one bounded queue.
+
+Thread discipline (rtlint RT006): the producer is a module-level
+function that communicates with the consumer ONLY through the queue
+(("batch", v) / ("error", exc) / ("done", None) tuples), a stop Event,
+and the lock-guarded FeedStats. No instance attribute is written on one
+side and read on the other.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
+
+from ray_tpu.util import metrics as _metrics
+
+# Wall seconds the consumer spent blocked on an empty feed queue (the
+# step loop outran the producer). One observation per stall.
+_STALL_SECONDS = _metrics.get_or_create(
+    _metrics.Histogram,
+    "data_feed_stall_seconds",
+    "Consumer wait per feed stall (queue empty when the step loop "
+    "asked for a batch)",
+    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0],
+)
+_BATCHES_TOTAL = _metrics.get_or_create(
+    _metrics.Counter,
+    "data_feed_batches_total",
+    "Batches delivered through the background device-feed pipeline",
+)
+
+
+class FeedStats:
+    """Per-iterator feed timings, written from both sides of the pipe.
+
+    wait_s/stall_count are consumer-side (time blocked on the queue);
+    assemble_s (block pull + batch slicing) and h2d_s (device_put
+    dispatch) are producer-side. All mutation is lock-guarded; read a
+    consistent view with snapshot().
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wait_s = 0.0
+        self._assemble_s = 0.0
+        self._h2d_s = 0.0
+        self._stall_count = 0
+        self._batches = 0
+
+    def add_wait(self, seconds: float):
+        with self._lock:
+            self._wait_s += seconds
+            self._stall_count += 1
+        _STALL_SECONDS.observe(seconds)
+
+    def add_assemble(self, seconds: float):
+        with self._lock:
+            self._assemble_s += seconds
+
+    def add_h2d(self, seconds: float):
+        with self._lock:
+            self._h2d_s += seconds
+
+    def add_batch(self):
+        with self._lock:
+            self._batches += 1
+        _BATCHES_TOTAL.inc()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "wait_s": self._wait_s,
+                "assemble_s": self._assemble_s,
+                "h2d_s": self._h2d_s,
+                "stall_count": self._stall_count,
+                "batches": self._batches,
+            }
+
+    def render(self) -> str:
+        s = self.snapshot()
+        return (
+            f"feed: {s['batches']} batches, "
+            f"assemble {s['assemble_s'] * 1e3:.1f}ms, "
+            f"h2d {s['h2d_s'] * 1e3:.1f}ms, "
+            f"stalls {s['stall_count']} ({s['wait_s'] * 1e3:.1f}ms waiting)"
+        )
+
+
+def _q_put(q: "queue.Queue", item: Tuple[str, Any],
+           stop_event: threading.Event) -> bool:
+    """put() that never wedges on a full queue after the consumer left:
+    poll the stop event between bounded attempts."""
+    while not stop_event.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _produce(source_factory: Callable[[], Iterable],
+             transform: Optional[Callable[[Any], Any]],
+             q: "queue.Queue", stop_event: threading.Event,
+             stats: FeedStats) -> None:
+    """Producer-thread body. Terminates by enqueueing ("done", None) /
+    ("error", exc), or silently when the stop event fires."""
+    try:
+        it = iter(source_factory())
+        while not stop_event.is_set():
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            stats.add_assemble(time.perf_counter() - t0)
+            if transform is not None:
+                t1 = time.perf_counter()
+                item = transform(item)
+                stats.add_h2d(time.perf_counter() - t1)
+            if not _q_put(q, ("batch", item), stop_event):
+                return
+        _q_put(q, ("done", None), stop_event)
+    except BaseException as e:  # noqa: BLE001 — shipped to the consumer
+        _q_put(q, ("error", e), stop_event)
+
+
+def _shutdown(q: "queue.Queue", stop_event: threading.Event,
+              thread: threading.Thread) -> None:
+    """Idempotent teardown (stop() and GC finalizer): wake the producer
+    out of any blocking put by draining, then join."""
+    stop_event.set()
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
+    if thread.is_alive():
+        thread.join(timeout=5.0)
+
+
+class _DevicePrefetcher:
+    """Iterator that runs its source on a background thread, `depth`
+    batches ahead of the consumer (plus the one being assembled).
+
+    `transform` runs producer-side — pass the device_put staging there so
+    the H2D transfer for batch i+1 is dispatched while the consumer is
+    still inside step i. Exceptions from the source or transform
+    re-raise at the consumer's next(); stop() (also wired to GC) joins
+    the thread.
+    """
+
+    def __init__(self, source_factory: Callable[[], Iterable],
+                 depth: int,
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 stats: Optional[FeedStats] = None,
+                 name: str = "feed"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._stats = stats if stats is not None else FeedStats()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop_event = threading.Event()
+        self._thread = threading.Thread(
+            target=_produce,
+            args=(source_factory, transform, self._queue, self._stop_event,
+                  self._stats),
+            name=f"rt-data-{name}",
+            daemon=True,
+        )
+        # The finalizer must not capture self, or it would keep the
+        # prefetcher alive and GC could never trigger it.
+        self._finalizer = weakref.finalize(
+            self, _shutdown, self._queue, self._stop_event, self._thread
+        )
+        self._finished = False
+        self._thread.start()
+
+    @property
+    def stats(self) -> FeedStats:
+        return self._stats
+
+    def stop(self) -> None:
+        """Stop the producer and join its thread (idempotent)."""
+        self._finished = True
+        self._finalizer()
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        try:
+            kind, payload = self._queue.get_nowait()
+        except queue.Empty:
+            # Feed stall: the consumer outran the producer.
+            t0 = time.perf_counter()
+            kind, payload = self._blocking_get()
+            self._stats.add_wait(time.perf_counter() - t0)
+        if kind == "batch":
+            self._stats.add_batch()
+            return payload
+        self.stop()
+        if kind == "error":
+            raise payload
+        raise StopIteration  # "done"
+
+    def _blocking_get(self) -> Tuple[str, Any]:
+        while True:
+            try:
+                return self._queue.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop_event.is_set() or not self._thread.is_alive():
+                    # Producer died without a terminal item (or an external
+                    # stop raced us): end the stream instead of wedging.
+                    self._finished = True
+                    raise StopIteration from None

@@ -228,11 +228,21 @@ class DataIterator:
     pipeline re-executes per epoch, coordinated across the n iterators
     (reference: data/iterator.py DataIterator semantics)."""
 
-    def __init__(self, coordinator, split_idx: int, n: int):
+    def __init__(self, coordinator, split_idx: int, n: int,
+                 prefetch_blocks: Optional[int] = None):
+        from ray_tpu._private.config import get_config
+
         self._coord = coordinator
         self._idx = split_idx
         self._n = n
         self._epoch = 0
+        # Blocks requested per coordinator round trip AND pulled ahead of
+        # consumption; threaded from train.DataConfig(prefetch_blocks=...)
+        # (config default: data_iterator_prefetch_blocks).
+        self._prefetch_blocks = (
+            get_config().data_iterator_prefetch_blocks
+            if prefetch_blocks is None else int(prefetch_blocks)
+        )
 
     def iter_blocks(self) -> Iterator[Any]:
         import time as _time
@@ -250,9 +260,15 @@ class DataIterator:
                     "consuming the previous epoch"
                 )
             _time.sleep(0.05)
+        max_blocks = max(1, self._prefetch_blocks)
         while True:
-            out = rt.get(self._coord.next_blocks.remote(epoch, self._idx),
-                         timeout=600)
+            out = rt.get(
+                self._coord.next_blocks.remote(epoch, self._idx, max_blocks),
+                timeout=600,
+            )
+            # Start every granted block's pull at once; the per-ref gets
+            # below then overlap transfer with downstream batch work.
+            rt.prefetch(out["blocks"])
             for ref in out["blocks"]:
                 yield rt.get(ref)
             if out["done"]:
@@ -272,7 +288,32 @@ class DataIterator:
             yield from B.block_to_rows(block)
 
     def iter_batches(self, batch_size: int = 256,
-                     batch_format: str = "numpy") -> Iterator[Any]:
+                     batch_format: str = "numpy",
+                     prefetch_batches: Optional[int] = None) -> Iterator[Any]:
+        """Re-batch this split's epoch stream. By default (prefetch_batches
+        = config.data_feed_prefetch_batches) the pull + assembly runs on a
+        background producer thread that stays that many ready batches
+        ahead of the training step (data/feed.py), so trainer workers get
+        the pipelined feed through session.get_dataset_shard with no code
+        change; 0 assembles inline. Feed timings land in feed_stats()."""
+        if prefetch_batches is None:
+            from ray_tpu._private.config import get_config
+
+            prefetch_batches = get_config().data_feed_prefetch_batches
+        if prefetch_batches and prefetch_batches > 0:
+            from ray_tpu.data.feed import FeedStats, _DevicePrefetcher
+
+            self._last_feed_stats = FeedStats()
+            return _DevicePrefetcher(
+                lambda: self._iter_batches_local(batch_size, batch_format),
+                depth=prefetch_batches,
+                stats=self._last_feed_stats,
+                name=f"split{self._idx}",
+            )
+        return self._iter_batches_local(batch_size, batch_format)
+
+    def _iter_batches_local(self, batch_size: int,
+                            batch_format: str) -> Iterator[Any]:
         rows: List[Any] = []
         for block in self.iter_blocks():
             rows.extend(B.block_to_rows(block))
@@ -281,6 +322,12 @@ class DataIterator:
                 yield B.block_to_batch(B.block_from_rows(chunk), batch_format)
         if rows:
             yield B.block_to_batch(B.block_from_rows(rows), batch_format)
+
+    def feed_stats(self):
+        """Snapshot of the newest prefetching iter_batches pipeline's
+        timings (None before one runs)."""
+        stats = getattr(self, "_last_feed_stats", None)
+        return None if stats is None else stats.snapshot()
 
     def stats(self):
         return rt.get(self._coord.stats.remote())

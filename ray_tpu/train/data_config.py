@@ -9,18 +9,23 @@ broadcast whole to every worker.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Union
+from typing import Any, Dict, List, Optional, Union
 
 
 class DataConfig:
     def __init__(self,
-                 datasets_to_split: Union[str, List[str]] = "all"):
+                 datasets_to_split: Union[str, List[str]] = "all",
+                 prefetch_blocks: Optional[int] = None):
+        """prefetch_blocks: blocks each worker's DataIterator requests
+        from the split coordinator (and pulls to its node) ahead of
+        consumption; None uses config.data_iterator_prefetch_blocks."""
         if datasets_to_split != "all" and not isinstance(
                 datasets_to_split, (list, tuple, set)):
             raise TypeError(
                 "datasets_to_split must be 'all' or a list of dataset names"
             )
         self._datasets_to_split = datasets_to_split
+        self._prefetch_blocks = prefetch_blocks
 
     def _should_split(self, name: str) -> bool:
         if self._datasets_to_split == "all":
@@ -37,7 +42,10 @@ class DataConfig:
             if (self._should_split(name)
                     and hasattr(ds, "streaming_split")
                     and num_workers >= 1):
-                splits = ds.streaming_split(num_workers, equal=True)
+                splits = ds.streaming_split(
+                    num_workers, equal=True,
+                    prefetch_blocks=self._prefetch_blocks,
+                )
                 for i in range(num_workers):
                     per_worker[i][name] = splits[i]
             else:

@@ -80,6 +80,15 @@ def _bench_infer_r5_implied_step_ms():
     return get
 
 
+def _bench_data(metric_sub: str, field: str):
+    def get():
+        for e in _load("BENCH_DATA.json"):
+            if metric_sub in e.get("metric", ""):
+                return e[field]
+        raise KeyError(f"no BENCH_DATA entry matching {metric_sub!r}")
+    return get
+
+
 def _bench_ft(metric_sub: str, field: str):
     def get():
         for e in _load("BENCH_FT.json"):
@@ -227,6 +236,26 @@ CLAIMS = [
           _bench_infer("llama2(0.8B) decode", "ms_per_decode_step",
                        batch=8),
           rel_tol=0.02),
+    # Input-pipeline feed numbers <- BENCH_DATA.json (bench_data.py).
+    # Tight tolerance: docs and artifact are committed together.
+    Claim("MIGRATION.md", r"serial feed (\d+\.\d+) batches/s",
+          _bench_data("feed throughput", "serial_batches_per_s"),
+          rel_tol=0.02),
+    Claim("MIGRATION.md", r"pipelined (\d+\.\d+) batches/s",
+          _bench_data("feed throughput", "pipelined_batches_per_s"),
+          rel_tol=0.02),
+    Claim("MIGRATION.md", r"feed speedup (\d+\.\d+)x",
+          _bench_data("feed throughput", "speedup"), rel_tol=0.02),
+    Claim("MIGRATION.md", r"overlap ratio (0\.\d+)",
+          _bench_data("feed throughput", "overlap_ratio"), rel_tol=0.02),
+    Claim("MIGRATION.md", r"resolves in\s*\n?\s*(\d+\.\d+) probe rounds",
+          _bench_data("multi-ref get", "parallel_probe_rounds"),
+          rel_tol=0.1),
+    Claim("MIGRATION.md", r"vs (\d+\.\d+) serially",
+          _bench_data("multi-ref get", "serial_probe_rounds"),
+          rel_tol=0.1),
+    Claim("MIGRATION.md", r"multi-ref speedup (\d+\.\d+)x",
+          _bench_data("multi-ref get", "speedup"), rel_tol=0.02),
     # Fault-tolerance latencies <- BENCH_FT.json (bench_ft.py). Loose
     # tolerances: these are wall-clock timings of control-plane paths on
     # a shared CI box (detection additionally quantizes to the 50ms poll
