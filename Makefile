@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint rtlint sanitizers test fast-test bench-data
+.PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs
 
 lint: rtlint sanitizers
 
@@ -15,6 +15,11 @@ rtlint:
 # tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
 bench-data:
 	JAX_PLATFORMS=cpu $(PY) bench_data.py
+
+# Regenerates BENCH_OBS.json (flight-recorder overhead probes); run
+# tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
+bench-obs:
+	JAX_PLATFORMS=cpu $(PY) bench_obs.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

@@ -36,6 +36,10 @@ _poll_delays_left: int = 0
 # (wait_object_local), modelling slow cross-node transfer.
 _pull_delay_s: float = 0.0
 _pull_delays_left: int = 0
+# Deterministic delay applied to the next training steps (consumed by
+# the flight recorder's StepProfiler), modelling a straggling rank.
+_step_delay_s: float = 0.0
+_step_delays_left: int = 0
 
 
 def enabled() -> bool:
@@ -57,12 +61,15 @@ def clear():
     """Drop all pending driver-side injections."""
     global _poll_delay_s, _poll_delays_left
     global _pull_delay_s, _pull_delays_left
+    global _step_delay_s, _step_delays_left
     with _lock:
         _injected_drain_ranks.clear()
         _poll_delay_s = 0.0
         _poll_delays_left = 0
         _pull_delay_s = 0.0
         _pull_delays_left = 0
+        _step_delay_s = 0.0
+        _step_delays_left = 0
 
 
 def _require_enabled(what: str):
@@ -188,3 +195,31 @@ def take_pull_delay() -> Optional[float]:
             return None
         _pull_delays_left -= 1
         return _pull_delay_s
+
+
+def delay_steps(seconds: float, count: int = 1):
+    """Deterministically slow down this process's next `count` training
+    steps (consumed by flight_recorder.StepProfiler at step start) —
+    models a straggling rank for skew-attribution tests without
+    nondeterministic sleeps in the loop body. Process-local: call it
+    from inside the rank you want to slow."""
+    _require_enabled("delay_steps")
+    global _step_delay_s, _step_delays_left
+    with _lock:
+        _step_delay_s = float(seconds)
+        _step_delays_left = int(count)
+
+
+def take_step_delay() -> Optional[float]:
+    """Pop one pending step delay (None when chaos is off or exhausted).
+
+    Runs once per training step, so the common no-injection case exits
+    on a plain global read before touching os.environ or the lock."""
+    global _step_delays_left
+    if _step_delays_left <= 0 or not enabled():
+        return None
+    with _lock:
+        if _step_delays_left <= 0:
+            return None
+        _step_delays_left -= 1
+        return _step_delay_s

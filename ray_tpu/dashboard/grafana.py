@@ -45,6 +45,72 @@ _SYSTEM_PANELS = [
     ]),
 ]
 
+# Flight-recorder panel set: the curated training/serving/memory views
+# the StepProfiler + memory accountant + serving engine publish. Emitted
+# ahead of the generic per-registered-metric panels so a fresh cluster's
+# dashboard has the observability story laid out even before any process
+# registers the series locally. (name, targets, unit) triples.
+_FLIGHT_RECORDER_PANELS = [
+    ("Train step wall time p50/p95 by rank", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "train_step_wall_seconds_bucket[1m]))",
+         "legend": "p50 rank {{rank}}"},
+        {"expr": "histogram_quantile(0.95, rate("
+                 "train_step_wall_seconds_bucket[1m]))",
+         "legend": "p95 rank {{rank}}"},
+    ], "s"),
+    ("Train step phase breakdown", [
+        {"expr": "rate(train_step_phase_seconds_total[1m])",
+         "legend": "rank {{rank}} {{phase}}"},
+    ], "s"),
+    ("Cross-rank step skew (straggler gap)", [
+        {"expr": "histogram_quantile(0.95, rate("
+                 "train_step_skew_seconds_bucket[1m]))",
+         "legend": "p95 skew"},
+        {"expr": "train_straggler_rank", "legend": "straggler rank"},
+    ], "s"),
+    ("Training throughput / MFU", [
+        {"expr": "train_tokens_per_s", "legend": "rank {{rank}} tok/s"},
+        {"expr": "train_step_mfu", "legend": "rank {{rank}} MFU"},
+    ], "short"),
+    ("Step compiles (retraces)", [
+        {"expr": "rate(train_step_compiles_total[5m])",
+         "legend": "rank {{rank}}"},
+    ], "short"),
+    ("Device HBM (live arrays vs allocator)", [
+        {"expr": "device_hbm_live_bytes",
+         "legend": "{{node}} {{device}} live"},
+        {"expr": "device_hbm_in_use_bytes",
+         "legend": "{{node}} {{device}} in use"},
+        {"expr": "device_hbm_limit_bytes",
+         "legend": "{{node}} {{device}} limit"},
+    ], "bytes"),
+    ("Object store usage by node", [
+        {"expr": "rt_raylet_store_used_bytes", "legend": "{{node}}"},
+    ], "bytes"),
+    ("Data feed stalls", [
+        {"expr": "rate(data_feed_stall_seconds_count[1m])",
+         "legend": "stalls/s"},
+        {"expr": "rate(data_feed_stall_seconds_sum[1m])",
+         "legend": "stall seconds/s"},
+    ], "short"),
+    ("Serving TTFT p50/p95", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "serve_llm_ttft_seconds_bucket[1m]))", "legend": "p50"},
+        {"expr": "histogram_quantile(0.95, rate("
+                 "serve_llm_ttft_seconds_bucket[1m]))", "legend": "p95"},
+    ], "s"),
+    ("Serving TPOT p50/p95", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "serve_llm_tpot_seconds_bucket[1m]))", "legend": "p50"},
+        {"expr": "histogram_quantile(0.95, rate("
+                 "serve_llm_tpot_seconds_bucket[1m]))", "legend": "p95"},
+    ], "s"),
+    ("Serving batch occupancy", [
+        {"expr": "serve_llm_batch_occupancy", "legend": "occupancy"},
+    ], "percentunit"),
+]
+
 
 def generate_dashboard(
     user_metrics: Optional[List[Dict]] = None,
@@ -73,8 +139,27 @@ def generate_dashboard(
         pid += 1
         y += 8 * (pid % 2 == 1)
 
+    covered = set()
+    for name, targets, unit in _FLIGHT_RECORDER_PANELS:
+        panels.append(_panel(pid, name, targets, y, unit=unit))
+        pid += 1
+        y += 8 * (pid % 2 == 1)
+        for t in targets:
+            # Track the base series each curated panel queries so the
+            # generic per-metric pass below doesn't duplicate it.
+            expr = t["expr"]
+            for suffix in ("_bucket", "_sum", "_count"):
+                expr = expr.replace(suffix, "")
+            for token in expr.replace("(", " ").replace(")", " ").replace(
+                    "[1m]", " ").replace("[5m]", " ").split():
+                if token.startswith(("train_", "serve_", "device_", "data_",
+                                     "rt_raylet_")):
+                    covered.add(token)
+
     for info in user_metrics:
         name, mtype = info["name"], info["type"]
+        if name in covered:
+            continue
         if mtype == "counter":
             targets = [{"expr": f"rate({name}[1m])", "legend": name}]
         elif mtype == "gauge":

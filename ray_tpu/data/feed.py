@@ -30,6 +30,7 @@ import weakref
 from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
 
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 # Wall seconds the consumer spent blocked on an empty feed queue (the
 # step loop outran the producer). One observation per stall.
@@ -119,25 +120,32 @@ def _q_put(q: "queue.Queue", item: Tuple[str, Any],
 def _produce(source_factory: Callable[[], Iterable],
              transform: Optional[Callable[[Any], Any]],
              q: "queue.Queue", stop_event: threading.Event,
-             stats: FeedStats) -> None:
+             stats: FeedStats, trace_ctx=None) -> None:
     """Producer-thread body. Terminates by enqueueing ("done", None) /
-    ("error", exc), or silently when the stop event fires."""
+    ("error", exc), or silently when the stop event fires.
+
+    trace_ctx is the span context active when the prefetcher was built:
+    trace context is thread-local, so without re-attaching it here every
+    span the pull/assembly path opens (rt.get, rt.prefetch) would root a
+    detached trace instead of joining the request tree.
+    """
     try:
-        it = iter(source_factory())
-        while not stop_event.is_set():
-            t0 = time.perf_counter()
-            try:
-                item = next(it)
-            except StopIteration:
-                break
-            stats.add_assemble(time.perf_counter() - t0)
-            if transform is not None:
-                t1 = time.perf_counter()
-                item = transform(item)
-                stats.add_h2d(time.perf_counter() - t1)
-            if not _q_put(q, ("batch", item), stop_event):
-                return
-        _q_put(q, ("done", None), stop_event)
+        with _tracing.attach(trace_ctx):
+            it = iter(source_factory())
+            while not stop_event.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                stats.add_assemble(time.perf_counter() - t0)
+                if transform is not None:
+                    t1 = time.perf_counter()
+                    item = transform(item)
+                    stats.add_h2d(time.perf_counter() - t1)
+                if not _q_put(q, ("batch", item), stop_event):
+                    return
+            _q_put(q, ("done", None), stop_event)
     except BaseException as e:  # noqa: BLE001 — shipped to the consumer
         _q_put(q, ("error", e), stop_event)
 
@@ -180,7 +188,7 @@ class _DevicePrefetcher:
         self._thread = threading.Thread(
             target=_produce,
             args=(source_factory, transform, self._queue, self._stop_event,
-                  self._stats),
+                  self._stats, _tracing.current()),
             name=f"rt-data-{name}",
             daemon=True,
         )

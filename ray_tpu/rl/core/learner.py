@@ -35,6 +35,11 @@ class Learner:
         self.params = module.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
         self._update = jax.jit(self._update_impl)
+        # Optional flight recorder (train.StepProfiler): when set,
+        # update_from_batch records each update as one profiled step
+        # (the float() readback already fences, so compute attribution
+        # is exact without extra syncs).
+        self.profiler = None
 
     def _update_impl(self, params, opt_state, batch):
         (loss, metrics), grads = jax.value_and_grad(
@@ -49,10 +54,19 @@ class Learner:
 
     # -- reference API shape ---------------------------------------------
     def update_from_batch(self, batch: Dict[str, jnp.ndarray]) -> Dict:
-        self.params, self.opt_state, metrics = self._update(
-            self.params, self.opt_state, batch
-        )
-        return {k: float(v) for k, v in metrics.items()}
+        prof = self.profiler
+        if prof is None:
+            self.params, self.opt_state, metrics = self._update(
+                self.params, self.opt_state, batch
+            )
+            return {k: float(v) for k, v in metrics.items()}
+        n = len(next(iter(batch.values()))) if batch else None
+        with prof.step(samples=n):
+            with prof.phase("compute"):
+                self.params, self.opt_state, metrics = self._update(
+                    self.params, self.opt_state, batch
+                )
+                return {k: float(v) for k, v in metrics.items()}
 
     def compute_gradients(self, batch) -> Tuple[Any, Dict]:
         (loss, metrics), grads = jax.value_and_grad(

@@ -267,12 +267,168 @@ def cmd_timeline(args):
 def cmd_memory(args):
     from ray_tpu.util.state import list_objects
 
+    if getattr(args, "devices", False):
+        # Unified HBM + object-store view from the memory accountant.
+        from ray_tpu.util.memory import memory_summary
+
+        s = memory_summary(address=_resolve_address(args))
+        print(f"HBM (live jax arrays): {s['hbm_live_bytes'] / 1e6:.1f} MB "
+              f"across {len(s['devices'])} sampled device(s)")
+        for d in s["devices"]:
+            extra = ""
+            if "bytes_in_use" in d:
+                extra = f"  in_use {d['bytes_in_use'] / 1e6:.1f} MB"
+                if "bytes_limit" in d and d["bytes_limit"]:
+                    extra += (f" / limit {d['bytes_limit'] / 1e6:.1f} MB"
+                              f" ({100 * d['bytes_in_use'] / d['bytes_limit']:.0f}%)")
+            print(f"  node {d['node']} {d['device']}: "
+                  f"{d.get('live_bytes', 0) / 1e6:>9.1f} MB live "
+                  f"({d.get('live_arrays', 0)} arrays){extra}")
+        st = s["object_store"]
+        print(f"object store: {st['used_bytes'] / 1e6:.1f} MB, "
+              f"{st['num_objects']} objects "
+              f"({s['objects']['count']} in object table, "
+              f"{s['objects']['bytes'] / 1e6:.1f} MB primary copies)")
+        for node, v in sorted(st["per_node"].items()):
+            print(f"  node {node}: {v.get('used_bytes', 0) / 1e6:>9.1f} MB, "
+                  f"{v.get('num_objects', 0)} objects")
+        return
+
     objs = list_objects(address=_resolve_address(args))
     total = sum(o["size"] for o in objs)
     print(f"{len(objs)} objects, {total / 1e6:.1f} MB total")
     for o in sorted(objs, key=lambda o: -o["size"])[:50]:
         locs = ",".join(loc[:8] for loc in o["locations"])
         print(f"  {o['object_id'][:16]}  {o['size']:>12} B  on [{locs}]")
+
+
+def _series_by_tags(snapshot, name):
+    """[(tags_dict, value)] for one metric from a metrics_snapshot reply."""
+    for m in snapshot:
+        if m["name"] == name:
+            return [(dict(tuple(t) for t in tags), val)
+                    for tags, val in m["series"]]
+    return []
+
+
+def _hist_total(snapshot, name):
+    """(count, sum) over every tag set of a histogram metric."""
+    count, total = 0, 0.0
+    for _, st in _series_by_tags(snapshot, name):
+        if isinstance(st, dict):
+            count += st.get("count", 0)
+            total += st.get("sum", 0.0)
+    return count, total
+
+
+def _render_top(snapshot, nodes) -> str:
+    """One frame of the `rt top` live cluster view, assembled purely from
+    the GCS metrics snapshot + node table (no per-node dials)."""
+    lines = []
+    alive = [n for n in nodes if n["state"] == "ALIVE"]
+    lines.append(f"rt top — {len(alive)}/{len(nodes)} nodes alive")
+
+    # -- training: per-rank step wall + skew/straggler -------------------
+    per_rank = {}
+    for tags, st in _series_by_tags(snapshot, "train_step_wall_seconds"):
+        if isinstance(st, dict) and st.get("count"):
+            per_rank[tags.get("rank", "-")] = st
+    if per_rank:
+        lines.append("train:")
+        phases = {}
+        for tags, v in _series_by_tags(snapshot,
+                                       "train_step_phase_seconds_total"):
+            phases.setdefault(tags.get("rank", "-"), {})[
+                tags.get("phase", "?")] = v
+        compiles = {t.get("rank", "-"): v for t, v in
+                    _series_by_tags(snapshot, "train_step_compiles_total")}
+        tput = {t.get("rank", "-"): v for t, v in
+                _series_by_tags(snapshot, "train_tokens_per_s")}
+        means = {}
+        for rank in sorted(per_rank):
+            st = per_rank[rank]
+            mean_ms = st["sum"] / st["count"] * 1e3
+            means[rank] = mean_ms
+            ph = phases.get(rank, {})
+            ph_total = sum(ph.values()) or 1.0
+            ph_str = " ".join(
+                f"{k} {100 * v / ph_total:.0f}%"
+                for k, v in sorted(ph.items(), key=lambda kv: -kv[1])
+            )
+            extra = ""
+            if rank in tput:
+                extra += f"  {tput[rank]:,.0f} tok/s"
+            if compiles.get(rank):
+                extra += f"  compiles={compiles[rank]:.0f}"
+            lines.append(f"  rank {rank}: {st['count']} steps, "
+                         f"{mean_ms:.1f} ms/step  [{ph_str}]{extra}")
+        if len(means) >= 2:
+            slowest = max(means, key=means.get)
+            skew_ms = means[slowest] - min(means.values())
+            lines.append(f"  skew: {skew_ms:.1f} ms/step — slowest rank "
+                         f"{slowest} (straggler)")
+    sk_count, sk_sum = _hist_total(snapshot, "train_step_skew_seconds")
+    if sk_count:
+        lines.append(f"  skew metric: {sk_sum / sk_count * 1e3:.1f} ms avg "
+                     f"over {sk_count} polls")
+
+    # -- memory: HBM gauges + per-node object store ----------------------
+    hbm = _series_by_tags(snapshot, "device_hbm_live_bytes")
+    store = _series_by_tags(snapshot, "rt_raylet_store_used_bytes")
+    if hbm or store:
+        lines.append("memory:")
+        for tags, v in sorted(hbm, key=lambda x: (x[0].get("node", ""),
+                                                  x[0].get("device", ""))):
+            lines.append(f"  hbm {tags.get('node', '-')} "
+                         f"{tags.get('device', '?')}: {v / 1e6:.1f} MB live")
+        for tags, v in sorted(store, key=lambda x: x[0].get("node", "")):
+            lines.append(f"  store {tags.get('node', '-')}: "
+                         f"{v / 1e6:.1f} MB")
+
+    # -- data feed -------------------------------------------------------
+    st_count, st_sum = _hist_total(snapshot, "data_feed_stall_seconds")
+    batches = sum(v for _, v in
+                  _series_by_tags(snapshot, "data_feed_batches_total"))
+    if batches or st_count:
+        lines.append(f"data feed: {batches:.0f} batches, {st_count} stalls "
+                     f"({st_sum * 1e3:.1f} ms waiting)")
+
+    # -- serving ---------------------------------------------------------
+    occ = _series_by_tags(snapshot, "serve_llm_batch_occupancy")
+    ttft_c, ttft_s = _hist_total(snapshot, "serve_llm_ttft_seconds")
+    tpot_c, tpot_s = _hist_total(snapshot, "serve_llm_tpot_seconds")
+    if occ or ttft_c:
+        lines.append("serve:")
+        if occ:
+            lines.append(f"  batch occupancy: "
+                         f"{100 * sum(v for _, v in occ) / len(occ):.0f}%")
+        if ttft_c:
+            lines.append(f"  ttft: {ttft_s / ttft_c * 1e3:.1f} ms avg "
+                         f"({ttft_c} requests)")
+        if tpot_c:
+            lines.append(f"  tpot: {tpot_s / tpot_c * 1e3:.2f} ms/token avg")
+    return "\n".join(lines)
+
+
+def cmd_top(args):
+    """Live cluster view: per-rank step times + skew, HBM/object-store
+    memory, feed stalls, serving occupancy/latency — everything the
+    flight recorder publishes, one screen."""
+    from ray_tpu.util.state.api import StateApiClient
+
+    address = _resolve_address(args)
+    while True:
+        client = StateApiClient(address)
+        try:
+            snapshot = client.call("metrics_snapshot")["metrics"]
+            nodes = client.nodes()
+        finally:
+            client.close()
+        print(_render_top(snapshot, nodes))
+        if not args.watch:
+            return
+        time.sleep(args.interval)
+        print()
 
 
 def cmd_drain(args):
@@ -490,8 +646,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("memory", help="object store usage by object")
+    sp.add_argument("--devices", action="store_true",
+                    help="unified HBM + object-store view per device/node")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser(
+        "top", help="live cluster view: step times, skew, memory, serving"
+    )
+    sp.add_argument("--watch", action="store_true",
+                    help="refresh continuously instead of one shot")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period with --watch (seconds)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("serve", help="declarative Serve deploys")
     sp.add_argument("serve_command", choices=["deploy", "status", "shutdown"])

@@ -70,7 +70,8 @@ def _engine_metrics() -> Dict:
     global _metrics
     with _metrics_lock:
         if _metrics is None:
-            from ray_tpu.util.metrics import Counter, Histogram
+            from ray_tpu.util import metrics as _mx
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
             _metrics = {
                 "dispatch_ms": Histogram(
@@ -99,6 +100,27 @@ def _engine_metrics() -> Dict:
                     "serve_llm_param_uploads_total",
                     "Host->device sampling-param/active-mask refreshes "
                     "(only on slot admission/eviction, never per step)",
+                ),
+                # Request-level latency (flight recorder): TTFT is
+                # submit->first token (queue wait + prefill), TPOT the
+                # mean inter-token interval after the first. Seconds,
+                # sub-ms-resolving boundaries.
+                "ttft_s": Histogram(
+                    "serve_llm_ttft_seconds",
+                    "Time to first token: submit() to the first pushed "
+                    "token, per request",
+                    boundaries=_mx.LATENCY_BOUNDARIES,
+                ),
+                "tpot_s": Histogram(
+                    "serve_llm_tpot_seconds",
+                    "Time per output token after the first (decode-rate "
+                    "inverse), per finished request",
+                    boundaries=_mx.LATENCY_BOUNDARIES,
+                ),
+                "occupancy": Gauge(
+                    "serve_llm_batch_occupancy",
+                    "Decoding slots in use / total slots, sampled every "
+                    "engine step (how full the continuous batch runs)",
                 ),
             }
         return _metrics
@@ -325,13 +347,30 @@ class GenerationHandle:
         self.temperature = 0.0
         self.top_k = 0
         self.top_p = 1.0
+        # Latency bookkeeping (engine thread only): submit stamps
+        # submitted_at; the first/terminal pushes yield TTFT/TPOT.
+        self.submitted_at: Optional[float] = None
+        self._first_token_t: Optional[float] = None
 
     # -- engine side --
     def _push(self, token: int, done: bool):
+        now = time.perf_counter()
+        first = self._first_token_t is None
+        if first:
+            self._first_token_t = now
         with self._cond:
             self._tokens.append(int(token))
             self._done = self._done or done
             self._cond.notify_all()
+        # Observe outside the condition: a blocked consumer wakes without
+        # waiting on the metrics registry lock.
+        m = _engine_metrics()
+        if first and self.submitted_at is not None:
+            m["ttft_s"].observe(now - self.submitted_at)
+        if done and self.produced > 1 and not first:
+            m["tpot_s"].observe(
+                (now - self._first_token_t) / (self.produced - 1)
+            )
 
     def _fail(self, err: BaseException):
         with self._cond:
@@ -593,6 +632,7 @@ class ContinuousBatchingEngine:
         with self._lock:
             h = GenerationHandle(self._next_id)
             self._next_id += 1
+            h.submitted_at = time.perf_counter()
             h.prompt = prompt
             h.max_new_tokens = int(max_new_tokens)
             h.temperature = float(temperature)
@@ -630,6 +670,14 @@ class ContinuousBatchingEngine:
                     "dispatch_ms_total": self._t_dispatch * 1e3,
                     "fetch_ms_total": self._t_fetch * 1e3,
                     "host_ms_total": self._t_host * 1e3,
+                },
+                # Request-level latency (flight recorder): process-wide
+                # lifetime summaries of the TTFT/TPOT histograms, plus
+                # the instantaneous batch occupancy.
+                "latency": {
+                    "ttft": _engine_metrics()["ttft_s"].summary(),
+                    "tpot": _engine_metrics()["tpot_s"].summary(),
+                    "occupancy": len(self._slots) / self.num_slots,
                 },
             }
 
@@ -850,6 +898,7 @@ class ContinuousBatchingEngine:
                     m["dispatch_ms"].observe(dispatch_s * 1e3)
                     m["fetch_ms"].observe(fetch_s * 1e3)
                     m["host_ms"].observe(host_s * 1e3)
+                    m["occupancy"].set(len(snapshot) / self.num_slots)
                     compiles = self._compile_count()
                     grew = compiles - self._last_compiles
                     if grew > 0:

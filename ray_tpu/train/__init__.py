@@ -33,6 +33,7 @@ from ray_tpu.train.session import (
     should_stop,
 )
 from ray_tpu.train.backend_executor import TrainingFailedError
+from ray_tpu.train.flight_recorder import StepProfiler, compute_skew
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from ray_tpu.train.data_config import DataConfig
 from ray_tpu.train import torch  # noqa: F401 — train.torch.TorchTrainer
@@ -67,5 +68,7 @@ __all__ = [
     "get_trial_dir",
     "get_session",
     "should_stop",
+    "StepProfiler",
+    "compute_skew",
     "TrainingFailedError",
 ]

@@ -39,11 +39,30 @@ class TrainSession:
         self._finished = False
         self._error: Optional[BaseException] = None
         self._stop_requested = threading.Event()
+        # Flight recorder: StepProfiler self-registers here on
+        # construction so its records ride report()/poll() untouched by
+        # the user's loop code.
+        self._profiler = None
 
     # -- user API --------------------------------------------------------
     def report(self, metrics: Dict, checkpoint: Optional[Checkpoint] = None):
+        import time as _time
+
+        from ray_tpu.train import flight_recorder as _fr
+
+        t0 = _time.perf_counter()
+        prof = self._profiler
+        rec = {"metrics": dict(metrics), "checkpoint": checkpoint}
+        if prof is not None:
+            # Ship the steps completed since the last report with this
+            # one, so the trainer sees per-step records in order.
+            rec["step_records"] = prof.drain_records()
         with self._lock:
-            self._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint})
+            self._reports.append(rec)
+        # A report carrying a checkpoint is the checkpoint handoff — its
+        # wall time is checkpoint time of the step it happened inside.
+        if checkpoint is not None:
+            _fr.note_phase("checkpoint", _time.perf_counter() - t0)
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._start_checkpoint
@@ -58,6 +77,15 @@ class TrainSession:
         lost work; loops that don't are restarted from their last
         checkpoint like any crash."""
         return self._stop_requested.is_set()
+
+    def attach_profiler(self, profiler) -> None:
+        """Register this worker's StepProfiler (called by the profiler's
+        own constructor). The latest attached profiler wins."""
+        self._profiler = profiler
+
+    @property
+    def profiler(self):
+        return self._profiler
 
     # -- trainer side ----------------------------------------------------
     def request_stop(self):

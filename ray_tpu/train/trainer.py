@@ -54,6 +54,37 @@ def _fault_metrics():
     )
 
 
+def _skew_metrics():
+    """Flight-recorder driver-side metrics: cross-rank step skew and the
+    current straggler rank (lazy, same reason as _fault_metrics)."""
+    from ray_tpu.util import metrics as rt_metrics
+
+    return (
+        rt_metrics.get_or_create(
+            rt_metrics.Histogram, "train_step_skew_seconds",
+            "Cross-rank skew: slowest minus fastest rank's mean step "
+            "wall time, per trainer poll.",
+            boundaries=rt_metrics.LATENCY_BOUNDARIES,
+        ),
+        rt_metrics.get_or_create(
+            rt_metrics.Gauge, "train_straggler_rank",
+            "Rank with the highest mean step wall time right now.",
+        ),
+    )
+
+
+def _mean_breakdown(records: List[Dict]) -> Dict[str, float]:
+    """Average the per-phase seconds over a batch of step records."""
+    out: Dict[str, float] = {}
+    for rec in records:
+        for k, v in rec.items():
+            if (k.endswith("_s") and k != "tokens_per_s"
+                    and isinstance(v, (int, float))):
+                out[k] = out.get(k, 0.0) + v
+    n = len(records)
+    return {k: round(v / n, 6) for k, v in out.items()}
+
+
 class BaseTrainer:
     def __init__(
         self,
@@ -155,6 +186,10 @@ class DataParallelTrainer(BaseTrainer):
         # carries everything that was reported before the last failure.
         self._final_metrics: Dict = {}
         self._metrics_history: List[Dict] = []
+        # Flight recorder: latest cumulative step stats per rank (from
+        # poll) and the skew/straggler view computed from them.
+        self._rank_step_stats: List[Optional[Dict]] = []
+        self._step_skew: Optional[Dict] = None
 
         executor = BackendExecutor(self.backend_config, self.scaling_config)
         try:
@@ -197,15 +232,48 @@ class DataParallelTrainer(BaseTrainer):
         first-worker results in TrainingIterator); every rank's
         checkpoints are registered (the drain path checkpoints on
         whichever ranks got the stop request first)."""
+        from ray_tpu.train import flight_recorder
+
         for rank, st in enumerate(statuses):
             for rep in st["reports"]:
                 if rank == 0:
-                    self._final_metrics = rep["metrics"]
-                    self._metrics_history.append(rep["metrics"])
+                    entry = dict(rep["metrics"])
+                    recs = rep.get("step_records")
+                    if recs:
+                        # Per-phase step breakdown (mean over the steps
+                        # this report covers) lands in metrics_history.
+                        entry["train_step_breakdown"] = _mean_breakdown(recs)
+                    self._final_metrics = entry
+                    self._metrics_history.append(entry)
                 if rep["checkpoint_path"]:
                     ckpt = Checkpoint.from_directory(rep["checkpoint_path"])
                     manager.register(ckpt, rep["metrics"])
                     self._latest_checkpoint = ckpt
+        # Cross-rank straggler attribution from the per-rank cumulative
+        # step stats each poll carries.
+        stats = [st.get("step_stats") for st in statuses]
+        if any(s for s in stats):
+            self._rank_step_stats = stats
+            skew = flight_recorder.compute_skew(stats)
+            if skew is not None:
+                self._step_skew = skew
+                skew_hist, straggler_gauge = _skew_metrics()
+                skew_hist.observe(skew["skew_s"])
+                straggler_gauge.set(float(skew["straggler_rank"]))
+        if self._metrics_history and self._step_skew is not None:
+            # Enrich the newest history entry (same dict object as
+            # _final_metrics) so Result names the straggler. Refreshed
+            # every poll, not just on appends: a fast rank can drain all
+            # its reports before the straggler completes a single step,
+            # and the skew only becomes computable on a LATER poll.
+            self._metrics_history[-1].update({
+                "train_step_skew_s": round(self._step_skew["skew_s"], 6),
+                "train_straggler_rank": self._step_skew["straggler_rank"],
+                "train_step_wall_by_rank":
+                    self._step_skew["mean_step_s_by_rank"],
+                "train_straggler_breakdown":
+                    self._step_skew["straggler_breakdown"],
+            })
 
     def _run_attempt(
         self,

@@ -86,17 +86,23 @@ class TrainWorker:
         out = []
         for r in reports:
             ckpt = r["checkpoint"]
-            out.append(
-                {
-                    "metrics": r["metrics"],
-                    "checkpoint_path": ckpt.path if ckpt else None,
-                }
-            )
+            entry = {
+                "metrics": r["metrics"],
+                "checkpoint_path": ckpt.path if ckpt else None,
+            }
+            if r.get("step_records"):
+                entry["step_records"] = r["step_records"]
+            out.append(entry)
+        # Flight recorder: cumulative per-rank step stats ride every poll
+        # (not just reports), so the trainer's skew/straggler view stays
+        # current even for loops that report rarely.
+        prof = self.session.profiler if self.session else None
         return {
             "reports": out,
             "done": self._done,
             "error": self._error,
             "error_type": getattr(self, "_error_type", None),
+            "step_stats": prof.summary() if prof is not None else None,
         }
 
     def ping(self):

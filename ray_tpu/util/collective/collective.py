@@ -18,6 +18,7 @@ serves control-plane sync, weight broadcast outside jit, and CPU testing.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -168,51 +169,93 @@ def _as_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+# Collective-op observers: callables (op_name, seconds) invoked after each
+# eager collective completes. The flight recorder registers one so the
+# step profiler can attribute collective wall time per training step
+# without this module importing anything from train/. The timed path only
+# runs when an observer is registered — unobserved collectives pay two
+# list checks and nothing else.
+_op_observers: List = []
+
+
+def add_op_observer(cb) -> None:
+    """Register `cb(op_name: str, seconds: float)` to run after every
+    eager collective op in this process (idempotent per callable)."""
+    if cb not in _op_observers:
+        _op_observers.append(cb)
+
+
+def remove_op_observer(cb) -> None:
+    try:
+        _op_observers.remove(cb)
+    except ValueError:
+        pass
+
+
+def _observed(op_name: str, fn):
+    """Run fn(), reporting its wall time to any registered observers."""
+    if not _op_observers:
+        return fn()
+    t0 = time.perf_counter()
+    try:
+        return fn()
+    finally:
+        dt = time.perf_counter() - t0
+        for cb in list(_op_observers):
+            try:
+                cb(op_name, dt)
+            except Exception:  # rtlint: disable=RT007 — observers must never break the op
+                pass
+
+
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     """In-place-style allreduce (reference :258). Returns the reduced value
     (numpy for DCN; device arrays for XLA)."""
     g = _manager.get(group_name)
     if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return g.allreduce(tensor, op)
-    return g.allreduce(_as_numpy(tensor), op)
+        return _observed("allreduce", lambda: g.allreduce(tensor, op))
+    return _observed("allreduce", lambda: g.allreduce(_as_numpy(tensor), op))
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
     g = _manager.get(group_name)
-    return g.reduce(_as_numpy(tensor), dst_rank, op)
+    return _observed("reduce", lambda: g.reduce(_as_numpy(tensor), dst_rank, op))
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
     if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return g.broadcast(tensor, src_rank)
-    return g.broadcast(_as_numpy(tensor), src_rank)
+        return _observed("broadcast", lambda: g.broadcast(tensor, src_rank))
+    return _observed("broadcast",
+                     lambda: g.broadcast(_as_numpy(tensor), src_rank))
 
 
 def allgather(tensor, group_name: str = "default"):
     g = _manager.get(group_name)
     if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return g.allgather(tensor)
-    return g.allgather(_as_numpy(tensor))
+        return _observed("allgather", lambda: g.allgather(tensor))
+    return _observed("allgather", lambda: g.allgather(_as_numpy(tensor)))
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     g = _manager.get(group_name)
     if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return g.reducescatter(tensor, op)
-    return g.reducescatter(_as_numpy(tensor), op)
+        return _observed("reducescatter", lambda: g.reducescatter(tensor, op))
+    return _observed("reducescatter",
+                     lambda: g.reducescatter(_as_numpy(tensor), op))
 
 
 def barrier(group_name: str = "default"):
-    _manager.get(group_name).barrier()
+    g = _manager.get(group_name)
+    _observed("barrier", g.barrier)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
-    g.send(_as_numpy(tensor), dst_rank)
+    _observed("send", lambda: g.send(_as_numpy(tensor), dst_rank))
 
 
 def recv(tensor_shape, src_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
-    return g.recv(src_rank)
+    return _observed("recv", lambda: g.recv(src_rank))

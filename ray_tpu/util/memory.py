@@ -1,0 +1,233 @@
+"""Unified memory accounting: device HBM + host object store.
+
+A TPU job's memory lives in two pools the runtime previously accounted
+separately or not at all: HBM (jax arrays on device — invisible to the
+object store) and plasma (host shared memory — invisible to jax). OOMs
+on either side get diagnosed by the other side's numbers unless someone
+joins them. This module is the join:
+
+  * device_memory() — this process's per-device view: live array bytes
+    (summed over `jax.live_arrays()` shards per device) plus the
+    allocator's own numbers (`device.memory_stats()`: bytes_in_use /
+    peak / limit) where the backend provides them (TPU/GPU yes, CPU no).
+  * MemoryAccountant / sample_once() — publish that view as node+device
+    tagged gauges through the existing metrics stream, so the driver,
+    `rt memory --devices`, `rt top`, and Grafana all read one source.
+  * memory_summary() — the cluster-unified view assembled from the GCS:
+    HBM gauges from every sampling process, per-node plasma usage from
+    the raylet's `rt_raylet_store_used_bytes` gauge, and the object
+    listing's primary-copy totals from the state API.
+
+Reference analog: `ray memory` / memory_utils.py group object stats per
+node; the HBM half has no reference analog (the reference has no device
+accounting at all) — the shape follows jm.live_arrays-based profilers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util import metrics as _metrics
+
+_gauges_lock = threading.Lock()
+_gauges: Optional[Dict[str, Any]] = None
+
+
+def _hbm_gauges() -> Dict[str, Any]:
+    global _gauges
+    with _gauges_lock:
+        if _gauges is None:
+            _gauges = {
+                "live": _metrics.get_or_create(
+                    _metrics.Gauge, "device_hbm_live_bytes",
+                    "Bytes of live jax arrays resident per device.",
+                    tag_keys=("node", "device"),
+                ),
+                "arrays": _metrics.get_or_create(
+                    _metrics.Gauge, "device_hbm_live_arrays",
+                    "Count of live jax arrays per device.",
+                    tag_keys=("node", "device"),
+                ),
+                "in_use": _metrics.get_or_create(
+                    _metrics.Gauge, "device_hbm_in_use_bytes",
+                    "Allocator bytes_in_use per device (memory_stats; "
+                    "absent on backends without allocator stats).",
+                    tag_keys=("node", "device"),
+                ),
+                "limit": _metrics.get_or_create(
+                    _metrics.Gauge, "device_hbm_limit_bytes",
+                    "Allocator bytes_limit per device (memory_stats).",
+                    tag_keys=("node", "device"),
+                ),
+            }
+        return _gauges
+
+
+def _node_tag() -> str:
+    from ray_tpu._private import worker as worker_mod
+
+    client = worker_mod.get_client_or_none()
+    if client is not None and getattr(client, "node_id", None):
+        return client.node_id.hex()[:12]
+    return "-"
+
+
+def device_memory() -> List[Dict[str, Any]]:
+    """Per-device memory view of THIS process: one dict per addressable
+    jax device with live-array accounting and (when the backend exposes
+    it) allocator stats. Empty list when jax has no backend."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no jax backend in this process
+        return []
+    live_bytes: Dict[Any, int] = {}
+    live_count: Dict[Any, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            for shard in arr.addressable_shards:
+                d = shard.device
+                nbytes = getattr(shard.data, "nbytes", 0)
+                live_bytes[d] = live_bytes.get(d, 0) + int(nbytes)
+                live_count[d] = live_count.get(d, 0) + 1
+        except Exception:  # noqa: BLE001 — deleted/donated array mid-walk
+            continue
+    out = []
+    for d in devices:
+        entry: Dict[str, Any] = {
+            "device": str(d),
+            "kind": getattr(d, "device_kind", "?"),
+            "live_bytes": live_bytes.get(d, 0),
+            "live_arrays": live_count.get(d, 0),
+        }
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without stats
+            stats = None
+        if stats:
+            for src, dst in (("bytes_in_use", "bytes_in_use"),
+                             ("peak_bytes_in_use", "peak_bytes_in_use"),
+                             ("bytes_limit", "bytes_limit")):
+                if src in stats:
+                    entry[dst] = int(stats[src])
+        out.append(entry)
+    return out
+
+
+def sample_once() -> List[Dict[str, Any]]:
+    """Take one device-memory sample and publish it as gauges; returns
+    the sample. Call from any process holding device arrays (training
+    workers, serving engines) — each publishes under its own node tag."""
+    sample = device_memory()
+    if not sample:
+        return sample
+    g = _hbm_gauges()
+    node = _node_tag()
+    for entry in sample:
+        tags = {"node": node, "device": entry["device"]}
+        g["live"].set(float(entry["live_bytes"]), tags=tags)
+        g["arrays"].set(float(entry["live_arrays"]), tags=tags)
+        if "bytes_in_use" in entry:
+            g["in_use"].set(float(entry["bytes_in_use"]), tags=tags)
+        if "bytes_limit" in entry:
+            g["limit"].set(float(entry["bytes_limit"]), tags=tags)
+    return sample
+
+
+def _sample_loop(stop_event: threading.Event, interval_s: float) -> None:
+    """Sampler-thread body (module function per RT006: communicates with
+    the owner only through the stop event; gauges are process-global)."""
+    while not stop_event.wait(interval_s):
+        try:
+            sample_once()
+        except Exception:  # noqa: BLE001 — sampling must never kill the host  # rtlint: disable=RT007
+            pass
+
+
+class MemoryAccountant:
+    """Background HBM sampler: publishes this process's device gauges
+    every `interval_s` until stop() (or GC — daemon thread). One per
+    process is enough; the gauges are process-global."""
+
+    def __init__(self, interval_s: float = 5.0):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=_sample_loop, args=(self._stop, interval_s),
+            name="rt-mem-accountant", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+def _snapshot_metrics(address: Optional[str]) -> Dict[str, Dict]:
+    """{name: {tags_tuple: value}} for the gauges memory_summary reads."""
+    from ray_tpu.util.state.api import StateApiClient
+
+    client = StateApiClient(address)
+    try:
+        snapshot = client.call("metrics_snapshot")["metrics"]
+    finally:
+        client.close()
+    out: Dict[str, Dict] = {}
+    for m in snapshot:
+        series = {}
+        for tags, val in m["series"]:
+            series[tuple(sorted((k, v) for k, v in tags))] = val
+        out[m["name"]] = series
+    return out
+
+
+def memory_summary(address: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster-unified memory view: every sampled device's HBM gauges,
+    per-node object-store usage, and the object table's primary-copy
+    totals — one dict, one source for CLI/dashboard rendering."""
+    from ray_tpu.util.state import api as state_api
+
+    snap = _snapshot_metrics(address)
+
+    devices: Dict[tuple, Dict[str, Any]] = {}
+    for metric, field in (
+        ("device_hbm_live_bytes", "live_bytes"),
+        ("device_hbm_live_arrays", "live_arrays"),
+        ("device_hbm_in_use_bytes", "bytes_in_use"),
+        ("device_hbm_limit_bytes", "bytes_limit"),
+    ):
+        for tags, val in snap.get(metric, {}).items():
+            td = dict(tags)
+            key = (td.get("node", "-"), td.get("device", "?"))
+            d = devices.setdefault(
+                key, {"node": key[0], "device": key[1]}
+            )
+            d[field] = int(val)
+
+    per_node_store: Dict[str, Dict[str, int]] = {}
+    for tags, val in snap.get("rt_raylet_store_used_bytes", {}).items():
+        node = dict(tags).get("node", "-")
+        per_node_store.setdefault(node, {})["used_bytes"] = int(val)
+    for tags, val in snap.get("rt_raylet_store_objects", {}).items():
+        node = dict(tags).get("node", "-")
+        per_node_store.setdefault(node, {})["num_objects"] = int(val)
+
+    objects = state_api.list_objects(address=address)
+    obj_bytes = sum(o["size"] or 0 for o in objects)
+
+    return {
+        "devices": sorted(
+            devices.values(), key=lambda d: (d["node"], d["device"])
+        ),
+        "hbm_live_bytes": sum(d.get("live_bytes", 0)
+                              for d in devices.values()),
+        "object_store": {
+            "per_node": per_node_store,
+            "used_bytes": sum(v.get("used_bytes", 0)
+                              for v in per_node_store.values()),
+            "num_objects": sum(v.get("num_objects", 0)
+                               for v in per_node_store.values()),
+        },
+        "objects": {"count": len(objects), "bytes": obj_bytes},
+    }

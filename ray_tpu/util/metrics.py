@@ -10,13 +10,26 @@ prometheus_exporter.py plays in the reference).
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
+from bisect import bisect_left as _bisect_left
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _DEFAULT_BOUNDARIES = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
     25.0, 50.0, 100.0,
+)
+
+# Boundaries for step/latency-class histograms (seconds). TPU step phases
+# (dispatch, fetch, collective, feed stall) live well under the 5ms floor
+# of _DEFAULT_BOUNDARIES; metrics that time hot-loop phases should pass
+# these instead. Existing metrics keep _DEFAULT_BOUNDARIES — the GCS
+# aggregator rejects a histogram re-registered under different
+# boundaries, so the default must stay stable.
+LATENCY_BOUNDARIES = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
 _registry_lock = threading.Lock()
@@ -73,6 +86,12 @@ class Metric:
         merged.update(tags or {})
         return merged
 
+    def _key(self, tags: Optional[Dict[str, str]]) -> tuple:
+        """Resolve tags to the internal series key once, for hot paths
+        that record per step/request: validate + merge + sort here, then
+        pass the key to *_keyed() on every observation."""
+        return _tags_key(self._merged(tags))
+
     def _drain(self) -> Optional[dict]:  # -> report record or None
         raise NotImplementedError
 
@@ -87,7 +106,10 @@ class Counter(Metric):
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
         if value <= 0:
             raise ValueError("Counter.inc() requires value > 0")
-        key = _tags_key(self._merged(tags))
+        self.inc_keyed(self._key(tags), value)
+
+    def inc_keyed(self, key: tuple, value: float = 1.0):
+        """inc() with a key pre-resolved via _key() — per-step hot path."""
         with self._lock:
             self._deltas[key] = self._deltas.get(key, 0.0) + value
 
@@ -113,7 +135,10 @@ class Gauge(Metric):
         self._dirty = False
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = _tags_key(self._merged(tags))
+        self.set_keyed(self._key(tags), value)
+
+    def set_keyed(self, key: tuple, value: float):
+        """set() with a key pre-resolved via _key() — per-step hot path."""
         with self._lock:
             self._values[key] = float(value)
             self._dirty = True
@@ -151,17 +176,17 @@ class Histogram(Metric):
         self._life_max = 0.0
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = _tags_key(self._merged(tags))
+        self.observe_keyed(self._key(tags), value)
+
+    def observe_keyed(self, key: tuple, value: float):
+        """observe() with a key pre-resolved via _key() — hot path."""
         with self._lock:
             st = self._state.get(key)
             if st is None:
                 st = self._state[key] = [
                     [0] * (len(self._boundaries) + 1), 0.0, 0,
                 ]
-            idx = 0
-            while idx < len(self._boundaries) and value > self._boundaries[idx]:
-                idx += 1
-            st[0][idx] += 1
+            st[0][_bisect_left(self._boundaries, value)] += 1
             st[1] += value
             st[2] += 1
             self._life_sum += value
@@ -259,3 +284,9 @@ def _ensure_flusher():
     threading.Thread(
         target=_flusher_loop, name="rt-metrics-flush", daemon=True
     ).start()
+    # Final drain at interpreter exit: a short-lived task/worker that
+    # records and exits within the flusher's 1s period would otherwise
+    # silently drop its last counters (profiling.py registers the same
+    # guard for timeline spans). Registered with the flusher — once per
+    # process, and only in processes that actually use metrics.
+    atexit.register(_flush_once)

@@ -131,6 +131,25 @@ def span(name: str):
 
 
 @contextmanager
+def attach(ctx: Optional[Dict[str, str]]):
+    """Adopt an existing span context on THIS thread without opening a
+    new span. Trace context is thread-local, so a background thread
+    spawned mid-span starts detached; capture `current()` on the
+    spawning side and `with tracing.attach(ctx):` in the thread body,
+    and spans the thread opens join the request tree instead of rooting
+    fresh traces. No-op (and records nothing) when ctx is None."""
+    if not ctx:
+        yield
+        return
+    prev = current()
+    _tls.ctx = dict(ctx)
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+@contextmanager
 def activate(trace_ctx: Optional[Dict[str, str]], name: str):
     """Worker-side: adopt a received trace context for the duration of a
     task body, recording the execution as a child span. No-op when the
