@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs
+.PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs bench-scale
 
 lint: rtlint sanitizers
 
@@ -20,6 +20,12 @@ bench-data:
 # tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
 bench-obs:
 	JAX_PLATFORMS=cpu $(PY) bench_obs.py
+
+# Regenerates BENCH_SCALE.json (scalability envelope + control-plane
+# profiler decomposition); run tools/check_claims.py afterwards —
+# MIGRATION.md pins these numbers.
+bench-scale:
+	JAX_PLATFORMS=cpu $(PY) bench_scale.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

@@ -219,6 +219,13 @@ class Config:
     release_actor_timeout_s: float = 2.0
     # Worker-side task-event flush period (batched to the GCS).
     task_event_flush_interval_s: float = 1.0
+    # Control-plane profiler head-sampling rate (0 disables, 1 traces
+    # every task). Also flippable cluster-wide at runtime via
+    # `rt profile --on` (GCS profile_config broadcast).
+    task_trace_sample: float = 0.0
+    # Bounded delay before buffered trace/profiling spans flush to the
+    # GCS (replaces the old one-RPC-per-span eager flush).
+    trace_flush_delay_s: float = 0.25
 
     # -- wire protocol ---------------------------------------------------
     # Frames at/above this size bypass coalescing and await drain.

@@ -34,11 +34,26 @@ import asyncio
 import os
 import struct
 import time
+from bisect import bisect_left
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Set
 
 from ray_tpu._private.config import get_config
 from ray_tpu._private.protocol import RpcServer, ServerConnection
+
+#: Bucket boundaries (seconds) for the per-method server-side RPC latency
+#: histograms — matches util.metrics.LATENCY_BOUNDARIES so gcs_rpc_*
+#: series quantile the same way client-side metrics do. Kept as a local
+#: copy: the GCS process must not import the client metrics registry.
+_RPC_LATENCY_BOUNDS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+#: Task-event ring capacity (GcsTaskManager's task_events_max_num_task_
+#: in_gcs analog). Evictions are counted so consumers can detect
+#: truncation instead of silently missing history.
+_TASK_EVENTS_CAP = 100_000
 
 
 #: Handlers that mutate durable tables; each marks the snapshot dirty.
@@ -108,6 +123,20 @@ class GcsServer:
                 method, self.rpc_counts[method] + 1
             )
         )
+        # Per-method handler-latency accounting (count/sum/max + fixed
+        # buckets), feeding `rt rpc` and the gcs_rpc_server_seconds
+        # series in metrics_snapshot. Makes "N GCS round-trips per actor
+        # birth, at M µs each" a reported number.
+        self.rpc_latency: Dict[str, dict] = {}
+        self.rpc.on_complete = self._rpc_complete
+        # Evicted-task-event count: lets list_task_events consumers warn
+        # on truncated history instead of silently under-reporting.
+        self._task_events_dropped = 0
+        # Cluster-wide runtime profiling config (`rt profile --on`):
+        # stored here, broadcast to every connected client over the
+        # profile_config pubsub channel (server-originated; clients may
+        # not publish to it).
+        self.profile_config: Dict[str, Any] = {}
 
         r = self.rpc.register
         # kv
@@ -164,6 +193,9 @@ class GcsServer:
         r("metrics_report", self.h_metrics_report)
         r("metrics_snapshot", self.h_metrics_snapshot)
         r("gcs_stats", self.h_gcs_stats)
+        # control-plane profiler (runtime sampling toggle)
+        r("set_profile_config", self.h_set_profile_config)
+        r("get_profile_config", self.h_get_profile_config)
         # misc
         r("ping", self.h_ping)
 
@@ -452,9 +484,25 @@ class GcsServer:
     async def _health_loop(self):
         cfg = get_config()
         tick = 0
+        sleep_s = min(0.25, cfg.health_check_period_s)
+        last_wake = time.monotonic()
         while True:
-            await asyncio.sleep(min(0.25, cfg.health_check_period_s))
+            await asyncio.sleep(sleep_s)
             tick += 1
+            # Suspend detection (the standard failure-detector guard, cf.
+            # phi-accrual): if this loop itself just missed its deadline —
+            # event-loop stall, GC pause, machine suspend — the monitor
+            # was deaf for that window and cannot distinguish "node
+            # silent" from "I wasn't listening". Forgive the pause
+            # instead of charging it against every node's heartbeat.
+            now = time.monotonic()
+            pause = now - last_wake - sleep_s
+            last_wake = now
+            if pause > cfg.health_check_period_s:
+                for info in self.nodes.values():
+                    info["last_heartbeat"] = min(
+                        now, info["last_heartbeat"] + pause
+                    )
             # Retry pending actors as the resource view changes.
             for actor_id in list(self.pending_actors):
                 a = self.actors.get(actor_id)
@@ -562,22 +610,61 @@ class GcsServer:
             out.append({k: v for k, v in info.items() if k != "last_heartbeat"})
         return {"nodes": out}
 
+    def _rpc_complete(self, method: str, dur_s: float) -> None:
+        """RpcServer on_complete hook: fold one served RPC's handler
+        latency into the per-method accounting."""
+        st = self.rpc_latency.get(method)
+        if st is None:
+            st = self.rpc_latency[method] = {
+                "count": 0, "sum_s": 0.0, "max_s": 0.0,
+                "buckets": [0] * (len(_RPC_LATENCY_BOUNDS) + 1),
+            }
+        st["count"] += 1
+        st["sum_s"] += dur_s
+        if dur_s > st["max_s"]:
+            st["max_s"] = dur_s
+        st["buckets"][bisect_left(_RPC_LATENCY_BOUNDS, dur_s)] += 1
+
     async def h_gcs_stats(self, d, conn):
         """GCS-internal runtime metrics (per-component stats, the
-        stats/metric_defs.h role): rpc volume by method + table sizes."""
+        stats/metric_defs.h role): rpc volume + per-method handler
+        latency (count/sum/max/buckets over rpc_latency_boundaries) +
+        table sizes. `rt rpc` renders the latency table."""
         return {
             "rpc_counts": dict(self.rpc_counts),
+            "rpc_latency": {
+                m: dict(st, buckets=list(st["buckets"]))
+                for m, st in self.rpc_latency.items()
+            },
+            "rpc_latency_boundaries": list(_RPC_LATENCY_BOUNDS),
             "nodes_alive": sum(
                 1 for n in self.nodes.values() if n["state"] == "ALIVE"
             ),
             "kv_entries": sum(len(t) for t in self.kv.values()),
             "task_events": len(self.task_events),
+            "task_events_dropped": self._task_events_dropped,
             "subscriber_conns": sum(
                 len(s) for s in self.subscribers.values()
             ),
             "object_dir_entries": len(self.object_dir),
             "placement_groups": len(self.placement_groups),
         }
+
+    async def h_set_profile_config(self, d, conn):
+        """Flip control-plane profiling at runtime (`rt profile --on`):
+        persist the sampling rate in the GCS and broadcast it so every
+        connected client (drivers AND workers) adjusts without restarts.
+        Server-originated publish — profile_config is not a client-
+        publishable channel."""
+        updates = {
+            k: d[k] for k in ("task_trace_sample",) if d.get(k) is not None
+        }
+        self.profile_config.update(updates)
+        await self.publish("profile_config", dict(self.profile_config))
+        return {"ok": True, "profile_config": dict(self.profile_config)}
+
+    async def h_get_profile_config(self, d, conn):
+        return {"profile_config": dict(self.profile_config)}
 
     async def h_resource_update(self, d, conn):
         """Raylet pushes its resource view (ray_syncer analog:
@@ -1404,13 +1491,34 @@ class GcsServer:
     # -- task events ------------------------------------------------------
     async def h_add_task_events(self, d, conn):
         self.task_events.extend(d["events"])
-        if len(self.task_events) > 100_000:
-            del self.task_events[: len(self.task_events) - 100_000]
+        overflow = len(self.task_events) - _TASK_EVENTS_CAP
+        if overflow > 0:
+            del self.task_events[:overflow]
+            self._task_events_dropped += overflow
         return {"ok": True}
 
     async def h_list_task_events(self, d, conn):
+        """Page through the task-event ring.
+
+        With "offset": events[offset : offset+limit] from the ring's
+        current start — consumers loop until offset reaches "total"
+        (pages may shift if the ring evicts mid-pagination; "dropped"
+        counts lifetime evictions so they can warn on truncated
+        history). Without "offset": legacy tail slice of the newest
+        `limit` events.
+        """
         limit = d.get("limit", 1000)
-        return {"events": self.task_events[-limit:]}
+        total = len(self.task_events)
+        if "offset" in d:
+            off = max(0, int(d["offset"]))
+            events = self.task_events[off:off + limit]
+        else:
+            events = self.task_events[-limit:]
+        return {
+            "events": events,
+            "total": total,
+            "dropped": self._task_events_dropped,
+        }
 
     # -- metrics ----------------------------------------------------------
     async def h_metrics_report(self, d, conn):
@@ -1465,6 +1573,35 @@ class GcsServer:
 
     async def h_metrics_snapshot(self, d, conn):
         out = []
+        # GCS-internal RPC accounting joins the cluster metric surface as
+        # synthetic series (the GCS has no client-side flusher of its
+        # own): counts as a counter, handler latency as a histogram, both
+        # tagged by method — so Grafana's gcs_rpc_* panels and `rt top`
+        # see them like any reported metric.
+        if self.rpc_counts:
+            out.append({
+                "name": "gcs_rpc_calls_total",
+                "type": "counter",
+                "description": "GCS RPCs served, by method",
+                "boundaries": None,
+                "series": [
+                    [[["method", m]], float(c)]
+                    for m, c in self.rpc_counts.items()
+                ],
+            })
+        if self.rpc_latency:
+            out.append({
+                "name": "gcs_rpc_server_seconds",
+                "type": "histogram",
+                "description": "GCS handler latency, by method",
+                "boundaries": list(_RPC_LATENCY_BOUNDS),
+                "series": [
+                    [[["method", m]],
+                     {"buckets": list(st["buckets"]), "sum": st["sum_s"],
+                      "count": st["count"]}]
+                    for m, st in self.rpc_latency.items()
+                ],
+            })
         for name, m in self.metrics.items():
             out.append(
                 {

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import struct
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
@@ -294,6 +295,11 @@ class RpcServer:
         # per-component rpc volume metrics). Called synchronously with
         # the method name before dispatch.
         self.on_request: Optional[Callable[[str], None]] = None
+        # Per-method handler-latency hook: called synchronously with
+        # (method, duration_s) after the handler returns or raises. Feeds
+        # the GCS's gcs_rpc_* latency histograms; None keeps dispatch
+        # timer-free.
+        self.on_complete: Optional[Callable[[str, float], None]] = None
 
     def register(self, method: str, handler: Handler):
         self.handlers[method] = handler
@@ -343,8 +349,14 @@ class RpcServer:
             if cid:
                 await conn.respond(cid, error=f"no such method: {method}")
             return
+        on_complete = self.on_complete
+        t0 = time.monotonic() if on_complete is not None else 0.0
         try:
-            result = await handler(frame.get("d"), conn)
+            try:
+                result = await handler(frame.get("d"), conn)
+            finally:
+                if on_complete is not None:
+                    on_complete(method, time.monotonic() - t0)
             if cid:
                 if isinstance(result, BinResponse):
                     await conn.respond_bin(cid, result.data, result.payload)

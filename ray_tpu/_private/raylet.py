@@ -40,6 +40,7 @@ from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, connect, spawn
+from ray_tpu.util import lifecycle
 
 
 class _PullByteBudget:
@@ -265,7 +266,20 @@ class Raylet:
         self._metric_tasks_dispatched = 0
         self._metric_tasks_failed = 0
         self._metric_objects_spilled = 0
+        # Scheduler queue instrumentation (control-plane profiler): how
+        # many dispatch passes ran, how many head-of-queue scans they
+        # did, how many leases were granted — plus last-pass gauges, so
+        # "queue scans per dispatched task" is a reported number.
+        self._metric_dispatch_passes = 0
+        self._metric_dispatch_scans = 0
+        self._metric_lease_grants = 0
+        self._last_dispatch_batch = 0
+        self._last_dispatch_scan = 0
         self._metric_reported: Dict[str, int] = {}
+        # Control-plane profiler: enqueue stamps for sampled specs
+        # (task_id -> (monotonic, epoch)), closed into queue_wait at
+        # dispatch; bounded against leaks from forwarded/failed tasks.
+        self._lc_enqueue: Dict[bytes, tuple] = {}
 
         r = self.rpc.register
         r("register_worker", self.h_register_worker)
@@ -1344,6 +1358,12 @@ class Raylet:
         self._enqueue_task(spec, fut)
         self._queued_demand_add(resources, +1, spec)
         self._record_task_event(spec, "PENDING_SCHEDULING")
+        if spec.get("sampled"):
+            self._lc_enqueue[spec["task_id"]] = (time.monotonic(), time.time())
+            if len(self._lc_enqueue) > 16384:
+                # Entries for forwarded/cancelled tasks never close;
+                # drop oldest rather than grow without bound.
+                self._lc_enqueue.pop(next(iter(self._lc_enqueue)), None)
         self._dispatch_event.set()
         return await fut
 
@@ -1402,6 +1422,7 @@ class Raylet:
         worker.idle = False
         worker.lease_resources = dict(resources)
         worker.leased_by = conn  # released if this owner disconnects
+        self._metric_lease_grants += 1
         return {
             "status": "ok",
             "worker_id": worker.worker_id,
@@ -1600,12 +1621,17 @@ class Raylet:
             self._dispatch_event.clear()
             ctx = {"nodes": None}  # one get_nodes snapshot per pass
             blocked = False
+            self._metric_dispatch_passes += 1
+            scans0 = self._metric_dispatch_scans
+            dispatched0 = self._metric_tasks_dispatched
             for key in list(self.task_queues.keys()):
                 q = self.task_queues.get(key)
                 if not q:
                     self.task_queues.pop(key, None)
                     continue
                 blocked |= await self._dispatch_class(q, ctx, cfg)
+            self._last_dispatch_batch = self._metric_tasks_dispatched - dispatched0
+            self._last_dispatch_scan = self._metric_dispatch_scans - scans0
             if blocked:
                 # Blocked on resources/workers: rescan the moment anything
                 # completes (h_task_done sets the event) instead of a fixed
@@ -1625,6 +1651,7 @@ class Raylet:
         Returns True if tasks remain queued (class is blocked)."""
         while q:
             spec, fut = q[0]
+            self._metric_dispatch_scans += 1
             if fut.done():
                 q.popleft()
                 self._queued_demand_add(spec.get("resources", {}), -1, spec)
@@ -1762,6 +1789,12 @@ class Raylet:
                 return True
             if not self._try_acquire_for(spec):
                 return True
+            lc = (
+                self._lc_enqueue.pop(spec["task_id"], None)
+                if spec.get("sampled")
+                else None
+            )
+            t_disp = time.monotonic()
             q.popleft()
             self._queued_demand_add(resources, -1, spec)
             worker.idle = False
@@ -1777,6 +1810,19 @@ class Raylet:
                 spec, "RUNNING", worker_id=worker.worker_id
             )
             await worker.conn.push("run_task", spec)
+            if lc is not None:
+                # queue_wait: submit-RPC arrival -> dispatch decision;
+                # dispatch: decision -> run_task pushed to the worker.
+                qw = max(0.0, t_disp - lc[0])
+                self._task_events.append(lifecycle.event(
+                    spec["task_id"], spec.get("name") or "",
+                    spec.get("job_id", b""), self.node_id.binary(),
+                    "raylet",
+                    {"queue_wait": [lc[1], qw],
+                     "dispatch": [lc[1] + qw,
+                                  max(0.0, time.monotonic() - t_disp)]},
+                    worker_id=worker.worker_id,
+                ))
         return False
 
     def _idle_worker(self, renv_hash: Optional[str] = None) -> Optional[WorkerHandle]:
@@ -2569,6 +2615,9 @@ class Raylet:
             "rt_raylet_tasks_dispatched_total": self._metric_tasks_dispatched,
             "rt_raylet_tasks_failed_total": self._metric_tasks_failed,
             "rt_raylet_objects_spilled_total": self._metric_objects_spilled,
+            "rt_raylet_dispatch_passes_total": self._metric_dispatch_passes,
+            "rt_raylet_dispatch_scans_total": self._metric_dispatch_scans,
+            "rt_raylet_lease_grants_total": self._metric_lease_grants,
         }
         records = []
         commits = {}
@@ -2586,6 +2635,8 @@ class Raylet:
             ("rt_raylet_store_objects", stats.get("num_objects", 0)),
             ("rt_raylet_workers", len(self.workers)),
             ("rt_raylet_tasks_queued", len(self._queued_specs)),
+            ("rt_raylet_dispatch_batch_last", self._last_dispatch_batch),
+            ("rt_raylet_dispatch_scan_last", self._last_dispatch_scan),
         ):
             records.append(
                 {"name": name, "type": "gauge",

@@ -46,9 +46,35 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 
+from ray_tpu.util import lifecycle
+
 # Thread-local flag: serializing task args => promote refs to the shared store.
 _ser_ctx = threading.local()
 _EMPTY_ARGS_PAYLOAD: Optional[bytes] = None
+
+# Lazily-created client-side GCS RPC metrics (module-level so every
+# CoreClient in the process shares one series set).
+_gcs_rpc_metric_pair = None
+
+
+def _gcs_rpc_metrics():
+    global _gcs_rpc_metric_pair
+    if _gcs_rpc_metric_pair is None:
+        from ray_tpu.util import metrics as _metrics
+
+        _gcs_rpc_metric_pair = (
+            _metrics.get_or_create(
+                _metrics.Counter, "gcs_rpc_client_calls_total",
+                "Client-issued GCS RPCs, by method", tag_keys=("method",),
+            ),
+            _metrics.get_or_create(
+                _metrics.Histogram, "gcs_rpc_client_seconds",
+                "Client-observed GCS RPC round-trip latency, by method",
+                boundaries=_metrics.LATENCY_BOUNDARIES,
+                tag_keys=("method",),
+            ),
+        )
+    return _gcs_rpc_metric_pair
 
 
 class _InStoreSentinel:
@@ -378,6 +404,19 @@ class CoreClient:
         # GCS-restart survival (client half): see _gcs_call.
         self._subscribed_channels: set = set()
         self._gcs_redial_lock = None
+        # Client-side GCS RPC accounting (per-method count + wall sum):
+        # cheap plain dicts read directly by benches/tests; the metric
+        # registry mirrors them as gcs_rpc_client_* series.
+        from collections import defaultdict as _dd
+
+        self.gcs_rpc_counts: Dict[str, int] = _dd(int)
+        self.gcs_rpc_time_s: Dict[str, float] = _dd(float)
+        # Control-plane profiler (util/lifecycle): submit-side state for
+        # sampled tasks — task_id -> {"t0", "t_buf", "phases", ...},
+        # completed (popped + LIFECYCLE_SPAN emitted) in _complete_task;
+        # return-oid -> task_id for driver-side get_wait stamps.
+        self._lc_pending: Dict[bytes, dict] = {}
+        self._lc_get_map: Dict[bytes, bytes] = {}
         # In-flight background pulls started by prefetch(): oid -> loop
         # task running _pull_object. get() joins an in-flight pull instead
         # of racing a second probe for the same object. Loop-side only.
@@ -404,6 +443,27 @@ class CoreClient:
             self.raylet = await connect(
                 *self.raylet_addr, push_handler=self._on_raylet_push
             )
+        # Control-plane profiler runtime toggle: adopt the cluster-wide
+        # sampling rate (if one was set via `rt profile --on`) and follow
+        # future changes over the profile_config broadcast channel —
+        # drivers AND workers, so the sampled bit appears wherever tasks
+        # are submitted from. Best-effort: profiling never gates connect.
+        try:
+            self._push_handlers.setdefault(
+                "profile_config", []
+            ).append(self._on_profile_config)
+            self._subscribed_channels.add("profile_config")
+            await self.gcs.call("subscribe", {"channel": "profile_config"})
+            r = await self.gcs.call("get_profile_config", {})
+            self._on_profile_config(r.get("profile_config") or {})
+        except Exception:  # noqa: BLE001 — profiling is best-effort
+            pass
+
+    @staticmethod
+    def _on_profile_config(payload):
+        rate = (payload or {}).get("task_trace_sample")
+        if rate is not None:
+            lifecycle.set_sample_rate(float(rate))
 
     async def _gcs_call(self, method, payload=None, timeout=None):
         """GCS call that survives a GCS restart: on a dead connection,
@@ -418,11 +478,26 @@ class CoreClient:
         """
         if method == "subscribe":
             self._subscribed_channels.add(payload["channel"])
+        t0 = time.monotonic()
         try:
-            return await self.gcs.call(method, payload, timeout=timeout)
-        except ConnectionLost:
-            await self._redial_gcs()
-            return await self.gcs.call(method, payload, timeout=timeout)
+            try:
+                return await self.gcs.call(method, payload, timeout=timeout)
+            except ConnectionLost:
+                await self._redial_gcs()
+                return await self.gcs.call(method, payload, timeout=timeout)
+        finally:
+            # Per-method accounting, success or failure: "N GCS
+            # round-trips per actor birth" must be a reported number.
+            dur = time.monotonic() - t0
+            self.gcs_rpc_counts[method] += 1
+            self.gcs_rpc_time_s[method] += dur
+            try:
+                calls, lat = _gcs_rpc_metrics()
+                tags = {"method": method}
+                calls.inc(1.0, tags)
+                lat.observe(dur, tags)
+            except Exception:  # noqa: BLE001 — accounting must never break RPCs
+                pass
 
     async def _redial_gcs(self):
         lock = self._gcs_redial_lock
@@ -765,10 +840,17 @@ class CoreClient:
         if isinstance(a, _InlineArg):
             return a.value
         if isinstance(a, _StoreArg):
-            return self.get(
-            [ObjectRef(ObjectID(a.oid))],
-            timeout=get_config().arg_fetch_timeout_s,
-        )[0]
+            # Store pull under the executing worker's deserialize window:
+            # the lifecycle profiler splits this wait out as arg_fetch
+            # (thread-local accumulator, armed only for sampled tasks).
+            t0 = time.monotonic()
+            try:
+                return self.get(
+                    [ObjectRef(ObjectID(a.oid))],
+                    timeout=get_config().arg_fetch_timeout_s,
+                )[0]
+            finally:
+                lifecycle.add_arg_fetch(time.monotonic() - t0)
         return a
 
     def promote_ref(self, ref: ObjectRef):
@@ -894,6 +976,7 @@ class CoreClient:
         return ref
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float]):
+        lc_t0 = time.monotonic() if self._lc_get_map else None
         deadline = None if timeout is None else time.monotonic() + timeout
         out: List[Any] = [None] * len(refs)
         remote: List[Tuple[int, ObjectRef]] = []
@@ -916,6 +999,8 @@ class CoreClient:
                 if isinstance(res, BaseException):
                     raise res  # first failing ref in list order
                 out[i] = self._read_store(ObjectID(ref.id.binary()))
+        if lc_t0 is not None:
+            self._lc_note_get_wait(refs, time.monotonic() - lc_t0)
         return out
 
     def prefetch(self, refs: List[ObjectRef]) -> int:
@@ -1279,8 +1364,16 @@ class CoreClient:
         max_calls: Optional[int] = None,
     ) -> List[ObjectRef]:
         cfg = get_config()
+        # Control-plane profiler head sampling: one module-attr check per
+        # task when off; a sampled task carries the bit in its spec and
+        # every hop stamps phase marks (util/lifecycle).
+        lc_sampled = lifecycle.enabled and lifecycle.sample()
+        if lc_sampled:
+            _lc_t0, _lc_ts0 = time.monotonic(), time.time()
         fn_key = self.fn_manager.export(fn)
         payload, deps, borrow_oids = self.serialize_args(args, kwargs)
+        if lc_sampled:
+            _lc_ser = time.monotonic() - _lc_t0
         task_id = TaskID.from_random()
         resolved_env = self._resolve_runtime_env(runtime_env)
         spec = {
@@ -1330,6 +1423,10 @@ class CoreClient:
                 refs.append(ref)
                 futures.append(fut)
         self._borrow_deps(spec, borrow_oids)
+        if lc_sampled:
+            spec["sampled"] = True
+            self._lc_track(task_id.binary(), name, _lc_t0, _lc_ts0,
+                           _lc_ser, refs)
         with self._submit_lock:
             self._submit_buf.append((spec, futures, retries))
             need_schedule = not self._submit_scheduled
@@ -1338,6 +1435,81 @@ class CoreClient:
         if need_schedule:
             self.loop.call_soon_threadsafe(self._drain_submits)
         return refs
+
+    # -- control-plane profiler (submit side) ---------------------------
+    def _lc_track(self, task_id, name, t0, ts0, serialize_s, refs):
+        """Register a sampled submission: phase accumulator keyed by task
+        id (finished in _complete_task) + return-oid map for get_wait."""
+        self._lc_pending[task_id] = {
+            "t0": t0,
+            "t_buf": time.monotonic(),
+            "name": name,
+            "phases": {"serialize": [ts0, serialize_s]},
+        }
+        for ref in refs:
+            if isinstance(ref, ObjectRef):
+                self._lc_get_map[ref.id.binary()] = task_id
+        # Bound both maps: tasks whose completion we miss (client-side
+        # crash paths) and refs never passed to get() must not leak.
+        for m in (self._lc_pending, self._lc_get_map):
+            while len(m) > 16384:
+                m.pop(next(iter(m)), None)
+
+    def _lc_emit(self, ev):
+        """Queue one LIFECYCLE_SPAN event on the shared profiling buffer
+        (bounded-delay batched flush to the GCS)."""
+        from ray_tpu.util import profiling
+
+        with profiling._lock:
+            profiling._buffer.append(ev)
+        profiling.request_flush()
+
+    def _lc_close_submit_buffer(self, spec):
+        """Close a sampled task's submit_buffer phase: .remote() → the
+        task reaching its sender coroutine (burst-buffer wait + drain
+        routing + the event-loop hop into the sender), so the client-side
+        phases tile the submit window with no unattributed gap."""
+        pend = self._lc_pending.get(spec["task_id"])
+        if pend is not None and "t_buf" in pend:
+            dur = max(0.0, time.monotonic() - pend.pop("t_buf"))
+            pend["phases"]["submit_buffer"] = [time.time() - dur, dur]
+
+    def _lc_stamp_rpc_wait(self, task_id, t0_m):
+        """Close a sampled task's rpc_wait mark: the submit RPC's full
+        round-trip, stamped only on single-spec frames (a batch frame's
+        wall spans its siblings' execution, so per-task attribution
+        would lie). The stitcher subtracts the remote-attributed phases
+        to derive the ``transport`` (wire + event-loop) residual."""
+        pend = self._lc_pending.get(task_id)
+        if pend is not None:
+            dur = max(0.0, time.monotonic() - t0_m)
+            pend["phases"]["rpc_wait"] = [time.time() - dur, dur]
+
+    def _lc_complete(self, spec):
+        """_complete_task: emit the client-hop LIFECYCLE_SPAN carrying
+        the submit-side phases and the authoritative e2e wall."""
+        pend = self._lc_pending.pop(spec["task_id"], None)
+        if pend is None:
+            return
+        self._lc_emit(lifecycle.event(
+            spec["task_id"], pend["name"], self.job_id.binary(),
+            self.node_id, "client", pend["phases"],
+            e2e_s=max(0.0, time.monotonic() - pend["t0"]),
+        ))
+
+    def _lc_note_get_wait(self, refs, dur_s):
+        """get(): attribute one blocking-get wall to each sampled task
+        whose return ref was fetched (overlaps remote phases; kept out
+        of the phase sum — see lifecycle.SUM_PHASES)."""
+        now = time.time()
+        for ref in refs:
+            tid = self._lc_get_map.pop(ref.id.binary(), None)
+            if tid is None:
+                continue
+            self._lc_emit(lifecycle.event(
+                tid, "", self.job_id.binary(), self.node_id, "client",
+                {"get_wait": [now - dur_s, dur_s]},
+            ))
 
     def _drain_submits(self):
         """Runs on the loop: route a burst of queued submissions.
@@ -1404,10 +1576,24 @@ class CoreClient:
             chunk = items[i:i + batch_max]
             i += batch_max
             entry = None
+            lc_t = time.monotonic() if self._lc_pending else None
+            if lc_t is not None:
+                for _spec, _f, _r in chunk:
+                    self._lc_close_submit_buffer(_spec)
             try:
                 entry = await self._lease_for(chunk[0][0])
             except Exception:  # noqa: BLE001 — lease loss must never lose a task
                 entry = None
+            if lc_t is not None:
+                # Lease acquisition (usually a pool hit, ~0; a raylet
+                # round-trip when the pool grows) charged to every
+                # sampled task in the chunk that shared it.
+                dur = time.monotonic() - lc_t
+                wall = time.time() - dur
+                for _spec, _f, _r in chunk:
+                    pend = self._lc_pending.get(_spec["task_id"])
+                    if pend is not None:
+                        pend["phases"]["lease"] = [wall, dur]
             if entry is None:
                 for spec, futures, retries in chunk:
                     spawn(self._submit_with_retries(spec, futures, retries))
@@ -1418,13 +1604,22 @@ class CoreClient:
             # burst lands on the same worker.
             entry["outstanding"] += len(chunk)
             entry["last_used"] = time.monotonic()
-            spawn(self._send_direct_batch(entry, chunk))
+            # rpc_wait anchors here (not inside the spawned sender) so the
+            # event-loop hop into the sender coroutine is attributed too.
+            spawn(self._send_direct_batch(entry, chunk, time.monotonic()))
 
-    async def _send_direct_batch(self, entry, chunk):
+    async def _send_direct_batch(self, entry, chunk, rpc_t0=None):
         try:
             if len(chunk) == 1:
+                spec0 = chunk[0][0]
+                rpc_t = (
+                    (rpc_t0 or time.monotonic())
+                    if spec0.get("sampled") and self._lc_pending else None
+                )
                 results = [await entry["conn"].call(
-                    "run_task_direct", chunk[0][0], timeout=None)]
+                    "run_task_direct", spec0, timeout=None)]
+                if rpc_t is not None:
+                    self._lc_stamp_rpc_wait(spec0["task_id"], rpc_t)
             else:
                 resp = await entry["conn"].call(
                     "run_tasks_batch",
@@ -1613,11 +1808,19 @@ class CoreClient:
     async def _submit_with_retries(self, spec, futures, retries):
         attempt = 0
         refusals = 0
+        if spec.get("sampled") and self._lc_pending:
+            self._lc_close_submit_buffer(spec)
         while True:
+            rpc_t = (
+                time.monotonic()
+                if spec.get("sampled") and self._lc_pending else None
+            )
             try:
                 result = await self.raylet.call("submit_task", spec, timeout=None)
             except ConnectionLost:
                 result = {"status": "worker_crashed", "error": "raylet connection lost"}
+            if rpc_t is not None:
+                self._lc_stamp_rpc_wait(spec["task_id"], rpc_t)
             status = result.get("status")
             if result.get("not_executed") and refusals < 100:
                 # Refused before running (a worker retiring under
@@ -1640,6 +1843,8 @@ class CoreClient:
 
     def _complete_task(self, spec, result, futures):
         self._release_borrows(spec)
+        if spec.get("sampled") and self._lc_pending:
+            self._lc_complete(spec)
         status = result.get("status")
         if status == "ok" and result.get("generator"):
             # Dynamic-generator task: items already live in the store
@@ -1853,7 +2058,12 @@ class CoreClient:
         num_returns: int = 1,
         max_task_retries: int = 0,
     ) -> List[ObjectRef]:
+        lc_sampled = lifecycle.enabled and lifecycle.sample()
+        if lc_sampled:
+            _lc_t0, _lc_ts0 = time.monotonic(), time.time()
         payload, deps, borrow_oids = self.serialize_args(args, kwargs)
+        if lc_sampled:
+            _lc_ser = time.monotonic() - _lc_t0
         task_id = TaskID.from_random()
         request = {
             "actor_id": actor_id.binary(),
@@ -1864,6 +2074,8 @@ class CoreClient:
             "caller": self.client_id,
             "num_returns": num_returns,
         }
+        if lc_sampled:
+            request["sampled"] = True
         from ray_tpu.util import tracing
 
         trace_ctx = tracing.inject()
@@ -1886,6 +2098,10 @@ class CoreClient:
                 futures.append(fut)
         spec = {"task_id": task_id.binary()}
         self._borrow_deps(spec, borrow_oids)
+        if lc_sampled:
+            spec["sampled"] = True
+            self._lc_track(task_id.binary(), f"{method}()", _lc_t0,
+                           _lc_ts0, _lc_ser, refs)
         # Same burst batching as plain tasks: one thread->loop crossing
         # per burst of .remote() calls, not one per call.
         with self._submit_lock:
@@ -1937,6 +2153,9 @@ class CoreClient:
         while i < len(calls):
             chunk = calls[i:i + batch_max]
             i += batch_max
+            if self._lc_pending:
+                for _, _, _spec, _, _ in chunk:
+                    self._lc_close_submit_buffer(_spec)
             try:
                 async with lock:
                     conn = await self._actor_conn_for_call(actor_id)
@@ -1997,7 +2216,13 @@ class CoreClient:
         """
         attempt = 0
         lock = self._actor_locks.setdefault(actor_id.binary(), asyncio.Lock())
+        if request.get("sampled") and self._lc_pending:
+            self._lc_close_submit_buffer(spec)
         while True:
+            rpc_t = (
+                time.monotonic()
+                if request.get("sampled") and self._lc_pending else None
+            )
             try:
                 async with lock:
                     conn = await self._actor_conn_for_call(actor_id)
@@ -2009,6 +2234,8 @@ class CoreClient:
                         conn.call("actor_call", request, timeout=None)
                     )
                 result = await call_task
+                if rpc_t is not None:
+                    self._lc_stamp_rpc_wait(request["task_id"], rpc_t)
             except (ConnectionLost, OSError):
                 self._actor_cache.pop(actor_id.binary(), None)
                 if attempt < retries:

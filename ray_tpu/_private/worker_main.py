@@ -17,6 +17,7 @@ import heapq
 import inspect
 import os
 import threading
+import time
 import traceback
 from typing import Any, Dict, Optional
 
@@ -27,6 +28,7 @@ from ray_tpu._private.ids import JobID, ObjectID, TaskID, object_id_for_task
 from ray_tpu._private.protocol import RpcServer, connect, spawn
 from ray_tpu._private.worker import CoreClient, make_task_error
 from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.util import lifecycle
 
 _TPU_ATTACHED = False
 _TPU_ATTACH_LOCK = threading.Lock()
@@ -281,6 +283,15 @@ class WorkerProcess:
             }
         )
 
+    def _lc_emit(self, task_id: bytes, name: str, phases: Dict[str, list],
+                 job_id: bytes = b""):
+        """Queue a worker-hop lifecycle span for a sampled task; rides the
+        existing task-event flush loop (no extra RPC)."""
+        self._task_events.append(lifecycle.event(
+            task_id, name, job_id, self.node_id, "worker", phases,
+            worker_id=self.worker_id,
+        ))
+
     async def _flush_events_loop(self):
         while True:
             await asyncio.sleep(get_config().task_event_flush_interval_s)
@@ -327,7 +338,13 @@ class WorkerProcess:
         node's accounting)."""
         if self._retiring:
             return _retired_result()
+        t0 = time.monotonic() if d.get("sampled") else None
         async with self._direct_lock:
+            # Sampled: the wait for earlier pipelined pushes on this
+            # lease IS the task's queue time (the raylet never sees
+            # direct tasks, so the worker owns the queue_wait phase).
+            if t0 is not None:
+                d["_lc_queue_wait"] = time.monotonic() - t0
             # _execute_accounted re-checks _retiring inside (a push may
             # have queued on the lock behind the call that crossed the
             # threshold — it must refuse, not run-and-be-killed).
@@ -342,17 +359,26 @@ class WorkerProcess:
         if self._retiring:
             return {"results": [_retired_result() for _ in d["specs"]]}
         specs = d["specs"]
+        t_recv = time.monotonic()
 
         def run_all():
             # Per-spec accounting: once the threshold is crossed the
             # REST of the batch is refused (not_executed -> the owner
             # resubmits it on a fresh worker), so the worker never
             # exceeds max_calls by the batch size.
-            return [self._execute_accounted(s) for s in specs]
+            out = []
+            for s in specs:
+                # Sampled: batch-arrival -> this spec's turn is its
+                # queue time (predecessors in the run + lock wait).
+                if s.get("sampled"):
+                    s["_lc_queue_wait"] = time.monotonic() - t_recv
+                out.append(self._execute_accounted(s))
+            return out
 
         async with self._direct_lock:
             results = await self.loop.run_in_executor(self.executor, run_all)
         return {"results": results}
+
 
     def _execute_accounted(self, spec) -> dict:
         """Execute a task with max_calls bookkeeping. Runs on an
@@ -411,6 +437,17 @@ class WorkerProcess:
             return self._execute_task_body(spec)
 
     def _execute_task_body(self, spec) -> dict:
+        # Control-plane profiler (worker hop): sampled specs carry
+        # "sampled"; stamp fn_fetch / arg_fetch / deserialize / exec /
+        # result_store from monotonic deltas. Unsampled tasks pay one
+        # dict miss.
+        lc: Optional[Dict[str, list]] = {} if spec.get("sampled") else None
+        if lc is not None:
+            qw = spec.get("_lc_queue_wait")
+            if qw:
+                # Direct-transport queue time stamped by the push handler
+                # (the raylet is off the per-task path for leased tasks).
+                lc["queue_wait"] = [time.time() - qw, qw]
         try:
             if _wants_tpu(spec.get("resources")):
                 ensure_tpu_backend()
@@ -426,10 +463,31 @@ class WorkerProcess:
                 fn = getattr(importlib.import_module(mod_name), attr)
                 value = fn(*(spec.get("plain_args") or []))
                 return self._package_returns(spec, value, xlang=True)
+            if lc is not None:
+                t0, w0 = time.monotonic(), time.time()
             fn = self.client.fn_manager.fetch(spec["fn_key"])
+            if lc is not None:
+                now = time.monotonic()
+                lc["fn_fetch"] = [w0, max(0.0, now - t0)]
+                t0, w0 = now, time.time()
+                lifecycle.begin_arg_capture()
             args, kwargs = self.client.deserialize_args(spec["args"])
+            if lc is not None:
+                total = max(0.0, time.monotonic() - t0)
+                arg_s = min(lifecycle.end_arg_capture(), total)
+                lc["arg_fetch"] = [w0, arg_s]
+                lc["deserialize"] = [w0, max(0.0, total - arg_s)]
+                t0, w0 = time.monotonic(), time.time()
             value = fn(*args, **kwargs)
-            return self._package_returns(spec, value)
+            if lc is not None:
+                lc["exec"] = [w0, max(0.0, time.monotonic() - t0)]
+                t0, w0 = time.monotonic(), time.time()
+            out = self._package_returns(spec, value)
+            if lc is not None:
+                lc["result_store"] = [w0, max(0.0, time.monotonic() - t0)]
+                self._lc_emit(spec["task_id"], spec.get("name") or "", lc,
+                              spec.get("job_id", b""))
+            return out
         except BaseException as e:  # noqa: BLE001 — shipped to the caller
             return make_task_error(e)
 
@@ -642,24 +700,43 @@ class WorkerProcess:
             results = []
             for d in reqs:
                 self._record_task_event(d["task_id"], d["method"], "RUNNING")
+                lc = {} if d.get("sampled") else None
                 try:
                     method = getattr(actor.instance, d["method"])
                     if d.get("xlang"):
                         args, kwargs = tuple(d.get("plain_args") or ()), {}
                     else:
+                        if lc is not None:
+                            t0, w0 = time.monotonic(), time.time()
+                            lifecycle.begin_arg_capture()
                         args, kwargs = self.client.deserialize_args(d["args"])
+                        if lc is not None:
+                            total = max(0.0, time.monotonic() - t0)
+                            arg_s = min(lifecycle.end_arg_capture(), total)
+                            lc["arg_fetch"] = [w0, arg_s]
+                            lc["deserialize"] = [w0, max(0.0, total - arg_s)]
+                    if lc is not None:
+                        t0, w0 = time.monotonic(), time.time()
                     with tracing.activate(d.get("trace_ctx"), d["method"]):
                         with actor.lock:
                             if inspect.iscoroutinefunction(method):
                                 value = asyncio.run(method(*args, **kwargs))
                             else:
                                 value = method(*args, **kwargs)
+                    if lc is not None:
+                        lc["exec"] = [w0, max(0.0, time.monotonic() - t0)]
+                        t0, w0 = time.monotonic(), time.time()
                     spec = {"task_id": d["task_id"],
                             "num_returns": d.get("num_returns", 1)}
                     results.append(
                         self._package_returns(spec, value,
                                               bool(d.get("xlang")))
                     )
+                    if lc is not None:
+                        lc["result_store"] = [
+                            w0, max(0.0, time.monotonic() - t0)
+                        ]
+                        self._lc_emit(d["task_id"], f"{d['method']}()", lc)
                     self._record_task_event(
                         d["task_id"], d["method"], "FINISHED")
                 except BaseException as e:  # noqa: BLE001 — to the caller
@@ -671,6 +748,7 @@ class WorkerProcess:
 
     async def _invoke_actor_method(self, actor: ActorState, d) -> dict:
         self._record_task_event(d["task_id"], d["method"], "RUNNING")
+        lc: Optional[Dict[str, list]] = {} if d.get("sampled") else None
 
         def do_call():
             from ray_tpu.util import tracing
@@ -679,13 +757,27 @@ class WorkerProcess:
             if d.get("xlang"):
                 args, kwargs = tuple(d.get("plain_args") or ()), {}
             else:
+                if lc is not None:
+                    t0, w0 = time.monotonic(), time.time()
+                    lifecycle.begin_arg_capture()
                 args, kwargs = self.client.deserialize_args(d["args"])
+                if lc is not None:
+                    total = max(0.0, time.monotonic() - t0)
+                    arg_s = min(lifecycle.end_arg_capture(), total)
+                    lc["arg_fetch"] = [w0, arg_s]
+                    lc["deserialize"] = [w0, max(0.0, total - arg_s)]
 
             def invoke():
-                with tracing.activate(d.get("trace_ctx"), d["method"]):
-                    if inspect.iscoroutinefunction(method):
-                        return asyncio.run(method(*args, **kwargs))
-                    return method(*args, **kwargs)
+                t0, w0 = (time.monotonic(), time.time()) if lc is not None \
+                    else (0.0, 0.0)
+                try:
+                    with tracing.activate(d.get("trace_ctx"), d["method"]):
+                        if inspect.iscoroutinefunction(method):
+                            return asyncio.run(method(*args, **kwargs))
+                        return method(*args, **kwargs)
+                finally:
+                    if lc is not None:
+                        lc["exec"] = [w0, max(0.0, time.monotonic() - t0)]
 
             if actor.max_concurrency == 1:
                 # Shares the state lock with compiled-DAG loops so stages
@@ -700,7 +792,13 @@ class WorkerProcess:
             # spill, so neither half may run on the event loop).
             value = do_call()
             spec = {"task_id": d["task_id"], "num_returns": d.get("num_returns", 1)}
-            return self._package_returns(spec, value, bool(d.get("xlang")))
+            if lc is None:
+                return self._package_returns(spec, value, bool(d.get("xlang")))
+            t0, w0 = time.monotonic(), time.time()
+            out = self._package_returns(spec, value, bool(d.get("xlang")))
+            lc["result_store"] = [w0, max(0.0, time.monotonic() - t0)]
+            self._lc_emit(d["task_id"], f"{d['method']}()", lc)
+            return out
 
         try:
             result = await self.loop.run_in_executor(

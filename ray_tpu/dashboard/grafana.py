@@ -109,6 +109,37 @@ _FLIGHT_RECORDER_PANELS = [
     ("Serving batch occupancy", [
         {"expr": "serve_llm_batch_occupancy", "legend": "occupancy"},
     ], "percentunit"),
+    # -- control-plane profiler -----------------------------------------
+    ("GCS RPC rate by method", [
+        {"expr": "rate(gcs_rpc_calls_total[1m])", "legend": "{{method}}"},
+    ], "short"),
+    ("GCS RPC handler latency p50/p99", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "gcs_rpc_server_seconds_bucket[1m]))", "legend": "p50"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "gcs_rpc_server_seconds_bucket[1m]))", "legend": "p99"},
+    ], "s"),
+    ("GCS RPC client-observed latency p50/p99", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "gcs_rpc_client_seconds_bucket[1m]))", "legend": "p50"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "gcs_rpc_client_seconds_bucket[1m]))", "legend": "p99"},
+    ], "s"),
+    ("Scheduler queue depth by node", [
+        {"expr": "rt_raylet_tasks_queued", "legend": "{{node}}"},
+    ], "short"),
+    ("Scheduler dispatch scans / passes", [
+        {"expr": "rate(rt_raylet_dispatch_scans_total[1m])",
+         "legend": "{{node}} scans/s"},
+        {"expr": "rate(rt_raylet_dispatch_passes_total[1m])",
+         "legend": "{{node}} passes/s"},
+    ], "short"),
+    ("Scheduler last dispatch batch / scan length", [
+        {"expr": "rt_raylet_dispatch_batch_last",
+         "legend": "{{node}} batch"},
+        {"expr": "rt_raylet_dispatch_scan_last",
+         "legend": "{{node}} scan"},
+    ], "short"),
 ]
 
 
@@ -153,7 +184,7 @@ def generate_dashboard(
             for token in expr.replace("(", " ").replace(")", " ").replace(
                     "[1m]", " ").replace("[5m]", " ").split():
                 if token.startswith(("train_", "serve_", "device_", "data_",
-                                     "rt_raylet_")):
+                                     "rt_raylet_", "gcs_rpc_")):
                     covered.add(token)
 
     for info in user_metrics:

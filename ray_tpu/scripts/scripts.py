@@ -257,11 +257,132 @@ def cmd_summary(args):
 def cmd_timeline(args):
     from ray_tpu.util.state import get_timeline
 
-    trace = get_timeline(address=_resolve_address(args))
+    trace = get_timeline(
+        address=_resolve_address(args),
+        lifecycle=getattr(args, "lifecycle", False),
+    )
     out = args.output or f"timeline-{int(time.time())}.json"
     with open(out, "w") as f:
         json.dump(trace, f)
     print(f"wrote {len(trace)} events to {out} (open in chrome://tracing)")
+
+
+def cmd_profile(args):
+    """Flip cluster-wide lifecycle sampling / show the phase breakdown."""
+    from ray_tpu.util import lifecycle
+    from ray_tpu.util.state.api import StateApiClient
+
+    client = StateApiClient(_resolve_address(args))
+    try:
+        if args.on or args.off:
+            rate = 0.0 if args.off else (
+                args.rate if args.rate is not None else 1.0
+            )
+            client.call("set_profile_config", {"task_trace_sample": rate})
+            state = "off" if rate == 0.0 else f"on (rate {rate:g})"
+            print(f"task lifecycle sampling: {state} — applies to every "
+                  "connected driver and worker")
+            return
+        if args.profile_command != "tasks":
+            print("usage: rt profile [--on [--rate R] | --off | tasks]",
+                  file=sys.stderr)
+            sys.exit(2)
+        records = lifecycle.stitch(client.task_events())
+        if not records:
+            print("no sampled lifecycle spans; enable with `rt profile --on"
+                  " [--rate R]` or RT_TASK_TRACE_SAMPLE=R")
+            return
+        agg = lifecycle.aggregate(records)
+        cov = agg.pop("coverage", None)
+        e2e = agg.pop("e2e", None)
+        print(f"{len(records)} sampled tasks — per-phase latency (µs)")
+        print(f"  {'phase':<14}{'count':>8}{'mean':>12}{'p50':>12}{'p99':>12}")
+        for phase, row in agg.items():
+            print(f"  {phase:<14}{row['count']:>8}{row['mean_us']:>12.1f}"
+                  f"{row['p50_us']:>12.1f}{row['p99_us']:>12.1f}")
+        if e2e:
+            print(f"  {'e2e':<14}{e2e['count']:>8}{e2e['mean_us']:>12.1f}"
+                  f"{e2e['p50_us']:>12.1f}{e2e['p99_us']:>12.1f}")
+        if cov:
+            print(f"  phase coverage of e2e wall: mean "
+                  f"{100 * cov['mean_us']:.1f}%  p50 {100 * cov['p50_us']:.1f}%")
+    finally:
+        client.close()
+
+
+def _hist_percentile(buckets, bounds, q):
+    """Upper-bound percentile estimate from cumulative histogram buckets."""
+    total = sum(buckets)
+    if not total:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, n in enumerate(buckets):
+        cum += n
+        if cum >= target:
+            return bounds[i] if i < len(bounds) else bounds[-1]
+    return bounds[-1]
+
+
+def cmd_rpc(args):
+    """Per-method GCS RPC accounting (server-side handler latency)."""
+    from ray_tpu.util.state.api import StateApiClient
+
+    client = StateApiClient(_resolve_address(args))
+    try:
+        stats = client.call("gcs_stats")
+    finally:
+        client.close()
+    lat = stats.get("rpc_latency") or {}
+    bounds = stats.get("rpc_latency_boundaries") or []
+    if not lat:
+        print("no GCS RPCs recorded yet")
+        return
+    rows = sorted(lat.items(), key=lambda kv: -kv[1].get("sum_s", 0.0))
+    total_calls = sum(st.get("count", 0) for _, st in rows)
+    total_s = sum(st.get("sum_s", 0.0) for _, st in rows)
+    print(f"GCS RPCs: {total_calls} calls, {total_s * 1e3:.1f} ms handler "
+          "time — by method, busiest first")
+    print(f"  {'method':<24}{'calls':>9}{'total_ms':>11}{'mean_us':>10}"
+          f"{'p50_us':>9}{'p99_us':>9}{'max_ms':>9}")
+    for method, st in rows:
+        n = st.get("count", 0) or 1
+        bkts = st.get("buckets") or []
+        print(f"  {method:<24}{st.get('count', 0):>9}"
+              f"{st.get('sum_s', 0.0) * 1e3:>11.1f}"
+              f"{st.get('sum_s', 0.0) / n * 1e6:>10.1f}"
+              f"{_hist_percentile(bkts, bounds, 0.5) * 1e6:>9.0f}"
+              f"{_hist_percentile(bkts, bounds, 0.99) * 1e6:>9.0f}"
+              f"{st.get('max_s', 0.0) * 1e3:>9.2f}")
+
+
+def cmd_trace(args):
+    """Print one trace's span tree (TRACE_SPAN events, parent-linked)."""
+    from ray_tpu.util import tracing
+
+    spans = tracing.get_trace(args.trace_id, address=_resolve_address(args))
+    if not spans:
+        print(f"no finished spans for trace {args.trace_id}")
+        return
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict = {}
+    roots = []
+    for s in spans:
+        pid = s.get("parent_id") or ""
+        if pid and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+
+    def emit(s, depth):
+        print(f"  {'  ' * depth}{s['name'] or '<unnamed>'}  "
+              f"[{s['kind']}]  {s['dur_s'] * 1e3:.2f} ms")
+        for c in children.get(s["span_id"], []):
+            emit(c, depth + 1)
+
+    print(f"trace {args.trace_id}: {len(spans)} spans")
+    for r in roots:
+        emit(r, 0)
 
 
 def cmd_memory(args):
@@ -630,8 +751,32 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("timeline", help="dump chrome-trace task timeline")
     sp.add_argument("--output", "-o")
+    sp.add_argument("--lifecycle", action="store_true",
+                    help="include sampled per-phase lifecycle rows")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "profile", help="sampled task-lifecycle profiler (control plane)"
+    )
+    sp.add_argument("profile_command", nargs="?", choices=["tasks"],
+                    help="tasks: per-phase latency breakdown")
+    sp.add_argument("--on", action="store_true",
+                    help="enable sampling cluster-wide")
+    sp.add_argument("--off", action="store_true", help="disable sampling")
+    sp.add_argument("--rate", type=float,
+                    help="sample probability 0..1 (with --on; default 1)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("rpc", help="per-method GCS RPC latency accounting")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_rpc)
+
+    sp = sub.add_parser("trace", help="print one trace's span tree")
+    sp.add_argument("trace_id")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("logs", help="list or tail session log files")
     sp.add_argument("filename", nargs="?", help="log file to tail")

@@ -212,12 +212,18 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         """Dispatch a request; returns a DeploymentResponse (streaming
         handles return an iterator over chunks instead)."""
+        from ray_tpu.util import tracing
+
         if self._stream:
             return self._stream_call(args, kwargs)
+        # Serve-path trace propagation: the caller's active span (or a
+        # fresh root when tracing is enabled) rides the request so the
+        # replica's execution joins the request's span tree.
+        trace_ctx = tracing.inject()
         replica = self._pick_replica()
         done = self._track(replica)
         ref = replica.handle_request.remote(
-            self.method, args, kwargs, self.multiplexed_model_id
+            self.method, args, kwargs, self.multiplexed_model_id, trace_ctx
         )
 
         failed = {replica._actor_id.binary()}
@@ -231,7 +237,8 @@ class DeploymentHandle:
             failed.add(r._actor_id.binary())
             d = self._track(r)
             new_ref = r.handle_request.remote(
-                self.method, args, kwargs, self.multiplexed_model_id
+                self.method, args, kwargs, self.multiplexed_model_id,
+                trace_ctx,
             )
             if new_ref._future is not None:
                 new_ref._future.add_done_callback(lambda _f: d())
@@ -243,10 +250,14 @@ class DeploymentHandle:
     def _stream_call(self, args, kwargs):
         """Generator deployment: yields chunks as the replica produces
         them (reference: handle_request_streaming, replica.py:478)."""
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.inject()
         replica = self._pick_replica()
         sid = rt.get(
             replica.start_stream.remote(
-                self.method, args, kwargs, self.multiplexed_model_id
+                self.method, args, kwargs, self.multiplexed_model_id,
+                trace_ctx,
             ),
             timeout=get_config().serve_rpc_timeout_s,
         )

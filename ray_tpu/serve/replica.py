@@ -53,20 +53,27 @@ class ReplicaActor:
             return self.callable
         return getattr(self.callable, method or "__call__")
 
-    def handle_request(self, method: str, args, kwargs, model_id: str = ""):
+    def handle_request(self, method: str, args, kwargs, model_id: str = "",
+                       trace_ctx: Optional[Dict[str, str]] = None):
         """Execute one request (reference: replica.py handle_request)."""
         from ray_tpu.serve.multiplex import _set_request_model_id
+        from ray_tpu.util import tracing
 
         with self._lock:
             self.ongoing += 1
         try:
             _set_request_model_id(model_id)
             target = self._target(method)
-            if inspect.iscoroutinefunction(target):
-                import asyncio
+            with tracing.activate(
+                trace_ctx,
+                f"serve.{type(self.callable).__name__}"
+                f".{method or '__call__'}",
+            ):
+                if inspect.iscoroutinefunction(target):
+                    import asyncio
 
-                return asyncio.run(target(*args, **kwargs))
-            return target(*args, **kwargs)
+                    return asyncio.run(target(*args, **kwargs))
+                return target(*args, **kwargs)
         finally:
             _set_request_model_id("")
             with self._lock:
@@ -75,7 +82,8 @@ class ReplicaActor:
 
     # -- streaming (reference: handle_request_streaming, replica.py:478) --
     def start_stream(self, method: str, args, kwargs,
-                     model_id: str = "") -> int:
+                     model_id: str = "",
+                     trace_ctx: Optional[Dict[str, str]] = None) -> int:
         """Begin a generator request; returns a stream id to poll."""
         sid = next(self._stream_ids)
         buf = _StreamBuf()
@@ -85,14 +93,20 @@ class ReplicaActor:
 
         def run():
             from ray_tpu.serve.multiplex import _set_request_model_id
+            from ray_tpu.util import tracing
 
             try:
                 _set_request_model_id(model_id)
-                gen = self._target(method)(*args, **kwargs)
-                for chunk in gen:
-                    with buf.cond:
-                        buf.chunks.append(chunk)
-                        buf.cond.notify_all()
+                with tracing.activate(
+                    trace_ctx,
+                    f"serve.{type(self.callable).__name__}"
+                    f".{method or '__call__'} [stream]",
+                ):
+                    gen = self._target(method)(*args, **kwargs)
+                    for chunk in gen:
+                        with buf.cond:
+                            buf.chunks.append(chunk)
+                            buf.cond.notify_all()
             except BaseException as e:  # noqa: BLE001 — crosses the wire
                 with buf.cond:
                     buf.error = f"{type(e).__name__}: {e}"

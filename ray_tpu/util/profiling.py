@@ -18,11 +18,42 @@ from typing import Dict, List, Optional
 _lock = threading.Lock()
 _buffer: List[dict] = []
 _last_flush = 0.0
+_flush_timer: threading.Timer | None = None
 
 # Spans recorded just before exit must still reach the timeline.
 import atexit
 
 atexit.register(lambda: _flush(force=True))
+
+
+def request_flush(delay_s: float | None = None) -> None:
+    """Schedule a forced flush within a bounded delay.
+
+    The batching path for high-rate span producers (tracing._record used
+    to force one GCS RPC per span): the first request arms a one-shot
+    timer, subsequent requests while it is armed are free, and every
+    span buffered in the window rides one add_task_events RPC. Eager
+    flushing remains only at atexit/driver exit.
+    """
+    global _flush_timer
+    if delay_s is None:
+        from ray_tpu._private.config import get_config
+
+        delay_s = get_config().trace_flush_delay_s
+    with _lock:
+        if _flush_timer is not None:
+            return
+        t = threading.Timer(delay_s, _timer_fire)
+        t.daemon = True
+        _flush_timer = t
+    t.start()
+
+
+def _timer_fire() -> None:
+    global _flush_timer
+    with _lock:
+        _flush_timer = None
+    _flush(force=True)
 
 
 def _flush(force: bool = False):

@@ -9,6 +9,7 @@ protocol; per-node worker listings fan out to each raylet's get_info.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import worker as worker_mod
@@ -18,6 +19,37 @@ from ray_tpu._private.protocol import connect
 
 def _hex(b) -> str:
     return b.hex() if isinstance(b, (bytes, bytearray)) else str(b)
+
+
+def fetch_task_events(call, page: int = 10_000, warn: bool = True) -> List[dict]:
+    """Fetch the FULL task-event ring via offset pagination.
+
+    `call` is any callable(method, payload) -> reply dict. Replaces the
+    old single `limit=100_000` fetch that silently truncated; when the
+    GCS reports evicted events ("dropped"), a warning lands on stderr so
+    truncated history is never mistaken for complete history.
+    """
+    events: List[dict] = []
+    offset = 0
+    dropped = 0
+    while True:
+        r = call("list_task_events", {"offset": offset, "limit": page})
+        evs = r.get("events", [])
+        events.extend(evs)
+        dropped = r.get("dropped", 0)
+        total = r.get("total")
+        if total is None:
+            break  # pre-pagination server: one tail page is all there is
+        offset += len(evs)
+        if not evs or offset >= total:
+            break
+    if warn and dropped:
+        print(
+            f"warning: GCS task-event ring evicted {dropped} old events; "
+            "timeline/trace history is incomplete",
+            file=sys.stderr,
+        )
+    return events
 
 
 class StateApiClient:
@@ -112,12 +144,20 @@ class StateApiClient:
                 out.append(w)
         return out
 
+    def task_events(self, warn: bool = True) -> List[dict]:
+        """Every event in the GCS ring (paginated; warns if truncated)."""
+        return fetch_task_events(self.call, warn=warn)
+
     def tasks(self, limit: int = 1000) -> List[dict]:
-        events = self.call("list_task_events", {"limit": 100_000})["events"]
+        events = self.task_events()
         # Collapse the event log into latest-state-per-task
         # (GcsTaskManager's task view).
         tasks: Dict[bytes, dict] = {}
         for ev in events:
+            if ev.get("type") == "LIFECYCLE_SPAN":
+                # Phase-mark events are per-hop profiler payloads, not
+                # task state transitions.
+                continue
             t = tasks.setdefault(
                 ev["task_id"],
                 {
@@ -217,14 +257,44 @@ class StateApiClient:
                 )
         return out
 
-    def timeline(self) -> List[dict]:
+    def timeline(self, lifecycle: bool = False) -> List[dict]:
         """Chrome-trace events (ray timeline analog,
-        _private/profiling.py:124 chrome_tracing_dump)."""
-        events = self.call("list_task_events", {"limit": 100_000})["events"]
+        _private/profiling.py:124 chrome_tracing_dump). With
+        lifecycle=True, sampled tasks' control-plane phase marks
+        (LIFECYCLE_SPAN events) become their own rows — one lane per
+        hop (client/raylet/worker) under the emitting node."""
+        events = self.task_events()
         spans: Dict[bytes, dict] = {}
         trace: List[dict] = []
         for ev in events:
             key = ev["task_id"]
+            if ev.get("type") == "LIFECYCLE_SPAN":
+                if not lifecycle:
+                    continue
+                extra = ev.get("extra") or {}
+                hop = extra.get("hop", "?")
+                for phase, mark in (extra.get("phases") or {}).items():
+                    try:
+                        start, dur = float(mark[0]), float(mark[1])
+                    except (TypeError, ValueError, IndexError):
+                        continue
+                    trace.append(
+                        {
+                            "name": phase,
+                            "cat": "lifecycle",
+                            "ph": "X",
+                            "ts": start * 1e6,
+                            "dur": dur * 1e6,
+                            "pid": "node:" + _hex(ev.get("node_id", b""))[:8],
+                            "tid": f"lifecycle:{hop}",
+                            "args": {
+                                "task_id": _hex(key),
+                                "task": ev.get("name", ""),
+                                "hop": hop,
+                            },
+                        }
+                    )
+                continue
             if ev["state"] == "RUNNING":
                 spans[key] = ev
             elif ev["state"] in ("FINISHED", "FAILED") and key in spans:
@@ -292,8 +362,8 @@ def list_workers(c):
 
 
 @_with_client
-def get_timeline(c):
-    return c.timeline()
+def get_timeline(c, lifecycle: bool = False):
+    return c.timeline(lifecycle=lifecycle)
 
 
 @_with_client

@@ -103,9 +103,12 @@ def _record(name: str, ctx: Dict[str, str], parent_id: str, start: float,
     with profiling._lock:
         profiling._buffer.append({**base, "state": "RUNNING", "ts": start})
         profiling._buffer.append({**base, "state": "FINISHED", "ts": end})
-    # Spans are low-volume and workers may idle right after a task —
-    # flush eagerly so traces are queryable as soon as the call returns.
-    profiling._flush(force=True)
+    # Bounded-delay batch flush: every span recorded inside the window
+    # rides ONE add_task_events RPC (the old force-flush here cost one
+    # GCS RPC per span — untenable once serve requests are traced).
+    # atexit still force-flushes, so spans recorded just before a worker
+    # idles out or the driver exits reach the timeline regardless.
+    profiling.request_flush()
 
 
 @contextmanager
@@ -173,10 +176,13 @@ def get_trace(trace_id: str, address: Optional[str] = None) -> List[dict]:
     """Assemble one trace's spans (finished only) from the task-event
     stream, parent-linked: [{"name", "span_id", "parent_id", "ts",
     "dur_s", "kind"}]."""
-    from ray_tpu.util.state.api import StateApiClient
+    from ray_tpu.util.state.api import StateApiClient, fetch_task_events
 
     client = StateApiClient(address)
-    events = client.call("list_task_events", {"limit": 100_000})["events"]
+    try:
+        events = fetch_task_events(client.call)
+    finally:
+        client.close()
     starts: Dict[bytes, dict] = {}
     spans: List[dict] = []
     for ev in events:

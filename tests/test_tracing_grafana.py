@@ -59,7 +59,9 @@ def test_task_spans_form_cross_process_tree(rt_start):
     from ray_tpu.util import profiling
 
     profiling.flush()
-    time.sleep(0.3)
+    # Worker-side spans ride the bounded-delay batch flush (default
+    # 0.25s) rather than an eager per-span RPC — wait out one window.
+    time.sleep(0.7)
 
     spans = tracing.get_trace(root_ctx["trace_id"])
     # Task spans carry the function qualname; match by suffix.
@@ -88,7 +90,9 @@ def test_actor_call_spans_join_the_trace(rt_start):
     from ray_tpu.util import profiling
 
     profiling.flush()
-    time.sleep(0.3)
+    # Worker-side spans ride the bounded-delay batch flush (default
+    # 0.25s) rather than an eager per-span RPC — wait out one window.
+    time.sleep(0.7)
     spans = tracing.get_trace(ctx["trace_id"])
     by_name = {s["name"]: s for s in spans}
     assert by_name["work"]["parent_id"] == by_name["actor-request"]["span_id"]

@@ -57,6 +57,30 @@ def _bench_scale_tasks(n: int, field: str):
     return get
 
 
+def _bench_scale_probe(probe: str, field: str):
+    def get():
+        for e in _load("BENCH_SCALE.json"):
+            if e.get("probe") == probe:
+                return e[field]
+        raise KeyError(f"no probe {probe!r} in BENCH_SCALE.json")
+    return get
+
+
+def _bench_scale_lifecycle(n: int, field: str, phase: str = None):
+    """Lifecycle decomposition point n=<n>: a top-level field, or one
+    phase's mean µs when ``phase`` is given."""
+    def get():
+        for e in _load("BENCH_SCALE.json"):
+            if e.get("probe") == "lifecycle phase decomposition":
+                for pt in e["points"]:
+                    if pt["n"] == n:
+                        return pt["phases_us"][phase] if phase else pt[field]
+        raise KeyError(
+            f"no lifecycle decomposition point n={n} in BENCH_SCALE.json"
+        )
+    return get
+
+
 def _bench_infer(metric_sub: str, field: str, **where):
     def get():
         for e in _load("BENCH_INFER.json"):
@@ -297,6 +321,25 @@ CLAIMS = [
           _rtlint_rule_count(), rel_tol=0.0),
     Claim("MIGRATION.md", r"holds (\d+) known findings",
           _rtlint_baseline_size(), rel_tol=0.0),
+    # Control-plane profiler <- BENCH_SCALE.json lifecycle probes.
+    # Loose tolerances on the absolute µs (wall timings on a shared
+    # 1-core box); tight on the coverage fraction, which is the claim.
+    Claim("MIGRATION.md", r"explain (0\.\d+)\s*\n?\s*of the mean",
+          _bench_scale_lifecycle(1000, "phase_sum_fraction_of_e2e"),
+          rel_tol=0.05),
+    Claim("MIGRATION.md", r"transport at ~(\d+) µs",
+          _bench_scale_lifecycle(1000, None, phase="transport"),
+          rel_tol=0.5),
+    Claim("MIGRATION.md", r"of a (\d+) µs\s*\n?\s*mean submit",
+          _bench_scale_lifecycle(1000, "us_per_task"), rel_tol=0.5),
+    Claim("MIGRATION.md", r"costs (\d+\.?\d*) GCS round-trips",
+          _bench_scale_probe("gcs rpcs per actor create",
+                             "gcs_rpcs_per_actor_create"),
+          rel_tol=0.3),
+    Claim("MIGRATION.md", r"guard ops cost (\d+\.\d+) µs",
+          _bench_scale_probe("lifecycle off-path overhead",
+                             "fastpath_ops_us_per_task"),
+          rel_tol=1.5, note="sub-µs micro-bench, noisy on a shared box"),
 ]
 
 
