@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs bench-scale
+.PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs \
+  bench-scale bench-serve-obs
 
 lint: rtlint sanitizers
 
@@ -26,6 +27,12 @@ bench-obs:
 # MIGRATION.md pins these numbers.
 bench-scale:
 	JAX_PLATFORMS=cpu $(PY) bench_scale.py
+
+# Regenerates BENCH_SERVE_OBS.json (request-observatory overhead +
+# phase-coverage + HOL probes); run tools/check_claims.py afterwards —
+# MIGRATION.md pins these numbers.
+bench-serve-obs:
+	JAX_PLATFORMS=cpu $(PY) bench_serve_obs.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

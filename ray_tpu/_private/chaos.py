@@ -40,6 +40,8 @@ _pull_delays_left: int = 0
 # the flight recorder's StepProfiler), modelling a straggling rank.
 _step_delay_s: float = 0.0
 _step_delays_left: int = 0
+_prefill_delay_s: float = 0.0
+_prefill_delays_left: int = 0
 
 
 def enabled() -> bool:
@@ -62,6 +64,7 @@ def clear():
     global _poll_delay_s, _poll_delays_left
     global _pull_delay_s, _pull_delays_left
     global _step_delay_s, _step_delays_left
+    global _prefill_delay_s, _prefill_delays_left
     with _lock:
         _injected_drain_ranks.clear()
         _poll_delay_s = 0.0
@@ -70,6 +73,8 @@ def clear():
         _pull_delays_left = 0
         _step_delay_s = 0.0
         _step_delays_left = 0
+        _prefill_delay_s = 0.0
+        _prefill_delays_left = 0
 
 
 def _require_enabled(what: str):
@@ -223,3 +228,31 @@ def take_step_delay() -> Optional[float]:
             return None
         _step_delays_left -= 1
         return _step_delay_s
+
+
+def delay_prefills(seconds: float, count: int = 1):
+    """Deterministically stretch this process's next `count` engine
+    prefill passes (consumed by ContinuousBatchingEngine at prefill
+    start) — models a long-prompt head-of-line blocker for the serve
+    observatory's HOL-attribution tests without needing a genuinely
+    huge prompt. Process-local: call it inside the replica process."""
+    _require_enabled("delay_prefills")
+    global _prefill_delay_s, _prefill_delays_left
+    with _lock:
+        _prefill_delay_s = float(seconds)
+        _prefill_delays_left = int(count)
+
+
+def take_prefill_delay() -> Optional[float]:
+    """Pop one pending prefill delay (None when chaos is off/exhausted).
+
+    Runs once per prefill pass — never on the steady-state decode path —
+    and the no-injection case exits on a plain global read."""
+    global _prefill_delays_left
+    if _prefill_delays_left <= 0 or not enabled():
+        return None
+    with _lock:
+        if _prefill_delays_left <= 0:
+            return None
+        _prefill_delays_left -= 1
+        return _prefill_delay_s

@@ -262,6 +262,21 @@ class Config:
     # ActorError (a flapping replica must not be hammered in a tight loop).
     serve_redispatch_backoff_s: float = 0.05
     serve_redispatch_backoff_max_s: float = 2.0
+    # Request observatory: always-on per-request phase attribution,
+    # per-tenant SLO accounting, and the ServeSignals autoscaling plane.
+    serve_observatory: bool = True
+    # Finished-request phase records retained per replica (ring buffer).
+    serve_obs_ring: int = 256
+    # Controller cadence for publishing the ServeSignals snapshot to the
+    # GCS KV (rt serve / autoscalers read it).
+    serve_signals_interval_s: float = 2.0
+    # A prefill pass blocking active decode slots longer than this is
+    # recorded as a head-of-line event (serve_hol_blocked_seconds_total).
+    serve_hol_threshold_s: float = 0.05
+    # Fast/slow sliding windows for per-tenant SLO burn-rate accounting
+    # (multi-window burn alerting a la SRE workbook).
+    serve_slo_fast_window_s: float = 60.0
+    serve_slo_slow_window_s: float = 600.0
 
     # -- data -------------------------------------------------------------
     # Undelivered blocks buffered per streaming_split consumer before the

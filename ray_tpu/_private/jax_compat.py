@@ -1,0 +1,19 @@
+"""Version-tolerant imports for jax APIs that moved between releases.
+
+`shard_map` graduated from `jax.experimental.shard_map` to the top-level
+`jax` namespace (jax >= 0.5); importing it from the wrong home raises
+ImportError at module import time, which darkened the whole
+parallel/collective test tree on the pinned image (ROADMAP item 4).
+Import it from here instead:
+
+    from ray_tpu._private.jax_compat import shard_map
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: public top-level API
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental home
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["shard_map"]

@@ -109,6 +109,37 @@ _FLIGHT_RECORDER_PANELS = [
     ("Serving batch occupancy", [
         {"expr": "serve_llm_batch_occupancy", "legend": "occupancy"},
     ], "percentunit"),
+    # -- request observatory --------------------------------------------
+    ("Serve request e2e p50/p99", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "serve_request_e2e_seconds_bucket[1m]))",
+         "legend": "{{app}} p50"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "serve_request_e2e_seconds_bucket[1m]))",
+         "legend": "{{app}} p99"},
+    ], "s"),
+    ("Serve request phase breakdown", [
+        {"expr": "rate(serve_request_phase_seconds_total[1m])",
+         "legend": "{{app}} {{phase}}"},
+    ], "s"),
+    ("Serve per-tenant request rate", [
+        {"expr": "rate(serve_requests_total[1m])",
+         "legend": "{{app}} {{tenant}}"},
+    ], "short"),
+    ("Serve SLO burn rate by tenant", [
+        {"expr": "serve_slo_burn_rate",
+         "legend": "{{app}} {{tenant}} {{slo}}"},
+    ], "short"),
+    ("Serve head-of-line blocking", [
+        {"expr": "rate(serve_hol_blocked_seconds_total[1m])",
+         "legend": "blocked slot-seconds/s"},
+    ], "s"),
+    ("Serve engine admission queue", [
+        {"expr": "serve_llm_waiting_requests", "legend": "waiting"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "serve_llm_admission_wait_seconds_bucket[1m]))",
+         "legend": "admission wait p99"},
+    ], "short"),
     # -- control-plane profiler -----------------------------------------
     ("GCS RPC rate by method", [
         {"expr": "rate(gcs_rpc_calls_total[1m])", "legend": "{{method}}"},

@@ -18,8 +18,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map
 
 
 def pipeline_stages(

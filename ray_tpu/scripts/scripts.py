@@ -518,16 +518,43 @@ def _render_top(snapshot, nodes) -> str:
     occ = _series_by_tags(snapshot, "serve_llm_batch_occupancy")
     ttft_c, ttft_s = _hist_total(snapshot, "serve_llm_ttft_seconds")
     tpot_c, tpot_s = _hist_total(snapshot, "serve_llm_tpot_seconds")
-    if occ or ttft_c:
+    req = _series_by_tags(snapshot, "serve_requests_total")
+    if occ or ttft_c or req:
         lines.append("serve:")
         if occ:
             lines.append(f"  batch occupancy: "
                          f"{100 * sum(v for _, v in occ) / len(occ):.0f}%")
+        waiting = _series_by_tags(snapshot, "serve_llm_waiting_requests")
+        if waiting:
+            lines.append(f"  waiting: "
+                         f"{sum(v for _, v in waiting):.0f} queued")
         if ttft_c:
             lines.append(f"  ttft: {ttft_s / ttft_c * 1e3:.1f} ms avg "
                          f"({ttft_c} requests)")
         if tpot_c:
             lines.append(f"  tpot: {tpot_s / tpot_c * 1e3:.2f} ms/token avg")
+        # Observatory phase attribution: where request wall-time goes.
+        phases = _series_by_tags(snapshot,
+                                 "serve_request_phase_seconds_total")
+        if phases:
+            total = sum(v for _, v in phases) or 1.0
+            top = sorted(phases, key=lambda x: -x[1])[:4]
+            lines.append("  phases: " + " ".join(
+                f"{t.get('phase', '?')}={100 * v / total:.0f}%"
+                for t, v in top
+            ))
+        hol = sum(v for _, v in _series_by_tags(
+            snapshot, "serve_hol_blocked_seconds_total"))
+        if hol:
+            lines.append(f"  hol blocked: {hol:.3f} slot-seconds")
+        if req:
+            by_tenant: dict = {}
+            for t, v in req:
+                key = t.get("tenant", "-")
+                by_tenant[key] = by_tenant.get(key, 0) + v
+            lines.append("  tenants: " + " ".join(
+                f"{k}={v:.0f}" for k, v in sorted(by_tenant.items())
+            ))
     return "\n".join(lines)
 
 
@@ -610,24 +637,140 @@ def cmd_job(args):
     job_cli(args, _resolve_address(args))
 
 
+def _fetch_serve_signals(address=None):
+    """Read the controller-published ServeSignals doc off the GCS KV.
+
+    No actors are dialed — one kv_get against the GCS (the controller
+    republishes each serve_signals_interval_s), so this works from any
+    machine that can reach the head. None when nothing is published."""
+    import json as _json
+
+    from ray_tpu.serve.observatory import SIGNALS_KEY
+    from ray_tpu.util.state.api import StateApiClient
+
+    client = StateApiClient(address)
+    try:
+        raw = client.call(
+            "kv_get", {"key": SIGNALS_KEY, "ns": "serve"}
+        ).get("value")
+    finally:
+        client.close()
+    if not raw:
+        return None
+    return _json.loads(raw)
+
+
+def _render_serve(doc) -> str:
+    """ServeSignals -> the `rt serve` table (deployments, replicas,
+    latency, phase breakdown, HOL, per-tenant SLO burn)."""
+    if not doc or not doc.get("apps"):
+        return "no serve signals published (is a serve app running?)"
+    age = time.time() - doc.get("ts", 0.0)
+    lines = [f"serve signals  seq={doc.get('seq')}  age={age:.1f}s"]
+    for name, app in sorted(doc["apps"].items()):
+        occ = app.get("occupancy")
+        drain = app.get("backlog_drain_s")
+        frac = app.get("phase_sum_fraction")
+        lines.append(
+            f"app {name}: qps={app.get('qps', 0.0):.2f} "
+            f"waiting={app.get('waiting', 0)}"
+            + (f" occupancy={100 * occ:.0f}%" if occ is not None else "")
+            + (f" backlog_drain={drain:.2f}s" if drain is not None else "")
+            + (f" phase_sum={100 * frac:.1f}%" if frac is not None else "")
+        )
+        for r in app.get("replicas") or []:
+            status = ("UNREACHABLE" if r.get("unreachable")
+                      else f"ongoing={r.get('ongoing')} "
+                           f"served={r.get('total_served')}")
+            hf = r.get("health_fails", 0)
+            lines.append(
+                f"  replica {r.get('actor_id', '?')[:8]}: {status}"
+                + (f" health_fails={hf}" if hf else "")
+            )
+        ttft, tpot = app.get("ttft_s") or {}, app.get("tpot_s") or {}
+        if ttft.get("n"):
+            lines.append(
+                f"  ttft p50={ttft['p50'] * 1e3:.1f}ms "
+                f"p99={ttft['p99'] * 1e3:.1f}ms (n={ttft['n']})  "
+                f"tpot p50={tpot.get('p50', 0) * 1e3:.2f}ms "
+                f"p99={tpot.get('p99', 0) * 1e3:.2f}ms"
+            )
+        phases = app.get("phases") or {}
+        if phases:
+            total = sum(p["sum_s"] for p in phases.values()) or 1.0
+            parts = [
+                f"{ph}={100 * p['sum_s'] / total:.0f}%"
+                for ph, p in sorted(
+                    phases.items(), key=lambda kv: -kv[1]["sum_s"]
+                )
+            ]
+            lines.append("  phases: " + " ".join(parts))
+        hol = app.get("hol") or {}
+        if hol.get("blocked_slot_seconds"):
+            lines.append(
+                f"  hol: {hol['blocked_slot_seconds']:.3f} "
+                f"slot-seconds blocked"
+            )
+            for ev in (hol.get("events") or [])[-3:]:
+                culprits = ", ".join(
+                    f"req {c['request_id']} ({c['prompt_tokens']} tok)"
+                    for c in ev.get("culprits") or []
+                ) or "unknown"
+                lines.append(
+                    f"    {ev['prefill_s'] * 1e3:.0f}ms prefill stalled "
+                    f"{ev['victims']} slot(s) — {culprits}"
+                )
+        for tname, t in sorted((app.get("tenants") or {}).items()):
+            burns = []
+            for w, kinds in sorted(t.get("slo_windows", {}).items(),
+                                   key=lambda kv: int(kv[0])):
+                for kind, row in sorted(kinds.items()):
+                    burns.append(
+                        f"{kind}@{w}s={row['burn']:.2f}"
+                        f"({row['total'] - row['good']}/{row['total']})"
+                    )
+            lines.append(
+                f"  tenant {tname}: req={t.get('requests', 0)} "
+                f"tokens={t.get('tokens_in', 0)}/{t.get('tokens_out', 0)}"
+                + ("  burn " + " ".join(burns) if burns else "")
+            )
+    return "\n".join(lines)
+
+
 def cmd_serve(args):
-    """`rt serve deploy <config>`: declarative deploys (reference:
-    `serve deploy`, serve/scripts.py:256)."""
+    """`rt serve [signals]`: live ServeSignals table straight off the
+    GCS KV (per-deployment QPS/occupancy/latency, per-tenant SLO burn,
+    HOL events). `rt serve deploy <config>`: declarative deploys
+    (reference: `serve deploy`, serve/scripts.py:256)."""
+    cmdname = args.serve_command or "signals"
+    if cmdname == "signals":
+        # Read-only path: one GCS kv_get, no rt.init / actor dials.
+        address = _resolve_address(args)
+        if not getattr(args, "watch", False):
+            print(_render_serve(_fetch_serve_signals(address)))
+            return
+        try:
+            while True:
+                out = _render_serve(_fetch_serve_signals(address))
+                print("\x1b[2J\x1b[H" + out, flush=True)
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
     import ray_tpu as rt
     from ray_tpu import serve
 
     rt.init(address=_resolve_address(args), num_cpus=0,
             ignore_reinit_error=True)
-    if args.serve_command == "deploy":
+    if cmdname == "deploy":
         if not args.config:
             raise SystemExit("rt serve deploy requires a config file path")
         handles = serve.run_from_config(args.config)
         print(f"deployed: {', '.join(handles) or '(nothing)'}")
-    elif args.serve_command == "status":
+    elif cmdname == "status":
         import json as _json
 
         print(_json.dumps(serve.status(), indent=2, default=str))
-    elif args.serve_command == "shutdown":
+    elif cmdname == "shutdown":
         serve.shutdown()
         print("serve shut down")
 
@@ -806,9 +949,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_top)
 
-    sp = sub.add_parser("serve", help="declarative Serve deploys")
-    sp.add_argument("serve_command", choices=["deploy", "status", "shutdown"])
+    sp = sub.add_parser(
+        "serve",
+        help="serve observability (signals) and declarative deploys",
+    )
+    sp.add_argument(
+        "serve_command", nargs="?",
+        choices=["signals", "deploy", "status", "shutdown"],
+        help="default: signals (live ServeSignals table off the GCS)",
+    )
     sp.add_argument("config", nargs="?", help="JSON/YAML app config")
+    sp.add_argument("--watch", action="store_true",
+                    help="refresh the signals table continuously")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period with --watch (seconds)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve)
 

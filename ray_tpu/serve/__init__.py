@@ -19,6 +19,7 @@ from ray_tpu.serve.deployment import (
     Application,
     AutoscalingConfig,
     Deployment,
+    SloConfig,
     deployment,
 )
 from ray_tpu.serve.batching import batch
@@ -212,6 +213,7 @@ __all__ = [
     "Deployment",
     "Application",
     "AutoscalingConfig",
+    "SloConfig",
     "DeploymentHandle",
     "run",
     "get_app_handle",

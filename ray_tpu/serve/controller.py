@@ -11,6 +11,7 @@ version number and long-poll-style refresh on change
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -58,6 +59,11 @@ class ServeController:
         # handles to the GCS KV; a restarted controller re-adopts running
         # replicas, so controller death costs no routes and no replica
         # restarts.
+        # ServeSignals publication (observatory): versioned snapshot of
+        # per-app load/latency/SLO state written to the GCS KV each
+        # serve_signals_interval_s (rt serve + autoscalers read it).
+        self._signals_seq = 0
+        self._signals_last = 0.0
         self._restore()
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
@@ -348,6 +354,8 @@ class ServeController:
                     app["init_args"],
                     app["init_kwargs"],
                     dep.user_config,
+                    name,
+                    getattr(dep, "slo", None),
                 )
                 new.append(replica)
             with self._lock:
@@ -382,6 +390,164 @@ class ServeController:
                          "(handles fall back to polling)", name,
                          exc_info=True)
 
+    def _publish_signals(self):
+        """Assemble and publish the ServeSignals snapshot (observatory).
+
+        Fans out observatory_snapshot() to every replica, merges per app
+        (QPS sums, occupancy averages, latency sample sets pool before
+        the percentile cut, per-tenant SLO window counts add before the
+        burn-rate division — burn of sums, not mean of burns), and
+        writes ONE versioned JSON document to the GCS KV under
+        ns="serve"/serve_signals. Read path needs no actors: rt serve
+        and autoscalers kv_get it straight off the GCS."""
+        from ray_tpu.serve import observatory
+
+        cfg = get_config()
+        if not cfg.serve_observatory:
+            return
+        now = time.monotonic()
+        if now - self._signals_last < cfg.serve_signals_interval_s:
+            return
+        self._signals_last = now
+        with self._lock:
+            app_replicas = {
+                name: list(app["replicas"]) for name, app in self.apps.items()
+            }
+        doc = {
+            "schema": observatory.SIGNALS_SCHEMA_VERSION,
+            "seq": self._signals_seq,
+            "ts": time.time(),
+            "apps": {},
+        }
+        self._signals_seq += 1
+        for name, replicas in app_replicas.items():
+            snaps = []
+            refs = [r.observatory_snapshot.remote() for r in replicas]
+            ready, _ = rt.wait(
+                refs, num_returns=len(refs),
+                timeout=cfg.serve_probe_timeout_s,
+            )
+            per_replica = []
+            for r, ref in zip(replicas, refs):
+                entry = {
+                    "actor_id": r._actor_id.hex(),
+                    "health_fails": self._health_fails.get(
+                        r._actor_id.binary(), 0
+                    ),
+                }
+                if ref in ready:
+                    try:
+                        snap = rt.get(ref, timeout=1.0)
+                        snaps.append(snap)
+                        entry["ongoing"] = snap.get("ongoing")
+                        entry["total_served"] = snap.get("total_served")
+                        entry["qps"] = snap.get("qps")
+                    except Exception:  # rtlint: disable=RT007 — replica mid-death; marked unreachable
+                        entry["unreachable"] = True
+                else:
+                    entry["unreachable"] = True
+                per_replica.append(entry)
+            doc["apps"][name] = self._merge_app_signals(
+                name, snaps, per_replica, cfg
+            )
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            worker_mod.get_client().kv_put(
+                observatory.SIGNALS_KEY,
+                json.dumps(doc).encode(),
+                ns="serve",
+            )
+        except Exception:  # noqa: BLE001 — next tick republishes
+            logger.debug("ServeSignals publish failed", exc_info=True)
+
+    @staticmethod
+    def _merge_app_signals(name, snaps, per_replica, cfg):
+        from ray_tpu.serve import observatory
+
+        qps = sum(s.get("qps") or 0.0 for s in snaps)
+        ttft = sorted(x for s in snaps for x in s.get("ttft_samples") or [])
+        tpot = sorted(x for s in snaps for x in s.get("tpot_samples") or [])
+        phases: Dict[str, Dict[str, float]] = {}
+        fractions = [s["phase_sum_fraction"] for s in snaps
+                     if s.get("phase_sum_fraction") is not None]
+        for s in snaps:
+            for phase, row in (s.get("phases") or {}).items():
+                agg = phases.setdefault(phase, {"sum_s": 0.0, "count": 0})
+                agg["sum_s"] += row["sum_s"]
+                agg["count"] += row["count"]
+        waiting = sum(
+            (s.get("engine") or {}).get("waiting") or 0 for s in snaps
+        )
+        occ = [
+            (s.get("engine") or {}).get("occupancy")
+            for s in snaps if (s.get("engine") or {}).get("occupancy") is not None
+        ]
+        hol_s = sum(
+            ((s.get("engine") or {}).get("hol") or {})
+            .get("blocked_slot_seconds") or 0.0
+            for s in snaps
+        )
+        hol_events = [
+            ev for s in snaps
+            for ev in (((s.get("engine") or {}).get("hol") or {})
+                       .get("events") or [])
+        ]
+        hol_events.sort(key=lambda e: e.get("ts", 0.0))
+        slo = next((s["slo"] for s in snaps if s.get("slo")), None)
+        objective = (slo or {}).get("objective", 0.99)
+        # Per-tenant merge: window counts ADD across replicas, then one
+        # burn-rate division over the pooled counts.
+        tenants: Dict[str, Dict] = {}
+        for s in snaps:
+            for tname, t in (s.get("tenants") or {}).items():
+                agg = tenants.setdefault(tname, {
+                    "requests": 0, "tokens_in": 0, "tokens_out": 0,
+                    "queue_s": 0.0, "slo_windows": {},
+                })
+                for k in ("requests", "tokens_in", "tokens_out"):
+                    agg[k] += t.get(k) or 0
+                agg["queue_s"] += t.get("queue_s") or 0.0
+                for w, kinds in (t.get("slo_windows") or {}).items():
+                    aw = agg["slo_windows"].setdefault(w, {})
+                    for kind, row in kinds.items():
+                        ar = aw.setdefault(kind, {"good": 0, "total": 0})
+                        ar["good"] += row["good"]
+                        ar["total"] += row["total"]
+        for t in tenants.values():
+            for kinds in t["slo_windows"].values():
+                for row in kinds.values():
+                    row["burn"] = observatory.burn_rate(
+                        row["good"], row["total"], objective
+                    )
+        return {
+            "replicas": per_replica,
+            "qps": qps,
+            "waiting": waiting,
+            "occupancy": sum(occ) / len(occ) if occ else None,
+            # Backlog-drain estimate: queued requests over current
+            # throughput — how many seconds of arrivals are waiting.
+            "backlog_drain_s": (waiting / qps) if qps > 0 else None,
+            "ttft_s": {
+                "p50": observatory.percentile(ttft, 0.50),
+                "p99": observatory.percentile(ttft, 0.99),
+                "n": len(ttft),
+            },
+            "tpot_s": {
+                "p50": observatory.percentile(tpot, 0.50),
+                "p99": observatory.percentile(tpot, 0.99),
+                "n": len(tpot),
+            },
+            "phases": phases,
+            "phase_sum_fraction": (
+                sum(fractions) / len(fractions) if fractions else None
+            ),
+            "hol": {"blocked_slot_seconds": hol_s,
+                    "events": hol_events[-16:]},
+            "slo": slo,
+            "tenants": tenants,
+        }
+
     def _reconcile_loop(self):
         while not self._stop.is_set():
             time.sleep(get_config().serve_reconcile_interval_s)
@@ -395,6 +561,7 @@ class ServeController:
                     self._reconcile_once(name)
                 if proxy_mode:
                     self._reconcile_proxies()
+                self._publish_signals()
             except Exception:  # noqa: BLE001 — keep reconciling; next
                 # tick retries. Logged, not swallowed: a persistent error
                 # here silently freezes replica replacement (it did once).

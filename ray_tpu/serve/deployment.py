@@ -25,6 +25,24 @@ class AutoscalingConfig:
 
 
 @dataclass
+class SloConfig:
+    """Declared latency objectives the request observatory scores every
+    finished request against, per tenant (observatory.RequestProfiler).
+
+    Each `*_ms` bound is optional: declare only the dimensions that
+    matter for the deployment (TTFT/TPOT only make sense for token
+    streams; e2e applies everywhere). `objective` is the attainment
+    target the burn-rate math divides by — 0.99 means a 1% error
+    budget, and a burn rate of 1.0 consumes it exactly on schedule.
+    """
+
+    ttft_ms: Optional[float] = None   # time-to-first-token bound
+    tpot_ms: Optional[float] = None   # mean time-per-output-token bound
+    e2e_ms: Optional[float] = None    # end-to-end request wall bound
+    objective: float = 0.99
+
+
+@dataclass
 class Deployment:
     func_or_class: Any
     name: str
@@ -33,6 +51,7 @@ class Deployment:
     max_ongoing_requests: int = 100
     autoscaling_config: Optional[AutoscalingConfig] = None
     user_config: Optional[Dict] = None
+    slo: Optional[SloConfig] = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -44,6 +63,8 @@ class Deployment:
         for k, v in overrides.items():
             if not hasattr(d, k):
                 raise ValueError(f"unknown deployment option {k!r}")
+            if k == "slo" and isinstance(v, dict):
+                v = SloConfig(**v)
             setattr(d, k, v)
         return d
 
@@ -66,8 +87,11 @@ def deployment(
     max_ongoing_requests: int = 100,
     autoscaling_config: Optional[AutoscalingConfig] = None,
     user_config: Optional[Dict] = None,
+    slo: Optional[SloConfig] = None,
 ):
     """@serve.deployment decorator (reference: serve/api.py)."""
+    if isinstance(slo, dict):
+        slo = SloConfig(**slo)
 
     def wrap(obj):
         return Deployment(
@@ -78,6 +102,7 @@ def deployment(
             max_ongoing_requests=max_ongoing_requests,
             autoscaling_config=autoscaling_config,
             user_config=user_config,
+            slo=slo,
         )
 
     if _func_or_class is not None:

@@ -71,11 +71,14 @@ class DeploymentResponse:
 class DeploymentHandle:
     def __init__(self, app_name: str, method: str = "__call__",
                  multiplexed_model_id: str = "", stream: bool = False,
-                 max_retries: int = 2, _shared=None):
+                 max_retries: int = 2, tenant: str = "", _shared=None):
         self.app_name = app_name
         self.method = method
         self.multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        # Observatory attribution label: requests from this handle are
+        # accounted (tokens, queue time, SLO burn) under this tenant.
+        self.tenant = tenant
         # Retry-on-replica-failure count (reference: router retry config).
         # Retries re-dispatch the same args — at-least-once semantics, so
         # mutating deployments should set max_retries=0 via .options().
@@ -95,7 +98,8 @@ class DeploymentHandle:
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
                 stream: Optional[bool] = None,
-                max_retries: Optional[int] = None) -> "DeploymentHandle":
+                max_retries: Optional[int] = None,
+                tenant: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name,
             method_name if method_name is not None else self.method,
@@ -103,6 +107,7 @@ class DeploymentHandle:
              else self.multiplexed_model_id),
             stream if stream is not None else self._stream,
             max_retries if max_retries is not None else self.max_retries,
+            tenant if tenant is not None else self.tenant,
             _shared=self._shared,
         )
 
@@ -219,11 +224,18 @@ class DeploymentHandle:
         # Serve-path trace propagation: the caller's active span (or a
         # fresh root when tracing is enabled) rides the request so the
         # replica's execution joins the request's span tree.
+        from ray_tpu.serve import observatory
+
+        obs_ctx = observatory.make_wire_ctx(self.tenant)
         trace_ctx = tracing.inject()
         replica = self._pick_replica()
         done = self._track(replica)
+        if obs_ctx is not None:
+            # handle_queue ends here: routing done, dispatching now.
+            obs_ctx["disp_t"] = time.time()
         ref = replica.handle_request.remote(
-            self.method, args, kwargs, self.multiplexed_model_id, trace_ctx
+            self.method, args, kwargs, self.multiplexed_model_id, trace_ctx,
+            obs_ctx,
         )
 
         failed = {replica._actor_id.binary()}
@@ -236,9 +248,13 @@ class DeploymentHandle:
             r = self._pick_replica(exclude=frozenset(failed))
             failed.add(r._actor_id.binary())
             d = self._track(r)
+            if obs_ctx is not None:
+                # Re-dispatch restarts the wire leg; the backoff before
+                # it stays attributed to handle_queue-side waiting.
+                obs_ctx["disp_t"] = time.time()
             new_ref = r.handle_request.remote(
                 self.method, args, kwargs, self.multiplexed_model_id,
-                trace_ctx,
+                trace_ctx, obs_ctx,
             )
             if new_ref._future is not None:
                 new_ref._future.add_done_callback(lambda _f: d())
@@ -250,14 +266,18 @@ class DeploymentHandle:
     def _stream_call(self, args, kwargs):
         """Generator deployment: yields chunks as the replica produces
         them (reference: handle_request_streaming, replica.py:478)."""
+        from ray_tpu.serve import observatory
         from ray_tpu.util import tracing
 
+        obs_ctx = observatory.make_wire_ctx(self.tenant)
         trace_ctx = tracing.inject()
         replica = self._pick_replica()
+        if obs_ctx is not None:
+            obs_ctx["disp_t"] = time.time()
         sid = rt.get(
             replica.start_stream.remote(
                 self.method, args, kwargs, self.multiplexed_model_id,
-                trace_ctx,
+                trace_ctx, obs_ctx,
             ),
             timeout=get_config().serve_rpc_timeout_s,
         )
@@ -287,7 +307,7 @@ class DeploymentHandle:
         return (
             DeploymentHandle,
             (self.app_name, self.method, self.multiplexed_model_id,
-             self._stream),
+             self._stream, self.max_retries, self.tenant),
         )
 
     def __call__(self, *args, **kwargs):

@@ -45,7 +45,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu._private import chaos
 from ray_tpu._private.config import get_config
+from ray_tpu.serve import observatory
 from ray_tpu.models.transformer import (
     TransformerConfig,
     _act,
@@ -121,6 +123,24 @@ def _engine_metrics() -> Dict:
                     "serve_llm_batch_occupancy",
                     "Decoding slots in use / total slots, sampled every "
                     "engine step (how full the continuous batch runs)",
+                ),
+                "waiting": Gauge(
+                    "serve_llm_waiting_requests",
+                    "Requests enqueued but not yet granted a decode slot "
+                    "(admission queue depth; the backlog half of the "
+                    "autoscaling signal next to occupancy)",
+                ),
+                "admission_wait_s": Histogram(
+                    "serve_llm_admission_wait_seconds",
+                    "submit() enqueue to decode-slot grant, per request "
+                    "(pure queueing: saturation shows here before TTFT)",
+                    boundaries=_mx.LATENCY_BOUNDARIES,
+                ),
+                "hol_s": Counter(
+                    "serve_hol_blocked_seconds_total",
+                    "Decode-slot-seconds stalled behind prefill passes "
+                    "crossing serve_hol_threshold_s (head-of-line "
+                    "blocking attributed to the long prefill causing it)",
                 ),
             }
         return _metrics
@@ -351,6 +371,9 @@ class GenerationHandle:
         # submitted_at; the first/terminal pushes yield TTFT/TPOT.
         self.submitted_at: Optional[float] = None
         self._first_token_t: Optional[float] = None
+        # Observatory stamp card (set by submit() from the request
+        # thread's context; engine thread writes marks into it).
+        self.obs = None
 
     # -- engine side --
     def _push(self, token: int, done: bool):
@@ -371,6 +394,13 @@ class GenerationHandle:
             m["tpot_s"].observe(
                 (now - self._first_token_t) / (self.produced - 1)
             )
+        obs = self.obs
+        if obs is not None:
+            if first:
+                obs.marks["first_token"] = now
+            if done:
+                obs.marks["engine_done"] = now
+                obs.tokens_out = self.produced
 
     def _fail(self, err: BaseException):
         with self._cond:
@@ -513,6 +543,13 @@ class ContinuousBatchingEngine:
         self._next_id = 0
         self._steps = 0  # decode-step counter (observability + tests)
         self._recompiles = 0  # compilations observed after warmup
+        # Head-of-line ledger (engine thread writes, stats() reads under
+        # the lock): recent prefill passes that stalled active decode
+        # slots past serve_hol_threshold_s, blamed on the prefilling
+        # request(s) that ran in the pass.
+        self._hol_events: deque = deque(maxlen=64)
+        self._hol_blocked_s = 0.0
+        self._last_prefill_work: list = []
         self._warmup()
         self._warm_compiles = self._compile_count()
         self._last_compiles = self._warm_compiles
@@ -629,6 +666,7 @@ class ContinuousBatchingEngine:
             max_new_tokens = self.default_max_new_tokens
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        obs = observatory.current()
         with self._lock:
             h = GenerationHandle(self._next_id)
             self._next_id += 1
@@ -638,7 +676,14 @@ class ContinuousBatchingEngine:
             h.temperature = float(temperature)
             h.top_k = int(top_k or 0)
             h.top_p = float(1.0 if top_p is None else top_p)
+            # Adopt the request thread's stamp card: engine admission
+            # wait is measured from THIS enqueue, not from slot grant.
+            h.obs = obs
+            if obs is not None:
+                obs.marks["engine_enqueue"] = h.submitted_at
+                obs.tokens_in = len(prompt)
             self._waiting.append(h)
+            _engine_metrics()["waiting"].set(float(len(self._waiting)))
         self._work.set()
         return h
 
@@ -679,6 +724,12 @@ class ContinuousBatchingEngine:
                     "tpot": _engine_metrics()["tpot_s"].summary(),
                     "occupancy": len(self._slots) / self.num_slots,
                 },
+                # Head-of-line ledger: decode stalls attributed to the
+                # long prefill that caused them (observatory + rt serve).
+                "hol": {
+                    "blocked_slot_seconds": self._hol_blocked_s,
+                    "events": list(self._hol_events),
+                },
             }
 
     def shutdown(self):
@@ -703,8 +754,16 @@ class ContinuousBatchingEngine:
         prefill ONE chunk per loop iteration (_advance_prefills), so a
         long prompt never stalls other slots' decode for more than a
         chunk."""
+        admitted = self._waiting and self._free
         while self._free and self._waiting:
             h = self._waiting.popleft()
+            grant_t = time.perf_counter()
+            if h.submitted_at is not None:
+                _engine_metrics()["admission_wait_s"].observe(
+                    grant_t - h.submitted_at
+                )
+            if h.obs is not None:
+                h.obs.marks["slot_grant"] = grant_t
             # Deliverable budget: the loop cuts a sequence at lengths >=
             # max_len - 2 (one in-flight pipelined step keeps a margin
             # row), so a prompt of P rows can emit max_len - 1 - P
@@ -715,6 +774,8 @@ class ContinuousBatchingEngine:
             )
             slot = self._free.popleft()
             self._prefilling[slot] = {"h": h, "offset": 0}
+        if admitted:
+            _engine_metrics()["waiting"].set(float(len(self._waiting)))
 
     # Single-writer: KV cache, rng, and token buffers are engine-thread-
     # owned device state; no other thread touches them after __init__.
@@ -729,6 +790,20 @@ class ContinuousBatchingEngine:
         all of this round's first tokens to their handles — not one
         blocking scalar device_get per request."""
         c = self.prefill_chunk
+        # Chaos hook: a deterministic stretch stands in for a genuinely
+        # huge prompt so HOL-attribution tests don't need one. Inside
+        # the timed window on purpose — the watchdog must see it.
+        injected = chaos.take_prefill_delay()
+        if injected:
+            time.sleep(injected)
+        self._last_prefill_work = [
+            {
+                "request_id": e["h"].request_id,
+                "prompt_tokens": int(len(e["h"].prompt)),
+                "offset": int(e["offset"]),
+            }
+            for e in self._prefilling.values()
+        ]
         finished = []  # (slot, handle, first-token device array [1])
         for slot, entry in list(self._prefilling.items()):
             h, off = entry["h"], entry["offset"]
@@ -791,6 +866,28 @@ class ContinuousBatchingEngine:
                     self._active[slot] = True
                     self._params_dirty = True
 
+    def _note_hol(self, prefill_s: float, n_active: int):
+        """Attribute a slow prefill pass to the decode slots it stalled.
+
+        Chunked prefill bounds the stall at one chunk per pass, but a
+        pass can still cross the threshold (huge chunk, slow host, chaos
+        injection). Cost: one get_config() + comparison per PREFILL
+        pass; the steady-state decode loop never reaches here."""
+        if n_active <= 0 or prefill_s < get_config().serve_hol_threshold_s:
+            return
+        blocked = prefill_s * n_active  # slot-seconds of stalled decode
+        culprits = self._last_prefill_work
+        with self._lock:
+            self._hol_blocked_s += blocked
+            self._hol_events.append({
+                "ts": time.time(),
+                "prefill_s": prefill_s,
+                "victims": n_active,
+                "blocked_slot_seconds": blocked,
+                "culprits": culprits,
+            })
+        _engine_metrics()["hol_s"].inc(blocked)
+
     def _loop(self):
         """Pipelined decode loop with ASYNC double-buffered fetch:
         dispatch step k+1 (inputs taken from step k's ON-DEVICE pick),
@@ -810,7 +907,16 @@ class ContinuousBatchingEngine:
                 t_iter = time.perf_counter()
                 with self._lock:
                     self._admit_locked()
-                self._advance_prefills()
+                # HOL watchdog: prefill passes (never the bare decode
+                # path) are timed, and a pass that stalls active decode
+                # slots past serve_hol_threshold_s is recorded with the
+                # prefilling request(s) to blame. Zero cost when nothing
+                # is prefilling.
+                if self._prefilling:
+                    n_active = len(self._slots)
+                    t_pf = time.perf_counter()
+                    self._advance_prefills()
+                    self._note_hol(time.perf_counter() - t_pf, n_active)
                 with self._lock:
                     snapshot = [
                         (s, int(self._gen[s]), h)
@@ -899,6 +1005,7 @@ class ContinuousBatchingEngine:
                     m["fetch_ms"].observe(fetch_s * 1e3)
                     m["host_ms"].observe(host_s * 1e3)
                     m["occupancy"].set(len(snapshot) / self.num_slots)
+                    m["waiting"].set(float(len(self._waiting)))
                     compiles = self._compile_count()
                     grew = compiles - self._last_compiles
                     if grew > 0:

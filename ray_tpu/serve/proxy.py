@@ -61,6 +61,7 @@ class ProxyActor:
         async def handle_request(request: web.Request):
             app_name = request.match_info["app"]
             model_id = request.headers.get("serve_multiplexed_model_id", "")
+            tenant = request.headers.get("serve_tenant", "")
             want_stream = (
                 request.query.get("stream") == "1"
                 or "text/event-stream" in request.headers.get("Accept", "")
@@ -73,6 +74,9 @@ class ProxyActor:
             handle = get_handle(app_name)
             if model_id:
                 handle = handle.options(multiplexed_model_id=model_id)
+            if tenant:
+                # Observatory attribution: per-tenant tokens/SLO burn.
+                handle = handle.options(tenant=tenant)
 
             def dispatch(h):
                 if isinstance(payload, dict):
@@ -141,8 +145,8 @@ class ProxyActor:
             """Binary-framed ingress (the reference gRPC proxy's role,
             serve/_private/grpc_util.py): length-prefixed msgpack frames —
             the same wire format the C++ client speaks — carrying
-            {app, method?, args?, kwargs?, multiplexed_model_id?}. The
-            result must be msgpack-encodable."""
+            {app, method?, args?, kwargs?, multiplexed_model_id?,
+            tenant?}. The result must be msgpack-encodable."""
             app_name = d["app"]
             handle = get_handle(app_name)
             if d.get("method") and d["method"] != "__call__":
@@ -151,6 +155,8 @@ class ProxyActor:
                 handle = handle.options(
                     multiplexed_model_id=d["multiplexed_model_id"]
                 )
+            if d.get("tenant"):
+                handle = handle.options(tenant=d["tenant"])
             args = d.get("args") or []
             kwargs = d.get("kwargs") or {}
             loop = asyncio.get_event_loop()

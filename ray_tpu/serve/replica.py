@@ -32,7 +32,8 @@ class _StreamBuf:
 
 @rt.remote
 class ReplicaActor:
-    def __init__(self, cls_or_fn, init_args, init_kwargs, user_config=None):
+    def __init__(self, cls_or_fn, init_args, init_kwargs, user_config=None,
+                 app_name: str = "", slo=None):
         self._is_function = not inspect.isclass(cls_or_fn)
         if self._is_function:
             self.callable = cls_or_fn
@@ -47,6 +48,12 @@ class ReplicaActor:
         self._streams: Dict[int, _StreamBuf] = {}
         self._stream_ids = itertools.count(1)
         self._lock = threading.Lock()
+        # Label this process's request observatory with the deployment
+        # name + declared SLO (one replica per process).
+        self._app_name = app_name or type(self.callable).__name__
+        from ray_tpu.serve import observatory
+
+        observatory.configure(self._app_name, slo)
 
     def _target(self, method: str):
         if self._is_function:
@@ -54,13 +61,16 @@ class ReplicaActor:
         return getattr(self.callable, method or "__call__")
 
     def handle_request(self, method: str, args, kwargs, model_id: str = "",
-                       trace_ctx: Optional[Dict[str, str]] = None):
+                       trace_ctx: Optional[Dict[str, str]] = None,
+                       obs_ctx: Optional[Dict] = None):
         """Execute one request (reference: replica.py handle_request)."""
         from ray_tpu.serve.multiplex import _set_request_model_id
+        from ray_tpu.serve import observatory
         from ray_tpu.util import tracing
 
         with self._lock:
             self.ongoing += 1
+        octx = observatory.begin(obs_ctx, self._app_name, method)
         try:
             _set_request_model_id(model_id)
             target = self._target(method)
@@ -75,6 +85,7 @@ class ReplicaActor:
                     return asyncio.run(target(*args, **kwargs))
                 return target(*args, **kwargs)
         finally:
+            observatory.finish(octx)
             _set_request_model_id("")
             with self._lock:
                 self.ongoing -= 1
@@ -83,7 +94,8 @@ class ReplicaActor:
     # -- streaming (reference: handle_request_streaming, replica.py:478) --
     def start_stream(self, method: str, args, kwargs,
                      model_id: str = "",
-                     trace_ctx: Optional[Dict[str, str]] = None) -> int:
+                     trace_ctx: Optional[Dict[str, str]] = None,
+                     obs_ctx: Optional[Dict] = None) -> int:
         """Begin a generator request; returns a stream id to poll."""
         sid = next(self._stream_ids)
         buf = _StreamBuf()
@@ -93,8 +105,13 @@ class ReplicaActor:
 
         def run():
             from ray_tpu.serve.multiplex import _set_request_model_id
+            from ray_tpu.serve import observatory
             from ray_tpu.util import tracing
 
+            # begin() in THIS thread: the generator body (and its
+            # engine submit()) executes here, so thread-local capture
+            # lands the engine's marks on this request's card.
+            octx = observatory.begin(obs_ctx, self._app_name, method)
             try:
                 _set_request_model_id(model_id)
                 with tracing.activate(
@@ -111,6 +128,7 @@ class ReplicaActor:
                 with buf.cond:
                     buf.error = f"{type(e).__name__}: {e}"
             finally:
+                observatory.finish(octx)
                 _set_request_model_id("")
                 with buf.cond:
                     buf.done = True
@@ -170,6 +188,31 @@ class ReplicaActor:
             if sizes:
                 out["batch_sizes"] = sizes
         return out
+
+    def observatory_snapshot(self) -> Dict:
+        """Per-replica half of ServeSignals (controller merges these
+        across replicas each publish tick)."""
+        from ray_tpu.serve import observatory
+
+        snap = observatory.profiler().snapshot()
+        snap["ongoing"] = self.ongoing
+        snap["total_served"] = self.total_served
+        # Engine-backed deployments contribute occupancy/backlog/HOL.
+        if not self._is_function:
+            engine = getattr(self.callable, "engine", None)
+            if engine is not None and hasattr(engine, "stats"):
+                try:
+                    es = engine.stats()
+                    snap["engine"] = {
+                        "active": es.get("active"),
+                        "waiting": es.get("waiting"),
+                        "prefilling": es.get("prefilling"),
+                        "occupancy": es.get("latency", {}).get("occupancy"),
+                        "hol": es.get("hol"),
+                    }
+                except Exception:  # rtlint: disable=RT007 — snapshot is best-effort
+                    pass
+        return snap
 
     def reconfigure(self, user_config):
         if hasattr(self.callable, "reconfigure"):

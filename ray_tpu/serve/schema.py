@@ -17,7 +17,9 @@ Config shape (mirrors the reference's ServeDeploySchema subset):
           "deployments": [
             {"name": "Summarizer", "num_replicas": 2,
              "max_ongoing_requests": 16,
-             "ray_actor_options": {"resources": {"TPU": 4}}}
+             "ray_actor_options": {"resources": {"TPU": 4}},
+             "slo": {"ttft_ms": 200, "e2e_ms": 2000,
+                     "objective": 0.99}}       # observatory SLO targets
           ]
         }
       ],

@@ -52,7 +52,8 @@ class XlaLocalGroup:
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax import shard_map
+
+        from ray_tpu._private.jax_compat import shard_map
 
         reducer = {
             ReduceOp.SUM: jax.lax.psum,

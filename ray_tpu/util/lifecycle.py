@@ -52,6 +52,19 @@ PHASE_ORDER = (
 #: on queue/exec time already counted), so it stays out of the sum.
 SUM_PHASES = frozenset(PHASE_ORDER) - {"get_wait"}
 
+#: Canonical phase order for SERVE requests (serve.observatory emits
+#: these on sampled requests; display order for rt trace / aggregate).
+#: `exec` replaces the four engine phases on non-engine deployments.
+SERVE_PHASE_ORDER = (
+    "handle_queue",           # caller: .remote() → router dispatch
+    "dispatch",               # wire + replica pre-engine work
+    "engine_admission_wait",  # engine queue → decode-slot grant
+    "prefill",                # slot grant → first token
+    "decode",                 # first token → terminal token
+    "stream",                 # terminal token → reply handed back
+    "exec",                   # non-engine deployments: user callable body
+)
+
 #: Fast-path guard: hops check this module attribute before doing ANY
 #: sampling work. Only set_sample_rate flips it.
 enabled = False
@@ -240,7 +253,7 @@ def aggregate(records: Dict[str, dict]) -> Dict[str, dict]:
             "p99_us": _percentile(vals, 0.99),
         }
 
-    for phase in PHASE_ORDER:
+    for phase in PHASE_ORDER + SERVE_PHASE_ORDER:
         if phase in by_phase:
             out[phase] = _row(by_phase.pop(phase))
     for phase, vals in sorted(by_phase.items()):  # unknown extras last

@@ -49,6 +49,17 @@ def request_flush(delay_s: float | None = None) -> None:
     t.start()
 
 
+def buffer_events(events: List[dict], flush_delay_s: float | None = None) -> None:
+    """Append pre-built task events (e.g. serve LIFECYCLE_SPANs) to the
+    batched flush buffer. Rides the same armed-timer add_task_events
+    batching as spans — no per-event GCS RPC."""
+    if not events:
+        return
+    with _lock:
+        _buffer.extend(events)
+    request_flush(flush_delay_s)
+
+
 def _timer_fire() -> None:
     global _flush_timer
     with _lock:
