@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs \
-  bench-scale bench-serve-obs
+  bench-scale bench-serve-obs bench-serve-ft
 
 lint: rtlint sanitizers
 
@@ -33,6 +33,12 @@ bench-scale:
 # MIGRATION.md pins these numbers.
 bench-serve-obs:
 	JAX_PLATFORMS=cpu $(PY) bench_serve_obs.py
+
+# Regenerates BENCH_SERVE_FT.json (survival-plane probes: chaos TTFT,
+# shed latency, drain, controller failover); run tools/check_claims.py
+# afterwards — MIGRATION.md pins these numbers.
+bench-serve-ft:
+	JAX_PLATFORMS=cpu $(PY) bench_serve_ft.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

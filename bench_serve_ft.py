@@ -1,0 +1,357 @@
+"""Serve survival-plane benchmarks under sustained load. Writes
+BENCH_SERVE_FT.json.
+
+Fault tolerance is only worth its complexity if the plane keeps its
+latency shape while things die, so every probe here runs REAL traffic
+against the full serve stack (controller, replicas, handles) and injects
+the failure mid-stream — each with an explicit pass/fail gate:
+
+  1. sustained QPS through replica chaos: closed-loop streaming clients
+     drive a 3-replica app for a no-chaos baseline phase, then the same
+     load while a chaos loop SIGKILLs a replica every ~2 s (the
+     controller respawns them; handles resume streams at the delivered
+     chunk offset). Gates: p99 TTFT under chaos <= 3x the no-chaos
+     baseline, and ZERO lost non-shed requests.
+  2. overload burst shed latency: one saturated single-slot replica, a
+     burst of requests that must all shed handle-side. The shed decision
+     is synchronous and RPC-free, so its price is the admission math
+     itself. Gates: every burst request sheds typed, p99 shed decision
+     < 5 ms.
+  3. graceful drain: replicas with in-flight work are drained directly;
+     the drain must wait for the work (duration >= remaining work) and
+     the in-flight results must all land. Gate: zero lost in-flight.
+  4. controller kill+restart under traffic: a client hammers an app
+     while the controller is chaos-killed (restart=True). Handles serve
+     cached routes through the outage. Gates: zero failed requests,
+     controller back (status() answers) before the phase ends.
+
+Run: python bench_serve_ft.py [--quick]  (--quick: shorter phases, no
+artifact). Exits non-zero when a gate fails.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import threading
+import time
+
+BASE_PHASE_S = 8.0        # per traffic phase (baseline / chaos)
+CLIENTS = 4               # closed-loop client threads
+BURSTS = 300              # shed-latency burst size
+KILL_PERIOD_S = 2.0       # replica kill cadence under chaos
+
+
+def _pct(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def probe_chaos_ttft(results, quick: bool):
+    """Streaming TTFT under replica chaos vs a clean baseline."""
+    from ray_tpu import serve
+    from ray_tpu._private import chaos
+
+    phase_s = 3.0 if quick else BASE_PHASE_S
+
+    @serve.deployment(num_replicas=3)
+    class Gen:
+        def __call__(self, n=4):
+            time.sleep(0.1)  # model work before the first token
+            yield 0
+            for i in range(1, n):
+                time.sleep(0.01)
+                yield i
+
+    h = serve.run(Gen.bind())
+    # Warm: routes cached, replicas imported.
+    list(h.options(stream=True).remote(2))
+
+    def run_phase(chaos_on):
+        ttfts, lost, done = [], [], [0]
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    it = iter(h.options(stream=True).remote(4))
+                    next(it)
+                    ttfts.append(time.perf_counter() - t0)
+                    for _ in it:
+                        pass
+                    done[0] += 1
+                except Exception as e:  # noqa: BLE001 — tally, gate below
+                    from ray_tpu.exceptions import ServeOverloadedError
+                    if isinstance(e, ServeOverloadedError):
+                        nonlocal_shed[0] += 1
+                    else:
+                        lost.append(f"{type(e).__name__}: {e}")
+
+        nonlocal_shed = [0]
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(CLIENTS)]
+        kills = [0]
+
+        def killer():
+            while not stop.is_set():
+                time.sleep(KILL_PERIOD_S)
+                if stop.is_set():
+                    break
+                try:
+                    chaos.kill_replica("Gen", 0)
+                    kills[0] += 1
+                except Exception:  # noqa: BLE001 — replica set in flux
+                    pass
+
+        for t in threads:
+            t.start()
+        kt = None
+        if chaos_on:
+            kt = threading.Thread(target=killer, daemon=True)
+            kt.start()
+        time.sleep(phase_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        if kt:
+            kt.join(timeout=10)
+        return ttfts, lost, nonlocal_shed[0], done[0], kills[0]
+
+    base_ttfts, base_lost, _, base_done, _ = run_phase(False)
+    chaos.enable()
+    try:
+        chaos_ttfts, chaos_lost, chaos_shed, chaos_done, kills = \
+            run_phase(True)
+    finally:
+        chaos.disable()
+        chaos.clear()
+    base_p99 = _pct(base_ttfts, 0.99)
+    chaos_p99 = _pct(chaos_ttfts, 0.99)
+    ratio = chaos_p99 / base_p99 if base_p99 else float("inf")
+    lost = base_lost + chaos_lost
+    entry = {
+        "metric": "sustained streaming QPS through replica chaos",
+        "phase_s": phase_s,
+        "clients": CLIENTS,
+        "requests_baseline": base_done,
+        "requests_chaos": chaos_done,
+        "replicas_killed": kills,
+        "shed": chaos_shed,
+        "baseline_ttft_p50_ms": round(_pct(base_ttfts, 0.5) * 1e3, 2),
+        "baseline_ttft_p99_ms": round(base_p99 * 1e3, 2),
+        "chaos_ttft_p50_ms": round(_pct(chaos_ttfts, 0.5) * 1e3, 2),
+        "chaos_ttft_p99_ms": round(chaos_p99 * 1e3, 2),
+        "chaos_over_baseline_p99": round(ratio, 3),
+        "lost_non_shed": len(lost),
+        "lost_samples": lost[:5],
+        "gate": "chaos_over_baseline_p99 <= 3 and lost_non_shed == 0 "
+                "and replicas_killed >= 1",
+        "pass": ratio <= 3.0 and not lost and kills >= 1,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+    serve.delete("Gen")
+
+
+def probe_shed_latency(results, quick: bool):
+    """Handle-side shed decision latency under an overload burst."""
+    from ray_tpu import serve
+    from ray_tpu._private.config import get_config
+    from ray_tpu.exceptions import ServeOverloadedError
+
+    cfg = get_config()
+    saved = cfg.serve_max_queued_per_replica
+    cfg.serve_max_queued_per_replica = 1
+
+    @serve.deployment(max_ongoing_requests=1)
+    class Busy:
+        def __call__(self, s=0.0):
+            time.sleep(s)
+            return s
+
+    try:
+        h = serve.run(Busy.bind())
+        h.remote(0.0).result(timeout=60)  # warm route cache
+        admitted = [h.remote(3.0), h.remote(3.0)]  # saturate: 1 run + 1 queue
+        n = 50 if quick else BURSTS
+        shed_lat, not_shed = [], 0
+        for _ in range(n):
+            t0 = time.perf_counter()
+            try:
+                h.remote(0.0)
+                not_shed += 1
+            except ServeOverloadedError:
+                shed_lat.append(time.perf_counter() - t0)
+        for r in admitted:
+            r.result(timeout=60)
+        p99_ms = _pct(shed_lat, 0.99) * 1e3
+        entry = {
+            "metric": "overload burst shed decision latency (handle-side)",
+            "burst": n,
+            "shed": len(shed_lat),
+            "not_shed": not_shed,
+            "shed_p50_us": round(_pct(shed_lat, 0.5) * 1e6, 1),
+            "shed_p99_ms": round(p99_ms, 4),
+            "gate": "shed == burst and shed_p99_ms < 5",
+            "pass": len(shed_lat) == n and p99_ms < 5.0,
+        }
+        print(json.dumps(entry))
+        results.append(entry)
+        serve.delete("Busy")
+    finally:
+        cfg.serve_max_queued_per_replica = saved
+
+
+def probe_drain(results, quick: bool):
+    """Graceful drain waits for in-flight work; nothing is lost."""
+    import ray_tpu as rt
+    from ray_tpu.serve.replica import ReplicaActor
+
+    def napper(s):
+        time.sleep(s)
+        return s
+
+    rounds = 2 if quick else 4
+    durations, lost = [], 0
+    for i in range(rounds):
+        work_s = 0.3 + 0.15 * i
+        rep = ReplicaActor.options(max_concurrency=8).remote(napper, (), {})
+        refs = [rep.handle_request.remote("__call__", (work_s,), {})
+                for _ in range(3)]
+        time.sleep(0.1)  # the requests are admitted and executing
+        d = rt.get(rep.drain.remote(10.0), timeout=30)
+        durations.append(d["duration_s"])
+        for ref in refs:
+            try:
+                assert rt.get(ref, timeout=10) == work_s
+            except Exception:  # noqa: BLE001 — a loss is the gate failure
+                lost += 1
+        rt.kill(rep)
+    entry = {
+        "metric": "graceful drain with in-flight requests",
+        "drains": rounds,
+        "inflight_per_drain": 3,
+        "drain_p50_s": round(_pct(durations, 0.5), 3),
+        "drain_max_s": round(max(durations), 3),
+        "lost_inflight": lost,
+        "gate": "lost_inflight == 0 and drain_max_s < 10",
+        "pass": lost == 0 and max(durations) < 10.0,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def probe_controller_failover(results, quick: bool):
+    """Traffic must flow through a controller kill + restart."""
+    from ray_tpu import serve
+    from ray_tpu._private import chaos
+
+    phase_s = 4.0 if quick else BASE_PHASE_S
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x + 1
+
+    h = serve.run(echo.bind())
+    assert h.remote(1).result(timeout=60) == 2  # routes cached
+    ok, failed = [0], []
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            try:
+                if h.remote(i).result(timeout=60) == i + 1:
+                    ok[0] += 1
+                else:
+                    failed.append("wrong result")
+            except Exception as e:  # noqa: BLE001 — tally, gate below
+                failed.append(f"{type(e).__name__}: {e}")
+            i += 1
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    chaos.enable()
+    down_t = time.perf_counter()
+    try:
+        chaos.drop_controller(restart=True)
+        # Wait for the restarted controller to answer status() again.
+        recovered_s = None
+        deadline = time.time() + phase_s
+        while time.time() < deadline:
+            try:
+                if "echo" in serve.status():
+                    recovered_s = time.perf_counter() - down_t
+                    break
+            except Exception:  # noqa: BLE001 — restart races are the probe
+                pass
+            time.sleep(0.1)
+        time.sleep(1.0)  # more traffic against the restored controller
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        chaos.disable()
+        chaos.clear()
+    entry = {
+        "metric": "controller kill+restart under traffic",
+        "requests_ok": ok[0],
+        "requests_failed": len(failed),
+        "failed_samples": failed[:5],
+        "controller_recovery_s": round(recovered_s, 3)
+        if recovered_s is not None else None,
+        "gate": "requests_failed == 0 and controller_recovery_s != None",
+        "pass": not failed and recovered_s is not None,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+    serve.delete("echo")
+
+
+def main():
+    quick = "--quick" in sys.argv
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    rt.init(num_cpus=8)
+    results = []
+    try:
+        probe_chaos_ttft(results, quick)
+        probe_shed_latency(results, quick)
+        probe_drain(results, quick)
+        probe_controller_failover(results, quick)
+    finally:
+        serve.shutdown()
+        rt.shutdown()
+    total_lost = sum(
+        r.get("lost_non_shed", 0) + r.get("lost_inflight", 0)
+        + r.get("requests_failed", 0) for r in results
+    )
+    summary = {
+        "metric": "survival plane summary",
+        "lost_requests_total": total_lost,
+        "gate": "lost_requests_total == 0",
+        "pass": total_lost == 0,
+    }
+    print(json.dumps(summary))
+    results.append(summary)
+    if not quick:
+        with open("BENCH_SERVE_FT.json", "w") as f:
+            json.dump(results, f, indent=1)
+    failed = [r["metric"] for r in results if r.get("pass") is False]
+    if failed:
+        print(f"GATE FAILURES: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,22 @@ Driver-side injections (drain, poll delay) live in process-local state;
 worker-side helpers (`die`, `sever_dcn_peer`) execute inside the worker
 that calls them — ship them there with `worker_group.execute*` or call
 them from the training loop itself.
+
+Injection table (all gated on RT_CHAOS=1):
+
+  hook                      | fires in          | models
+  --------------------------|-------------------|------------------------
+  die()                     | calling worker    | host preemption
+  sever_dcn_peer(rank)      | calling worker    | network partition
+  kill_rank(group, rank)    | driver            | train-worker host death
+  inject_drain(ranks)       | driver            | preemption notice
+  delay_polls(s, n)         | driver            | saturated control plane
+  delay_object_pulls(s, n)  | raylet (local)    | slow cross-node fetch
+  delay_steps(s, n)         | calling process   | straggling train rank
+  delay_prefills(s, n)      | replica process   | huge-prompt HOL blocker
+  kill_replica(app, index)  | driver            | serve replica death
+  delay_dispatch(s, n)      | handle process    | slow router dispatch
+  drop_controller()         | driver            | serve controller crash
 """
 
 from __future__ import annotations
@@ -42,6 +58,11 @@ _step_delay_s: float = 0.0
 _step_delays_left: int = 0
 _prefill_delay_s: float = 0.0
 _prefill_delays_left: int = 0
+# Deterministic delay applied to the next handle dispatches (consumed by
+# DeploymentHandle.remote before the replica call), modelling a slow
+# router so deadline-propagation tests can burn budget at a chosen hop.
+_dispatch_delay_s: float = 0.0
+_dispatch_delays_left: int = 0
 
 
 def enabled() -> bool:
@@ -65,6 +86,7 @@ def clear():
     global _pull_delay_s, _pull_delays_left
     global _step_delay_s, _step_delays_left
     global _prefill_delay_s, _prefill_delays_left
+    global _dispatch_delay_s, _dispatch_delays_left
     with _lock:
         _injected_drain_ranks.clear()
         _poll_delay_s = 0.0
@@ -75,6 +97,8 @@ def clear():
         _step_delays_left = 0
         _prefill_delay_s = 0.0
         _prefill_delays_left = 0
+        _dispatch_delay_s = 0.0
+        _dispatch_delays_left = 0
 
 
 def _require_enabled(what: str):
@@ -256,3 +280,69 @@ def take_prefill_delay() -> Optional[float]:
             return None
         _prefill_delays_left -= 1
         return _prefill_delay_s
+
+
+# -- serve-side faults ----------------------------------------------------
+def kill_replica(app: str, index: int = 0):
+    """Hard-kill one replica of a serve app (the serving analog of
+    kill_rank): looks the current replica set up from the controller and
+    SIGKILLs replica `index`. Deterministic: the caller picks which
+    replica dies and when; the controller's health pass + the handles'
+    redispatch path then have to recover. Returns the killed replica's
+    actor id hex so tests can assert replacement."""
+    _require_enabled("kill_replica")
+    import ray_tpu as rt
+    from ray_tpu._private.config import get_config
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    ctrl = rt.get_actor(CONTROLLER_NAME)
+    info = rt.get(ctrl.get_replicas.remote(app),
+                  timeout=get_config().serve_probe_timeout_s)
+    replicas = info["replicas"]
+    if not replicas:
+        raise RuntimeError(f"chaos.kill_replica: app {app!r} has no replicas")
+    victim = replicas[index % len(replicas)]
+    rt.kill(victim)
+    return victim._actor_id.hex()
+
+
+def delay_dispatch(seconds: float, count: int = 1):
+    """Deterministically slow down this process's next `count` handle
+    dispatches (consumed by DeploymentHandle.remote just before the
+    replica call) — lets deadline-propagation tests burn a request's
+    budget at the dispatch hop without nondeterministic sleeps."""
+    _require_enabled("delay_dispatch")
+    global _dispatch_delay_s, _dispatch_delays_left
+    with _lock:
+        _dispatch_delay_s = float(seconds)
+        _dispatch_delays_left = int(count)
+
+
+def take_dispatch_delay() -> Optional[float]:
+    """Pop one pending dispatch delay (None when chaos is off/exhausted).
+    Runs once per handle dispatch; the no-injection case exits on a
+    plain global read before touching os.environ or the lock."""
+    global _dispatch_delays_left
+    if _dispatch_delays_left <= 0 or not enabled():
+        return None
+    with _lock:
+        if _dispatch_delays_left <= 0:
+            return None
+        _dispatch_delays_left -= 1
+        return _dispatch_delay_s
+
+
+def drop_controller(restart: bool = True):
+    """Crash the serve controller actor (SIGKILL-style). With
+    restart=True (the default) the GCS replays the creation spec —
+    max_restarts=-1 — and the restarted controller restores from its
+    KV checkpoint; restart=False pins it dead so tests can exercise the
+    handles-serve-from-cached-routes window. Returns the old actor's
+    id hex."""
+    _require_enabled("drop_controller")
+    import ray_tpu as rt
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    ctrl = rt.get_actor(CONTROLLER_NAME)
+    rt.kill(ctrl, no_restart=not restart)
+    return ctrl._actor_id.hex()

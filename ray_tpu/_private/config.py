@@ -277,6 +277,32 @@ class Config:
     # (multi-window burn alerting a la SRE workbook).
     serve_slo_fast_window_s: float = 60.0
     serve_slo_slow_window_s: float = 600.0
+    # -- serve survival plane (overload/deadline/drain/failover) ----------
+    # Bound on requests queued (admitted but unexecuted) per replica, on
+    # top of the max_ongoing_requests executing; past it the replica
+    # sheds with ServeOverloadedError instead of growing the queue.
+    serve_max_queued_per_replica: int = 32
+    # Bound on the engine admission queue (waiting for a decode slot);
+    # past it submit() sheds instead of queueing unbounded.
+    serve_max_queued_per_engine: int = 64
+    # Handle-side per-replica circuit breaker: consecutive dispatch
+    # failures that open the circuit, and how long it stays open before
+    # a half-open trial request is allowed through.
+    serve_cb_failure_threshold: int = 3
+    serve_cb_reset_s: float = 5.0
+    # Graceful drain: how long a drained replica may spend finishing its
+    # in-flight requests before the controller hard-kills it.
+    serve_drain_timeout_s: float = 10.0
+    # Default request deadline when none is set on the handle/header.
+    # 0 disables (requests then run under serve_result_timeout_s only).
+    serve_default_deadline_s: float = 0.0
+    # How many times the streaming generator resumes on a new replica
+    # after replica death before giving up (resume-or-restart contract).
+    serve_stream_resume_attempts: int = 2
+    # Completed-request idempotency cache entries kept per replica (keyed
+    # on the handle's idempotency key; redispatch/retry joins or reuses
+    # the original execution instead of running it twice).
+    serve_idem_cache_size: int = 1024
 
     # -- data -------------------------------------------------------------
     # Undelivered blocks buffered per streaming_split consumer before the

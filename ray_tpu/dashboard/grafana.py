@@ -140,6 +140,31 @@ _FLIGHT_RECORDER_PANELS = [
                  "serve_llm_admission_wait_seconds_bucket[1m]))",
          "legend": "admission wait p99"},
     ], "short"),
+    # -- serve survival plane -------------------------------------------
+    ("Serve shed rate (admission control)", [
+        {"expr": "rate(serve_requests_shed_total[1m])",
+         "legend": "{{app}} {{tenant}} {{reason}}"},
+    ], "short"),
+    ("Serve circuit-breaker state (0 closed / 2 open)", [
+        {"expr": "serve_circuit_breaker_state",
+         "legend": "{{app}} {{replica}}"},
+    ], "short"),
+    ("Serve drain duration p50/p99", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "serve_drain_seconds_bucket[5m]))",
+         "legend": "{{app}} p50"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "serve_drain_seconds_bucket[5m]))",
+         "legend": "{{app}} p99"},
+    ], "s"),
+    ("Serve deadline expirations by hop", [
+        {"expr": "rate(serve_deadline_expired_total[1m])",
+         "legend": "{{app}} {{hop}}"},
+    ], "short"),
+    ("Serve HTTP responses by code", [
+        {"expr": "rate(serve_http_responses_total[1m])",
+         "legend": "{{app}} {{code}}"},
+    ], "short"),
     # -- control-plane profiler -----------------------------------------
     ("GCS RPC rate by method", [
         {"expr": "rate(gcs_rpc_calls_total[1m])", "legend": "{{method}}"},

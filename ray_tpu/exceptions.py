@@ -52,6 +52,52 @@ class CollectiveTimeoutError(RayTpuError, TimeoutError):
         super().__init__(message)
 
 
+class ServeOverloadedError(RayTpuError):
+    """The serving tier shed this request instead of queueing it.
+
+    Raised when a bounded admission queue (replica or engine) is full,
+    or a draining/sick replica refuses new work and no healthy replica
+    remains. Always retryable: the request was REJECTED before consuming
+    a slot, so a later retry is safe regardless of deployment semantics.
+    ``retry_after_s`` is the server's backlog-drain estimate — the proxy
+    surfaces it as HTTP 429 + ``Retry-After``."""
+
+    def __init__(self, message: str, *, app: str = "", tenant: str = "",
+                 reason: str = "queue_full", retry_after_s: float = 1.0):
+        self.app = app
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+
+class RequestCancelledError(RayTpuError):
+    """A serve request was cancelled instead of executed to completion.
+
+    ``reason`` is one of ``"deadline"`` (the propagated absolute deadline
+    expired — every hop checks it and expired work is evicted rather than
+    run), ``"client"`` (the caller closed the stream / cancelled), or
+    ``"shutdown"`` (the engine/replica is going away). Deadline
+    cancellations are NOT retryable — the budget is gone by definition."""
+
+    def __init__(self, message: str, *, reason: str = "deadline",
+                 app: str = "", rid: str = ""):
+        self.reason = reason
+        self.app = app
+        self.rid = rid
+        super().__init__(message)
+
+
+class ReplicaDrainingError(RayTpuError):
+    """The chosen replica is draining (scale-down / migration) and no
+    longer admits requests. Retryable by construction: the handle
+    redispatches to a live replica exactly as for a dead one."""
+
+    def __init__(self, message: str, *, app: str = ""):
+        self.app = app
+        super().__init__(message)
+
+
 class ObjectLostError(RayTpuError):
     """All copies of the object are gone and it cannot be reconstructed."""
 

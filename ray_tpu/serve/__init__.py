@@ -215,6 +215,7 @@ __all__ = [
     "AutoscalingConfig",
     "SloConfig",
     "DeploymentHandle",
+    "DeploymentResponse",
     "run",
     "get_app_handle",
     "delete",

@@ -151,6 +151,12 @@ class ServeController:
             self._proxy_every_node = state.get("proxy_every_node", False)
             for nid, e in state.get("proxies", {}).items():
                 self._proxies[nid] = dict(e)
+        # Controller failover: handles kept serving from CACHED routes
+        # while we were down. Push an invalidation per app so they
+        # re-sync with the restored (version-bumped) table immediately
+        # instead of trusting possibly-stale caches for a full TTL.
+        for name in list(self.apps):
+            self._publish_routes(name)
 
     # -- API -------------------------------------------------------------
     @staticmethod
@@ -260,9 +266,7 @@ class ServeController:
             # replace below so state and replicas cannot diverge.
         with self._lock:
             old = self.apps.get(name)
-            if old:
-                for r in old["replicas"]:
-                    _kill_quietly(r)
+            to_retire = list(old["replicas"]) if old else []
             self.apps[name] = {
                 "deployment": deployment,
                 "init_args": init_args,
@@ -277,23 +281,35 @@ class ServeController:
             }
         self._reconcile_once(name)
         self._checkpoint()
+        # New replicas are up and published; the replaced generation
+        # drains (finishes in-flight requests) before dying.
+        self._drain_then_kill(to_retire, name)
         return True
 
     def delete(self, name: str):
         with self._lock:
             app = self.apps.pop(name, None)
-        if app:
-            for r in app["replicas"]:
-                _kill_quietly(r)
         self._checkpoint()
+        if app:
+            # Short drain on delete: in-flight requests get a grace
+            # window without making serve.shutdown() (which deletes
+            # every app) wait out the full drain budget per app.
+            self._drain_then_kill(
+                app["replicas"], name,
+                timeout_s=min(get_config().serve_drain_timeout_s, 1.0),
+            )
         return True
 
     def get_replicas(self, name: str):
         with self._lock:
             app = self.apps.get(name)
             if app is None:
-                return {"version": -1, "replicas": []}
-            return {"version": app["version"], "replicas": list(app["replicas"])}
+                return {"version": -1, "replicas": [], "max_ongoing": 0}
+            return {
+                "version": app["version"],
+                "replicas": list(app["replicas"]),
+                "max_ongoing": app["deployment"].max_ongoing_requests,
+            }
 
     def status(self) -> Dict:
         with self._lock:
@@ -356,6 +372,7 @@ class ServeController:
                     dep.user_config,
                     name,
                     getattr(dep, "slo", None),
+                    dep.max_ongoing_requests,
                 )
                 new.append(replica)
             with self._lock:
@@ -368,10 +385,44 @@ class ServeController:
                 excess = app["replicas"][target:]
                 app["replicas"] = app["replicas"][:target]
                 app["version"] += 1
+            # Routes flip FIRST (handles stop picking the victims), then
+            # the victims drain: new requests they still receive bounce
+            # with ReplicaDrainingError and redispatch, in-flight ones
+            # finish, and only then does the process die.
             self._publish_routes(name)
             self._checkpoint()
-            for r in excess:
-                _kill_quietly(r)
+            self._drain_then_kill(excess, name)
+
+    def _drain_then_kill(self, replicas: List, name: str = "",
+                         timeout_s: Optional[float] = None):
+        """Graceful scale-down/replace: each victim stops admitting
+        (handles redispatch its refusals), finishes in-flight work —
+        bounded by serve_drain_timeout_s — and only then is killed.
+        One collective wait bounds the whole pass; a replica that cannot
+        drain in time is killed anyway (drain improves the common case,
+        the kill below is the guarantee)."""
+        if not replicas:
+            return
+        cfg = get_config()
+        if timeout_s is None:
+            timeout_s = cfg.serve_drain_timeout_s
+        refs = [r.drain.remote(timeout_s) for r in replicas]
+        ready, _ = rt.wait(refs, num_returns=len(refs),
+                           timeout=timeout_s + 2.0)
+        ready_set = set(ready)
+        for r, ref in zip(replicas, refs):
+            if ref in ready_set:
+                try:
+                    res = rt.get(ref, timeout=1.0)
+                    logger.info(
+                        "replica %s of app %r drained in %.3fs "
+                        "(remaining=%d)", r._actor_id.hex(), name,
+                        res.get("duration_s", 0.0),
+                        res.get("remaining", 0),
+                    )
+                except Exception:  # rtlint: disable=RT007 — drain is best-effort; the kill below is the guarantee
+                    pass
+            _kill_quietly(r)
 
     def _publish_routes(self, name: str):
         """Push a routing-table invalidation to subscribed handles — the

@@ -47,6 +47,8 @@ import numpy as np
 
 from ray_tpu._private import chaos
 from ray_tpu._private.config import get_config
+from ray_tpu.exceptions import RequestCancelledError, ServeOverloadedError
+from ray_tpu.serve import context as request_context
 from ray_tpu.serve import observatory
 from ray_tpu.models.transformer import (
     TransformerConfig,
@@ -374,6 +376,24 @@ class GenerationHandle:
         # Observatory stamp card (set by submit() from the request
         # thread's context; engine thread writes marks into it).
         self.obs = None
+        # Survival plane (set by submit() from the request-scoped
+        # serving context): absolute deadline (0 = none), tenant label
+        # for the WFQ admission queue, and the caller-side cancel flag
+        # the engine loop polls at step boundaries.
+        self.deadline_ts = 0.0
+        self.tenant = "default"
+        self.cancelled = False
+
+    def cancel(self, reason: str = "client"):
+        """Caller-side cancellation: the consumer stops waiting NOW
+        (``_fail`` wakes it with RequestCancelledError) and the engine
+        loop evicts the slot at the next step boundary — the slot is
+        reclaimed without waiting for the sequence to finish."""
+        self.cancelled = True
+        self._fail(RequestCancelledError(
+            f"request {self.request_id} cancelled ({reason})",
+            reason=reason, rid=str(self.request_id),
+        ))
 
     # -- engine side --
     def _push(self, token: int, done: bool):
@@ -404,6 +424,8 @@ class GenerationHandle:
 
     def _fail(self, err: BaseException):
         with self._cond:
+            if self._done and self._error is None:
+                return  # finished cleanly first; late cancel/fail is moot
             self._error = err
             self._done = True
             self._cond.notify_all()
@@ -504,7 +526,20 @@ class ContinuousBatchingEngine:
         )
         self._lock = threading.Lock()
         self._work = threading.Event()
-        self._waiting: deque = deque()
+        # BOUNDED admission queue with per-tenant weighted-fair service:
+        # one deque per tenant, served deficit-round-robin (weight w
+        # accrues w credits per rotation; one credit admits one request,
+        # so with equal weights this is plain round-robin and a chatty
+        # tenant can no longer starve the others). The global bound
+        # (serve_max_queued_per_engine) converts queue collapse into a
+        # fast typed ServeOverloadedError shed at submit().
+        self._waiting: Dict[str, deque] = {}
+        self._waiting_n = 0
+        self._wfq_rr: deque = deque()          # tenant rotation order
+        self._wfq_credit: Dict[str, float] = {}
+        self._tenant_weights: Dict[str, float] = {}
+        self._shed_total = 0
+        self._deadline_expired = 0
         self._slots: Dict[int, GenerationHandle] = {}
         # Mid-prefill requests: slot -> {"h": handle, "offset": rows
         # already prefilled}. One chunk advances per loop iteration.
@@ -667,7 +702,37 @@ class ContinuousBatchingEngine:
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
         obs = observatory.current()
+        meta = request_context.current()
+        tenant = (meta.tenant if meta is not None else "") or "default"
+        deadline_ts = meta.deadline_ts if meta is not None else 0.0
+        cfg = get_config()
+        if deadline_ts and time.time() > deadline_ts:
+            # Budget already burned upstream (slow dispatch/wire): never
+            # enqueue work that cannot make its deadline.
+            with self._lock:
+                self._deadline_expired += 1
+            observatory.record_deadline_expired("", "engine_admission")
+            raise RequestCancelledError(
+                "deadline expired before engine admission",
+                reason="deadline", rid=meta.rid if meta else "",
+            )
         with self._lock:
+            if self._waiting_n >= cfg.serve_max_queued_per_engine:
+                # Fast shed: reject BEFORE allocating anything. The
+                # retry hint is a coarse backlog-drain estimate (queue
+                # depth over slot count, capped) — good enough to spread
+                # retries, not a latency promise.
+                self._shed_total += 1
+                retry = min(5.0, max(
+                    0.1, 0.05 * self._waiting_n / max(1, self.num_slots)
+                ))
+                observatory.record_shed("", tenant, "queue_full")
+                raise ServeOverloadedError(
+                    f"engine admission queue full "
+                    f"({self._waiting_n} waiting >= "
+                    f"{cfg.serve_max_queued_per_engine})",
+                    tenant=tenant, reason="queue_full", retry_after_s=retry,
+                )
             h = GenerationHandle(self._next_id)
             self._next_id += 1
             h.submitted_at = time.perf_counter()
@@ -676,16 +741,31 @@ class ContinuousBatchingEngine:
             h.temperature = float(temperature)
             h.top_k = int(top_k or 0)
             h.top_p = float(1.0 if top_p is None else top_p)
+            h.tenant = tenant
+            h.deadline_ts = deadline_ts
             # Adopt the request thread's stamp card: engine admission
             # wait is measured from THIS enqueue, not from slot grant.
             h.obs = obs
             if obs is not None:
                 obs.marks["engine_enqueue"] = h.submitted_at
                 obs.tokens_in = len(prompt)
-            self._waiting.append(h)
-            _engine_metrics()["waiting"].set(float(len(self._waiting)))
+            q = self._waiting.get(tenant)
+            if q is None:
+                q = self._waiting[tenant] = deque()
+                self._wfq_rr.append(tenant)
+            q.append(h)
+            self._waiting_n += 1
+            _engine_metrics()["waiting"].set(float(self._waiting_n))
         self._work.set()
         return h
+
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        """Give a tenant a WFQ share (> 1 admits proportionally more per
+        rotation, < 1 less; default 1.0 — equal shares)."""
+        if weight <= 0:
+            raise ValueError("tenant weight must be > 0")
+        with self._lock:
+            self._tenant_weights[tenant or "default"] = float(weight)
 
     def stats(self) -> Dict:
         with self._lock:
@@ -693,7 +773,12 @@ class ContinuousBatchingEngine:
             return {
                 "steps": self._steps,
                 "active": len(self._slots),
-                "waiting": len(self._waiting),
+                "waiting": self._waiting_n,
+                "waiting_tenants": {
+                    t: len(q) for t, q in self._waiting.items() if q
+                },
+                "shed_total": self._shed_total,
+                "deadline_expired": self._deadline_expired,
                 "prefilling": len(self._prefilling),
                 "free_slots": len(self._free),
                 # Hot-loop hygiene (tests pin these in steady state).
@@ -740,23 +825,77 @@ class ContinuousBatchingEngine:
         # in __iter__ would otherwise wait forever.
         err = RuntimeError("engine shut down")
         with self._lock:
-            pending = (list(self._slots.values()) + list(self._waiting)
+            pending = (list(self._slots.values())
+                       + self._drain_waiting_locked()
                        + [e["h"] for e in self._prefilling.values()])
             for h in pending:
                 h._fail(err)
             self._slots.clear()
-            self._waiting.clear()
             self._prefilling.clear()
 
     # -- engine loop -----------------------------------------------------
+    def _drain_waiting_locked(self) -> list:
+        """Flatten and empty every tenant queue (shutdown/failure)."""
+        out: list = []
+        for q in self._waiting.values():
+            out.extend(q)
+        self._waiting.clear()
+        self._wfq_rr.clear()
+        self._wfq_credit.clear()
+        self._waiting_n = 0
+        return out
+
+    def _pop_waiting_locked(self) -> Optional[GenerationHandle]:
+        """Next request under deficit-round-robin over tenant queues.
+
+        Each rotation a tenant earns its weight in credits; one credit
+        admits one request. Tenants whose queue empties leave the
+        rotation (and forfeit leftover credit — standard DRR, so idle
+        tenants cannot bank a burst). Terminates: credits grow every
+        full rotation while any queue is non-empty."""
+        while self._wfq_rr:
+            t = self._wfq_rr.popleft()
+            q = self._waiting.get(t)
+            if not q:
+                self._waiting.pop(t, None)
+                self._wfq_credit.pop(t, None)
+                continue
+            credit = (self._wfq_credit.get(t, 0.0)
+                      + self._tenant_weights.get(t, 1.0))
+            h = None
+            if credit >= 1.0:
+                h = q.popleft()
+                self._waiting_n -= 1
+                credit -= 1.0
+            self._wfq_credit[t] = credit
+            self._wfq_rr.append(t)
+            if h is not None:
+                return h
+        return None
+
     def _admit_locked(self):
         """Assign free slots to waiting requests; their prompts then
         prefill ONE chunk per loop iteration (_advance_prefills), so a
         long prompt never stalls other slots' decode for more than a
-        chunk."""
-        admitted = self._waiting and self._free
-        while self._free and self._waiting:
-            h = self._waiting.popleft()
+        chunk. Requests whose deadline expired while queued (or that the
+        caller cancelled) are dropped here instead of burning a slot."""
+        admitted = bool(self._waiting_n and self._free)
+        now = time.time()
+        while self._free and self._waiting_n:
+            h = self._pop_waiting_locked()
+            if h is None:
+                break
+            if h.cancelled:
+                continue  # cancel() already failed the handle
+            if h.deadline_ts and now > h.deadline_ts:
+                self._deadline_expired += 1
+                h._fail(RequestCancelledError(
+                    f"deadline expired in admission queue "
+                    f"(request {h.request_id})",
+                    reason="deadline", rid=str(h.request_id),
+                ))
+                observatory.record_deadline_expired("", "engine_admission")
+                continue
             grant_t = time.perf_counter()
             if h.submitted_at is not None:
                 _engine_metrics()["admission_wait_s"].observe(
@@ -775,7 +914,7 @@ class ContinuousBatchingEngine:
             slot = self._free.popleft()
             self._prefilling[slot] = {"h": h, "offset": 0}
         if admitted:
-            _engine_metrics()["waiting"].set(float(len(self._waiting)))
+            _engine_metrics()["waiting"].set(float(self._waiting_n))
 
     # Single-writer: KV cache, rng, and token buffers are engine-thread-
     # owned device state; no other thread touches them after __init__.
@@ -805,8 +944,24 @@ class ContinuousBatchingEngine:
             for e in self._prefilling.values()
         ]
         finished = []  # (slot, handle, first-token device array [1])
+        now_wall = time.time()
         for slot, entry in list(self._prefilling.items()):
             h, off = entry["h"], entry["offset"]
+            if h.cancelled or (h.deadline_ts and now_wall > h.deadline_ts):
+                # Abandon the partial prefill: remaining chunks would be
+                # work for a request nobody is waiting on.
+                if not h.cancelled:
+                    h._fail(RequestCancelledError(
+                        f"deadline expired mid-prefill "
+                        f"(request {h.request_id})",
+                        reason="deadline", rid=str(h.request_id),
+                    ))
+                    observatory.record_deadline_expired("", "engine_decode")
+                with self._lock:
+                    self._deadline_expired += int(not h.cancelled)
+                    del self._prefilling[slot]
+                    self._free.append(slot)
+                continue
             chunk = h.prompt[off:off + c]
             n = len(chunk)
             padded = np.zeros((1, c), dtype=np.int32)
@@ -968,12 +1123,42 @@ class ContinuousBatchingEngine:
                         (prev_tokens, prev_lengths)
                     )
                     fetch_s = time.perf_counter() - t0
+                    now_wall = time.time()
                     with self._lock:
                         self._steps += 1
                         for s, gen, h in prev_snapshot:
                             if (self._gen[s] != gen
                                     or self._slots.get(s) is not h):
                                 continue  # evicted under the lag
+                            if h.cancelled or (
+                                h.deadline_ts and now_wall > h.deadline_ts
+                            ):
+                                # Dead work never holds a TPU slot: evict
+                                # mid-decode, fail the handle (cancel()
+                                # already did for the cancelled case),
+                                # and let the one in-flight step's token
+                                # be suppressed by the generation bump.
+                                if not h.cancelled:
+                                    self._deadline_expired += 1
+                                    h._fail(RequestCancelledError(
+                                        f"deadline expired mid-decode "
+                                        f"(request {h.request_id}, "
+                                        f"{h.produced} tokens produced)",
+                                        reason="deadline",
+                                        rid=str(h.request_id),
+                                    ))
+                                    observatory.record_deadline_expired(
+                                        "", "engine_decode"
+                                    )
+                                del self._slots[s]
+                                self._free.append(s)
+                                self._gen[s] += 1
+                                self._active[s] = False
+                                self._temps[s] = 0.0
+                                self._top_ks[s] = 0
+                                self._top_ps[s] = 1.0
+                                self._params_dirty = True
+                                continue
                             tok = int(toks[s])
                             h.produced += 1
                             done = (
@@ -1005,7 +1190,7 @@ class ContinuousBatchingEngine:
                     m["fetch_ms"].observe(fetch_s * 1e3)
                     m["host_ms"].observe(host_s * 1e3)
                     m["occupancy"].set(len(snapshot) / self.num_slots)
-                    m["waiting"].set(float(len(self._waiting)))
+                    m["waiting"].set(float(self._waiting_n))
                     compiles = self._compile_count()
                     grew = compiles - self._last_compiles
                     if grew > 0:
@@ -1024,13 +1209,13 @@ class ContinuousBatchingEngine:
             except BaseException as e:  # noqa: BLE001 — fail all, keep serving
                 with self._lock:
                     pending = (
-                        list(self._slots.values()) + list(self._waiting)
+                        list(self._slots.values())
+                        + self._drain_waiting_locked()
                         + [en["h"] for en in self._prefilling.values()]
                     )
                     for h in pending:
                         h._fail(e)
                     self._slots.clear()
-                    self._waiting.clear()
                     self._prefilling.clear()
                     self._free = deque(range(self.num_slots))
                     # Donated buffers may have been consumed mid-failure:
@@ -1080,18 +1265,33 @@ class LLMReplica:
     def __call__(self, prompt, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None):
+        # A propagated deadline bounds the blocking wait too (the engine
+        # would cancel the slot anyway — don't outlive it by waiting the
+        # full configured timeout).
+        budget = request_context.remaining_budget()
+        timeout = get_config().serve_result_timeout_s
+        if budget != float("inf"):
+            timeout = max(0.01, min(timeout, budget))
         return self.engine.submit(
             prompt, max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p,
-        ).result(timeout=get_config().serve_result_timeout_s)
+        ).result(timeout=timeout)
 
     def stream(self, prompt, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None):
-        yield from self.engine.submit(
+        h = self.engine.submit(
             prompt, max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p,
         )
+        try:
+            yield from h
+        except GeneratorExit:
+            # The consumer abandoned the stream (replica cancel_stream,
+            # deadline expiry, client disconnect): free the decode slot
+            # instead of generating tokens nobody reads.
+            h.cancel("client")
+            raise
 
     def stats(self):
         return self.engine.stats()

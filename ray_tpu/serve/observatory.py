@@ -129,6 +129,32 @@ def _obs_metrics() -> Dict:
                     "budget); > 1 consumes budget faster than allowed",
                     tag_keys=("app", "tenant", "slo"),
                 ),
+                # -- survival plane (PR 8) ---------------------------
+                "shed": _mx.get_or_create(
+                    _mx.Counter, "serve_requests_shed_total",
+                    "Requests rejected by admission control instead of "
+                    "queued (reason: queue_full/draining/circuit_open)",
+                    tag_keys=("app", "tenant", "reason"),
+                ),
+                "deadline_expired": _mx.get_or_create(
+                    _mx.Counter, "serve_deadline_expired_total",
+                    "Requests cancelled because their propagated deadline "
+                    "expired, by the hop that noticed (replica/engine_"
+                    "admission/engine_decode/handle)",
+                    tag_keys=("app", "hop"),
+                ),
+                "drain_s": _mx.get_or_create(
+                    _mx.Histogram, "serve_drain_seconds",
+                    "Graceful replica drain duration (admission stop -> "
+                    "last in-flight request finished)",
+                    boundaries=_mx.LATENCY_BOUNDARIES, tag_keys=("app",),
+                ),
+                "cb_state": _mx.get_or_create(
+                    _mx.Gauge, "serve_circuit_breaker_state",
+                    "Per-replica circuit breaker state as seen by a "
+                    "handle (0 closed, 1 half-open, 2 open)",
+                    tag_keys=("app", "replica"),
+                ),
             }
         return _metrics
 
@@ -325,6 +351,11 @@ class RequestProfiler:
         self._ttft: deque = deque(maxlen=512)        # recent samples the
         self._tpot: deque = deque(maxlen=512)        # controller merges
         self._requests = 0
+        # Survival-plane tallies: sheds keyed "tenant|reason", deadline
+        # expiries keyed by the hop that noticed. Written by the replica/
+        # engine threads under the same lock as the ring.
+        self._shed: Dict[str, int] = {}
+        self._expired: Dict[str, int] = {}
         # Hot-path metric keys resolved once per (phase)/(tenant) label
         # set — the keyed fast path from util.metrics.
         self._phase_keys: Dict[str, tuple] = {}
@@ -452,6 +483,23 @@ class RequestProfiler:
         except Exception:  # rtlint: disable=RT007 — observability must never fail a request
             pass
 
+    def record_shed(self, tenant: str, reason: str) -> None:
+        """Account one admission rejection (metric + snapshot tally)."""
+        tenant = tenant or "default"
+        with self._lock:
+            key = f"{tenant}|{reason}"
+            self._shed[key] = self._shed.get(key, 0) + 1
+        m = _obs_metrics()
+        m["shed"].inc(1, tags={"app": self.app, "tenant": tenant,
+                               "reason": reason})
+
+    def record_deadline_expired(self, hop: str) -> None:
+        """Account one deadline cancellation at the hop that noticed."""
+        with self._lock:
+            self._expired[hop] = self._expired.get(hop, 0) + 1
+        m = _obs_metrics()
+        m["deadline_expired"].inc(1, tags={"app": self.app, "hop": hop})
+
     # -- read side -------------------------------------------------------
     def records(self) -> List[Dict]:
         with self._lock:
@@ -474,6 +522,8 @@ class RequestProfiler:
             ttft = sorted(self._ttft)
             tpot = sorted(self._tpot)
             requests = self._requests
+            shed = dict(self._shed)
+            expired = dict(self._expired)
         phase_agg: Dict[str, Dict[str, float]] = {}
         fractions: List[float] = []
         for rec in ring:
@@ -532,6 +582,9 @@ class RequestProfiler:
             "slo": slo_doc,
             "slo_windows_s": [int(w) for w in windows],
             "tenants": tenant_doc,
+            "shed": shed,
+            "shed_total": sum(shed.values()),
+            "deadline_expired": expired,
         }
 
 
@@ -551,6 +604,42 @@ def profiler() -> RequestProfiler:
 def configure(app: str, slo=None) -> None:
     """Label this replica process's profiler (called at replica init)."""
     profiler().configure(app, slo)
+
+
+def record_shed(app: str, tenant: str = "",
+                reason: str = "queue_full") -> None:
+    """Module-level shed accounting (replica/engine/handle hops call
+    this; no-op with the observatory disabled — shedding itself is
+    never gated on observability)."""
+    if not get_config().serve_observatory:
+        return
+    p = profiler()
+    if app and p.app in ("-", ""):
+        p.app = app
+    p.record_shed(tenant, reason)
+
+
+def record_deadline_expired(app: str, hop: str) -> None:
+    """Module-level deadline-expiry accounting, by noticing hop."""
+    if not get_config().serve_observatory:
+        return
+    profiler().record_deadline_expired(hop)
+
+
+def record_drain(app: str, seconds: float) -> None:
+    """One graceful-drain duration observation (controller-side)."""
+    if not get_config().serve_observatory:
+        return
+    _obs_metrics()["drain_s"].observe(seconds, tags={"app": app or "-"})
+
+
+def set_circuit_state(app: str, replica: str, state: int) -> None:
+    """Publish a handle's view of one replica's breaker (0 closed,
+    1 half-open, 2 open)."""
+    if not get_config().serve_observatory:
+        return
+    _obs_metrics()["cb_state"].set(
+        float(state), tags={"app": app or "-", "replica": replica or "-"})
 
 
 def reset_for_tests() -> None:

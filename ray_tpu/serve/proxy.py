@@ -9,15 +9,97 @@ deployments (`?stream=1` or `Accept: text/event-stream`) are served as
 Server-Sent Events; the `serve_multiplexed_model_id` header tags requests
 for model multiplexing (reference: serve/_private/proxy.py header of the
 same name).
+
+Survival plane (PR 8): typed serve failures map to distinct HTTP codes —
+429 + Retry-After for admission shed (ServeOverloadedError: retryable,
+the request was never executed), 503 + Retry-After for replica death
+mid-request (retryable: redispatch exhausted its attempts), 504 for
+deadline expiry (NOT retryable: the budget is gone) — instead of a
+generic 500, so clients and load balancers can tell "back off" from
+"try another instance" from "give up". Every response increments
+`serve_http_responses_total{app,code}` and lands one access-log line
+tagged with the outcome kind.
+
+The `serve_deadline_ms` request header sets the request's end-to-end
+budget: the proxy converts it to an absolute deadline that propagates
+handle -> replica -> engine.
 """
 
 from __future__ import annotations
 
+import logging
+import math
 import threading
+import time
 from typing import Dict, Optional
 
 import ray_tpu as rt
 from ray_tpu._private.config import get_config
+from ray_tpu.exceptions import (
+    ActorError,
+    GetTimeoutError,
+    RequestCancelledError,
+    ServeOverloadedError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger("ray_tpu.serve.proxy")
+
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict] = None
+
+
+def _proxy_metrics() -> Dict:
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util import metrics as _mx
+
+            _metrics = {
+                "responses": _mx.get_or_create(
+                    _mx.Counter, "serve_http_responses_total",
+                    "HTTP responses by status code (200 ok, 429 shed, "
+                    "503 replica death, 504 deadline, 500 other), per app",
+                    tag_keys=("app", "code"),
+                ),
+            }
+        return _metrics
+
+
+def _classify_error(e: BaseException):
+    """(status, retry_after_s | None, kind) for a failed serve request.
+
+    Typed serve errors usually arrive WRAPPED in TaskError (they were
+    raised inside the replica); classification looks through to the
+    cause, falling back to cause_cls_name when the cause did not
+    unpickle."""
+    cause = getattr(e, "cause", None) if isinstance(e, TaskError) else e
+    cause_name = (getattr(e, "cause_cls_name", "")
+                  if isinstance(e, TaskError) else type(e).__name__)
+    if isinstance(cause, ServeOverloadedError) or (
+            cause_name == "ServeOverloadedError"):
+        retry = getattr(cause, "retry_after_s", 1.0) or 1.0
+        return 429, retry, "shed"
+    if isinstance(cause, RequestCancelledError) or (
+            cause_name == "RequestCancelledError"):
+        return 504, None, "deadline"
+    if isinstance(e, (ActorError, WorkerCrashedError)) or (
+            cause_name in ("ActorDiedError", "ActorUnavailableError",
+                           "WorkerCrashedError")):
+        return 503, 1.0, "replica_death"
+    if isinstance(e, GetTimeoutError):
+        return 504, None, "timeout"
+    return 500, None, "error"
+
+
+def _count_response(app: str, code: int) -> None:
+    try:
+        _proxy_metrics()["responses"].inc(
+            1, tags={"app": app, "code": str(code)}
+        )
+    except Exception:  # rtlint: disable=RT007 — metrics must never fail a response
+        pass
 
 
 @rt.remote
@@ -44,13 +126,32 @@ class ProxyActor:
                 self._handles[app_name] = handle
             return handle
 
-        async def resolve(loop, response):
+        async def resolve(loop, response, deadline_ts: float = 0.0):
             """Await a DeploymentResponse without burning a thread: the
             completion future resolves on the client loop; only store-kind
-            results (rare for JSON responses) fall back to an executor."""
+            results (rare for JSON responses) fall back to an executor.
+            A deadline bounds the await — past it the client gets 504
+            instead of a result it no longer wants."""
             ref = response.ref
             if ref._future is not None:
-                value = await asyncio.wrap_future(ref._future)
+                fut = asyncio.wrap_future(ref._future)
+                if deadline_ts:
+                    remaining = deadline_ts - time.time()
+                    if remaining <= 0:
+                        fut.cancel()
+                        raise RequestCancelledError(
+                            "deadline expired before result",
+                            reason="deadline",
+                        )
+                    try:
+                        value = await asyncio.wait_for(fut, timeout=remaining)
+                    except asyncio.TimeoutError:
+                        raise RequestCancelledError(
+                            "deadline expired while awaiting result",
+                            reason="deadline",
+                        ) from None
+                else:
+                    value = await fut
                 if value is not _IN_STORE:
                     return value
             return await loop.run_in_executor(
@@ -62,6 +163,7 @@ class ProxyActor:
             app_name = request.match_info["app"]
             model_id = request.headers.get("serve_multiplexed_model_id", "")
             tenant = request.headers.get("serve_tenant", "")
+            deadline_ms = request.headers.get("serve_deadline_ms", "")
             want_stream = (
                 request.query.get("stream") == "1"
                 or "text/event-stream" in request.headers.get("Accept", "")
@@ -77,6 +179,14 @@ class ProxyActor:
             if tenant:
                 # Observatory attribution: per-tenant tokens/SLO burn.
                 handle = handle.options(tenant=tenant)
+            deadline_ts = 0.0
+            if deadline_ms:
+                try:
+                    budget_s = max(0.001, float(deadline_ms) / 1000.0)
+                    handle = handle.options(deadline_s=budget_s)
+                    deadline_ts = time.time() + budget_s
+                except ValueError:
+                    pass  # malformed header: no deadline
 
             def dispatch(h):
                 if isinstance(payload, dict):
@@ -118,24 +228,52 @@ class ProxyActor:
                                 f"data: {json.dumps(chunk)}\n\n".encode()
                             )
                     except Exception as e:  # noqa: BLE001
+                        status, _retry, kind = _classify_error(e)
+                        _count_response(app_name, status)
+                        logger.info(
+                            "POST /%s -> stream error %d (%s): %s",
+                            app_name, status, kind, e,
+                        )
                         await sse.write(
                             b"event: error\ndata: "
                             + json.dumps(
-                                f"{type(e).__name__}: {e}"
+                                {
+                                    "error": f"{type(e).__name__}: {e}",
+                                    "status": status,
+                                    "kind": kind,
+                                }
                             ).encode()
                             + b"\n\n"
                         )
+                    else:
+                        _count_response(app_name, 200)
                     await sse.write_eof()
                     return sse
                 # Dispatch is quick (replica pick + actor-call submit);
                 # the potentially-long wait is the await below, which
                 # holds no thread.
                 response = await loop.run_in_executor(None, dispatch, handle)
-                result = await resolve(loop, response)
+                result = await resolve(loop, response, deadline_ts)
+                _count_response(app_name, 200)
                 return web.json_response({"result": result})
             except Exception as e:  # noqa: BLE001
+                status, retry_after, kind = _classify_error(e)
+                headers = {}
+                if retry_after is not None:
+                    headers["Retry-After"] = str(
+                        max(1, math.ceil(retry_after))
+                    )
+                _count_response(app_name, status)
+                # Access log distinguishes shed (429: request never ran,
+                # back off) from replica_death (503: retry elsewhere)
+                # from deadline (504: budget gone, do not retry).
+                logger.info(
+                    "POST /%s -> %d (%s): %s", app_name, status, kind, e
+                )
                 return web.json_response(
-                    {"error": f"{type(e).__name__}: {e}"}, status=500
+                    {"error": f"{type(e).__name__}: {e}", "kind": kind},
+                    status=status,
+                    headers=headers,
                 )
 
         async def healthz(request):
@@ -157,13 +295,32 @@ class ProxyActor:
                 )
             if d.get("tenant"):
                 handle = handle.options(tenant=d["tenant"])
+            deadline_ts = 0.0
+            if d.get("deadline_ms"):
+                budget_s = max(0.001, float(d["deadline_ms"]) / 1000.0)
+                handle = handle.options(deadline_s=budget_s)
+                deadline_ts = time.time() + budget_s
             args = d.get("args") or []
             kwargs = d.get("kwargs") or {}
             loop = asyncio.get_event_loop()
-            response = await loop.run_in_executor(
-                None, lambda: handle.remote(*args, **kwargs)
-            )
-            result = await resolve(loop, response)
+            try:
+                response = await loop.run_in_executor(
+                    None, lambda: handle.remote(*args, **kwargs)
+                )
+                result = await resolve(loop, response, deadline_ts)
+            except Exception as e:  # noqa: BLE001
+                status, retry_after, kind = _classify_error(e)
+                _count_response(app_name, status)
+                logger.info(
+                    "serve_call %s -> %d (%s): %s", app_name, status, kind, e
+                )
+                return {
+                    "error": f"{type(e).__name__}: {e}",
+                    "status": status,
+                    "kind": kind,
+                    "retry_after_s": retry_after,
+                }
+            _count_response(app_name, 200)
             return {"result": result}
 
         def run_server():

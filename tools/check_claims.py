@@ -140,6 +140,15 @@ def _bench_ft(metric_sub: str, field: str):
     return get
 
 
+def _bench_serve_ft(metric_sub: str, field: str):
+    def get():
+        for e in _load("BENCH_SERVE_FT.json"):
+            if metric_sub in e.get("metric", ""):
+                return e[field]
+        raise KeyError(f"no BENCH_SERVE_FT entry matching {metric_sub!r}")
+    return get
+
+
 def _bench_r(field: str, sub: str = None):
     def get():
         d = _load("BENCH_TPU_LIVE.json")
@@ -344,6 +353,31 @@ CLAIMS = [
     Claim("MIGRATION.md", r"as (\d+\.\d+) blocked slot-seconds",
           _bench_serve_obs("HOL watchdog", "blocked_slot_seconds"),
           rel_tol=0.25, note="injected 0.2s + one real prefill pass"),
+    # Serve survival plane <- BENCH_SERVE_FT.json (bench_serve_ft.py).
+    # Wall-clock probes on a shared box get loose tolerances; the zero
+    # lost-request pins are exact — any loss must fail the doc check.
+    Claim("MIGRATION.md", r"shed decision costs (\d+\.\d+) µs",
+          _bench_serve_ft("shed decision latency", "shed_p50_us"),
+          rel_tol=1.0, note="µs micro-bench, noisy on a shared box"),
+    Claim("MIGRATION.md", r"sheds every request\s*\n?\s*with a "
+                          r"(\d+\.\d+) ms p99",
+          _bench_serve_ft("shed decision latency", "shed_p99_ms"),
+          rel_tol=1.0, note="p99 of a µs-scale decision"),
+    Claim("MIGRATION.md", r"p99 TTFT at (\d+\.\d+)× the",
+          _bench_serve_ft("replica chaos", "chaos_over_baseline_p99"),
+          rel_tol=1.0, note="ratio hovers just above 1 on a quiet box"),
+    Claim("MIGRATION.md", r"drains in (\d+\.\d+) s median",
+          _bench_serve_ft("graceful drain", "drain_p50_s"), rel_tol=0.5),
+    Claim("MIGRATION.md", r"answers again in\s*\n?\s*(\d+\.\d+) s",
+          _bench_serve_ft("controller kill+restart",
+                          "controller_recovery_s"),
+          rel_tol=2.0, note="named-actor restart + checkpoint restore"),
+    Claim("MIGRATION.md", r"traffic loses (\d+) requests",
+          _bench_serve_ft("controller kill+restart",
+                          "requests_failed"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"with (\d+) lost non-shed requests",
+          _bench_serve_ft("survival plane summary",
+                          "lost_requests_total"), rel_tol=0.0),
     # Static-analysis section <- rtlint itself. Exact pins (rel_tol=0):
     # adding a rule or regenerating the baseline must update the doc.
     Claim("MIGRATION.md", r"lint pass\s*\n?\s*with (\d+) rules",
