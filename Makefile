@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs \
-  bench-scale bench-serve-obs bench-serve-ft
+  bench-scale bench-serve-obs bench-serve-ft bench-collective
 
 lint: rtlint sanitizers
 
@@ -39,6 +39,13 @@ bench-serve-obs:
 # afterwards — MIGRATION.md pins these numbers.
 bench-serve-ft:
 	JAX_PLATFORMS=cpu $(PY) bench_serve_ft.py
+
+# Regenerates BENCH_COLLECTIVE.json (topology-native collectives:
+# algorithm selection, sharded-hier DCN bytes, quantized wire); the
+# bench asserts its own gates. Run tools/check_claims.py afterwards —
+# MIGRATION.md pins these numbers.
+bench-collective:
+	JAX_PLATFORMS=cpu $(PY) bench_collective.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

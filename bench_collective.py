@@ -1,0 +1,260 @@
+"""Topology-native collective benchmarks. Writes BENCH_COLLECTIVE.json.
+
+Four probes, all deterministic on CPU loopback (the DCN "slow tier" is
+manufactured with the chaos injections — a fixed per-send latency and a
+bandwidth cap — so the measured regime is the modeled one, not whatever
+the scheduler felt like):
+
+  1. algorithm selection: the cost model on the 2-host x 4-chip
+     topology must pick recursive doubling under the crossover size and
+     sharded-hier above it (MIGRATION.md pins the crossover).
+  2. rd vs ring latency: chaos-delayed n=4 ring, 1KB message —
+     recursive doubling's log2(n) rounds must beat the ring's 2(n-1)
+     serialized hops when the per-message alpha dominates.
+  3. sharded-hier DCN bytes: 2 procs x 4 local devices, 64KB per
+     device; total DCN wire bytes of the sharded two-tier exchange vs
+     the flat ring in which all 8 devices are DCN members. GATE:
+     ratio <= 1/n_local + 10%.
+  4. int8 quantized wire: GATES: wire-byte reduction >= 3.5x, max
+     relative error <= 1e-2, and error feedback closes the error over
+     steps (20-step cumulative-mean error < single-shot error).
+
+Gates are asserted here — a red gate makes the bench exit nonzero.
+
+Run: python bench_collective.py [--quick]  (--quick: no artifact)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+N_LOCAL = 4
+HIER_ELEMS = 16 * 1024          # per-device fp32 elements for probe 3
+QUANT_ELEMS = 64 * 1024         # per-rank fp32 elements for probe 4
+RD_ELEMS = 256                  # 1KB message for the latency probe
+SEND_DELAY_S = 0.004            # manufactured per-message DCN latency
+EF_STEPS = 20
+
+
+class _KV:
+    """Dict-backed stand-in for the GCS KV (rendezvous only)."""
+
+    def __init__(self):
+        self.d, self.lock = {}, threading.Lock()
+
+    def kv_put(self, k, v, ns=None):
+        with self.lock:
+            self.d[(ns, k)] = v
+
+    def kv_get(self, k, ns=None):
+        with self.lock:
+            return self.d.get((ns, k))
+
+    def kv_del(self, k, ns=None):
+        with self.lock:
+            self.d.pop((ns, k), None)
+
+
+def _run(n, make, fn):
+    groups, errs, out = [None] * n, [None] * n, [None] * n
+
+    def mk(r):
+        try:
+            groups[r] = make(r)
+        except Exception as e:  # noqa: BLE001
+            errs[r] = e
+
+    ts = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not any(errs), errs
+
+    def work(r):
+        try:
+            out[r] = fn(groups[r], r)
+        except Exception as e:  # noqa: BLE001
+            errs[r] = e
+
+    ts = [threading.Thread(target=work, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for g in groups:
+        g.destroy()
+    assert not any(errs), errs
+    return out, groups
+
+
+def _dcn(n, fn, name, **kw):
+    from ray_tpu.util.collective.dcn_group import DcnGroup
+
+    kv = _KV()
+    kw.setdefault("timeout", 30)
+    kw.setdefault("op_timeout", 30)
+    return _run(n, lambda r: DcnGroup(kv, n, r, name, **kw), fn)
+
+
+def probe_selection(results):
+    from ray_tpu.util.collective.topology import Topology
+
+    topo = Topology.detect(2, n_local=N_LOCAL)
+    cross = topo.crossover_nbytes()
+    small = topo.select("allreduce", 1024)
+    large = topo.select("allreduce", 64 << 20)
+    entry = {
+        "metric": "algorithm selection (2 hosts x 4 chips)",
+        "selected_1KB": small,
+        "selected_64MB": large,
+        "crossover_KiB": cross // 1024,
+    }
+    assert small == "rd", f"gate: small-message algo {small} != rd"
+    assert large == "hier", f"gate: large-message algo {large} != hier"
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def probe_rd_vs_ring(results):
+    """Fixed per-send chaos latency, tiny message: latency-bound regime."""
+    from ray_tpu._private import chaos
+
+    data = np.ones(RD_ELEMS, dtype=np.float32)
+
+    def timed(algo):
+        def fn(g, r):
+            g.allreduce(data, algo=algo)  # warm up peer connections
+            t0 = time.perf_counter()
+            g.allreduce(data, algo=algo)
+            return time.perf_counter() - t0
+
+        chaos.delay_dcn_send(SEND_DELAY_S, count=10 ** 6)
+        try:
+            out, _ = _dcn(4, fn, f"lat_{algo}")
+        finally:
+            chaos.clear()
+        return max(out)
+
+    chaos.enable()
+    try:
+        ring_s = timed("ring")
+        rd_s = timed("rd")
+    finally:
+        chaos.disable()
+    entry = {
+        "metric": "rd vs ring latency (chaos-delayed, n=4, 1KB)",
+        "send_delay_ms": SEND_DELAY_S * 1e3,
+        "ring_ms": round(ring_s * 1e3, 2),
+        "rd_ms": round(rd_s * 1e3, 2),
+        "speedup": round(ring_s / rd_s, 2),
+    }
+    assert rd_s < ring_s, (
+        f"gate: rd ({rd_s * 1e3:.1f}ms) not faster than ring "
+        f"({ring_s * 1e3:.1f}ms) at small nbytes"
+    )
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def probe_hier_bytes(results):
+    from ray_tpu.util.collective.hier_group import HierarchicalGroup
+
+    data = {
+        r: [np.full(HIER_ELEMS, float(r * N_LOCAL + d), dtype=np.float32)
+            for d in range(N_LOCAL)]
+        for r in range(2)
+    }
+    kv = _KV()
+    _, hg = _run(
+        2,
+        lambda r: HierarchicalGroup(kv, 2, r, "bh",
+                                    num_local_devices=N_LOCAL, epoch=0),
+        lambda g, r: g.allreduce(data[r], algo="hier"),
+    )
+    hier_total = sum(g.dcn.bytes_sent for g in hg)
+
+    flat_in = [data[r][d] for r in range(2) for d in range(N_LOCAL)]
+    _, fg = _dcn(8, lambda g, r: g.allreduce(flat_in[r], algo="ring"), "bf")
+    flat_total = sum(g.bytes_sent for g in fg)
+
+    ratio = hier_total / flat_total
+    gate = 1 / N_LOCAL + 0.10
+    entry = {
+        "metric": "sharded-hier DCN bytes vs flat ring (2x4 devices)",
+        "elems_per_device": HIER_ELEMS,
+        "hier_dcn_bytes": hier_total,
+        "flat_dcn_bytes": flat_total,
+        "ratio": round(ratio, 4),
+        "gate_max_ratio": round(gate, 3),
+    }
+    assert ratio <= gate, f"gate: hier/flat byte ratio {ratio:.3f} > {gate}"
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def probe_quant(results):
+    rng = np.random.default_rng(0)
+    data = [rng.standard_normal(QUANT_ELEMS).astype(np.float32)
+            for _ in range(2)]
+    exact = data[0] + data[1]
+
+    res_q, qg = _dcn(2, lambda g, r: g.allreduce(data[r], quant="int8"),
+                     "bq")
+    _, fg = _dcn(2, lambda g, r: g.allreduce(data[r]), "bqf")
+    q_bytes = qg[0].last_op_info["bytes"]
+    f_bytes = fg[0].last_op_info["bytes"]
+    reduction = f_bytes / q_bytes
+    rel_err = float(np.abs(res_q[0] - exact).max() / np.abs(exact).max())
+
+    # error feedback: cumulative mean of repeated quantized sums must
+    # converge toward the exact sum (EF-SGD telescoping)
+    def ef_loop(g, r):
+        outs = []
+        for _ in range(EF_STEPS):
+            outs.append(g.allreduce(data[r], quant="int8",
+                                    error_feedback=True, ef_key="b"))
+        return np.stack(outs)
+
+    res_ef, _ = _dcn(2, ef_loop, "bef")
+    single = float(np.abs(res_ef[0][0] - exact).max())
+    mean_err = float(np.abs(res_ef[0].mean(axis=0) - exact).max())
+
+    entry = {
+        "metric": "int8 quantized DCN allreduce (n=2, 256KB fp32)",
+        "fp32_wire_bytes": f_bytes,
+        "int8_wire_bytes": q_bytes,
+        "wire_reduction": round(reduction, 2),
+        "max_rel_error": round(rel_err, 6),
+        "ef_single_shot_error": round(single, 6),
+        "ef_mean_error_20_steps": round(mean_err, 6),
+    }
+    assert reduction >= 3.5, f"gate: wire reduction {reduction:.2f} < 3.5"
+    assert rel_err <= 1e-2, f"gate: max rel error {rel_err:.4f} > 1e-2"
+    assert mean_err < single, (
+        f"gate: EF mean error {mean_err} not below single-shot {single}"
+    )
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    results = []
+    probe_selection(results)
+    probe_rd_vs_ring(results)
+    probe_hier_bytes(results)
+    probe_quant(results)
+    if not quick:
+        with open("BENCH_COLLECTIVE.json", "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

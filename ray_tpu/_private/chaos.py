@@ -31,6 +31,8 @@ Injection table (all gated on RT_CHAOS=1):
   kill_replica(app, index)  | driver            | serve replica death
   delay_dispatch(s, n)      | handle process    | slow router dispatch
   drop_controller()         | driver            | serve controller crash
+  delay_dcn_send(s, n)      | calling process   | DCN per-message latency
+  cap_dcn_bandwidth(B/s)    | calling process   | DCN bandwidth ceiling
 """
 
 from __future__ import annotations
@@ -63,6 +65,14 @@ _prefill_delays_left: int = 0
 # router so deadline-propagation tests can burn budget at a chosen hop.
 _dispatch_delay_s: float = 0.0
 _dispatch_delays_left: int = 0
+# Deterministic per-message latency on the next DCN socket sends plus an
+# optional bandwidth ceiling (consumed by dcn_group._Peer.send_bytes) —
+# turns the loopback TCP of CPU tests into a modelable slow tier so the
+# collective-algorithm benches measure deterministic cost, not scheduler
+# noise.
+_dcn_send_delay_s: float = 0.0
+_dcn_send_delays_left: int = 0
+_dcn_bandwidth_cap_bps: float = 0.0
 
 
 def enabled() -> bool:
@@ -87,6 +97,7 @@ def clear():
     global _step_delay_s, _step_delays_left
     global _prefill_delay_s, _prefill_delays_left
     global _dispatch_delay_s, _dispatch_delays_left
+    global _dcn_send_delay_s, _dcn_send_delays_left, _dcn_bandwidth_cap_bps
     with _lock:
         _injected_drain_ranks.clear()
         _poll_delay_s = 0.0
@@ -99,6 +110,9 @@ def clear():
         _prefill_delays_left = 0
         _dispatch_delay_s = 0.0
         _dispatch_delays_left = 0
+        _dcn_send_delay_s = 0.0
+        _dcn_send_delays_left = 0
+        _dcn_bandwidth_cap_bps = 0.0
 
 
 def _require_enabled(what: str):
@@ -330,6 +344,58 @@ def take_dispatch_delay() -> Optional[float]:
             return None
         _dispatch_delays_left -= 1
         return _dispatch_delay_s
+
+
+# -- DCN wire faults ------------------------------------------------------
+def delay_dcn_send(seconds: float, count: int = 1):
+    """Deterministically add `seconds` of latency to this process's next
+    `count` DCN socket sends (consumed by the collective transport just
+    before sendall) — models per-message DCN latency (the alpha term of
+    the cost model) so algorithm-selection benches on loopback TCP
+    measure a deterministic latency regime. Process-local: call it
+    inside the rank whose sends should stall."""
+    _require_enabled("delay_dcn_send")
+    global _dcn_send_delay_s, _dcn_send_delays_left
+    with _lock:
+        _dcn_send_delay_s = float(seconds)
+        _dcn_send_delays_left = int(count)
+
+
+def take_dcn_send_delay() -> Optional[float]:
+    """Pop one pending DCN send delay (None when chaos is off or
+    exhausted). Runs on every DCN message, so the no-injection case
+    exits on a plain global read before touching os.environ or the
+    lock."""
+    global _dcn_send_delays_left
+    if _dcn_send_delays_left <= 0 or not enabled():
+        return None
+    with _lock:
+        if _dcn_send_delays_left <= 0:
+            return None
+        _dcn_send_delays_left -= 1
+        return _dcn_send_delay_s
+
+
+def cap_dcn_bandwidth(bytes_per_s: float):
+    """Impose a bandwidth ceiling on this process's DCN sends until
+    cleared: each message sleeps nbytes/bytes_per_s before hitting the
+    socket (the beta term of the cost model). Unlike the counted delays
+    this persists until clear()/disable() — a slow tier, not an event.
+    Pass 0 to lift the cap."""
+    _require_enabled("cap_dcn_bandwidth")
+    global _dcn_bandwidth_cap_bps
+    if bytes_per_s < 0:
+        raise ValueError("bandwidth cap must be >= 0")
+    with _lock:
+        _dcn_bandwidth_cap_bps = float(bytes_per_s)
+
+
+def dcn_bandwidth_cap() -> Optional[float]:
+    """The active DCN bandwidth cap in bytes/s (None when chaos is off
+    or no cap is set). Fast path: plain global read first."""
+    if not _dcn_bandwidth_cap_bps or not enabled():
+        return None
+    return _dcn_bandwidth_cap_bps
 
 
 def drop_controller(restart: bool = True):

@@ -196,6 +196,17 @@ _FLIGHT_RECORDER_PANELS = [
         {"expr": "rt_raylet_dispatch_scan_last",
          "legend": "{{node}} scan"},
     ], "short"),
+    # -- topology-native collectives -------------------------------------
+    ("Collective wire bytes by tier/algo", [
+        {"expr": "rate(collective_bytes_total[1m])",
+         "legend": "{{tier}} {{algo}} {{dtype}}"},
+    ], "Bps"),
+    ("Collective op latency p50/p95", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "collective_op_seconds_bucket[1m]))", "legend": "p50"},
+        {"expr": "histogram_quantile(0.95, rate("
+                 "collective_op_seconds_bucket[1m]))", "legend": "p95"},
+    ], "s"),
 ]
 
 
@@ -240,7 +251,8 @@ def generate_dashboard(
             for token in expr.replace("(", " ").replace(")", " ").replace(
                     "[1m]", " ").replace("[5m]", " ").split():
                 if token.startswith(("train_", "serve_", "device_", "data_",
-                                     "rt_raylet_", "gcs_rpc_")):
+                                     "rt_raylet_", "gcs_rpc_",
+                                     "collective_")):
                     covered.add(token)
 
     for info in user_metrics:

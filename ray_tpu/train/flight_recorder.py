@@ -143,7 +143,12 @@ def note_phase(name: str, seconds: float) -> None:
         step._phases[name] = step._phases.get(name, 0.0) + seconds
 
 
-def _collective_observer(op_name: str, seconds: float) -> None:
+def _collective_observer(op_name: str, seconds: float,
+                         info: Optional[dict] = None) -> None:
+    # `info` carries {tier, algo, bytes, ...} from the collective layer;
+    # step attribution only needs the wall time, but accepting it keeps
+    # this on the three-arg observer protocol (collective.py calls with
+    # info when the group records one).
     note_phase("collective", seconds)
 
 

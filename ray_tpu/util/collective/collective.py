@@ -169,18 +169,24 @@ def _as_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
-# Collective-op observers: callables (op_name, seconds) invoked after each
-# eager collective completes. The flight recorder registers one so the
-# step profiler can attribute collective wall time per training step
-# without this module importing anything from train/. The timed path only
-# runs when an observer is registered — unobserved collectives pay two
-# list checks and nothing else.
+# Collective-op observers: callables (op_name, seconds, info) invoked
+# after each eager collective completes. The flight recorder registers one
+# so the step profiler can attribute collective wall time per training
+# step without this module importing anything from train/. `info` is the
+# group's last_op_info dict ({tier, algo, bytes, dtype, quant}) for
+# backends that record one, else None; legacy two-arg observers keep
+# working (called without info). The timed path only runs when an
+# observer is registered or the group records op info — a plain XLA-local
+# op with no observers pays two checks and nothing else.
 _op_observers: List = []
+
+_metrics = None  # lazy: {bytes: Counter, seconds: Histogram}
 
 
 def add_op_observer(cb) -> None:
-    """Register `cb(op_name: str, seconds: float)` to run after every
-    eager collective op in this process (idempotent per callable)."""
+    """Register `cb(op_name: str, seconds: float, info: Optional[dict])`
+    to run after every eager collective op in this process (idempotent
+    per callable). Two-arg callables are still supported."""
     if cb not in _op_observers:
         _op_observers.append(cb)
 
@@ -192,70 +198,159 @@ def remove_op_observer(cb) -> None:
         pass
 
 
-def _observed(op_name: str, fn):
-    """Run fn(), reporting its wall time to any registered observers."""
-    if not _op_observers:
+def _collective_metrics():
+    """collective_bytes_total / collective_op_seconds, created on the
+    first instrumented op so importing this module registers nothing."""
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util import metrics as metrics_mod
+
+        _metrics = {
+            "bytes": metrics_mod.get_or_create(
+                metrics_mod.Counter,
+                "collective_bytes_total",
+                "Bytes eager collectives pushed on the wire, by link tier, "
+                "algorithm, and element dtype.",
+                tag_keys=("tier", "algo", "dtype"),
+            ),
+            "seconds": metrics_mod.get_or_create(
+                metrics_mod.Histogram,
+                "collective_op_seconds",
+                "Wall time of eager collective ops.",
+                boundaries=metrics_mod.LATENCY_BOUNDARIES,
+                tag_keys=("op", "tier", "algo"),
+            ),
+        }
+    return _metrics
+
+
+def _emit_metrics(op_name: str, dt: float, info: Optional[dict]) -> None:
+    if not info:
+        return
+    try:
+        m = _collective_metrics()
+        tier = str(info.get("tier", ""))
+        algo = str(info.get("algo", ""))
+        nbytes = info.get("bytes", 0)
+        if nbytes:
+            m["bytes"].inc(
+                float(nbytes),
+                tags={"tier": tier, "algo": algo,
+                      "dtype": str(info.get("dtype", ""))},
+            )
+        m["seconds"].observe(
+            dt, tags={"op": op_name, "tier": tier, "algo": algo}
+        )
+    except Exception:  # rtlint: disable=RT007 — metrics must never break the op
+        pass
+
+
+def _observed(op_name: str, fn, group=None):
+    """Run fn(), reporting wall time + the group's recorded op info
+    (tier/algo/bytes) to observers and the collective metrics."""
+    records_info = group is not None and hasattr(group, "last_op_info")
+    if not _op_observers and not records_info:
         return fn()
     t0 = time.perf_counter()
     try:
         return fn()
     finally:
         dt = time.perf_counter() - t0
+        info = group.last_op_info if records_info else None
+        info = dict(info) if info else None  # snapshot; {} -> None
+        _emit_metrics(op_name, dt, info)
         for cb in list(_op_observers):
             try:
-                cb(op_name, dt)
+                try:
+                    cb(op_name, dt, info)
+                except TypeError:
+                    cb(op_name, dt)  # pre-info two-arg observer
             except Exception:  # rtlint: disable=RT007 — observers must never break the op
                 pass
 
 
-def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+def last_op_info(group_name: str = "default") -> dict:
+    """The {op, tier, algo, bytes, dtype, quant} record of the group's
+    most recent eager op ({} for backends that do not record one)."""
+    return dict(getattr(_manager.get(group_name), "last_op_info", None) or {})
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM,
+              quant: Optional[str] = None, error_feedback: bool = False,
+              algo: Optional[str] = None):
     """In-place-style allreduce (reference :258). Returns the reduced value
-    (numpy for DCN; device arrays for XLA)."""
+    (numpy for DCN; device arrays for XLA).
+
+    quant ("int8"/"fp8") and error_feedback quantize the DCN tier of the
+    exchange (see util/collective/quant.py); algo ("ring"/"rd"/"hier")
+    overrides the topology cost model's per-op choice. All three are
+    DCN/hierarchical-only — the XLA-local backend reduces over ICI where
+    the wire is effectively free, so asking to quantize it is an error.
+    """
     g = _manager.get(group_name)
-    if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return _observed("allreduce", lambda: g.allreduce(tensor, op))
-    return _observed("allreduce", lambda: g.allreduce(_as_numpy(tensor), op))
+    if isinstance(g, XlaLocalGroup):
+        if quant is not None or error_feedback or algo is not None:
+            raise ValueError(
+                "quant/error_feedback/algo apply to the DCN tier; the XLA "
+                "backend is ICI-local"
+            )
+        return _observed("allreduce", lambda: g.allreduce(tensor, op), g)
+    if isinstance(g, HierarchicalGroup):
+        return _observed(
+            "allreduce",
+            lambda: g.allreduce(tensor, op, quant=quant,
+                                error_feedback=error_feedback, algo=algo),
+            g,
+        )
+    return _observed(
+        "allreduce",
+        lambda: g.allreduce(_as_numpy(tensor), op, quant=quant,
+                            error_feedback=error_feedback, algo=algo),
+        g,
+    )
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
     g = _manager.get(group_name)
-    return _observed("reduce", lambda: g.reduce(_as_numpy(tensor), dst_rank, op))
+    return _observed("reduce",
+                     lambda: g.reduce(_as_numpy(tensor), dst_rank, op), g)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
     if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return _observed("broadcast", lambda: g.broadcast(tensor, src_rank))
+        return _observed("broadcast", lambda: g.broadcast(tensor, src_rank), g)
     return _observed("broadcast",
-                     lambda: g.broadcast(_as_numpy(tensor), src_rank))
+                     lambda: g.broadcast(_as_numpy(tensor), src_rank), g)
 
 
 def allgather(tensor, group_name: str = "default"):
     g = _manager.get(group_name)
     if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return _observed("allgather", lambda: g.allgather(tensor))
-    return _observed("allgather", lambda: g.allgather(_as_numpy(tensor)))
+        return _observed("allgather", lambda: g.allgather(tensor), g)
+    return _observed("allgather", lambda: g.allgather(_as_numpy(tensor)), g)
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     g = _manager.get(group_name)
     if isinstance(g, (XlaLocalGroup, HierarchicalGroup)):
-        return _observed("reducescatter", lambda: g.reducescatter(tensor, op))
+        return _observed("reducescatter",
+                         lambda: g.reducescatter(tensor, op), g)
     return _observed("reducescatter",
-                     lambda: g.reducescatter(_as_numpy(tensor), op))
+                     lambda: g.reducescatter(_as_numpy(tensor), op), g)
 
 
 def barrier(group_name: str = "default"):
     g = _manager.get(group_name)
-    _observed("barrier", g.barrier)
+    _observed("barrier", g.barrier, g)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
-    _observed("send", lambda: g.send(_as_numpy(tensor), dst_rank))
+    _observed("send", lambda: g.send(_as_numpy(tensor), dst_rank), g)
 
 
 def recv(tensor_shape, src_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
-    return _observed("recv", lambda: g.recv(src_rank))
+    return _observed("recv", lambda: g.recv(src_rank), g)

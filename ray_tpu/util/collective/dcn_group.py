@@ -8,13 +8,27 @@ tensors and control data. On TPU pods this is the cross-slice/DCN fallback;
 the high-bandwidth path is XLA collectives over ICI inside compiled
 programs (see parallel/).
 
-Algorithms:
-  * allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
-    2*(n-1)/n * bytes per link)
+Algorithms (selected per op from the alpha-beta cost model in
+topology.py, overridable via RT_COLLECTIVE_ALGO; the choice is recorded
+in `last_op_info` and flows to the flight-recorder op observers):
+  * allreduce[ring]: ring reduce-scatter + ring allgather (bandwidth-
+    optimal, 2*(n-1)/n * bytes per link); optionally quantized on the
+    wire (quant="int8"/"fp8", see quant.py): codes are decoded and
+    reduced in fp32 at every hop (ReduceOp-safe two-pass), with an
+    optional error-feedback residual folded into the next call.
+  * allreduce[rd]: recursive doubling — ceil(log2 n) rounds moving the
+    full message; latency-optimal for small tensors (barrier payloads,
+    scalars, control-plane sync). Non-power-of-2 folds the extra ranks
+    in and out.
   * allgather / reducescatter: single ring pass
   * broadcast: ring forward from root
   * barrier: zero-byte ring token
   * send/recv: direct socket between ranks
+
+Every payload byte that leaves this rank is counted (`bytes_sent`), and
+the send path honors the chaos DCN injections (`chaos.delay_dcn_send`,
+`chaos.cap_dcn_bandwidth`) so the algorithm-selection bench is
+deterministic on CPU loopback.
 
 Fault model (preemption-aware): every socket carries an op deadline, so a
 dead or wedged peer raises a typed CollectiveTimeoutError instead of
@@ -35,7 +49,15 @@ import numpy as np
 
 import logging
 
+from ray_tpu._private import chaos
 from ray_tpu.exceptions import CollectiveTimeoutError
+from ray_tpu.util.collective import quant as quant_mod
+from ray_tpu.util.collective.topology import (
+    ALGO_HIER,
+    ALGO_RD,
+    ALGO_RING,
+    Topology,
+)
 from ray_tpu.util.collective.types import ReduceOp
 
 logger = logging.getLogger("ray_tpu.collective")
@@ -59,9 +81,14 @@ def _reduce(op: ReduceOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
 
 
 class _Peer:
-    def __init__(self, sock: socket.socket, op_timeout: Optional[float] = None):
+    def __init__(self, sock: socket.socket, op_timeout: Optional[float] = None,
+                 on_send=None):
         self.sock = sock
         self.lock = threading.Lock()
+        # Owning group's byte accountant: called with the framed length
+        # of every send (powers collective_bytes_total and the bench's
+        # DCN-byte gates).
+        self.on_send = on_send
         # One deadline per blocking socket op: a peer that stops draining
         # (or stops sending) trips socket.timeout instead of blocking the
         # rank forever mid-collective.
@@ -70,7 +97,19 @@ class _Peer:
 
     def send_bytes(self, data: bytes):
         with self.lock:
+            # Chaos DCN injections: a fixed per-send delay (models link
+            # latency — what makes recursive doubling beat the ring) and
+            # a bandwidth cap (models a saturated slow tier — what makes
+            # quantization pay). Both are no-cost reads when chaos is off.
+            delay = chaos.take_dcn_send_delay()
+            if delay:
+                time.sleep(delay)
+            cap = chaos.dcn_bandwidth_cap()
+            if cap:
+                time.sleep((len(data) + _LEN.size) / cap)
             self.sock.sendall(_LEN.pack(len(data)) + data)
+            if self.on_send is not None:
+                self.on_send(len(data) + _LEN.size)
 
     def recv_bytes(self) -> bytes:
         header = self._recv_exact(8)
@@ -95,7 +134,38 @@ def _send_array(peer: _Peer, arr: np.ndarray):
 
 
 def _recv_array(peer: _Peer) -> np.ndarray:
+    out = _recv_frame(peer)
+    if isinstance(out, quant_mod.QuantPayload):
+        return quant_mod.decode(out)
+    return out
+
+
+def _send_quant(peer: _Peer, p: quant_mod.QuantPayload):
+    """Quantized frame: 'Q|' header + codes + scales (3 length-prefixed
+    messages; the byte accountant sees the true wire cost)."""
+    shape_str = ",".join(map(str, p.shape))
+    peer.send_bytes(
+        f"Q|{p.scheme}|{p.block}|{p.dtype}|{shape_str}".encode()
+    )
+    peer.send_bytes(p.codes.tobytes())
+    peer.send_bytes(p.scales.tobytes())
+
+
+def _recv_frame(peer: _Peer):
+    """Receive one frame: a plain ndarray or a QuantPayload (returned
+    undecoded so the allgather phase can forward codes verbatim without
+    re-quantizing)."""
     header = peer.recv_bytes().decode()
+    if header.startswith("Q|"):
+        _, scheme, block, dtype_str, shape_str = header.split("|")
+        shape = (tuple(int(s) for s in shape_str.split(","))
+                 if shape_str else ())
+        codes = np.frombuffer(peer.recv_bytes(), dtype=np.int8).copy()
+        scales = np.frombuffer(peer.recv_bytes(), dtype=np.float32).copy()
+        return quant_mod.QuantPayload(
+            scheme=scheme, codes=codes, scales=scales, shape=shape,
+            dtype=dtype_str, block=int(block),
+        )
     dtype_str, shape_str = header.split("|")
     shape = tuple(int(s) for s in shape_str.split(",")) if shape_str else ()
     data = peer.recv_bytes()
@@ -123,6 +193,16 @@ class DcnGroup:
         self.group_name = group_name
         self.epoch = int(epoch)
         self._kv = kv
+        # Flat topology as this ring sees it (each member is one DCN
+        # endpoint); drives the per-op ring-vs-recursive-doubling choice.
+        self.topo = Topology.detect(world_size, n_local=1)
+        # Framed payload bytes this rank has pushed onto DCN (lifetime).
+        self.bytes_sent = 0
+        # (op, algo, tier, bytes, dtype, quant) of the last completed op
+        # — read by the collective-API observer/metrics layer.
+        self.last_op_info: dict = {}
+        # Error-feedback residuals for quantized allreduce (lazy).
+        self._ef: Optional[quant_mod.ErrorFeedback] = None
         self._timeout = (timeout if timeout is not None
                          else cfg.collective_rendezvous_timeout_s)
         self._op_timeout = (op_timeout if op_timeout is not None
@@ -176,7 +256,7 @@ class DcnGroup:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            peer = _Peer(sock, self._op_timeout)
+            peer = _Peer(sock, self._op_timeout, on_send=self._count_sent)
             # First frame identifies the sender: (rank, epoch). A member
             # of a different epoch is a zombie from a torn-down attempt —
             # close the socket so it can never inject into this ring.
@@ -204,7 +284,7 @@ class DcnGroup:
             host, port = self._lookup(rank)
             sock = socket.create_connection((host, port), timeout=self._timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            peer = _Peer(sock, self._op_timeout)
+            peer = _Peer(sock, self._op_timeout, on_send=self._count_sent)
             peer.send_bytes(_IDENT.pack(self.rank, self.epoch))
             self._outgoing[rank] = peer
         return peer
@@ -243,36 +323,196 @@ class DcnGroup:
     def _left(self) -> int:
         return (self.rank - 1) % self.world_size
 
-    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+    def _count_sent(self, nbytes: int) -> None:
+        self.bytes_sent += nbytes
+
+    def _record_op(self, op_name: str, algo: str, bytes0: int,
+                   dtype, quant: Optional[str] = None) -> None:
+        self.last_op_info = {
+            "op": op_name,
+            "algo": algo,
+            "tier": "dcn",
+            "bytes": self.bytes_sent - bytes0,
+            "dtype": str(dtype),
+            "quant": quant,
+        }
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM,
+                  quant: Optional[str] = None, error_feedback: bool = False,
+                  algo: Optional[str] = None,
+                  ef_key: Optional[object] = None) -> np.ndarray:
+        """Allreduce with per-op algorithm selection.
+
+        quant: "int8"/"fp8" — block-scale-quantize every wire message
+            (ring only; codes are decoded and reduced in fp32 per hop).
+        error_feedback: keep this rank's quantization residual and fold
+            it into the next allreduce on the same `ef_key` (SUM only).
+        algo: force "ring"/"rd"; default consults the topology cost
+            model (and the RT_COLLECTIVE_ALGO env override).
+        """
         n = self.world_size
+        bytes0 = self.bytes_sent
+        if algo is None:
+            algo = self.topo.select("allreduce", arr.nbytes)
+        if algo == ALGO_HIER:
+            algo = ALGO_RING  # a flat ring has no local tier to shard on
+        if quant is not None:
+            quant_mod.validate_scheme(quant)
+            algo = ALGO_RING  # quantization targets the bandwidth regime
+        if error_feedback and not quant:
+            raise ValueError("error_feedback requires quant='int8'/'fp8'")
+        if error_feedback and op != ReduceOp.SUM:
+            raise ValueError(
+                "error_feedback folds an additive residual into the "
+                "input — only ReduceOp.SUM is EF-safe"
+            )
         if n == 1:
+            self._record_op("allreduce", algo, bytes0, arr.dtype, quant)
             return arr.copy()
+        if algo == ALGO_RD:
+            out = self._allreduce_rd(arr, op)
+        else:
+            out = self._allreduce_ring(arr, op, quant=quant,
+                                       error_feedback=error_feedback,
+                                       ef_key=ef_key)
+        self._record_op("allreduce", algo, bytes0, arr.dtype, quant)
+        return out
+
+    def _allreduce_ring(self, arr: np.ndarray, op: ReduceOp,
+                        quant: Optional[str] = None,
+                        error_feedback: bool = False,
+                        ef_key: Optional[object] = None) -> np.ndarray:
+        """Ring reduce-scatter + allgather; with `quant`, every hop's
+        message is quantized on the wire but reduced in fp32 (the
+        quantize-scatter / reduce-fp32 / quantize-gather two-pass), and
+        with `error_feedback` the rounding error this rank injects is
+        banked and folded into the next call's input."""
+        n = self.world_size
         flat = np.ascontiguousarray(arr).reshape(-1)
+        ef = None
+        if error_feedback:
+            if self._ef is None:
+                self._ef = quant_mod.ErrorFeedback()
+            ef = self._ef
+            if ef_key is None:
+                ef_key = ("allreduce", flat.size)
+            flat = ef.apply(ef_key, flat)
+        elif quant:
+            flat = flat.astype(np.float32, copy=False)
         chunks: List[np.ndarray] = [c.copy() for c in np.array_split(flat, n)]
+        # Flat offset of each chunk (EF residuals are positional).
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([c.size for c in chunks], out=offsets[1:])
         right, left = self._peer_out(self._right), self._peer_in(self._left)
+
+        def _ship(idx: int):
+            """Send chunk `idx`, quantizing (and EF-banking) if asked."""
+            if not quant:
+                _send_array(right, chunks[idx])
+                return
+            payload = quant_mod.encode(chunks[idx], quant)
+            _send_quant(right, payload)
+            if ef is not None:
+                ef.add(ef_key, int(offsets[idx]),
+                       chunks[idx] - quant_mod.decode(payload).reshape(-1),
+                       flat.size)
+
         try:
-            # Phase 1: ring reduce-scatter.
+            # Phase 1: ring reduce-scatter (reduction always on decoded
+            # fp32/native values, never on codes).
             for step in range(n - 1):
                 send_idx = (self.rank - step) % n
                 recv_idx = (self.rank - step - 1) % n
-                _send_array(right, chunks[send_idx])
+                _ship(send_idx)
                 incoming = _recv_array(left)
-                chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
-            # Phase 2: ring allgather of reduced chunks.
+                chunks[recv_idx] = _reduce(op, chunks[recv_idx],
+                                           incoming.reshape(-1))
+            # Phase 2: ring allgather of reduced chunks. Quantized mode
+            # encodes each chunk ONCE (by its owner) and forwards the
+            # received codes verbatim, so the gather pass adds exactly
+            # one rounding per chunk and every rank decodes identical
+            # values (bitwise-consistent results across the ring).
+            prev_payload = None
             for step in range(n - 1):
                 send_idx = (self.rank + 1 - step) % n
                 recv_idx = (self.rank - step) % n
-                _send_array(right, chunks[send_idx])
-                chunks[recv_idx] = _recv_array(left)
+                if not quant:
+                    _send_array(right, chunks[send_idx])
+                    chunks[recv_idx] = _recv_array(left).reshape(-1)
+                    continue
+                if step == 0:  # own reduced chunk: quantize once
+                    payload = quant_mod.encode(chunks[send_idx], quant)
+                    if ef is not None:
+                        ef.add(
+                            ef_key, int(offsets[send_idx]),
+                            chunks[send_idx]
+                            - quant_mod.decode(payload).reshape(-1),
+                            flat.size,
+                        )
+                    # Every rank must end with the same values: the
+                    # owner keeps the decoded codes too.
+                    chunks[send_idx] = (
+                        quant_mod.decode(payload).reshape(-1))
+                else:  # forward the received codes unchanged
+                    payload = prev_payload
+                _send_quant(right, payload)
+                prev_payload = _recv_frame(left)
+                chunks[recv_idx] = (
+                    quant_mod.decode(prev_payload).reshape(-1))
         except socket.timeout:
             raise self._timeout_error("allreduce", self._left) from None
         return np.concatenate(chunks).reshape(arr.shape).astype(arr.dtype, copy=False)
 
+    def _allreduce_rd(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        """Recursive doubling: ceil(log2 n) pairwise full-message
+        exchanges — latency-optimal for small messages. Non-power-of-2
+        world sizes fold the surplus ranks into the low ranks first and
+        fan the result back out at the end. Pair exchanges are ordered
+        by rank (lower sends first) so two peers can never deadlock in
+        sendall."""
+        n = self.world_size
+        r = self.rank
+        p = 1 << (n.bit_length() - 1)  # largest power of two <= n
+        val = np.ascontiguousarray(arr).reshape(-1).copy()
+        shape, dtype = arr.shape, arr.dtype
+        extra = n - p
+        partner = r  # last peer touched, for the timeout message
+        try:
+            if r >= p:
+                # Surplus rank: contribute to the partner, then wait for
+                # the fanned-out result.
+                partner = r - p
+                _send_array(self._peer_out(partner), val)
+                out = _recv_array(self._peer_in(partner)).reshape(-1)
+                return out.reshape(shape).astype(dtype, copy=False)
+            if r < extra:
+                partner = r + p
+                incoming = _recv_array(self._peer_in(r + p)).reshape(-1)
+                val = _reduce(op, val, incoming)
+            mask = 1
+            while mask < p:
+                partner = r ^ mask
+                if r < partner:
+                    _send_array(self._peer_out(partner), val)
+                    incoming = _recv_array(self._peer_in(partner))
+                else:
+                    incoming = _recv_array(self._peer_in(partner))
+                    _send_array(self._peer_out(partner), val)
+                val = _reduce(op, val, incoming.reshape(-1))
+                mask <<= 1
+            if r < extra:
+                _send_array(self._peer_out(r + p), val)
+        except socket.timeout:
+            raise self._timeout_error("allreduce[rd]", partner) from None
+        return val.reshape(shape).astype(dtype, copy=False)
+
     def allgather(self, arr: np.ndarray) -> List[np.ndarray]:
         n = self.world_size
+        bytes0 = self.bytes_sent
         out: List[Optional[np.ndarray]] = [None] * n
         out[self.rank] = np.asarray(arr).copy()
         if n == 1:
+            self._record_op("allgather", ALGO_RING, bytes0, np.asarray(arr).dtype)
             return out  # type: ignore[return-value]
         right, left = self._peer_out(self._right), self._peer_in(self._left)
         try:
@@ -283,6 +523,7 @@ class DcnGroup:
                 out[recv_idx] = _recv_array(left)
         except socket.timeout:
             raise self._timeout_error("allgather", self._left) from None
+        self._record_op("allgather", ALGO_RING, bytes0, np.asarray(arr).dtype)
         return out  # type: ignore[return-value]
 
     def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
@@ -292,9 +533,11 @@ class DcnGroup:
         holding fully-reduced chunk r.
         """
         n = self.world_size
+        bytes0 = self.bytes_sent
         flat = np.ascontiguousarray(arr).reshape(-1)
         chunks = [c.copy() for c in np.array_split(flat, n)]
         if n == 1:
+            self._record_op("reducescatter", ALGO_RING, bytes0, arr.dtype)
             return chunks[0]
         right, left = self._peer_out(self._right), self._peer_in(self._left)
         try:
@@ -306,10 +549,14 @@ class DcnGroup:
                 chunks[recv_idx] = _reduce(op, chunks[recv_idx], incoming)
         except socket.timeout:
             raise self._timeout_error("reducescatter", self._left) from None
+        self._record_op("reducescatter", ALGO_RING, bytes0, arr.dtype)
         return chunks[self.rank]
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        bytes0 = self.bytes_sent
         if self.world_size == 1:
+            self._record_op("broadcast", ALGO_RING, bytes0,
+                            np.asarray(arr).dtype)
             return np.asarray(arr).copy()
         if self.rank == root:
             out = np.asarray(arr).copy()
@@ -321,28 +568,40 @@ class DcnGroup:
                 _send_array(self._peer_out(self._right), out)
         except socket.timeout:
             raise self._timeout_error("broadcast", self._left) from None
+        self._record_op("broadcast", ALGO_RING, bytes0, out.dtype)
         return out
 
     def reduce(self, arr: np.ndarray, root: int = 0,
                op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         # Simple: allreduce then root keeps (fine at control-plane sizes).
+        bytes0 = self.bytes_sent
         out = self.allreduce(arr, op)
+        algo = self.last_op_info.get("algo", ALGO_RING)
+        self._record_op("reduce", algo, bytes0, arr.dtype)
         return out if self.rank == root else np.asarray(arr).copy()
 
     def barrier(self):
+        bytes0 = self.bytes_sent
         self.allreduce(np.zeros(1, dtype=np.int32))
+        algo = self.last_op_info.get("algo", ALGO_RING)
+        self._record_op("barrier", algo, bytes0, np.dtype(np.int32))
 
     def send(self, arr: np.ndarray, dst_rank: int):
+        bytes0 = self.bytes_sent
         try:
             _send_array(self._peer_out(dst_rank), np.asarray(arr))
         except socket.timeout:
             raise self._timeout_error("send", dst_rank) from None
+        self._record_op("send", "p2p", bytes0, np.asarray(arr).dtype)
 
     def recv(self, src_rank: int) -> np.ndarray:
+        bytes0 = self.bytes_sent
         try:
-            return _recv_array(self._peer_in(src_rank))
+            out = _recv_array(self._peer_in(src_rank))
         except socket.timeout:
             raise self._timeout_error("recv", src_rank) from None
+        self._record_op("recv", "p2p", bytes0, out.dtype)
+        return out
 
     def destroy(self):
         # Drop the rendezvous entry so a recreated group with the same name

@@ -5,25 +5,38 @@ The multi-slice TPU topology has two bandwidth tiers: ICI within a slice
 (fast, reached through XLA programs over local devices) and DCN between
 slices/hosts (orders of magnitude slower). A flat cross-host ring would
 push every device's data over DCN; the hierarchical schedule reduces
-locally first so only ONE copy per process crosses the slow tier:
+locally first, and — since PR 9 — SHARDS the cross-tier exchange:
 
-    allreduce = local XLA psum (ICI)          # n_local arrays -> 1 value
-              -> DCN ring allreduce of that value across processes
-              -> local broadcast of the global result (free: replication)
+    allreduce = ICI-local reduce-scatter      # n_local shards of local sum
+              -> DCN exchange, ONE shard per lane  (1/n_local the bytes
+                 a flat all-devices DCN ring would push per process)
+              -> ICI allgather of the reduced shards (free: replication)
 
-This is the standard two-level algorithm for multi-slice training (the
-scaling-book cross-slice recipe; reference analog: NCCL's intra-node
-NVLink + inter-node IB hierarchy, which NCCL performs internally — here
-the two tiers are explicit because they are different transports).
+The legacy schedule (local psum -> full-array DCN ring -> broadcast)
+remains available as the "ring" algorithm; "rd" runs the full local sum
+through the latency-optimal recursive-doubling exchange instead (small
+messages). The choice comes from the alpha-beta cost model in
+topology.py per (collective, topology, nbytes), overridable with
+RT_COLLECTIVE_ALGO, and is recorded in `last_op_info`.
+
+Quantization (quant="int8"/"fp8") applies to the DCN tier only — the
+ICI tier stays full-precision, exactly the EQuARX placement: compress
+where the wire is slow, never where it is free.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
 from ray_tpu.util.collective.dcn_group import DcnGroup
+from ray_tpu.util.collective.topology import (
+    ALGO_HIER,
+    ALGO_RD,
+    ALGO_RING,
+    Topology,
+)
 from ray_tpu.util.collective.types import ReduceOp
 from ray_tpu.util.collective.xla_group import XlaLocalGroup
 
@@ -43,50 +56,120 @@ class HierarchicalGroup:
                             epoch=epoch, op_timeout=op_timeout_s)
         self.world_size = world_size
         self.rank = rank
+        # Two-tier topology: DCN width = processes, local width = the
+        # devices this process actually drives.
+        self.topo = Topology.detect(world_size, n_local=self.local.world_size)
+        self.last_op_info: dict = {}
 
     @property
     def total_ranks(self) -> int:
         return self.world_size * self.local.world_size
 
-    def allreduce(self, tensors: List, op: ReduceOp = ReduceOp.SUM) -> List:
+    def _record_op(self, op_name: str, algo: str, dcn_bytes0: int,
+                   dtype, quant: Optional[str] = None) -> None:
+        self.last_op_info = {
+            "op": op_name,
+            "algo": algo,
+            "tier": "ici+dcn",
+            "bytes": self.dcn.bytes_sent - dcn_bytes0,  # slow-tier bytes
+            "dtype": str(dtype),
+            "quant": quant,
+        }
+
+    def allreduce(self, tensors: List, op: ReduceOp = ReduceOp.SUM,
+                  quant: Optional[str] = None,
+                  error_feedback: bool = False,
+                  algo: Optional[str] = None) -> List:
         """tensors: one per local device. Returns the GLOBAL reduction
         (across every device of every process), one copy per local
-        device."""
-        local = self.local.allreduce(tensors, op)  # ICI tier
-        if self.world_size == 1:
-            return local
-        global_val = self.dcn.allreduce(np.asarray(local[0]), op)  # DCN tier
+        device. quant/error_feedback apply to the DCN tier only."""
         import jax.numpy as jnp
 
+        arr0 = np.asarray(tensors[0])
+        dcn_bytes0 = self.dcn.bytes_sent
+        if algo is None:
+            algo = self.topo.select("allreduce", arr0.nbytes)
+        if algo == ALGO_HIER and self.local.world_size == 1:
+            algo = ALGO_RING  # nothing to shard over
+        if self.world_size == 1:
+            out = self.local.allreduce(tensors, op)
+            self._record_op("allreduce", algo, dcn_bytes0, arr0.dtype, quant)
+            return out
+
+        if algo == ALGO_HIER:
+            # ICI tier: local reduce-scatter — device d ends with shard
+            # d of the local sum (flat, 1/n_local of the elements).
+            shards = self.local.reducescatter(tensors, op)
+            # DCN tier: each shard crosses as its own lane (per-chip
+            # NICs in hardware; sequential over one socket here), so a
+            # lane's wire cost is 1/n_local of the full-array exchange.
+            reduced = [
+                self.dcn.allreduce(
+                    np.asarray(shard), op, quant=quant,
+                    error_feedback=error_feedback, algo=ALGO_RING,
+                    ef_key=("hier_lane", lane, np.asarray(shard).size),
+                )
+                for lane, shard in enumerate(shards)
+            ]
+            # ICI tier: allgather — replication of the host copy is
+            # free on the local tier.
+            full = np.concatenate([np.asarray(s).reshape(-1)
+                                   for s in reduced])
+            full = full.reshape(arr0.shape).astype(arr0.dtype, copy=False)
+            out_val = jnp.asarray(full)
+            self._record_op("allreduce", ALGO_HIER, dcn_bytes0,
+                            arr0.dtype, quant)
+            return [out_val for _ in range(self.local.world_size)]
+
+        # Legacy two-tier schedules: full local reduction, then the
+        # whole array crosses DCN once per process (ring or recursive
+        # doubling), then local broadcast by replication.
+        local = self.local.allreduce(tensors, op)  # ICI tier
+        dcn_algo = ALGO_RD if algo == ALGO_RD else ALGO_RING
+        global_val = self.dcn.allreduce(
+            np.asarray(local[0]), op, quant=quant,
+            error_feedback=error_feedback, algo=dcn_algo,
+        )
         out = jnp.asarray(global_val)
+        self._record_op("allreduce", dcn_algo, dcn_bytes0, arr0.dtype, quant)
         return [out for _ in range(self.local.world_size)]
 
     def broadcast(self, tensors: List, root_process: int = 0,
                   root_local: int = 0) -> List:
+        dcn_bytes0 = self.dcn.bytes_sent
         local = self.local.broadcast(tensors, root_local)
         if self.world_size == 1:
+            self._record_op("broadcast", ALGO_RING, dcn_bytes0,
+                            np.asarray(tensors[root_local]).dtype)
             return local
         global_val = self.dcn.broadcast(np.asarray(local[0]), root_process)
         import jax.numpy as jnp
 
         out = jnp.asarray(global_val)
+        self._record_op("broadcast", ALGO_RING, dcn_bytes0, global_val.dtype)
         return [out for _ in range(self.local.world_size)]
 
     def allgather(self, tensors: List) -> List[List]:
         """Returns, per local device, the list of every device's tensor
         across all processes (process-major, local-device-minor order)."""
+        dcn_bytes0 = self.dcn.bytes_sent
         local_lists = self.local.allgather(tensors)  # all local tensors
         if self.world_size == 1:
+            self._record_op("allgather", ALGO_RING, dcn_bytes0,
+                            np.asarray(tensors[0]).dtype)
             return local_lists
         stacked = np.stack([np.asarray(t) for t in local_lists[0]])
         gathered = self.dcn.allgather(stacked)  # [world][n_local, ...]
         flat = [g[i] for g in gathered for i in range(len(local_lists[0]))]
+        self._record_op("allgather", ALGO_RING, dcn_bytes0, stacked.dtype)
         return [list(flat) for _ in range(self.local.world_size)]
 
     def reducescatter(self, tensors: List, op: ReduceOp = ReduceOp.SUM) -> List:
         """Global reduce, then each local device takes its slice of the
         process's shard (total_ranks-way split)."""
+        dcn_bytes0 = self.dcn.bytes_sent
         reduced = self.allreduce(tensors, op)
+        algo = self.last_op_info.get("algo", ALGO_RING)
         outs = []
         n_local = self.local.world_size
         for i in range(n_local):
@@ -94,12 +177,18 @@ class HierarchicalGroup:
                 np.asarray(reduced[i]).reshape(-1), self.total_ranks
             )
             outs.append(chunks[self.rank * n_local + i])
+        self._record_op("reducescatter", algo, dcn_bytes0,
+                        np.asarray(tensors[0]).dtype)
         return outs
 
     def barrier(self):
+        dcn_bytes0 = self.dcn.bytes_sent
         self.local.barrier()
         if self.world_size > 1:
             self.dcn.barrier()
+        self._record_op("barrier", self.dcn.last_op_info.get("algo", ALGO_RING)
+                        if self.world_size > 1 else ALGO_RING,
+                        dcn_bytes0, np.dtype(np.int32))
 
     def destroy(self):
         self.local.destroy()

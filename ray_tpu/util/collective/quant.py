@@ -1,0 +1,186 @@
+"""Quantized wire codec for the DCN collective tier.
+
+Gradients that cross DCN pay the slow tier's bandwidth in fp32 today.
+EQuARX (arXiv:2506.17615) shows block-scaled int8 AllReduce inside XLA
+costs a bounded, SGD-tolerable error for a ~4x wire-byte cut; this
+module is the eager-DCN analog: a numpy codec the TCP ring applies
+per message, plus the error-feedback residual bookkeeping that makes
+the quantization noise average out over steps (EF-SGD).
+
+Schemes (the `quant=` argument of `collective.allreduce`):
+
+  * "int8" — per-block absmax scaling to int8 codes (block=256 floats
+    per fp32 scale: 1.56% scale overhead, ~3.9x wire reduction).
+  * "fp8"  — fp8 (e4m3) codes carried on the int8 wire: same byte
+    count, relative-precision rounding instead of uniform — better for
+    heavy-tailed blocks. Needs ml_dtypes (ships with jax); selecting it
+    without ml_dtypes raises rather than silently degrading.
+
+The codec is reduction-safe by construction: codes are NEVER reduced —
+every hop decodes to fp32, reduces in fp32, and re-encodes the partial
+it forwards (the "quantize-scatter / reduce in fp32 / quantize-gather"
+two-pass in dcn_group.py) — so SUM/MIN/MAX/PRODUCT all behave, and the
+error per element is bounded by the per-hop rounding radius times the
+hop count, independent of the values' magnitude spread across blocks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+#: Floats covered by one fp32 scale. 256 keeps the scale overhead at
+#: 4/256 = 1.56% of the code bytes while isolating magnitude outliers
+#: to their own block (EQuARX uses comparable block sizes).
+DEFAULT_BLOCK = 256
+
+SCHEMES = ("int8", "fp8")
+
+_FP8_MAX = 448.0  # e4m3 finite max
+
+
+def _fp8_dtype():
+    try:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.float8_e4m3fn)
+    except Exception:  # rtlint: disable=RT007 — optional dep probe
+        return None
+
+
+def validate_scheme(scheme: str) -> str:
+    if scheme not in SCHEMES:
+        raise ValueError(
+            f"unknown quant scheme {scheme!r}; valid: {SCHEMES}"
+        )
+    if scheme == "fp8" and _fp8_dtype() is None:
+        raise ValueError(
+            "quant='fp8' needs ml_dtypes (jax dependency) for the e4m3 "
+            "code table; install it or use quant='int8'"
+        )
+    return scheme
+
+
+@dataclass
+class QuantPayload:
+    """One quantized array on the wire: int8 codes + per-block fp32
+    scales + enough metadata to decode on any peer."""
+
+    scheme: str
+    codes: np.ndarray        # int8, flat
+    scales: np.ndarray       # float32, one per block
+    shape: tuple
+    dtype: str               # original dtype str, restored on decode
+    block: int = DEFAULT_BLOCK
+
+    @property
+    def wire_bytes(self) -> int:
+        return self.codes.nbytes + self.scales.nbytes
+
+
+def encode(arr: np.ndarray, scheme: str = "int8",
+           block: int = DEFAULT_BLOCK) -> QuantPayload:
+    """Quantize `arr` to block-scaled codes (lossy, bounded)."""
+    validate_scheme(scheme)
+    a = np.ascontiguousarray(arr)
+    flat = a.reshape(-1).astype(np.float32, copy=False)
+    n = flat.size
+    nblocks = max(1, -(-n // block))
+    padded = np.zeros(nblocks * block, dtype=np.float32)
+    padded[:n] = flat
+    blocks = padded.reshape(nblocks, block)
+    absmax = np.abs(blocks).max(axis=1)
+    if scheme == "int8":
+        scales = (absmax / 127.0).astype(np.float32)
+        safe = np.where(scales > 0, scales, 1.0)
+        codes = np.rint(blocks / safe[:, None]).clip(-127, 127).astype(np.int8)
+    else:  # fp8 codes on the int8 wire
+        f8 = _fp8_dtype()
+        scales = (absmax / _FP8_MAX).astype(np.float32)
+        safe = np.where(scales > 0, scales, 1.0)
+        codes = (blocks / safe[:, None]).astype(f8).view(np.int8)
+    # The pad exists only to block the scales math: truncate it off the
+    # wire (at DCN chunk sizes the tail pad would eat ~10% of the win).
+    return QuantPayload(
+        scheme=scheme, codes=codes.reshape(-1)[:n], scales=scales,
+        shape=tuple(a.shape), dtype=a.dtype.str, block=block,
+    )
+
+
+def decode(p: QuantPayload) -> np.ndarray:
+    """Dequantize to the original shape/dtype (values in fp32 grid)."""
+    if p.scheme == "int8":
+        vals = p.codes.astype(np.float32)
+    else:
+        f8 = _fp8_dtype()
+        if f8 is None:
+            raise ValueError("cannot decode fp8 payload without ml_dtypes")
+        vals = p.codes.view(f8).astype(np.float32)
+    nblocks = p.scales.size
+    if vals.size < nblocks * p.block:  # re-pad the truncated tail block
+        vals = np.concatenate(
+            [vals, np.zeros(nblocks * p.block - vals.size, dtype=np.float32)]
+        )
+    blocks = vals.reshape(nblocks, p.block) * p.scales[:, None]
+    n = int(np.prod(p.shape)) if p.shape else 1
+    out = blocks.reshape(-1)[:n].reshape(p.shape)
+    return out.astype(np.dtype(p.dtype), copy=False)
+
+
+def roundtrip_error(arr: np.ndarray, scheme: str = "int8",
+                    block: int = DEFAULT_BLOCK) -> float:
+    """Max abs error of one encode/decode, normalized by the array's
+    absmax — the per-hop noise radius the two-pass transport multiplies
+    by its hop count."""
+    a = np.asarray(arr, dtype=np.float32)
+    peak = float(np.abs(a).max()) if a.size else 0.0
+    if peak == 0.0:
+        return 0.0
+    err = float(np.abs(decode(encode(a, scheme, block)) - a).max())
+    return err / peak
+
+
+class ErrorFeedback:
+    """Per-group residual memory for EF-quantized allreduce (EF-SGD).
+
+    Every quantization a rank performs on the wire injects a rounding
+    error into the global sum. The transport reports each injection
+    here (`add`); the NEXT allreduce on the same key folds the
+    accumulated residual back into the input (`apply`), so the noise
+    telescopes: the time-average of the quantized results converges to
+    the time-average of the exact ones instead of random-walking away.
+    Keyed per tensor (caller-supplied `ef_key`, e.g. the gradient
+    bucket name or the hier lane index) because residuals are
+    positional.
+
+    SUM-only: folding an additive residual into MIN/MAX/PRODUCT inputs
+    would corrupt them, so the transport refuses EF for other ops.
+    """
+
+    def __init__(self):
+        self._residual: Dict[object, np.ndarray] = {}
+
+    def apply(self, key: object, flat: np.ndarray) -> np.ndarray:
+        """Return flat + residual[key] (fp32), claiming the residual."""
+        r = self._residual.pop(key, None)
+        if r is None or r.size != flat.size:
+            return flat.astype(np.float32, copy=True)
+        return flat.astype(np.float32) + r
+
+    def add(self, key: object, start: int, err: np.ndarray,
+            size: int) -> None:
+        """Record `err` (exact - quantized) at flat offset `start` of
+        the tensor known as `key` (total flat length `size`)."""
+        r = self._residual.get(key)
+        if r is None or r.size != size:
+            r = self._residual[key] = np.zeros(size, dtype=np.float32)
+        r[start:start + err.size] += err
+
+    def residual_norm(self, key: object) -> float:
+        r = self._residual.get(key)
+        return float(np.abs(r).max()) if r is not None else 0.0
+
+    def clear(self) -> None:
+        self._residual.clear()

@@ -46,6 +46,20 @@ class XlaLocalGroup:
         from jax.sharding import Mesh
 
         self.mesh = Mesh(np.array(self.devices), axis_names=("rank",))
+        # Same shape DcnGroup records, so the collective metrics/observer
+        # stream covers both tiers. "bytes" is the LOGICAL per-device
+        # message size — ICI wire bytes are XLA's business, not ours.
+        self.last_op_info: dict = {}
+
+    def _record_op(self, op_name: str, dtype, nbytes: int) -> None:
+        self.last_op_info = {
+            "op": op_name,
+            "algo": "psum",
+            "tier": "ici",
+            "bytes": int(nbytes),
+            "dtype": str(dtype),
+            "quant": None,
+        }
 
     @functools.lru_cache(maxsize=32)
     def _allreduce_fn(self, op: ReduceOp):
@@ -91,18 +105,25 @@ class XlaLocalGroup:
         )
 
     def allreduce(self, tensors: List, op: ReduceOp = ReduceOp.SUM) -> List:
+        import numpy as np
+
         if len(tensors) != self.world_size:
             raise ValueError(
                 f"need one tensor per device ({self.world_size}), got {len(tensors)}"
             )
         out = self._allreduce_fn(op)(self._stack(tensors))
+        arr0 = np.asarray(tensors[0])
+        self._record_op("allreduce", arr0.dtype, arr0.nbytes)
         return [out[i] for i in range(self.world_size)]
 
     def allgather(self, tensors: List) -> List[List]:
         import jax
+        import numpy as np
 
         stacked = self._stack(tensors)
         gathered = [stacked[i] for i in range(self.world_size)]
+        arr0 = np.asarray(tensors[0])
+        self._record_op("allgather", arr0.dtype, arr0.nbytes)
         return [list(gathered) for _ in range(self.world_size)]
 
     def reducescatter(self, tensors: List, op: ReduceOp = ReduceOp.SUM) -> List:
@@ -113,18 +134,25 @@ class XlaLocalGroup:
         for i in range(self.world_size):
             chunks = np.array_split(np.asarray(reduced[i]).reshape(-1), self.world_size)
             outs.append(chunks[i])
+        arr0 = np.asarray(tensors[0])
+        self._record_op("reducescatter", arr0.dtype, arr0.nbytes)
         return outs
 
     def broadcast(self, tensors: List, root_rank: int = 0) -> List:
         import jax.numpy as jnp
+        import numpy as np
 
         src = jnp.asarray(tensors[root_rank])
+        arr = np.asarray(tensors[root_rank])
+        self._record_op("broadcast", arr.dtype, arr.nbytes)
         return [src for _ in range(self.world_size)]
 
     def barrier(self):
         import jax.numpy as jnp
+        import numpy as np
 
         self.allreduce([jnp.zeros(1) for _ in range(self.world_size)])
+        self._record_op("barrier", np.dtype(np.float32), 0)
 
     def destroy(self):
         pass

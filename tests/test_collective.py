@@ -52,6 +52,29 @@ class CollectiveWorker:
         self.col.barrier(group_name)
         return True
 
+    def do_observed_allreduce(self, group_name="default"):
+        """Run an allreduce with an op observer attached and return the
+        (op, info) records — the flight-recorder attribution path."""
+        seen = []
+
+        def obs(op, seconds, info=None):
+            seen.append((op, info))
+
+        self.col.add_op_observer(obs)
+        try:
+            self.col.allreduce(np.full(1000, 1.0, dtype=np.float32),
+                               group_name)
+        finally:
+            self.col.remove_op_observer(obs)
+        return seen
+
+    def do_quant_allreduce(self, group_name="default"):
+        out = self.col.allreduce(
+            np.full(1024, float(self.rank + 1), dtype=np.float32),
+            group_name, quant="int8",
+        )
+        return out, self.col.last_op_info(group_name)
+
 
 @pytest.fixture
 def group(rt_start):
@@ -100,6 +123,32 @@ def test_dcn_sendrecv(group):
 
 def test_dcn_barrier(group):
     assert all(rt.get([w.do_barrier.remote() for w in group]))
+
+
+def test_dcn_ops_flow_through_observers_with_info(group):
+    """Eager DCN ops must reach collective._op_observers carrying
+    tier/algo/bytes so the flight recorder can attribute them."""
+    outs = rt.get([w.do_observed_allreduce.remote() for w in group])
+    for seen in outs:
+        assert len(seen) == 1
+        op, info = seen[0]
+        assert op == "allreduce"
+        assert info["tier"] == "dcn"
+        assert info["algo"] in ("ring", "rd")
+        assert info["bytes"] > 0
+        assert info["dtype"] == "float32"
+
+
+def test_dcn_quantized_allreduce_api(group):
+    """quant='int8' through the public API: bounded error and the op
+    record says what crossed the wire."""
+    outs = rt.get([w.do_quant_allreduce.remote() for w in group])
+    expected = np.full(1024, 6.0)  # 1+2+3 per element
+    for out, info in outs:
+        rel = np.abs(out - expected).max() / 6.0
+        assert rel <= 1e-2
+        assert info["quant"] == "int8"
+        assert info["algo"] == "ring"
 
 
 def test_xla_local_allreduce():

@@ -149,6 +149,15 @@ def _bench_serve_ft(metric_sub: str, field: str):
     return get
 
 
+def _bench_collective(metric_sub: str, field: str):
+    def get():
+        for e in _load("BENCH_COLLECTIVE.json"):
+            if metric_sub in e.get("metric", ""):
+                return e[field]
+        raise KeyError(f"no BENCH_COLLECTIVE entry matching {metric_sub!r}")
+    return get
+
+
 def _bench_r(field: str, sub: str = None):
     def get():
         d = _load("BENCH_TPU_LIVE.json")
@@ -403,6 +412,28 @@ CLAIMS = [
           _bench_scale_probe("lifecycle off-path overhead",
                              "fastpath_ops_us_per_task"),
           rel_tol=1.5, note="sub-µs micro-bench, noisy on a shared box"),
+    # Topology-native collectives <- BENCH_COLLECTIVE.json
+    # (bench_collective.py). Byte counts and the cost-model crossover
+    # are deterministic (tight pins); the latency speedup is wall clock
+    # on a shared box (loose).
+    Claim("MIGRATION.md", r"crossover at (\d+) KiB",
+          _bench_collective("algorithm selection", "crossover_KiB"),
+          rel_tol=0.0),
+    Claim("MIGRATION.md", r"moves (0\.\d+) of the flat ring's DCN bytes",
+          _bench_collective("sharded-hier DCN bytes", "ratio"),
+          rel_tol=0.05),
+    Claim("MIGRATION.md", r"cuts DCN wire bytes (\d+\.\d+)×",
+          _bench_collective("int8 quantized DCN allreduce",
+                            "wire_reduction"), rel_tol=0.02),
+    Claim("MIGRATION.md", r"max relative error (0\.\d+)",
+          _bench_collective("int8 quantized DCN allreduce",
+                            "max_rel_error"), rel_tol=0.1),
+    Claim("MIGRATION.md", r"to (0\.\d+) over 20 error-feedback steps",
+          _bench_collective("int8 quantized DCN allreduce",
+                            "ef_mean_error_20_steps"), rel_tol=0.25),
+    Claim("MIGRATION.md", r"recursive doubling beats it (\d+\.\d+)×",
+          _bench_collective("rd vs ring latency", "speedup"),
+          rel_tol=0.5, note="wall-clock ratio under injected latency"),
 ]
 
 
