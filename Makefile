@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs \
-  bench-scale bench-serve-obs bench-serve-ft bench-collective
+  bench-scale bench-serve-obs bench-serve-ft bench-collective \
+  bench-multitenant
 
 lint: rtlint sanitizers
 
@@ -39,6 +40,13 @@ bench-serve-obs:
 # afterwards — MIGRATION.md pins these numbers.
 bench-serve-ft:
 	JAX_PLATFORMS=cpu $(PY) bench_serve_ft.py
+
+# Regenerates BENCH_MULTITENANT.json (priority preemption: graceful
+# reclamation, chip return, three-tenant SLO accounting, hard-kill
+# deadline under mid-drain chaos); the bench asserts its own gates. Run
+# tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
+bench-multitenant:
+	JAX_PLATFORMS=cpu $(PY) bench_multitenant.py
 
 # Regenerates BENCH_COLLECTIVE.json (topology-native collectives:
 # algorithm selection, sharded-hier DCN bytes, quantized wire); the

@@ -33,6 +33,8 @@ Injection table (all gated on RT_CHAOS=1):
   drop_controller()         | driver            | serve controller crash
   delay_dcn_send(s, n)      | calling process   | DCN per-message latency
   cap_dcn_bandwidth(B/s)    | calling process   | DCN bandwidth ceiling
+  preempt_node(node_id)     | driver (GCS RPC)  | node-scope chip reclaim
+  kill_victim_mid_drain()   | driver            | victim dies while draining
 """
 
 from __future__ import annotations
@@ -396,6 +398,53 @@ def dcn_bandwidth_cap() -> Optional[float]:
     if not _dcn_bandwidth_cap_bps or not enabled():
         return None
     return _dcn_bandwidth_cap_bps
+
+
+# -- preemption faults -----------------------------------------------------
+def preempt_node(node_id: bytes):
+    """Node-scope preemption (a spot/maintenance reclaim of one host):
+    asks the GCS to cordon `node_id` and open a grace-then-hard-kill
+    eviction record for every CREATED placement group holding a bundle
+    there. Deterministic: the caller picks the node and the moment.
+    Returns the list of victim placement-group ids (hex)."""
+    _require_enabled("preempt_node")
+    from ray_tpu._private import worker as worker_mod
+
+    client = worker_mod.get_client()
+    resp = client._run(
+        client._gcs_call("preempt_node", {"node_id": node_id})
+    )
+    if not resp.get("ok"):
+        raise RuntimeError(
+            f"chaos.preempt_node: {resp.get('error', 'preempt_node failed')}"
+        )
+    return [v.hex() for v in resp.get("victims", [])]
+
+
+def kill_victim_mid_drain():
+    """Kill one actor of a currently-draining preemption victim — the
+    worst-case compound fault: the gang dies *while* it is gracefully
+    checkpointing out. The hard-kill deadline and the trainer's crash
+    path must still converge (no wedged placement groups). Returns the
+    killed actor's id hex."""
+    _require_enabled("kill_victim_mid_drain")
+    from ray_tpu._private import worker as worker_mod
+
+    client = worker_mod.get_client()
+    resp = client._run(client._gcs_call("get_preemptions", {}))
+    for rec in resp.get("preemptions", []):
+        if rec.get("state") != "draining":
+            continue
+        for aid in rec.get("victim_actors", []):
+            client._run(
+                client._gcs_call(
+                    "kill_actor", {"actor_id": aid, "no_restart": True}
+                )
+            )
+            return aid.hex()
+    raise RuntimeError(
+        "chaos.kill_victim_mid_drain: no draining victim with live actors"
+    )
 
 
 def drop_controller(restart: bool = True):

@@ -339,6 +339,18 @@ class Config:
     # the restart proceeds with whatever checkpoint is registered.
     train_drain_grace_s: float = 30.0
 
+    # -- preemption ------------------------------------------------------
+    # Master switch for the GCS reclamation pass: infeasible higher-priority
+    # demand may evict lower-priority placement groups (RT_PREEMPTION_ENABLED).
+    preemption_enabled: bool = True
+    # Per-victim graceful-eviction deadline: a preempted gang gets this long
+    # to checkpoint/drain and release its placement group before the GCS
+    # hard-kills its actors and force-removes the group (RT_PREEMPT_GRACE_S).
+    preempt_grace_s: float = 30.0
+    # How many completed preemption records the GCS keeps for `rt top` /
+    # `get_preemptions` before pruning the oldest.
+    preempt_history_limit: int = 256
+
     # -- core worker ------------------------------------------------------
     # Owner-side object-directory lookups (location gets during restart
     # waits and lineage probes).

@@ -50,6 +50,14 @@ _RPC_LATENCY_BOUNDS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+#: Bucket boundaries (seconds) for preempt_grace_seconds: how long a victim
+#: gang actually took from eviction notice to releasing its bundles. Spans
+#: sub-second cooperative drains up to multi-minute stragglers that hit the
+#: hard-kill deadline.
+_PREEMPT_GRACE_BOUNDS = (
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
 #: Task-event ring capacity (GcsTaskManager's task_events_max_num_task_
 #: in_gcs analog). Evictions are counted so consumers can detect
 #: truncation instead of silently missing history.
@@ -112,6 +120,23 @@ class GcsServer:
         self.pending_actors: Set[bytes] = set()
         self.pending_pgs: Set[bytes] = set()
         self.pg_counter = 0
+        # -- preemption (priority chip reclamation) ----------------------
+        # victim_pg_id -> record. A record is born "draining" when the
+        # reclamation pass marks the victim's nodes, flips to "released"
+        # when the victim gives its placement group back (cooperatively or
+        # via the hard-kill deadline), and is pruned from the tail of the
+        # history once preempt_history_limit is exceeded. Live-only state:
+        # fences and drains are re-derived after a GCS restart by the next
+        # reclamation pass.
+        self.preemptions: Dict[bytes, dict] = {}
+        # preempt_total{tenant,reason} counter state, exported as a
+        # synthetic series from h_metrics_snapshot like gcs_rpc_*.
+        self.preempt_counts: Dict[tuple, float] = {}
+        # preempt_grace_seconds histogram state (notice -> release).
+        self.preempt_grace = {
+            "buckets": [0] * (len(_PREEMPT_GRACE_BOUNDS) + 1),
+            "sum": 0.0, "count": 0,
+        }
         self._started = asyncio.Event()
         self._stopping = False
         self._health_task: Optional[asyncio.Task] = None
@@ -183,6 +208,9 @@ class GcsServer:
         r("remove_placement_group", self.h_remove_pg)
         r("get_placement_group", self.h_get_pg)
         r("list_placement_groups", self.h_list_pgs)
+        # preemption
+        r("get_preemptions", self.h_get_preemptions)
+        r("preempt_node", self.h_preempt_node)
         # pubsub
         r("subscribe", self.h_subscribe)
         r("publish", self.h_publish)
@@ -503,16 +531,37 @@ class GcsServer:
                     info["last_heartbeat"] = min(
                         now, info["last_heartbeat"] + pause
                     )
-            # Retry pending actors as the resource view changes.
-            for actor_id in list(self.pending_actors):
+            # Retry pending actors as the resource view changes — highest
+            # priority first, so a spike's demand is considered before the
+            # best-effort tier it may be about to evict.
+            for actor_id in sorted(
+                self.pending_actors,
+                key=lambda aid: -int(
+                    (self.actors.get(aid) or {}).get("priority") or 0
+                ),
+            ):
                 a = self.actors.get(actor_id)
                 if a is None or a["state"] not in ("PENDING", "RESTARTING"):
                     self.pending_actors.discard(actor_id)
                     continue
                 if await self._schedule_actor(actor_id):
                     self.pending_actors.discard(actor_id)
-            # Retry pending placement groups.
-            for pg_id in list(self.pending_pgs):
+                else:
+                    self._maybe_preempt(
+                        actor_id,
+                        a.get("name") or a.get("class_name") or "actor",
+                        int(a.get("priority") or 0),
+                        [a.get("resources") or {}],
+                        "PACK",
+                    )
+            # Retry pending placement groups, priority first.
+            for pg_id in sorted(
+                self.pending_pgs,
+                key=lambda pid: -int(
+                    (self.placement_groups.get(pid) or {}).get("priority")
+                    or 0
+                ),
+            ):
                 pg = self.placement_groups.get(pg_id)
                 if pg is None or pg["state"] != "PENDING":
                     self.pending_pgs.discard(pg_id)
@@ -520,6 +569,15 @@ class GcsServer:
                 result = await self._try_reserve_pg(pg)
                 if result.get("ok"):
                     self.pending_pgs.discard(pg_id)
+                else:
+                    self._maybe_preempt(
+                        pg_id,
+                        self._pg_tenant(pg),
+                        int(pg.get("priority") or 0),
+                        pg["bundles"],
+                        pg["strategy"],
+                    )
+            await self._preemption_tick()
             if tick * 0.25 < cfg.health_check_period_s:
                 continue
             tick = 0
@@ -896,30 +954,39 @@ class GcsServer:
 
     # -- actor scheduling ------------------------------------------------
     def _pick_node_for_resources(self, resources: Dict[str, float],
-                                 exclude: Set[bytes] = frozenset()) -> Optional[bytes]:
+                                 exclude: Set[bytes] = frozenset(),
+                                 claimant: Optional[bytes] = None) -> Optional[bytes]:
         """Least-utilized feasible node (GcsActorScheduler::ScheduleByGcs).
 
-        Feasibility is judged against node *totals* (availability views are
-        advisory and may be stale mid-burst); availability breaks ties.
+        Feasibility is judged against the node's *current availability*
+        (advisory view: deducted on placement, corrected by heartbeats).
+        Judging by totals would double-book chips a placement group has
+        reserved — and, worse, keep an infeasible high-priority actor out
+        of the pending queue, which is what arms the reclamation pass.
+        An actor nothing can hold right now stays PENDING and is retried
+        as the view changes (GcsActorManager's pending queue does the
+        same). Nodes fenced for a preemption claimant are invisible to
+        everyone but that claimant — freed chips must not leak to
+        bystanders.
         """
         best, best_score = None, None
         for node_id, info in self.nodes.items():
             if (info["state"] != "ALIVE" or node_id in exclude
                     or info.get("draining")):
                 continue
-            avail, total = info["resources_available"], info["resources_total"]
-            if not all(total.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()):
+            fence = info.get("fenced_for")
+            if fence is not None and fence != claimant:
                 continue
-            has_now = all(
-                avail.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()
-            )
+            avail, total = info["resources_available"], info["resources_total"]
+            if not all(avail.get(k, 0.0) + 1e-9 >= v
+                       for k, v in resources.items()):
+                continue
             util = 0.0
             for k, t in total.items():
                 if t > 0:
                     util = max(util, 1.0 - avail.get(k, 0.0) / t)
-            score = (0 if has_now else 1, util)
-            if best_score is None or score < best_score:
-                best, best_score = node_id, score
+            if best_score is None or util < best_score:
+                best, best_score = node_id, util
         return best
 
     async def h_register_actor(self, d, conn):
@@ -948,6 +1015,7 @@ class GcsServer:
             "death_cause": None,
             "detached": d.get("detached", False),
             "scheduling": d.get("scheduling"),
+            "priority": int(d.get("priority") or 0),
         }
         if d.get("subscribe"):
             # Bundle the caller's actor_update subscription into the
@@ -1001,7 +1069,9 @@ class GcsServer:
             if node_id is None:
                 return False
         if node_id is None:
-            node_id = self._pick_node_for_resources(a["resources"])
+            node_id = self._pick_node_for_resources(
+                a["resources"], claimant=actor_id
+            )
         if node_id is None:
             return False
         # Deduct from the advisory view so a burst of registrations spreads
@@ -1025,7 +1095,30 @@ class GcsServer:
             {"actor_id": actor_id, "create_spec": a["create_spec"],
              "resources": a["resources"], "scheduling": a.get("scheduling")},
         )
+        # A placed claimant no longer needs its reclamation fences.
+        self._clear_fences(actor_id)
         return True
+
+    async def h_actor_unplaceable(self, d, conn):
+        """A raylet refused a placement (the advisory view it was chosen
+        under went stale before the create arrived): return the advisory
+        deduction and re-queue the actor — the pending retry re-places it
+        or, for a high-priority claimant, arms the reclamation pass."""
+        a = self.actors.get(d["actor_id"])
+        if a is None or a["state"] not in ("PENDING", "RESTARTING"):
+            return {"ok": True}
+        nid = a.get("node_id")
+        if nid is not None and nid == d.get("node_id"):
+            info = self.nodes.get(nid)
+            if info is not None and \
+                    (a.get("scheduling") or {}).get("type") != "placement_group":
+                for k, v in (a.get("resources") or {}).items():
+                    info["resources_available"][k] = (
+                        info["resources_available"].get(k, 0) + v
+                    )
+            a["node_id"] = None
+        self.pending_actors.add(d["actor_id"])
+        return {"ok": True}
 
     async def h_actor_ready(self, d, conn):
         a = self.actors.get(d["actor_id"])
@@ -1293,6 +1386,7 @@ class GcsServer:
         the resource view changes.
         """
         pg_id = d["pg_id"]
+        self.pg_counter += 1
         pg = {
             "pg_id": pg_id,
             "name": d.get("name", ""),
@@ -1300,6 +1394,13 @@ class GcsServer:
             "strategy": d.get("strategy", "PACK"),
             "state": "PENDING",
             "bundle_nodes": [None] * len(d["bundles"]),
+            # Preemption tier: when this group cannot place, strictly
+            # lower-priority CREATED groups are eviction candidates (and
+            # this group is itself a candidate for higher tiers).
+            "priority": int(d.get("priority") or 0),
+            # Creation order: ties inside a priority tier evict the
+            # youngest gang first (it has the least sunk work).
+            "seq": self.pg_counter,
         }
         self.placement_groups[pg_id] = pg
         result = await self._try_reserve_pg(pg)
@@ -1311,7 +1412,7 @@ class GcsServer:
         pg_id = pg["pg_id"]
         bundles: List[Dict[str, float]] = pg["bundles"]
         strategy = pg["strategy"]
-        nodes = self._place_bundles(bundles, strategy)
+        nodes = self._place_bundles(bundles, strategy, claimant=pg_id)
         if nodes is None:
             return {"ok": False, "error": "infeasible placement group"}
         # Phase 1: prepare.
@@ -1359,17 +1460,32 @@ class GcsServer:
             return {"ok": False, "error": "placement group reservation failed"}
         pg["bundle_nodes"] = nodes
         pg["state"] = "CREATED"
+        # A placed claimant no longer needs its reclamation fences.
+        self._clear_fences(pg_id)
         await self.publish("pg_update:" + pg_id.hex(), {"state": "CREATED"})
         return {"ok": True, "bundle_nodes": nodes}
 
-    def _place_bundles(self, bundles, strategy) -> Optional[List[bytes]]:
+    def _place_bundles(self, bundles, strategy, claimant=None,
+                       avail_override=None) -> Optional[List[bytes]]:
         """Bundle placement policies (bundle_scheduling_policy.cc:
-        PACK/SPREAD/STRICT_PACK/STRICT_SPREAD)."""
-        alive = {
-            nid: dict(info["resources_available"])
-            for nid, info in self.nodes.items()
-            if info["state"] == "ALIVE" and not info.get("draining")
-        }
+        PACK/SPREAD/STRICT_PACK/STRICT_SPREAD).
+
+        Nodes fenced for a preemption claimant only admit that claimant.
+        avail_override substitutes the availability map — the reclamation
+        pass uses it to ask "would this demand fit if those victims were
+        gone?" without touching live state.
+        """
+        if avail_override is not None:
+            alive = {nid: dict(av) for nid, av in avail_override.items()}
+        else:
+            alive = {}
+            for nid, info in self.nodes.items():
+                if info["state"] != "ALIVE" or info.get("draining"):
+                    continue
+                fence = info.get("fenced_for")
+                if fence is not None and fence != claimant:
+                    continue
+                alive[nid] = dict(info["resources_available"])
 
         def fits(avail, b):
             return all(avail.get(k, 0) + 1e-9 >= v for k, v in b.items())
@@ -1454,6 +1570,13 @@ class GcsServer:
                             "cancel_bundle", {"pg_id": d["pg_id"], "bundle_index": i}
                         )
         pg["state"] = "REMOVED"
+        # Preemption hooks: a removed group may be a draining victim
+        # handing its chips back (finish the record, un-drain its nodes)
+        # or a pending claimant giving up (cancel its eviction).
+        rec = self.preemptions.get(d["pg_id"])
+        if rec is not None and rec["state"] == "draining":
+            self._finish_preemption(rec, outcome="graceful")
+        self._cancel_preemptions_for_claimant(d["pg_id"])
         return {"ok": True}
 
     async def h_get_pg(self, d, conn):
@@ -1462,6 +1585,343 @@ class GcsServer:
 
     async def h_list_pgs(self, d, conn):
         return {"pgs": list(self.placement_groups.values())}
+
+    # -- preemption ------------------------------------------------------
+    # The reclamation pass: when higher-priority demand (a pending
+    # placement group or actor) cannot place, pick victim gangs from the
+    # lowest-priority tier, mark their nodes draining (the PR 2 train
+    # migration path and the serve controller's eviction both key off
+    # that flag), fence the nodes for the claimant, and back the graceful
+    # window with a hard-kill deadline (RT_PREEMPT_GRACE_S).
+
+    def _pg_tenant(self, pg: dict) -> str:
+        return pg.get("name") or ("pg:" + pg["pg_id"].hex()[:8])
+
+    def _clear_fences(self, owner_id: bytes):
+        for info in self.nodes.values():
+            if info.get("fenced_for") == owner_id:
+                info.pop("fenced_for", None)
+
+    def _count_preempt(self, tenant: str, reason: str):
+        key = (("reason", reason), ("tenant", tenant))
+        self.preempt_counts[key] = self.preempt_counts.get(key, 0.0) + 1.0
+
+    def _maybe_preempt(self, owner_id: bytes, tenant: str, priority: int,
+                       bundles: List[Dict[str, float]], strategy: str) -> bool:
+        """One reclamation attempt for an infeasible pending demand.
+
+        Called from the health loop after a failed placement retry.
+        Greedy victim selection: walk CREATED groups from the lowest
+        priority tier up (youngest first inside a tier), hypothetically
+        credit each victim's bundles back, and stop at the first set
+        whose release makes the claimant feasible.
+        """
+        cfg = get_config()
+        if not cfg.preemption_enabled:
+            return False
+        # One in-flight reclamation per claimant: while victims drain,
+        # don't widen the blast radius — the retry loop re-enters here
+        # only if the claimant is still infeasible after they release.
+        for rec in self.preemptions.values():
+            if rec["state"] == "draining" and rec.get("claimant") == owner_id:
+                return False
+        # Hypothetical availability: nodes this claimant could use today.
+        hyp = {}
+        for nid, info in self.nodes.items():
+            if info["state"] != "ALIVE" or info.get("draining"):
+                continue
+            fence = info.get("fenced_for")
+            if fence is not None and fence != owner_id:
+                continue
+            hyp[nid] = dict(info["resources_available"])
+        cands = []
+        for pg in self.placement_groups.values():
+            if pg["state"] != "CREATED":
+                continue
+            if int(pg.get("priority") or 0) >= priority:
+                continue
+            vrec = self.preemptions.get(pg["pg_id"])
+            if vrec is not None and vrec["state"] == "draining":
+                continue
+            # The head node cannot drain; a gang with a bundle there is
+            # not evictable through the node-drain machinery.
+            if any(
+                (self.nodes.get(n) or {}).get("is_head")
+                for n in pg["bundle_nodes"]
+            ):
+                continue
+            cands.append(pg)
+        cands.sort(
+            key=lambda p: (int(p.get("priority") or 0), -p.get("seq", 0))
+        )
+        chosen = []
+        for pg in cands:
+            freed = False
+            for i, nid in enumerate(pg["bundle_nodes"]):
+                if nid in hyp:
+                    for k, v in pg["bundles"][i].items():
+                        hyp[nid][k] = hyp[nid].get(k, 0) + v
+                    freed = True
+            if not freed:
+                continue
+            chosen.append(pg)
+            if self._place_bundles(bundles, strategy,
+                                   avail_override=hyp) is not None:
+                break
+        else:
+            return False  # no victim set makes the claimant feasible
+        for pg in chosen:
+            self._register_preemption(
+                pg, reason="priority", claimant=owner_id,
+                claimant_tenant=tenant, claimant_priority=priority,
+                fence_for=owner_id,
+            )
+        return True
+
+    def _register_preemption(self, pg: dict, reason: str,
+                             claimant: Optional[bytes] = None,
+                             claimant_tenant: str = "",
+                             claimant_priority: int = 0,
+                             fence_for: Optional[bytes] = None,
+                             only_node: Optional[bytes] = None):
+        """Mark one victim gang draining and open its eviction record."""
+        cfg = get_config()
+        now = time.monotonic()
+        # Refcount semantics: the record lists every node it needs drained
+        # (idempotently re-marking already-draining ones); a node is
+        # un-drained only when no draining record still lists it.
+        nodes_marked = []
+        for nid in dict.fromkeys(pg["bundle_nodes"]):
+            if only_node is not None and nid != only_node:
+                continue
+            info = self.nodes.get(nid)
+            if not info or info["state"] != "ALIVE" or info.get("is_head"):
+                continue
+            info["draining"] = True
+            nodes_marked.append(nid)
+            if fence_for is not None:
+                info["fenced_for"] = fence_for
+        tenant = self._pg_tenant(pg)
+        self.preemptions[pg["pg_id"]] = {
+            "victim": pg["pg_id"],
+            "victim_tenant": tenant,
+            "victim_priority": int(pg.get("priority") or 0),
+            "claimant": claimant,
+            "claimant_tenant": claimant_tenant,
+            "claimant_priority": claimant_priority,
+            "nodes": nodes_marked,
+            "started": now,
+            "deadline": now + cfg.preempt_grace_s,
+            "state": "draining",
+            "reason": reason,
+            "released_at": None,
+            "outcome": None,
+        }
+        self._count_preempt(tenant, reason)
+        from ray_tpu.util.event import record_event
+
+        record_event(
+            "gcs",
+            f"preempting placement group ({reason}): tenant {tenant!r} "
+            f"(priority {int(pg.get('priority') or 0)}) drains for "
+            f"{claimant_tenant or 'node reclaim'!r} "
+            f"(priority {claimant_priority}); grace {cfg.preempt_grace_s}s",
+            severity="WARNING", pg_id=pg["pg_id"].hex(),
+        )
+
+    def _finish_preemption(self, rec: dict, outcome: str):
+        """Victim released its chips (or was hard-killed): close the
+        record, observe the grace histogram, un-drain the nodes this
+        preemption marked (the fence persists until the claimant places)."""
+        rec["state"] = "released"
+        rec["outcome"] = outcome
+        rec["released_at"] = time.monotonic()
+        took = rec["released_at"] - rec["started"]
+        h = self.preempt_grace
+        h["buckets"][bisect_left(_PREEMPT_GRACE_BOUNDS, took)] += 1
+        h["sum"] += took
+        h["count"] += 1
+        if outcome == "hard_kill":
+            self._count_preempt(rec["victim_tenant"], "hard_kill")
+        for nid in rec["nodes"]:
+            if any(
+                r is not rec and r["state"] == "draining"
+                and nid in r["nodes"]
+                for r in self.preemptions.values()
+            ):
+                continue  # another eviction still needs this node drained
+            info = self.nodes.get(nid)
+            if info is not None:
+                info.pop("draining", None)
+        self._prune_preemptions()
+
+    def _cancel_preemptions_for_claimant(self, owner_id: bytes):
+        """The claimant withdrew (its group was removed while pending):
+        stand the victims back up — un-drain, un-fence, drop records."""
+        for rec in list(self.preemptions.values()):
+            if rec["state"] != "draining" or rec.get("claimant") != owner_id:
+                continue
+            rec["state"] = "released"
+            rec["outcome"] = "cancelled"
+            rec["released_at"] = time.monotonic()
+            for nid in rec["nodes"]:
+                if any(
+                    r is not rec and r["state"] == "draining"
+                    and nid in r["nodes"]
+                    for r in self.preemptions.values()
+                ):
+                    continue
+                info = self.nodes.get(nid)
+                if info is not None:
+                    info.pop("draining", None)
+        self._clear_fences(owner_id)
+        self._prune_preemptions()
+
+    def _prune_preemptions(self):
+        limit = get_config().preempt_history_limit
+        released = [
+            (rec["released_at"] or 0.0, vid)
+            for vid, rec in self.preemptions.items()
+            if rec["state"] == "released"
+        ]
+        if len(self.preemptions) <= limit:
+            return
+        released.sort()
+        for _, vid in released[: len(self.preemptions) - limit]:
+            self.preemptions.pop(vid, None)
+
+    async def _preemption_tick(self):
+        """Health-loop step: enforce hard-kill deadlines and sweep fences
+        whose claimant is no longer waiting."""
+        now = time.monotonic()
+        for rec in list(self.preemptions.values()):
+            if rec["state"] != "draining" or now < rec["deadline"]:
+                continue
+            victim_id = rec["victim"]
+            from ray_tpu.util.event import record_event
+
+            record_event(
+                "gcs",
+                f"preemption grace expired: hard-killing tenant "
+                f"{rec['victim_tenant']!r}",
+                severity="ERROR", pg_id=victim_id.hex(),
+            )
+            # The deadline is the guarantee: kill every actor living in
+            # the victim group, then force-release its bundles.
+            rec["state"] = "hard_killing"
+            for actor_id, a in list(self.actors.items()):
+                sched = a.get("scheduling") or {}
+                if (
+                    sched.get("type") == "placement_group"
+                    and sched.get("pg_id") == victim_id
+                    and a["state"] in ("ALIVE", "PENDING", "RESTARTING")
+                ):
+                    a["max_restarts"] = 0
+                    node = self.node_conns.get(a.get("node_id"))
+                    if node is not None:
+                        try:
+                            await node.push(
+                                "kill_actor_worker",
+                                {"actor_id": actor_id, "will_restart": False},
+                            )
+                        except Exception:
+                            pass
+            pg = self.placement_groups.get(victim_id)
+            if pg is not None and pg["state"] == "CREATED":
+                # state "hard_killing" makes h_remove_pg's graceful-release
+                # hook skip this record; we close it ourselves below.
+                await self.h_remove_pg({"pg_id": victim_id}, None)
+                self._mark_dirty()
+            self._finish_preemption(rec, outcome="hard_kill")
+        # Fence sweep: a fence whose owner is neither pending nor waiting
+        # on a drain is stale (owner died, was cancelled, or placed
+        # through a path that missed the inline clear).
+        owners = {
+            info.get("fenced_for")
+            for info in self.nodes.values()
+            if info.get("fenced_for") is not None
+        }
+        for owner in owners:
+            waiting = (
+                owner in self.pending_pgs
+                or owner in self.pending_actors
+                or any(
+                    r["state"] == "draining" and r.get("claimant") == owner
+                    for r in self.preemptions.values()
+                )
+            )
+            if not waiting:
+                self._clear_fences(owner)
+
+    def _preemption_view(self, rec: dict) -> dict:
+        now = time.monotonic()
+        out = {
+            "victim_pg_id": rec["victim"],
+            "victim_tenant": rec["victim_tenant"],
+            "victim_priority": rec["victim_priority"],
+            "claimant": rec.get("claimant"),
+            "claimant_tenant": rec.get("claimant_tenant") or "",
+            "claimant_priority": rec.get("claimant_priority") or 0,
+            "nodes": list(rec["nodes"]),
+            "state": rec["state"],
+            "reason": rec["reason"],
+            "outcome": rec.get("outcome"),
+            "age_s": now - rec["started"],
+            "grace_remaining_s": (
+                max(0.0, rec["deadline"] - now)
+                if rec["state"] == "draining" and rec["deadline"] != float("inf")
+                else 0.0
+            ),
+        }
+        if rec["state"] == "draining":
+            # Victim actors still alive mid-drain — chaos's
+            # kill_victim_mid_drain picks from these.
+            out["victim_actors"] = [
+                aid for aid, a in self.actors.items()
+                if (a.get("scheduling") or {}).get("type")
+                == "placement_group"
+                and (a.get("scheduling") or {}).get("pg_id") == rec["victim"]
+                and a["state"] == "ALIVE"
+            ]
+        return out
+
+    async def h_get_preemptions(self, d, conn):
+        """Preemption records, active first (rt top's `preemptions`
+        section and chaos.kill_victim_mid_drain read this)."""
+        recs = sorted(
+            self.preemptions.values(),
+            key=lambda r: (r["state"] != "draining", -r["started"]),
+        )
+        return {"preemptions": [self._preemption_view(r) for r in recs]}
+
+    async def h_preempt_node(self, d, conn):
+        """Node-scope preemption (chaos.preempt_node / spot-reclaim
+        model): cordon the node and open an eviction record — with the
+        full grace-then-hard-kill guarantee — for every CREATED gang
+        holding a bundle there."""
+        info = self.nodes.get(d["node_id"])
+        if not info or info["state"] != "ALIVE":
+            return {"ok": False, "error": "node not alive"}
+        if info.get("is_head"):
+            return {"ok": False, "error": "refusing to preempt the head node"}
+        victims = []
+        for pg in self.placement_groups.values():
+            if pg["state"] != "CREATED":
+                continue
+            if d["node_id"] not in pg["bundle_nodes"]:
+                continue
+            vrec = self.preemptions.get(pg["pg_id"])
+            if vrec is not None and vrec["state"] == "draining":
+                continue
+            self._register_preemption(
+                pg, reason=d.get("reason", "chaos"),
+                only_node=d["node_id"],
+            )
+            victims.append(pg["pg_id"])
+        # Cordon even when no gang lives there: new work must not land on
+        # a node that is being reclaimed.
+        info["draining"] = True
+        return {"ok": True, "victims": victims}
 
     # -- pubsub ----------------------------------------------------------
     #: Channels clients may publish to. System channels (actor_update:*,
@@ -1600,6 +2060,74 @@ class GcsServer:
                      {"buckets": list(st["buckets"]), "sum": st["sum_s"],
                       "count": st["count"]}]
                     for m, st in self.rpc_latency.items()
+                ],
+            })
+        # Preemption accounting (the reclamation pass lives in the GCS, so
+        # these join the surface as synthetic series too).
+        if self.preempt_counts:
+            out.append({
+                "name": "preempt_total",
+                "type": "counter",
+                "description": "placement groups preempted, by victim "
+                               "tenant and reason",
+                "boundaries": None,
+                "series": [
+                    [[list(t) for t in key], v]
+                    for key, v in self.preempt_counts.items()
+                ],
+            })
+        if self.preempt_grace["count"]:
+            out.append({
+                "name": "preempt_grace_seconds",
+                "type": "histogram",
+                "description": "eviction notice to bundle release, per "
+                               "preempted gang",
+                "boundaries": list(_PREEMPT_GRACE_BOUNDS),
+                "series": [
+                    [[],
+                     {"buckets": list(self.preempt_grace["buckets"]),
+                      "sum": self.preempt_grace["sum"],
+                      "count": self.preempt_grace["count"]}],
+                ],
+            })
+        active = sum(
+            1 for r in self.preemptions.values() if r["state"] == "draining"
+        )
+        out.append({
+            "name": "preempt_active",
+            "type": "gauge",
+            "description": "victim gangs currently draining",
+            "boundaries": None,
+            "series": [[[], float(active)]],
+        })
+        # Per-tenant chip occupancy: TPU chips reserved by CREATED gangs
+        # (named by their placement group) and by bare actors holding
+        # chips outside any group.
+        occ: Dict[str, float] = {}
+        for pg in self.placement_groups.values():
+            if pg["state"] != "CREATED":
+                continue
+            chips = sum(float(b.get("TPU", 0.0)) for b in pg["bundles"])
+            if chips:
+                t = self._pg_tenant(pg)
+                occ[t] = occ.get(t, 0.0) + chips
+        for a in self.actors.values():
+            if a["state"] != "ALIVE":
+                continue
+            if (a.get("scheduling") or {}).get("type") == "placement_group":
+                continue  # counted through its group
+            chips = float((a.get("resources") or {}).get("TPU", 0.0))
+            if chips:
+                t = a.get("name") or a.get("class_name") or "actor"
+                occ[t] = occ.get(t, 0.0) + chips
+        if occ:
+            out.append({
+                "name": "tenant_chip_occupancy",
+                "type": "gauge",
+                "description": "TPU chips held, by tenant",
+                "boundaries": None,
+                "series": [
+                    [[["tenant", t]], v] for t, v in occ.items()
                 ],
             })
         for name, m in self.metrics.items():

@@ -115,9 +115,10 @@ class LocalClient:
     # -- tasks -----------------------------------------------------------
     def submit_task(self, fn, args, kwargs, name="", num_returns=1,
                     resources=None, scheduling=None, max_retries=None,
-                    runtime_env=None, max_calls=None):
-        # max_calls is a no-op in local mode: there is no worker process
-        # to retire (everything runs in the driver).
+                    runtime_env=None, max_calls=None, priority=0):
+        # max_calls and priority are no-ops in local mode: there is no
+        # worker process to retire and no queue to reorder (everything
+        # runs inline in the driver).
         try:
             value = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
@@ -130,7 +131,7 @@ class LocalClient:
     def create_actor(self, cls, args, kwargs, name=None, namespace="",
                      resources=None, max_restarts=0, max_task_retries=0,
                      max_concurrency=1, scheduling=None, detached=False,
-                     runtime_env=None):
+                     runtime_env=None, priority=0):
         instance = cls(*args, **kwargs)
         actor_id = ActorID.from_random()
         self.actors[actor_id.binary()] = instance

@@ -454,10 +454,18 @@ class Raylet:
                 (payload["pg_id"], payload["bundle_index"]), None
             )
             if bundle is not None:
-                for k, v in bundle["resources"].items():
+                # Credit only the bundle's *unused* share back: tasks still
+                # running inside the bundle physically hold the rest, and
+                # their completion release falls through to
+                # resources_available once the bundle is gone. Crediting
+                # the full reservation here would transiently oversubscribe
+                # the node — routine under preemption, where bundles are
+                # cancelled mid-flight all the time.
+                for k, v in bundle["available"].items():
                     self.resources_available[k] = (
                         self.resources_available.get(k, 0) + v
                     )
+                self._dispatch_event.set()
         elif channel == "run_job":
             await self._run_job(payload)
         elif channel == "stop_job":
@@ -935,6 +943,18 @@ class Raylet:
             for k, v in resources.items():
                 bundle["available"][k] = bundle["available"].get(k, 0) - v
         else:
+            if resources and not self._available_locally(resources):
+                # The GCS placed against a stale advisory view (its
+                # deduction raced a heartbeat overwrite). Acquiring anyway
+                # would oversubscribe chips a placement group already
+                # reserved — bounce the actor back to the pending queue,
+                # where the retry loop re-places it (or arms preemption).
+                await self.gcs.call(
+                    "actor_unplaceable",
+                    {"actor_id": payload["actor_id"],
+                     "node_id": self.node_id.binary()},
+                )
+                return
             self._acquire(resources)
         renv = payload["create_spec"].get("runtime_env")
         # A registered idle pool worker with the right env adopts the actor
@@ -1189,6 +1209,18 @@ class Raylet:
             return None
         return self.bundles.get((pb[0], pb[1]))
 
+    def _could_acquire(self, spec) -> bool:
+        """Non-mutating twin of _try_acquire_for: would this task's
+        resources be acquirable right now? Used by the worker-spawn gate."""
+        resources = spec.get("resources", {})
+        if spec.get("pg_bundle") is not None:
+            bundle = self._bundle_for(spec)
+            return bundle is not None and all(
+                bundle["available"].get(k, 0) + 1e-9 >= v
+                for k, v in resources.items()
+            )
+        return self._available_locally(resources)
+
     def _try_acquire_for(self, spec) -> bool:
         """Acquire task resources — from its placement-group bundle if the
         task targets one, else from node availability."""
@@ -1215,7 +1247,11 @@ class Raylet:
             if bundle is not None:
                 for k, v in resources.items():
                     bundle["available"][k] = bundle["available"].get(k, 0) + v
-            return
+                return
+            # Bundle cancelled while the task ran (preemption's normal
+            # case): cancel_bundle credited only the bundle's unused
+            # share, so this task's share goes straight back to the node
+            # — dropping it would leak the resources for good.
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0) + v
 
@@ -1370,10 +1406,14 @@ class Raylet:
     @staticmethod
     def _sched_class(spec) -> tuple:
         """Scheduling class: tasks in one class are interchangeable for
-        dispatch (same resource shape, runtime env, and bundle), so a
-        blocked head task blocks only its own class."""
+        dispatch (same resource shape, runtime env, bundle, and priority),
+        so a blocked head task blocks only its own class. Priority leads
+        the tuple: the dispatch loop walks classes highest-first, so a
+        high-priority class never waits behind best-effort work for the
+        same resources."""
         pg = spec.get("pg_bundle")
         return (
+            int(spec.get("priority") or 0),
             spec.get("runtime_env_hash"),
             tuple(sorted((spec.get("resources") or {}).items())),
             tuple(pg) if pg else None,
@@ -1624,7 +1664,11 @@ class Raylet:
             self._metric_dispatch_passes += 1
             scans0 = self._metric_dispatch_scans
             dispatched0 = self._metric_tasks_dispatched
-            for key in list(self.task_queues.keys()):
+            # Highest priority class first (priority leads the class
+            # tuple): a spike's tasks dispatch before best-effort work
+            # contending for the same freed resources.
+            for key in sorted(self.task_queues.keys(),
+                              key=lambda k: -k[0]):
                 q = self.task_queues.get(key)
                 if not q:
                     self.task_queues.pop(key, None)
@@ -1733,11 +1777,14 @@ class Raylet:
                 continue
             worker = self._idle_worker(renv_hash)
             if worker is None:
-                if not self._available_locally(resources):
+                if not self._could_acquire(spec):
                     # Every matching resource is already acquired by
                     # running tasks — a fresh worker could not take this
                     # task either. Spawning here is the storm that burns
                     # CPU on worker startup instead of task execution.
+                    # (Bundle-targeted tasks check their bundle's share:
+                    # a bundle reserving the whole node zeroes node
+                    # availability, yet its own tasks must still spawn.)
                     return True
                 # Spawn only as many workers as there is queued work,
                 # counting ones still starting up (WorkerPool prestart
@@ -1788,6 +1835,20 @@ class Raylet:
                     self._spawn_worker(spec.get("runtime_env"))
                 return True
             if not self._try_acquire_for(spec):
+                # Preemption cancels bundles at arbitrary points: when
+                # that is why acquisition failed, error the task now
+                # rather than leaving the whole class blocked until the
+                # next pass's head check notices.
+                if spec.get("pg_bundle") is not None \
+                        and self._bundle_for(spec) is None:
+                    q.popleft()
+                    self._queued_demand_add(resources, -1, spec)
+                    if not fut.done():
+                        fut.set_result(
+                            {"status": "error",
+                             "error": "placement group bundle was removed"}
+                        )
+                    continue
                 return True
             lc = (
                 self._lc_enqueue.pop(spec["task_id"], None)

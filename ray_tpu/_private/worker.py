@@ -1362,6 +1362,7 @@ class CoreClient:
         max_retries: Optional[int] = None,
         runtime_env=None,
         max_calls: Optional[int] = None,
+        priority: int = 0,
     ) -> List[ObjectRef]:
         cfg = get_config()
         # Control-plane profiler head sampling: one module-attr check per
@@ -1389,6 +1390,10 @@ class CoreClient:
             "runtime_env": resolved_env,
             "runtime_env_hash": resolved_env["hash"] if resolved_env else None,
         }
+        if priority:
+            # Priority class: orders raylet dispatch and makes the demand
+            # eligible to reclaim chips from lower-priority gangs.
+            spec["priority"] = int(priority)
         if max_calls:
             # Worker retires after this many executions of the function
             # (reference: @ray.remote(max_calls=N), remote_function.py —
@@ -1903,6 +1908,7 @@ class CoreClient:
         scheduling=None,
         detached: bool = False,
         runtime_env=None,
+        priority: int = 0,
     ) -> ActorHandle:
         cls_key = self.fn_manager.export(cls)
         payload, deps, borrow_oids = self.serialize_args(args, kwargs)
@@ -1939,6 +1945,7 @@ class CoreClient:
             "create_spec": create_spec,
             "detached": detached,
             "scheduling": scheduling,
+            "priority": int(priority),
             "subscribe": True,  # bundle the actor_update sub
         }
         if name:

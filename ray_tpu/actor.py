@@ -34,6 +34,7 @@ class ActorClass:
             scheduling=_scheduling_from_options(opts),
             detached=opts.get("lifetime") == "detached",
             runtime_env=opts.get("runtime_env"),
+            priority=int(opts.get("priority") or 0),
         )
 
     def options(self, **new_options):

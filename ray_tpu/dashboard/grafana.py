@@ -207,6 +207,21 @@ _FLIGHT_RECORDER_PANELS = [
         {"expr": "histogram_quantile(0.95, rate("
                  "collective_op_seconds_bucket[1m]))", "legend": "p95"},
     ], "s"),
+    # -- multi-tenancy / preemption ---------------------------------------
+    ("Preemptions by tenant/reason", [
+        {"expr": "rate(preempt_total[5m])",
+         "legend": "{{tenant}} {{reason}}"},
+        {"expr": "preempt_active", "legend": "active drains"},
+    ], "short"),
+    ("Preemption grace (drain-to-release) p50/p99", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "preempt_grace_seconds_bucket[5m]))", "legend": "p50"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "preempt_grace_seconds_bucket[5m]))", "legend": "p99"},
+    ], "s"),
+    ("Chip occupancy by tenant", [
+        {"expr": "tenant_chip_occupancy", "legend": "{{tenant}}"},
+    ], "short"),
 ]
 
 
@@ -252,7 +267,8 @@ def generate_dashboard(
                     "[1m]", " ").replace("[5m]", " ").split():
                 if token.startswith(("train_", "serve_", "device_", "data_",
                                      "rt_raylet_", "gcs_rpc_",
-                                     "collective_")):
+                                     "collective_", "preempt_",
+                                     "tenant_")):
                     covered.add(token)
 
     for info in user_metrics:

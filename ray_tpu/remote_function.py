@@ -63,6 +63,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries"),
             runtime_env=opts.get("runtime_env"),
             max_calls=opts.get("max_calls"),
+            priority=int(opts.get("priority") or 0),
         )
         return refs[0] if num_returns in (1, "dynamic") else refs
 

@@ -555,6 +555,30 @@ def _render_top(snapshot, nodes) -> str:
             lines.append("  tenants: " + " ".join(
                 f"{k}={v:.0f}" for k, v in sorted(by_tenant.items())
             ))
+
+    # -- preemption / multi-tenancy --------------------------------------
+    pre = _series_by_tags(snapshot, "preempt_total")
+    active = sum(v for _, v in _series_by_tags(snapshot, "preempt_active"))
+    chips = _series_by_tags(snapshot, "tenant_chip_occupancy")
+    if pre or active or chips:
+        lines.append("preemptions:")
+        if active:
+            lines.append(f"  active: {active:.0f} draining")
+        by_victim: dict = {}
+        for t, v in pre:
+            key = (t.get("tenant", "-"), t.get("reason", "-"))
+            by_victim[key] = by_victim.get(key, 0) + v
+        for (tenant, reason), v in sorted(by_victim.items()):
+            lines.append(f"  evicted {tenant}: {v:.0f} ({reason})")
+        g_count, g_sum = _hist_total(snapshot, "preempt_grace_seconds")
+        if g_count:
+            lines.append(f"  grace: {g_sum / g_count:.2f} s avg to release "
+                         f"({g_count} evictions)")
+        if chips:
+            lines.append("  chips: " + " ".join(
+                f"{t.get('tenant', '-')}={v:.0f}"
+                for t, v in sorted(chips, key=lambda x: -x[1])
+            ))
     return "\n".join(lines)
 
 

@@ -361,6 +361,10 @@ class ServeController:
                 replica = ReplicaActor.options(
                     num_cpus=opts.pop("num_cpus", 0.1),
                     resources=opts.pop("resources", None),
+                    # Priority tier rides the actor options: a latency-
+                    # critical deployment's pending replica may reclaim
+                    # chips from lower-priority gangs.
+                    priority=opts.pop("priority", 0),
                     # Concurrent request execution inside the replica: the
                     # substrate @serve.batch coalesces across (capped so a
                     # misconfigured deployment can't demand 100 threads).
@@ -608,6 +612,7 @@ class ServeController:
                     proxy_mode = self._proxy_every_node
                 for name in names:
                     self._check_replica_health(name)
+                    self._evict_draining_replicas(name)
                     self._autoscale(name)
                     self._reconcile_once(name)
                 if proxy_mode:
@@ -737,6 +742,69 @@ class ServeController:
             logger.debug("GCS actor-state lookup failed for %s (treated "
                          "as unknown)", actor_id.hex(), exc_info=True)
             return None
+
+    def _evict_draining_replicas(self, name: str):
+        """Graceful replica eviction off draining nodes (the preemption /
+        maintenance path): route-flip first, then the PR 8 drain-then-kill,
+        and _reconcile_once respawns the lost count elsewhere — the GCS
+        never places a new actor on a draining node. Zero lost non-shed
+        requests: victims stop receiving new work before they die."""
+        try:
+            draining = {
+                n["node_id"] for n in self._alive_nodes()
+                if n.get("draining")
+            }
+        except Exception:  # noqa: BLE001 — control-plane hiccup; next tick
+            logger.debug("draining-node sweep could not list nodes for "
+                         "app %r (retried next tick)", name, exc_info=True)
+            return
+        if not draining:
+            return
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            replicas = list(app["replicas"])
+        victims = []
+        for r in replicas:
+            try:
+                from ray_tpu._private import worker as worker_mod
+
+                client = worker_mod.get_client()
+                info = client._run(
+                    client._gcs_call(
+                        "get_actor", {"actor_id": r._actor_id.binary()}
+                    )
+                )["actor"]
+            except Exception:  # noqa: BLE001 — lookup hiccup; next tick
+                logger.debug("replica node lookup failed for app %r "
+                             "(retried next tick)", name, exc_info=True)
+                continue
+            if (
+                info
+                and info.get("state") == "ALIVE"
+                and info.get("node_id") in draining
+            ):
+                victims.append(r)
+        if not victims:
+            return
+        victim_ids = {v._actor_id.binary() for v in victims}
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            app["replicas"] = [
+                r for r in app["replicas"]
+                if r._actor_id.binary() not in victim_ids
+            ]
+            app["version"] += 1
+        logger.warning(
+            "evicting %d replica(s) of app %r from draining node(s)",
+            len(victims), name,
+        )
+        self._publish_routes(name)
+        self._checkpoint()
+        self._drain_then_kill(victims, name)
 
     def _check_replica_health(self, name: str):
         """Drop dead replicas so reconcile replaces them — the

@@ -100,6 +100,8 @@ class BackendExecutor:
                 self.scaling_config.worker_resources(),
                 self.scaling_config.placement_strategy,
                 epoch=self.epoch,
+                priority=getattr(self.scaling_config, "priority", 0),
+                name="train",
             )
         except PlacementGroupSchedulingError as e:
             # Infeasible bundles won't become feasible by retrying the

@@ -29,6 +29,9 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     mesh: Optional[Any] = None  # parallel.MeshConfig
+    # Preemption tier of the gang's placement group: lower-priority gangs
+    # are the first evicted when higher-priority demand cannot place.
+    priority: int = 0
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
@@ -50,6 +53,9 @@ class FailureConfig:
       attempts (attempt k sleeps min(backoff_s * 2**k, backoff_max_s)) —
       a crash-looping gang must not hammer the scheduler. The first
       restart after a clean failure is immediate when backoff_s == 0.
+      fit() counts consecutive *no-progress* failures: an attempt that
+      reported metrics or registered a checkpoint resets the doubling,
+      so a later unrelated failure starts from backoff_s again.
     """
 
     max_failures: int = 0

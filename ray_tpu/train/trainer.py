@@ -192,9 +192,16 @@ class DataParallelTrainer(BaseTrainer):
         self._step_skew: Optional[Dict] = None
 
         executor = BackendExecutor(self.backend_config, self.scaling_config)
+        # Backoff counter is decoupled from the retry budget: an attempt
+        # that made progress (new reports or a fresh checkpoint) proves
+        # the cluster recovered, so a later unrelated failure backs off
+        # from backoff_s again instead of the doubled-up tail.
+        backoff_attempt = 0
         try:
             executor.start()
             for attempt in range(attempts):
+                hist_before = len(self._metrics_history)
+                ckpt_before = self._latest_checkpoint
                 try:
                     return self._run_attempt(
                         executor, manager, checkpoint, trial_dir
@@ -206,10 +213,15 @@ class DataParallelTrainer(BaseTrainer):
                     if (failure_config.fail_fast or not e.retryable
                             or attempt + 1 >= attempts):
                         break
+                    if (len(self._metrics_history) > hist_before
+                            or self._latest_checkpoint is not ckpt_before):
+                        backoff_attempt = 0
                     # Resume from the newest checkpoint (reference:
                     # _restart backend_executor.py:701).
                     checkpoint = self._latest_checkpoint or checkpoint
-                    backoff = failure_config.backoff_for_attempt(attempt)
+                    backoff = failure_config.backoff_for_attempt(
+                        backoff_attempt)
+                    backoff_attempt += 1
                     if backoff:
                         time.sleep(backoff)
                     t0 = time.monotonic()

@@ -140,6 +140,8 @@ class WorkerGroup:
         resources_per_worker: Dict[str, float],
         placement_strategy: str = "PACK",
         epoch: int = 0,
+        priority: int = 0,
+        name: str = "",
     ):
         self.num_workers = num_workers
         # Gang attempt number — read by the backend's on_start to stamp
@@ -147,7 +149,9 @@ class WorkerGroup:
         self.epoch = epoch
         self._pg: Optional[PlacementGroup] = None
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
-        self._pg = placement_group(bundles, strategy=placement_strategy)
+        self._pg = placement_group(
+            bundles, strategy=placement_strategy, name=name, priority=priority
+        )
         # ready() raises PlacementGroupSchedulingError on INFEASIBLE /
         # REMOVED; a False return is a still-pending reservation.
         if not self._pg.ready(timeout=120):

@@ -3,6 +3,7 @@ from ray_tpu.util.check_serialize import inspect_serializability
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
+    PlacementGroupConfig,
     placement_group,
     remove_placement_group,
 )
@@ -12,6 +13,7 @@ __all__ = [
     "ActorPool",
     "debug",
     "PlacementGroup",
+    "PlacementGroupConfig",
     "placement_group",
     "remove_placement_group",
 ]

@@ -11,6 +11,7 @@ N-host slice.
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ray_tpu._private import worker as worker_mod
@@ -59,10 +60,35 @@ class PlacementGroup:
         return (PlacementGroup, (self.id, self.bundles))
 
 
+@dataclass
+class PlacementGroupConfig:
+    """Declarative gang spec: bundles plus the scheduling tier.
+
+    `priority` is the preemption class — when this gang cannot place, the
+    GCS may reclaim chips from strictly lower-priority gangs (and this
+    gang may in turn be evicted by higher tiers). 0 is the default
+    best-effort tier.
+    """
+
+    bundles: List[Dict[str, float]] = field(default_factory=list)
+    strategy: str = "PACK"
+    name: str = ""
+    priority: int = 0
+
+    def create(self) -> PlacementGroup:
+        return placement_group(
+            self.bundles,
+            strategy=self.strategy,
+            name=self.name,
+            priority=self.priority,
+        )
+
+
 def placement_group(
     bundles: List[Dict[str, float]],
     strategy: str = "PACK",
     name: str = "",
+    priority: int = 0,
 ) -> PlacementGroup:
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
@@ -78,6 +104,7 @@ def placement_group(
                 "bundles": [dict(b) for b in bundles],
                 "strategy": strategy,
                 "name": name,
+                "priority": int(priority),
             },
         )
     )

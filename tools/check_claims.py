@@ -149,6 +149,17 @@ def _bench_serve_ft(metric_sub: str, field: str):
     return get
 
 
+def _bench_multitenant(metric_sub: str, field: str):
+    def get():
+        for e in _load("BENCH_MULTITENANT.json"):
+            if metric_sub in e.get("metric", ""):
+                return e[field]
+        raise KeyError(
+            f"no BENCH_MULTITENANT entry matching {metric_sub!r}"
+        )
+    return get
+
+
 def _bench_collective(metric_sub: str, field: str):
     def get():
         for e in _load("BENCH_COLLECTIVE.json"):
@@ -387,6 +398,21 @@ CLAIMS = [
     Claim("MIGRATION.md", r"with (\d+) lost non-shed requests",
           _bench_serve_ft("survival plane summary",
                           "lost_requests_total"), rel_tol=0.0),
+    # Multi-tenancy / preemption <- BENCH_MULTITENANT.json
+    # (bench_multitenant.py). Wall-clock probes get loose tolerances;
+    # the zero-lost pin is exact, and the hard-kill latency is grace-
+    # dominated so it stays fairly tight.
+    Claim("MIGRATION.md", r"spike is\s*\n?\s*answering in (\d+\.\d+) s",
+          _bench_multitenant("graceful reclamation",
+                             "spike_deploy_to_first_response_s"),
+          rel_tol=1.0, note="drain+checkpoint+respawn wall clock"),
+    Claim("MIGRATION.md", r"places (\d+\.\d+) s after the\s*\n?\s*claim",
+          _bench_multitenant("hard-kill deadline",
+                             "spike_wait_to_placed_s"),
+          rel_tol=0.4, note="grace deadline (3 s) + kill/force-remove"),
+    Claim("MIGRATION.md", r"saw\s*\n?\s*(\d+) lost non-shed",
+          _bench_multitenant("three-tenant SLO accounting",
+                             "lost_non_shed"), rel_tol=0.0),
     # Static-analysis section <- rtlint itself. Exact pins (rel_tol=0):
     # adding a rule or regenerating the baseline must update the doc.
     Claim("MIGRATION.md", r"lint pass\s*\n?\s*with (\d+) rules",
