@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs \
   bench-scale bench-serve-obs bench-serve-ft bench-collective \
-  bench-multitenant
+  bench-multitenant bench-paged-kv
 
 lint: rtlint sanitizers
 
@@ -54,6 +54,13 @@ bench-multitenant:
 # MIGRATION.md pins these numbers.
 bench-collective:
 	JAX_PLATFORMS=cpu $(PY) bench_collective.py
+
+# Regenerates BENCH_PAGED_KV.json (paged KV engine: mixed-length
+# concurrency at equal HBM, shared-prefix TTFT, HOL, autoscaler ramp,
+# page-leak gate); the bench asserts its own gates. Run
+# tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
+bench-paged-kv:
+	JAX_PLATFORMS=cpu $(PY) bench_paged_kv.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

@@ -1,0 +1,329 @@
+"""Paged KV engine benchmarks. Writes BENCH_PAGED_KV.json.
+
+The paging claim is concrete: same HBM, more concurrent requests; a
+resident prefix is prefill you never pay again; and the memory plane
+must account for every page. Each probe gates it:
+
+  1. mixed-length admission: a slot-pinned baseline (every request owns
+     max_len rows) vs a paged engine given EXACTLY the same KV HBM
+     (same row count, page-granular). Mixed traffic — a couple of long
+     prompts among short ones — must reach a strictly higher peak of
+     concurrently decoding requests under paging. Gate:
+     paged_peak_concurrent > slotted_peak_concurrent.
+  2. shared-prefix TTFT: a 224-token prompt, cold vs resubmitted while
+     its pages are prefix-cache resident. The warm request skips every
+     resident full page (the skipped-tokens counter must say exactly
+     how many) and its TTFT must come in >= 2x faster. Gates:
+     cold_ttft / warm_ttft >= 2, skipped == prompt_len - 1.
+  3. head-of-line: chunked prefill + paging must keep the engine's HOL
+     ledger at ~0 blocked slot-seconds across probes 1-2's traffic.
+     Gate: hol_blocked_s <= 0.05.
+  4. autoscaler ramp: real serve stack, signals published every 0.5 s;
+     closed-loop clients ramp a 1-replica app up (signals-driven
+     autoscaler must reach >= 2 replicas), then stop (back down to 1,
+     reusing the PR 8 drain plane). Gates: scaled up, scaled back
+     down, zero lost non-shed requests.
+  5. page-leak: after probe 2's engine drains and its prefix cache is
+     chaos-flushed, the pool must be exactly empty. Gate:
+     pages_in_use == 0.
+
+Run: python bench_paged_kv.py [--quick]  (--quick: no artifact).
+Exits non-zero when a gate fails.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from dataclasses import replace
+
+import numpy as np
+
+
+def _tiny_model():
+    import jax
+
+    from ray_tpu.models import configs, init_params
+
+    cfg = replace(configs.tiny, dtype=np.float32)
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def probe_mixed_length_admission(results):
+    """Peak concurrent requests at equal KV HBM: paged vs slot-pinned."""
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    max_len, ps = 128, 16
+    base_slots = 4
+    # Equal HBM: the paged pool gets exactly the slotted cache's row
+    # count (base_slots * max_len rows = base_slots * pages_per_slot
+    # pages) + the reserved NULL page, but may spread it over 3x the
+    # slots because short requests reserve only their own footprint.
+    pages = base_slots * (max_len // ps) + 1
+    prompts = ([list(range(1, 41))] * 2
+               + [[7 + i, 3, 9, 1] for i in range(10)])
+
+    peaks, hol = {}, {}
+    for mode, slots, kv_pages in (("slotted", base_slots, None),
+                                  ("paged", 3 * base_slots, pages)):
+        eng = ContinuousBatchingEngine(
+            params, cfg, num_slots=slots, max_len=max_len, kv_mode=mode,
+            page_size=ps, kv_pages=kv_pages,
+        )
+        try:
+            handles = [eng.submit(p, max_new_tokens=24) for p in prompts]
+            done_evt = threading.Event()
+
+            def waiter(hs=handles, ev=done_evt):
+                for h in hs:
+                    h.result(timeout=300)
+                ev.set()
+
+            w = threading.Thread(target=waiter, daemon=True)
+            w.start()
+            peak = 0
+            while not done_evt.is_set():
+                peak = max(peak, eng.stats()["active"])
+                time.sleep(0.002)
+            w.join(timeout=300)
+            peaks[mode] = peak
+            hol[mode] = eng.stats()["hol"]["blocked_slot_seconds"]
+        finally:
+            eng.shutdown()
+
+    entry = {
+        "metric": "mixed-length peak concurrency at equal KV HBM",
+        "kv_rows_both": base_slots * max_len,
+        "requests": len(prompts),
+        "slotted_peak_concurrent": peaks["slotted"],
+        "paged_peak_concurrent": peaks["paged"],
+        "gate": "paged_peak_concurrent > slotted_peak_concurrent",
+        "pass": peaks["paged"] > peaks["slotted"],
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+    return hol
+
+
+def probe_shared_prefix_ttft(results):
+    """Cold vs prefix-cache-warm TTFT for a long shared prompt.
+    Returns the engine (probe 5 reuses it for the leak gate)."""
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=2, max_len=256, kv_mode="paged",
+        page_size=16, prefill_chunk=32,
+    )
+    prompt = [(5 * i + 2) % 50 for i in range(224)]  # 14 full pages
+
+    def ttft(p):
+        t0 = time.perf_counter()
+        h = eng.submit(p, max_new_tokens=4)
+        for _ in h:
+            return time.perf_counter() - t0, h
+
+    cold_s, h = ttft(prompt)
+    h.result(timeout=300)
+    # The insert happens at prefill completion; make sure the pages are
+    # resident before the warm pass.
+    deadline = time.monotonic() + 30
+    while eng.stats()["kv"]["prefix_cache_pages"] < len(prompt) // 16:
+        assert time.monotonic() < deadline, "prefix never cached"
+        time.sleep(0.01)
+    skipped_before = eng.stats()["kv"]["prefill_tokens_skipped"]
+    warm_s, h = ttft(prompt)
+    h.result(timeout=300)
+    skipped = eng.stats()["kv"]["prefill_tokens_skipped"] - skipped_before
+
+    speedup = cold_s / warm_s if warm_s > 0 else float("inf")
+    # All 14 resident pages cover the prompt; only the final token is
+    # recomputed (its logits seed generation): skip == len(prompt) - 1.
+    expect_skip = len(prompt) - 1
+    entry = {
+        "metric": "shared-prefix TTFT: cold vs prefix-cache hit",
+        "prompt_tokens": len(prompt),
+        "prefill_chunk": 32,
+        "cold_ttft_ms": round(cold_s * 1e3, 2),
+        "warm_ttft_ms": round(warm_s * 1e3, 2),
+        "speedup": round(speedup, 2),
+        "prefill_tokens_skipped": skipped,
+        "gate": f"speedup >= 2 and prefill_tokens_skipped == {expect_skip}",
+        "pass": speedup >= 2.0 and skipped == expect_skip,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+    return eng
+
+
+def probe_hol(results, hol_by_mode, eng2):
+    """Chunked prefill + paging keep head-of-line blocking at ~0."""
+    total = (hol_by_mode.get("paged", 0.0)
+             + eng2.stats()["hol"]["blocked_slot_seconds"])
+    entry = {
+        "metric": "head-of-line blocking across paged probes",
+        "hol_blocked_s": round(total, 4),
+        "gate": "hol_blocked_s <= 0.05",
+        "pass": total <= 0.05,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def probe_page_leak(results, eng):
+    """Drain + chaos-flush the prefix cache: the pool must hit zero."""
+    from ray_tpu._private import chaos
+
+    chaos.enable()
+    try:
+        held_before = eng.stats()["kv"]["prefix_cache_pages"]
+        chaos.flush_prefix_cache()
+        deadline = time.monotonic() + 30
+        while True:
+            kv = eng.stats()["kv"]
+            if kv["pages_in_use"] == 0:
+                break
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
+    finally:
+        chaos.disable()
+        chaos.clear()
+        eng.shutdown()
+    entry = {
+        "metric": "page-leak: pool empty after drain + cache flush",
+        "cache_pages_flushed": held_before,
+        "pages_in_use_after": kv["pages_in_use"],
+        "prefix_cache_pages_after": kv["prefix_cache_pages"],
+        "gate": "pages_in_use_after == 0",
+        "pass": kv["pages_in_use"] == 0,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+
+
+def probe_autoscaler_ramp(results, quick: bool):
+    """Signals-driven autoscaler tracks a traffic ramp up and down."""
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu._private.config import get_config
+
+    cfg = get_config()
+    saved = cfg.serve_signals_interval_s
+    cfg.serve_signals_interval_s = 0.5
+    rt.init(num_cpus=8)
+    try:
+        @serve.deployment(
+            num_replicas=1,
+            max_ongoing_requests=4,
+            autoscaling_config=serve.AutoscalingConfig(
+                min_replicas=1, max_replicas=3,
+                target_ongoing_requests=1,
+                upscale_delay_s=0.2, downscale_delay_s=1.0,
+                upscale_queue_depth=0.5,
+            ),
+        )
+        class Slowish:
+            def __call__(self, x=0):
+                time.sleep(0.25)
+                return x
+
+        serve.run(Slowish.bind(), name="ramp")
+        handle = serve.get_app_handle("ramp")
+        assert handle.remote(0).result(timeout=60) == 0
+
+        ok, lost, shed = [0], [], [0]
+        stop = threading.Event()
+
+        def pump():
+            from ray_tpu.exceptions import ServeOverloadedError
+
+            while not stop.is_set():
+                try:
+                    if handle.remote(1).result(timeout=60) == 1:
+                        ok[0] += 1
+                except ServeOverloadedError:
+                    shed[0] += 1
+                except Exception as e:  # noqa: BLE001 — tally, gate below
+                    lost.append(f"{type(e).__name__}: {e}")
+
+        def replicas():
+            return len(rt.get(
+                serve.get_or_create_controller().get_replicas.remote(
+                    "ramp"), timeout=10)["replicas"])
+
+        threads = [threading.Thread(target=pump, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        peak, up_s = 1, None
+        t0 = time.monotonic()
+        deadline = t0 + (30 if quick else 60)
+        try:
+            while time.monotonic() < deadline:
+                n = replicas()
+                peak = max(peak, n)
+                if n >= 2 and up_s is None:
+                    up_s = time.monotonic() - t0
+                if up_s is not None and time.monotonic() - t0 > up_s + 2:
+                    break
+                time.sleep(0.25)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        # Idle: the autoscaler must walk back down to min_replicas,
+        # draining the excess replicas gracefully (PR 8 drain plane).
+        down = False
+        deadline = time.monotonic() + (30 if quick else 60)
+        while time.monotonic() < deadline:
+            if replicas() == 1:
+                down = True
+                break
+            time.sleep(0.5)
+        entry = {
+            "metric": "signals-driven autoscaler ramp up/down",
+            "signals_interval_s": 0.5,
+            "requests_ok": ok[0],
+            "shed": shed[0],
+            "lost_non_shed": len(lost),
+            "lost_samples": lost[:5],
+            "peak_replicas": peak,
+            "scale_up_s": round(up_s, 2) if up_s is not None else None,
+            "scaled_back_down": down,
+            "gate": "peak_replicas >= 2 and scaled_back_down and "
+                    "lost_non_shed == 0",
+            "pass": peak >= 2 and down and not lost,
+        }
+        print(json.dumps(entry))
+        results.append(entry)
+        serve.delete("ramp")
+    finally:
+        serve.shutdown()
+        rt.shutdown()
+        cfg.serve_signals_interval_s = saved
+
+
+def main():
+    quick = "--quick" in sys.argv
+    results = []
+    hol_by_mode = probe_mixed_length_admission(results)
+    eng2 = probe_shared_prefix_ttft(results)
+    probe_hol(results, hol_by_mode, eng2)
+    probe_autoscaler_ramp(results, quick)
+    probe_page_leak(results, eng2)
+    if not quick:
+        with open("BENCH_PAGED_KV.json", "w") as f:
+            json.dump(results, f, indent=1)
+    failed = [r["metric"] for r in results if r.get("pass") is False]
+    if failed:
+        print(f"GATE FAILURES: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,8 @@ Injection table (all gated on RT_CHAOS=1):
   cap_dcn_bandwidth(B/s)    | calling process   | DCN bandwidth ceiling
   preempt_node(node_id)     | driver (GCS RPC)  | node-scope chip reclaim
   kill_victim_mid_drain()   | driver            | victim dies while draining
+  flush_prefix_cache()      | replica process   | prefix-cache cold start
+  exhaust_kv_pages(frac)    | replica process   | KV page-pool pressure
 """
 
 from __future__ import annotations
@@ -75,6 +77,12 @@ _dispatch_delays_left: int = 0
 _dcn_send_delay_s: float = 0.0
 _dcn_send_delays_left: int = 0
 _dcn_bandwidth_cap_bps: float = 0.0
+# Paged-KV faults (consumed by ContinuousBatchingEngine's loop): a
+# one-shot prefix-cache flush, and a PERSISTENT pool-pressure fraction
+# (the engine holds that share of pages until it is set back to 0 —
+# a memory squeeze, not an event). -1 = no injection.
+_flush_prefix_pending: bool = False
+_kv_exhaust_frac: float = -1.0
 
 
 def enabled() -> bool:
@@ -100,8 +108,11 @@ def clear():
     global _prefill_delay_s, _prefill_delays_left
     global _dispatch_delay_s, _dispatch_delays_left
     global _dcn_send_delay_s, _dcn_send_delays_left, _dcn_bandwidth_cap_bps
+    global _flush_prefix_pending, _kv_exhaust_frac
     with _lock:
         _injected_drain_ranks.clear()
+        _flush_prefix_pending = False
+        _kv_exhaust_frac = -1.0
         _poll_delay_s = 0.0
         _poll_delays_left = 0
         _pull_delay_s = 0.0
@@ -445,6 +456,56 @@ def kill_victim_mid_drain():
     raise RuntimeError(
         "chaos.kill_victim_mid_drain: no draining victim with live actors"
     )
+
+
+def flush_prefix_cache():
+    """Drop every resident prefix-cache entry in THIS process's serving
+    engine(s) at their next loop tick — a deterministic cold-cache
+    transition (rolling restart, cache invalidation) without restarting
+    the replica. One-shot: consumed once. Process-local: call it inside
+    the replica process (serve tests use worker_group.execute or a
+    replica method)."""
+    _require_enabled("flush_prefix_cache")
+    global _flush_prefix_pending
+    with _lock:
+        _flush_prefix_pending = True
+
+
+def take_flush_prefix_cache() -> bool:
+    """Pop the pending prefix-cache flush (False when chaos is off or
+    none pending). Runs every engine loop iteration, so the
+    no-injection case exits on a plain global read."""
+    global _flush_prefix_pending
+    if not _flush_prefix_pending or not enabled():
+        return False
+    with _lock:
+        if not _flush_prefix_pending:
+            return False
+        _flush_prefix_pending = False
+        return True
+
+
+def exhaust_kv_pages(frac: float):
+    """Squeeze the paged-KV pool: the engine holds `frac` of its usable
+    pages hostage (admissions then queue on pool pressure) until a
+    later call sets the fraction back to 0.0. Unlike the counted delays
+    this PERSISTS — it models a memory squeeze (fragmentation, a noisy
+    co-tenant), not an event. Process-local, like flush_prefix_cache."""
+    _require_enabled("exhaust_kv_pages")
+    if not 0.0 <= frac <= 1.0:
+        raise ValueError("exhaust_kv_pages frac must be in [0, 1]")
+    global _kv_exhaust_frac
+    with _lock:
+        _kv_exhaust_frac = float(frac)
+
+
+def kv_exhaust_frac() -> Optional[float]:
+    """The active pool-pressure fraction (None when chaos is off or no
+    squeeze is set). Runs every engine loop iteration: plain global
+    read first."""
+    if _kv_exhaust_frac < 0 or not enabled():
+        return None
+    return _kv_exhaust_frac
 
 
 def drop_controller(restart: bool = True):

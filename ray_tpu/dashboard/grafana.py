@@ -165,6 +165,24 @@ _FLIGHT_RECORDER_PANELS = [
         {"expr": "rate(serve_http_responses_total[1m])",
          "legend": "{{app}} {{code}}"},
     ], "short"),
+    # -- paged KV cache ---------------------------------------------------
+    ("Serve KV page-pool occupancy", [
+        {"expr": "serve_kv_pages_in_use", "legend": "pages in use"},
+    ], "short"),
+    ("Serve prefix-cache hit ratio", [
+        {"expr": "rate(serve_prefix_cache_hits_total[1m]) / "
+                 "(rate(serve_prefix_cache_hits_total[1m]) + "
+                 "rate(serve_prefix_cache_misses_total[1m]))",
+         "legend": "hit ratio"},
+        {"expr": "rate(serve_prefill_tokens_skipped_total[1m])",
+         "legend": "prefill tokens skipped/s"},
+    ], "short"),
+    ("Serve autoscaler target vs actual replicas", [
+        {"expr": "serve_autoscaler_target_replicas",
+         "legend": "{{app}} target"},
+        {"expr": "serve_autoscaler_actual_replicas",
+         "legend": "{{app}} actual"},
+    ], "short"),
     # -- control-plane profiler -----------------------------------------
     ("GCS RPC rate by method", [
         {"expr": "rate(gcs_rpc_calls_total[1m])", "legend": "{{method}}"},

@@ -71,6 +71,23 @@ class ServeOverloadedError(RayTpuError):
         super().__init__(message)
 
 
+class PromptTooLongError(RayTpuError, ValueError):
+    """The prompt cannot fit the serving engine's KV capacity.
+
+    Raised by ``ContinuousBatchingEngine.submit`` BEFORE queueing: the
+    bound is ``max_len - 2`` positions and, under the paged KV cache,
+    the page pool's total capacity — whichever is smaller. Not
+    retryable against the same engine (the limit is structural); the
+    proxy maps it to HTTP 413. Subclasses ValueError so callers of the
+    historical untyped rejection keep working."""
+
+    def __init__(self, message: str, *, prompt_len: int = 0,
+                 max_prompt_len: int = 0):
+        self.prompt_len = prompt_len
+        self.max_prompt_len = max_prompt_len
+        super().__init__(message)
+
+
 class RequestCancelledError(RayTpuError):
     """A serve request was cancelled instead of executed to completion.
 

@@ -695,20 +695,38 @@ def _render_serve(doc) -> str:
         occ = app.get("occupancy")
         drain = app.get("backlog_drain_s")
         frac = app.get("phase_sum_fraction")
+        # Schema v2 fields — absent in docs from older controllers.
+        tgt = app.get("target_replicas")
+        run = app.get("running_replicas")
         lines.append(
             f"app {name}: qps={app.get('qps', 0.0):.2f} "
             f"waiting={app.get('waiting', 0)}"
+            + (f" replicas={run}/{tgt}" if tgt is not None else "")
             + (f" occupancy={100 * occ:.0f}%" if occ is not None else "")
             + (f" backlog_drain={drain:.2f}s" if drain is not None else "")
             + (f" phase_sum={100 * frac:.1f}%" if frac is not None else "")
         )
+        kv = app.get("kv")
+        if kv:
+            hr = kv.get("prefix_hit_rate")
+            lines.append(
+                f"  kv: pages {kv.get('pages_in_use', 0)}"
+                f"/{kv.get('pages_total', 0)}"
+                + (f" ({100 * kv['util']:.0f}%)"
+                   if kv.get("util") is not None else "")
+                + (f" prefix_hit={100 * hr:.0f}%" if hr is not None else "")
+                + (f" prefill_skipped={kv['prefill_tokens_skipped']}"
+                   if kv.get("prefill_tokens_skipped") else "")
+            )
         for r in app.get("replicas") or []:
             status = ("UNREACHABLE" if r.get("unreachable")
                       else f"ongoing={r.get('ongoing')} "
                            f"served={r.get('total_served')}")
             hf = r.get("health_fails", 0)
+            ku = r.get("kv_util")
             lines.append(
                 f"  replica {r.get('actor_id', '?')[:8]}: {status}"
+                + (f" kv={100 * ku:.0f}%" if ku is not None else "")
                 + (f" health_fails={hf}" if hf else "")
             )
         ttft, tpot = app.get("ttft_s") or {}, app.get("tpot_s") or {}

@@ -24,6 +24,7 @@ from ray_tpu.exceptions import (
     GetTimeoutError,
     WorkerCrashedError,
 )
+from ray_tpu.serve import autoscale
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment
 from ray_tpu.serve.replica import ReplicaActor
 
@@ -64,6 +65,11 @@ class ServeController:
         # serve_signals_interval_s (rt serve + autoscalers read it).
         self._signals_seq = 0
         self._signals_last = 0.0
+        # Signals-driven autoscaler hysteresis memory, one entry per app
+        # (ray_tpu/serve/autoscale.py). Not checkpointed: hysteresis
+        # restarts cold after a controller crash, which only delays the
+        # next scaling move by one hold period.
+        self._scale_state: Dict[str, "autoscale.AutoscalerState"] = {}
         self._restore()
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
@@ -309,6 +315,12 @@ class ServeController:
                 "version": app["version"],
                 "replicas": list(app["replicas"]),
                 "max_ongoing": app["deployment"].max_ongoing_requests,
+                # Prefix-affinity hints (paged KV): actor_id hex -> list of
+                # first-page prefix hashes resident in that replica's
+                # cache, refreshed each signals tick. Handles route
+                # matching prompts to a covering replica.
+                "prefix": dict(app.get("prefix_routes") or {}),
+                "page_size": app.get("kv_page_size") or 0,
             }
 
     def status(self) -> Dict:
@@ -483,6 +495,8 @@ class ServeController:
                 timeout=cfg.serve_probe_timeout_s,
             )
             per_replica = []
+            prefix_routes: Dict[str, List[str]] = {}
+            page_size = 0
             for r, ref in zip(replicas, refs):
                 entry = {
                     "actor_id": r._actor_id.hex(),
@@ -497,14 +511,34 @@ class ServeController:
                         entry["ongoing"] = snap.get("ongoing")
                         entry["total_served"] = snap.get("total_served")
                         entry["qps"] = snap.get("qps")
+                        kv = (snap.get("engine") or {}).get("kv") or {}
+                        if kv.get("mode") == "paged":
+                            entry["kv_util"] = kv.get("util")
+                            entry["prefix_hit_rate"] = kv.get(
+                                "prefix_hit_rate")
+                            entry["prefill_tokens_skipped"] = kv.get(
+                                "prefill_tokens_skipped")
+                            if kv.get("roots"):
+                                prefix_routes[entry["actor_id"]] = list(
+                                    kv["roots"])
+                            page_size = kv.get("page_size") or page_size
                     except Exception:  # rtlint: disable=RT007 — replica mid-death; marked unreachable
                         entry["unreachable"] = True
                 else:
                     entry["unreachable"] = True
                 per_replica.append(entry)
-            doc["apps"][name] = self._merge_app_signals(
-                name, snaps, per_replica, cfg
-            )
+            app_sig = self._merge_app_signals(name, snaps, per_replica, cfg)
+            with self._lock:
+                app = self.apps.get(name)
+                if app is not None:
+                    # Cached for get_replicas(): handles learn prefix
+                    # residency on their normal routing-table refresh, no
+                    # extra RPC.
+                    app["prefix_routes"] = prefix_routes
+                    app["kv_page_size"] = page_size
+                    app_sig["target_replicas"] = app["target"]
+                    app_sig["running_replicas"] = len(app["replicas"])
+            doc["apps"][name] = app_sig
         try:
             from ray_tpu._private import worker as worker_mod
 
@@ -575,6 +609,30 @@ class ServeController:
                     row["burn"] = observatory.burn_rate(
                         row["good"], row["total"], objective
                     )
+        # Paged-KV aggregate (schema v2): pooled page counts across
+        # replicas, one hit-rate division over pooled lookups.
+        kv_snaps = [
+            (s.get("engine") or {}).get("kv") or {} for s in snaps
+        ]
+        kv_snaps = [k for k in kv_snaps if k.get("mode") == "paged"]
+        kv_agg = None
+        if kv_snaps:
+            hits = sum(k.get("prefix_hits") or 0 for k in kv_snaps)
+            misses = sum(k.get("prefix_misses") or 0 for k in kv_snaps)
+            total = sum(k.get("pages_total") or 0 for k in kv_snaps)
+            in_use = sum(k.get("pages_in_use") or 0 for k in kv_snaps)
+            kv_agg = {
+                "page_size": kv_snaps[0].get("page_size"),
+                "pages_total": total,
+                "pages_in_use": in_use,
+                "util": (in_use / total) if total else None,
+                "prefix_hit_rate": (
+                    hits / (hits + misses) if (hits + misses) else None
+                ),
+                "prefill_tokens_skipped": sum(
+                    k.get("prefill_tokens_skipped") or 0 for k in kv_snaps
+                ),
+            }
         return {
             "replicas": per_replica,
             "qps": qps,
@@ -601,6 +659,7 @@ class ServeController:
                     "events": hol_events[-16:]},
             "slo": slo,
             "tenants": tenants,
+            "kv": kv_agg,
         }
 
     def _reconcile_loop(self):
@@ -890,7 +949,76 @@ class ServeController:
             _kill_quietly(r)
 
     def _autoscale(self, name: str):
-        """Queue-length autoscaling (reference: autoscaling_policy.py)."""
+        """Replica autoscaling off the published ServeSignals snapshot.
+
+        ONE `kv_get` of the observatory document, zero actor calls: the
+        signal plane (PR 7) already carries ongoing requests, admission
+        queue depth, TTFT percentiles and SLO burn per app, so the
+        decision (ray_tpu/serve/autoscale.py) is a pure function over
+        the snapshot with per-app hysteresis memory. Falls back to the
+        legacy per-replica queue-length probe when the snapshot is
+        missing or stale (observatory disabled, first ticks after boot,
+        publisher wedged) — autoscaling never goes blind just because
+        telemetry did."""
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return
+            acfg: Optional[AutoscalingConfig] = (
+                app["deployment"].autoscaling_config)
+            target = app["target"]
+            running = len(app["replicas"])
+        if acfg is None or running == 0:
+            return
+        cfg = get_config()
+        app_sig = None
+        if cfg.serve_observatory:
+            from ray_tpu.serve import observatory
+
+            try:
+                from ray_tpu._private import worker as worker_mod
+
+                raw = worker_mod.get_client().kv_get(
+                    observatory.SIGNALS_KEY, ns="serve")
+                doc = json.loads(raw) if raw else None
+            except Exception:  # rtlint: disable=RT007 — doc=None routes to the queue-probe fallback below
+                doc = None
+            stale_after = max(3 * cfg.serve_signals_interval_s, 5.0)
+            if doc and time.time() - float(doc.get("ts") or 0) <= stale_after:
+                app_sig = (doc.get("apps") or {}).get(name)
+        if app_sig is None:
+            return self._autoscale_probe(name)
+        # _scale_state is only touched on the reconcile thread.
+        state = self._scale_state.setdefault(
+            name, autoscale.AutoscalerState())
+        now = time.monotonic()
+        new_target = autoscale.decide(
+            app_sig, acfg, state, now, target, running)
+        m = _controller_metrics()
+        m["as_target"].set(float(new_target), tags={"app": name})
+        m["as_actual"].set(float(running), tags={"app": name})
+        if new_target == target:
+            return
+        with self._lock:
+            app = self.apps.get(name)
+            # Bail if the app vanished or someone else moved the target
+            # (redeploy) between our read and this write.
+            if app is None or app["target"] != target:
+                return
+            app["target"] = new_target
+            if new_target > target:
+                app["last_scale_up"] = now
+            else:
+                app["last_scale_down"] = now
+        logger.info("autoscaler: app %r target %d -> %d (%s)",
+                    name, target, new_target, state.last_reason)
+        self._checkpoint()
+
+    def _autoscale_probe(self, name: str):
+        """Legacy queue-length autoscaling (reference:
+        autoscaling_policy.py): probes every replica's queue depth with
+        an actor call. Kept as the fallback for when ServeSignals are
+        unavailable."""
         with self._lock:
             app = self.apps.get(name)
             if app is None:
@@ -926,6 +1054,29 @@ class ServeController:
                     changed = True
         if changed:
             self._checkpoint()
+
+
+_METRICS: Optional[Dict[str, Any]] = None
+
+
+def _controller_metrics() -> Dict[str, Any]:
+    # Lazy: the metrics registry must not be touched at import time
+    # (same discipline as llm._engine_metrics).
+    global _METRICS
+    if _METRICS is None:
+        from ray_tpu.util.metrics import Gauge, get_or_create
+
+        _METRICS = {
+            "as_target": get_or_create(
+                Gauge, "serve_autoscaler_target_replicas",
+                "Autoscaler's desired replica count per app.",
+                tag_keys=("app",)),
+            "as_actual": get_or_create(
+                Gauge, "serve_autoscaler_actual_replicas",
+                "Running replica count per app as seen by the autoscaler.",
+                tag_keys=("app",)),
+        }
+    return _METRICS
 
 
 def _safe_eq(a, b) -> bool:  # rtlint: disable=RT007

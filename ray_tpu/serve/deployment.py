@@ -22,6 +22,13 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    # Signals-driven pressure thresholds (ray_tpu/serve/autoscale.py).
+    # Queued-per-replica above this is upscale pressure even while
+    # ongoing looks fine (saturation shows in the admission queue first).
+    upscale_queue_depth: Optional[float] = 1.0
+    # Opt-in latency/SLO pressure: None disables each signal.
+    ttft_p99_high_ms: Optional[float] = None
+    burn_rate_high: Optional[float] = None
 
 
 @dataclass

@@ -215,6 +215,11 @@ class DeploymentHandle:
                 "lock": threading.Lock(),
                 "subscribed": False,
                 "max_ongoing": 0,  # published by the controller's table
+                # Prefix-affinity hints (paged KV): actor_id hex -> set
+                # of resident first-page prefix hashes, plus the page
+                # size the hashes were computed with.
+                "prefix": {},
+                "page_size": 0,
                 # actor_id -> {"fails", "open_until", "window"} — the
                 # handle-side circuit breaker ledger.
                 "cb": {},
@@ -303,6 +308,11 @@ class DeploymentHandle:
                 s["version"] = info["version"]
                 s["replicas"] = info["replicas"]
                 s["max_ongoing"] = info.get("max_ongoing", 0)
+                s["prefix"] = {
+                    aid: set(keys)
+                    for aid, keys in (info.get("prefix") or {}).items()
+                }
+                s["page_size"] = info.get("page_size") or 0
             if s["last_refresh"] == lr0:
                 s["last_refresh"] = time.monotonic()
             # else: a push invalidation zeroed last_refresh while our RPC
@@ -379,10 +389,43 @@ class DeploymentHandle:
                     if e["open_until"] > now}
 
     # -- routing ---------------------------------------------------------
-    def _pick_replica(self, exclude=frozenset()):
+    def _route_key(self, args) -> Optional[str]:
+        """Prefix-affinity routing key for a prompt-shaped first arg:
+        the hash of its first KV page (paged_kv.prefix_route_key). None
+        whenever affinity doesn't apply — no advertised prefixes, a
+        multiplexed handle (model residency outranks cache residency),
+        or a first arg that isn't a token sequence spanning a page."""
+        s = self._shared
+        with s["lock"]:
+            page_size = s["page_size"]
+            has_prefixes = bool(s["prefix"])
+        if (not has_prefixes or not page_size or self.multiplexed_model_id
+                or not args):
+            return None
+        prompt = args[0]
+        if not isinstance(prompt, (list, tuple)) and not (
+                hasattr(prompt, "ndim") and getattr(prompt, "ndim", 0) == 1):
+            return None
+        try:
+            if len(prompt) < page_size:
+                return None
+            from ray_tpu.serve import paged_kv
+
+            return paged_kv.prefix_route_key(prompt, page_size)
+        except (TypeError, ValueError):  # non-token contents
+            return None
+
+    def _pick_replica(self, exclude=frozenset(),
+                      route_key: Optional[str] = None):
         """Power-of-two by handle-local in-flight count (router.py:295) —
         no probe RPCs on the request path. Multiplexed requests hash the
         model id to a stable replica so its weights stay resident.
+        `route_key`: a prompt's first-page prefix hash — when some
+        candidate replica advertises it (its prefix cache holds the
+        prompt's opening page), the pick prefers covering replicas (the
+        least-loaded of them), so repeat prompts land where their KV
+        pages already live and prefill skips them. Falls through to the
+        normal pick when nobody covers it.
         `exclude`: actor ids observed dead by a retrying response — skip
         them while the controller's table still lists them. Replicas
         with an OPEN circuit breaker are skipped the same way unless
@@ -410,6 +453,18 @@ class DeploymentHandle:
         if self.multiplexed_model_id:
             idx = zlib.crc32(self.multiplexed_model_id.encode()) % len(replicas)
             return replicas[idx]
+        if route_key is not None:
+            with s["lock"]:
+                pm = dict(s["prefix"])
+            covering = [r for r in replicas
+                        if route_key in pm.get(r._actor_id.hex(), ())]
+            if covering:
+                with s["lock"]:
+                    return min(
+                        covering,
+                        key=lambda r: s["inflight"].get(
+                            r._actor_id.binary(), 0),
+                    )
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
@@ -503,7 +558,8 @@ class DeploymentHandle:
             time.sleep(injected)
         self._refresh()
         self._shed_check(meta)
-        replica = self._pick_replica()
+        route_key = self._route_key(args)
+        replica = self._pick_replica(route_key=route_key)
         done = self._track(replica)
         if obs_ctx is not None:
             # handle_queue ends here: routing done, dispatching now.
@@ -523,7 +579,8 @@ class DeploymentHandle:
             # notably the idem_key, so a request the dead replica
             # half-finished cannot execute twice where it matters.
             self._refresh(force=True)
-            r = self._pick_replica(exclude=frozenset(failed))
+            r = self._pick_replica(exclude=frozenset(failed),
+                                   route_key=route_key)
             failed.add(r._actor_id.binary())
             d = self._track(r)
             if obs_ctx is not None:
@@ -568,6 +625,7 @@ class DeploymentHandle:
             time.sleep(injected)
         self._refresh()
         self._shed_check(meta)
+        route_key = self._route_key(args)
 
         def start_on(replica):
             if obs_ctx is not None:
@@ -591,7 +649,8 @@ class DeploymentHandle:
             """Pick a replica and start the request on it, retrying past
             dead (or draining) picks until the resume budget runs out."""
             while True:
-                r = self._pick_replica(exclude=frozenset(failed))
+                r = self._pick_replica(exclude=frozenset(failed),
+                                       route_key=route_key)
                 try:
                     return r, start_on(r)
                 except (ActorError, WorkerCrashedError, TaskError) as e:

@@ -47,9 +47,14 @@ import numpy as np
 
 from ray_tpu._private import chaos
 from ray_tpu._private.config import get_config
-from ray_tpu.exceptions import RequestCancelledError, ServeOverloadedError
+from ray_tpu.exceptions import (
+    PromptTooLongError,
+    RequestCancelledError,
+    ServeOverloadedError,
+)
 from ray_tpu.serve import context as request_context
 from ray_tpu.serve import observatory
+from ray_tpu.serve import paged_kv
 from ray_tpu.models.transformer import (
     TransformerConfig,
     _act,
@@ -143,6 +148,28 @@ def _engine_metrics() -> Dict:
                     "Decode-slot-seconds stalled behind prefill passes "
                     "crossing serve_hol_threshold_s (head-of-line "
                     "blocking attributed to the long prefill causing it)",
+                ),
+                # Paged KV memory plane (ray_tpu/serve/paged_kv).
+                "kv_pages": Gauge(
+                    "serve_kv_pages_in_use",
+                    "KV page-pool pages currently referenced (request "
+                    "block tables + prefix-cache entries), sampled every "
+                    "engine step",
+                ),
+                "prefix_hits": Counter(
+                    "serve_prefix_cache_hits_total",
+                    "Admissions whose prompt prefix was resident in the "
+                    "page-level prefix cache (>= 1 full page shared)",
+                ),
+                "prefix_misses": Counter(
+                    "serve_prefix_cache_misses_total",
+                    "Admissions that found no resident prompt prefix "
+                    "(every prefill chunk recomputed)",
+                ),
+                "prefill_skipped": Counter(
+                    "serve_prefill_tokens_skipped_total",
+                    "Prompt tokens NOT re-prefilled because their pages "
+                    "were shared from the prefix cache",
                 ),
             }
         return _metrics
@@ -471,7 +498,10 @@ class ContinuousBatchingEngine:
                  max_len: int = 256, eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32,
                  prefill_buckets=None, seed: int = 0,
-                 mesh=None, prefill_chunk: int = 64):
+                 mesh=None, prefill_chunk: int = 64,
+                 kv_mode: Optional[str] = None,
+                 page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None):
         """mesh: a jax.sharding.Mesh with a "tp" axis for tensor-
         parallel serving (the pods layout): pass params already sharded
         via parallel.shard_params and the engine lays the KV cache out
@@ -482,7 +512,15 @@ class ContinuousBatchingEngine:
         tokens, ONE chunk between decode steps — a long prompt never
         stalls other slots' decoding for more than a chunk (chunked
         prefill), and prefill compiles exactly once. prefill_buckets is
-        a deprecated no-op (chunking bounds compilation by itself)."""
+        a deprecated no-op (chunking bounds compilation by itself).
+
+        kv_mode / page_size / kv_pages: the KV memory plane. "paged"
+        (default; ray_tpu/serve/paged_kv) backs slots with a shared
+        page pool + block tables and a prefix cache; "slotted" is the
+        original one-[max_len]-row-per-slot cache kept for bit-exact
+        baselines. None defers to config (RT_SERVE_KV,
+        RT_SERVE_KV_PAGE_SIZE, RT_SERVE_KV_PAGES; kv_pages 0/None =
+        slotted-HBM parity)."""
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -502,28 +540,93 @@ class ContinuousBatchingEngine:
                     f"the mesh's tp={mesh.shape['tp']} must divide "
                     f"n_kv_heads={cfg.n_kv_heads}"
                 )
+        rcfg = get_config()
+        mode = (kv_mode or rcfg.serve_kv or "paged").lower()
+        if mode not in ("paged", "slotted"):
+            raise ValueError(
+                f"kv_mode must be 'paged' or 'slotted', got {mode!r}"
+            )
+        self.kv_mode = mode
+        self._paged = mode == "paged"
+        self._cow = None
+        if self._paged:
+            self.page_size = max(
+                1, min(int(page_size or rcfg.serve_kv_page_size), max_len)
+            )
+            self._pages_per_slot = -(-max_len // self.page_size)
+            self.kv_pages = int(kv_pages or rcfg.serve_kv_pages or 0)
+            if self.kv_pages <= 0:
+                # HBM parity with the slotted cache it replaces (+ the
+                # reserved NULL page).
+                self.kv_pages = num_slots * self._pages_per_slot + 1
+            self._pool = paged_kv.PagePool(self.kv_pages, self.page_size)
+            self._prefix_cache = (
+                paged_kv.PrefixCache(self._pool)
+                if rcfg.serve_prefix_cache else None
+            )
+            # Host mirror of the device block table; uploaded as ONE
+            # array only when admission/eviction changed it (same
+            # discipline — and the same test pins — as the sampling
+            # params: the steady-state decode step uploads nothing).
+            self._bt_host = np.zeros(
+                (num_slots, self._pages_per_slot), dtype=np.int32
+            )
+            self._bt_dirty = False
+            self._bt_uploads = 0
+            self._slot_pages: Dict[int, list] = {}
+            self._prefix_hits = 0
+            self._prefix_misses = 0
+            self._prefill_tok_skipped = 0
+            self._chaos_held: list = []
         cache = self._fresh_cache()
         self._k, self._v = cache["k"], cache["v"]
         self._lengths = cache["lengths"]
-        self._decode_sampled = jax.jit(
-            lambda p, t, k, v, ln, a, tp, tk, tpp, key: _decode_slots(
-                p, t, k, v, ln, a, tp, tk, tpp, key, cfg
-            ),
-            donate_argnums=(2, 3),
-        )
-        self._decode_greedy = jax.jit(
-            lambda p, t, k, v, ln, a: _decode_slots(
-                p, t, k, v, ln, a, None, None, None, None, cfg
-            ),
-            donate_argnums=(2, 3),
-        )
+        if self._paged:
+            self._bt_dev = cache["block_tables"]
+            self._decode_sampled = jax.jit(
+                lambda p, t, k, v, ln, a, bt, tp, tk, tpp, key:
+                paged_kv.decode_paged(
+                    p, t, k, v, ln, a, bt, tp, tk, tpp, key, cfg, max_len
+                ),
+                donate_argnums=(2, 3),
+            )
+            self._decode_greedy = jax.jit(
+                lambda p, t, k, v, ln, a, bt: paged_kv.decode_paged(
+                    p, t, k, v, ln, a, bt, None, None, None, None, cfg,
+                    max_len
+                ),
+                donate_argnums=(2, 3),
+            )
+            self._prefill = jax.jit(
+                lambda p, t, n, s, o, k, v, ln, bt:
+                paged_kv.prefill_chunk_paged(
+                    p, t, n, s, o, k, v, ln, bt, cfg, max_len
+                ),
+                donate_argnums=(5, 6),
+            )
+            self._cow = jax.jit(
+                paged_kv.cow_copy_page, donate_argnums=(0, 1)
+            )
+        else:
+            self._decode_sampled = jax.jit(
+                lambda p, t, k, v, ln, a, tp, tk, tpp, key: _decode_slots(
+                    p, t, k, v, ln, a, tp, tk, tpp, key, cfg
+                ),
+                donate_argnums=(2, 3),
+            )
+            self._decode_greedy = jax.jit(
+                lambda p, t, k, v, ln, a: _decode_slots(
+                    p, t, k, v, ln, a, None, None, None, None, cfg
+                ),
+                donate_argnums=(2, 3),
+            )
+            self._prefill = jax.jit(
+                lambda p, t, n, s, o, k, v, ln: _prefill_chunk(
+                    p, t, n, s, o, k, v, ln, cfg
+                ),
+                donate_argnums=(5, 6),
+            )
         self._pick = jax.jit(_pick_tokens)
-        self._prefill = jax.jit(
-            lambda p, t, n, s, o, k, v, ln: _prefill_chunk(
-                p, t, n, s, o, k, v, ln, cfg
-            ),
-            donate_argnums=(5, 6),
-        )
         self._lock = threading.Lock()
         self._work = threading.Event()
         # BOUNDED admission queue with per-tenant weighted-fair service:
@@ -606,20 +709,40 @@ class ContinuousBatchingEngine:
         are re-written by any real occupant before its length exposes
         them, so cache contents stay semantically untouched."""
         self._rng, k1, k2 = jax.random.split(self._rng, 3)
-        (_, self._k, self._v, self._lengths) = self._decode_greedy(
-            self.params, self._tokens_dev, self._k, self._v,
-            self._lengths, self._active_dev,
-        )
-        (_, self._k, self._v, self._lengths) = self._decode_sampled(
-            self.params, self._tokens_dev, self._k, self._v,
-            self._lengths, self._active_dev, self._temps_dev,
-            self._top_ks_dev, self._top_ps_dev, k1,
-        )
         pad = jnp.zeros((1, self.prefill_chunk), dtype=jnp.int32)
-        logits, self._k, self._v, self._lengths = self._prefill(
-            self.params, pad, jnp.int32(1), jnp.int32(0), jnp.int32(0),
-            self._k, self._v, self._lengths,
-        )
+        if self._paged:
+            (_, self._k, self._v, self._lengths) = self._decode_greedy(
+                self.params, self._tokens_dev, self._k, self._v,
+                self._lengths, self._active_dev, self._bt_dev,
+            )
+            (_, self._k, self._v, self._lengths) = self._decode_sampled(
+                self.params, self._tokens_dev, self._k, self._v,
+                self._lengths, self._active_dev, self._bt_dev,
+                self._temps_dev, self._top_ks_dev, self._top_ps_dev, k1,
+            )
+            logits, self._k, self._v, self._lengths = self._prefill(
+                self.params, pad, jnp.int32(1), jnp.int32(0), jnp.int32(0),
+                self._k, self._v, self._lengths, self._bt_dev,
+            )
+            # Warm the copy-on-write page fork too (NULL page onto
+            # itself: contents never observable).
+            self._k, self._v = self._cow(
+                self._k, self._v, jnp.int32(0), jnp.int32(0)
+            )
+        else:
+            (_, self._k, self._v, self._lengths) = self._decode_greedy(
+                self.params, self._tokens_dev, self._k, self._v,
+                self._lengths, self._active_dev,
+            )
+            (_, self._k, self._v, self._lengths) = self._decode_sampled(
+                self.params, self._tokens_dev, self._k, self._v,
+                self._lengths, self._active_dev, self._temps_dev,
+                self._top_ks_dev, self._top_ps_dev, k1,
+            )
+            logits, self._k, self._v, self._lengths = self._prefill(
+                self.params, pad, jnp.int32(1), jnp.int32(0), jnp.int32(0),
+                self._k, self._v, self._lengths,
+            )
         self._pick(
             logits, jnp.full(1, 0.5, jnp.float32),
             jnp.full(1, 1, jnp.int32), jnp.full(1, 1.0, jnp.float32), k2,
@@ -634,8 +757,11 @@ class ContinuousBatchingEngine:
         callables (the wrapper-counter the recompile guard pins: jit
         cache growth == a recompilation happened)."""
         n = 0
-        for f in (self._decode_greedy, self._decode_sampled,
-                  self._prefill, self._pick):
+        fns = [self._decode_greedy, self._decode_sampled,
+               self._prefill, self._pick]
+        if self._cow is not None:
+            fns.append(self._cow)
+        for f in fns:
             try:
                 n += f._cache_size()
             except (AttributeError, TypeError):
@@ -661,7 +787,53 @@ class ContinuousBatchingEngine:
         self._param_uploads += 1
         _engine_metrics()["param_uploads"].inc(1)
 
+    # Single-writer: _bt_dev is engine-thread-owned device state.
+    def _upload_block_table(self):  # rtlint: disable=RT006
+        """ONE host->device refresh of the block table. Admission-
+        reserved paging means the table only changes when slot
+        membership does — never per decode step (the paged analog of
+        _upload_sampling_state, with its own counter so tests can pin
+        the steady state)."""
+        bt = jnp.asarray(self._bt_host)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            bt = jax.device_put(bt, NamedSharding(self.mesh, P()))
+        self._bt_dev = bt
+        self._bt_dirty = False
+        self._bt_uploads += 1
+
+    # Single-writer: pool/cache are engine-thread-owned host state.
+    def _apply_kv_chaos(self):  # rtlint: disable=RT006
+        """Consume pending paged-KV chaos injections (RT_CHAOS=1 only):
+        a one-shot prefix-cache flush, and a persistent pool-pressure
+        target — the engine holds `frac` of the usable pages hostage,
+        adjusting toward the target as pages free up, until the frac is
+        set back to 0."""
+        if self._prefix_cache is not None and chaos.take_flush_prefix_cache():
+            with self._lock:
+                self._prefix_cache.flush()
+        frac = chaos.kv_exhaust_frac()
+        if frac is None and not self._chaos_held:
+            return
+        target = int(round((frac or 0.0) * self._pool.usable))
+        with self._lock:
+            if len(self._chaos_held) > target:
+                give_back = self._chaos_held[target:]
+                del self._chaos_held[target:]
+                self._pool.release(give_back)
+            elif len(self._chaos_held) < target:
+                grab = min(target - len(self._chaos_held),
+                           self._pool.free_pages)
+                if grab > 0:
+                    self._chaos_held.extend(self._pool.alloc(grab))
+
     def _fresh_cache(self) -> Dict:
+        if self._paged:
+            return paged_kv.init_paged_cache(
+                self.cfg, self.num_slots, self.kv_pages, self.page_size,
+                self._pages_per_slot, mesh=self.mesh,
+            )
         cache = init_slotted_cache(self.cfg, self.num_slots, self.max_len)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -692,10 +864,23 @@ class ContinuousBatchingEngine:
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
-        if len(prompt) > self.max_len - 2:
-            raise ValueError(
-                f"prompt length {len(prompt)} exceeds the engine's "
-                f"max_len - 2 = {self.max_len - 2}"
+        limit = self.max_len - 2
+        detail = f"max_len - 2 = {self.max_len - 2} positions"
+        if self._paged:
+            # The pool must hold the whole prompt plus one generated
+            # token (+1 margin row for the pipelined in-flight step).
+            pool_limit = self._pool.usable * self.page_size - 2
+            if pool_limit < limit:
+                limit = pool_limit
+                detail = (
+                    f"page pool = {self._pool.usable} pages x "
+                    f"{self.page_size} tokens - 2 = {pool_limit}"
+                )
+        if len(prompt) > limit:
+            raise PromptTooLongError(
+                f"prompt length {len(prompt)} exceeds this engine's "
+                f"limit of {limit} tokens ({detail})",
+                prompt_len=len(prompt), max_prompt_len=limit,
             )
         if max_new_tokens is None:
             max_new_tokens = self.default_max_new_tokens
@@ -767,10 +952,39 @@ class ContinuousBatchingEngine:
         with self._lock:
             self._tenant_weights[tenant or "default"] = float(weight)
 
+    def _kv_stats_locked(self) -> Dict:
+        """The KV memory plane's health (stats()["kv"]): pool occupancy,
+        prefix-cache effectiveness, and — for affinity routing — the
+        cache's advertised root keys."""
+        if not self._paged:
+            return {"mode": "slotted", "page_size": 0}
+        lookups = self._prefix_hits + self._prefix_misses
+        cache_pages = (self._prefix_cache.pages_held
+                       if self._prefix_cache is not None else 0)
+        return {
+            "mode": "paged",
+            "page_size": self.page_size,
+            "pages_total": self._pool.usable,
+            "pages_in_use": self._pool.in_use,
+            "pages_free": self._pool.free_pages,
+            "util": self._pool.in_use / max(1, self._pool.usable),
+            "prefix_cache_pages": cache_pages,
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "prefix_hit_rate": (self._prefix_hits / lookups
+                                if lookups else None),
+            "prefill_tokens_skipped": self._prefill_tok_skipped,
+            "bt_uploads": self._bt_uploads,
+            "chaos_held_pages": len(self._chaos_held),
+            "roots": (self._prefix_cache.roots()
+                      if self._prefix_cache is not None else []),
+        }
+
     def stats(self) -> Dict:
         with self._lock:
             ts = max(self._timed_steps, 1)
             return {
+                "kv": self._kv_stats_locked(),
                 "steps": self._steps,
                 "active": len(self._slots),
                 "waiting": self._waiting_n,
@@ -896,13 +1110,6 @@ class ContinuousBatchingEngine:
                 ))
                 observatory.record_deadline_expired("", "engine_admission")
                 continue
-            grant_t = time.perf_counter()
-            if h.submitted_at is not None:
-                _engine_metrics()["admission_wait_s"].observe(
-                    grant_t - h.submitted_at
-                )
-            if h.obs is not None:
-                h.obs.marks["slot_grant"] = grant_t
             # Deliverable budget: the loop cuts a sequence at lengths >=
             # max_len - 2 (one in-flight pipelined step keeps a margin
             # row), so a prompt of P rows can emit max_len - 1 - P
@@ -911,10 +1118,117 @@ class ContinuousBatchingEngine:
             h.max_new_tokens = min(
                 h.max_new_tokens, self.max_len - 1 - len(h.prompt)
             )
+            res = None
+            if self._paged:
+                # Reserve EVERY page the request can ever touch now:
+                # decode then never allocates, so the block table (like
+                # the sampling params) uploads only on slot membership
+                # changes and pool exhaustion can never strand a
+                # mid-decode sequence.
+                res = self._reserve_paged_locked(h)
+                if res is None:
+                    # Pool pressure: back to the FRONT of its tenant
+                    # queue; retried as decoding slots release pages.
+                    q = self._waiting.get(h.tenant)
+                    if q is None:
+                        q = self._waiting[h.tenant] = deque()
+                        self._wfq_rr.append(h.tenant)
+                    q.appendleft(h)
+                    self._waiting_n += 1
+                    break
+            grant_t = time.perf_counter()
+            if h.submitted_at is not None:
+                _engine_metrics()["admission_wait_s"].observe(
+                    grant_t - h.submitted_at
+                )
+            if h.obs is not None:
+                h.obs.marks["slot_grant"] = grant_t
             slot = self._free.popleft()
-            self._prefilling[slot] = {"h": h, "offset": 0}
+            entry = {"h": h, "offset": 0}
+            if self._paged:
+                entry["offset"] = res["skip"]
+                entry["pages"] = res["pages"]
+                entry["hashes"] = res["hashes"]
+                row = self._bt_host[slot]
+                row[:] = 0
+                row[:len(res["pages"])] = res["pages"]
+                self._bt_dirty = True
+            self._prefilling[slot] = entry
         if admitted:
             _engine_metrics()["waiting"].set(float(self._waiting_n))
+
+    # Caller holds self._lock (the `_locked` contract); the KV counters
+    # it bumps are read back under the same lock in _kv_stats_locked.
+    def _reserve_paged_locked(self, h) -> Optional[Dict]:  # rtlint: disable=RT006
+        """Pages for one admission: shared prefix pages from the cache
+        (refcount bump, prefill skipped below `skip`) plus freshly
+        allocated pages covering the rest of the request's maximum
+        footprint. None = pool exhausted even after LRU-evicting cache
+        entries; the caller requeues."""
+        ps = self.page_size
+        p_len = len(h.prompt)
+        hashes = (paged_kv.page_hashes(h.prompt, ps)
+                  if self._prefix_cache is not None else [])
+        shared = self._prefix_cache.match(hashes) if hashes else []
+        # Footprint: prompt + generated tokens + one margin row for the
+        # pipelined in-flight step, capped by addressable positions.
+        rows = min(p_len + h.max_new_tokens + 1, self.max_len)
+        need = -(-rows // ps) - len(shared)
+        try:
+            own = self._pool.alloc(need)
+        except paged_kv.OutOfPages:
+            own = None
+            if self._prefix_cache is not None and self._prefix_cache.pages_held:
+                self._prefix_cache.evict_pages(
+                    need - self._pool.free_pages
+                )
+                try:
+                    own = self._pool.alloc(need)
+                except paged_kv.OutOfPages:
+                    own = None
+        if own is None:
+            if shared:
+                self._pool.release(shared)
+            return None
+        pages = shared + own
+        # Always recompute at least the final prompt token: its logits
+        # seed the first generated token, and a partial tail page is
+        # never cached anyway.
+        skip = min(len(shared) * ps, p_len - 1)
+        m = _engine_metrics()
+        if hashes:
+            if shared:
+                self._prefix_hits += 1
+                m["prefix_hits"].inc(1)
+            else:
+                self._prefix_misses += 1
+                m["prefix_misses"].inc(1)
+        if skip > 0:
+            self._prefill_tok_skipped += skip
+            m["prefill_skipped"].inc(skip)
+        fw = skip // ps
+        if skip and fw < len(shared):
+            # Full-prefix hit: the recomputed final token's K/V lands in
+            # the LAST shared page — fork it copy-on-write first
+            # (refcount > 1 pages are never written).
+            try:
+                fork = self._pool.alloc(1)[0]
+            except paged_kv.OutOfPages:
+                self._pool.release(pages)
+                return None
+            self._k, self._v = self._cow(
+                self._k, self._v, jnp.int32(pages[fw]), jnp.int32(fork)
+            )
+            self._pool.release([pages[fw]])
+            pages[fw] = fork
+        return {"pages": pages, "hashes": hashes, "skip": skip}
+
+    def _release_slot_pages_locked(self, slot: int):
+        """Return a decoding slot's page references to the pool (slot
+        eviction; prefix-cache entries keep their own references)."""
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self._pool.release(pages)
 
     # Single-writer: KV cache, rng, and token buffers are engine-thread-
     # owned device state; no other thread touches them after __init__.
@@ -935,6 +1249,8 @@ class ContinuousBatchingEngine:
         injected = chaos.take_prefill_delay()
         if injected:
             time.sleep(injected)
+        if self._paged and self._bt_dirty:
+            self._upload_block_table()
         self._last_prefill_work = [
             {
                 "request_id": e["h"].request_id,
@@ -961,16 +1277,25 @@ class ContinuousBatchingEngine:
                     self._deadline_expired += int(not h.cancelled)
                     del self._prefilling[slot]
                     self._free.append(slot)
+                    if self._paged:
+                        self._pool.release(entry["pages"])
                 continue
             chunk = h.prompt[off:off + c]
             n = len(chunk)
             padded = np.zeros((1, c), dtype=np.int32)
             padded[0, :n] = chunk
-            logits, self._k, self._v, self._lengths = self._prefill(
-                self.params, jnp.asarray(padded),
-                jnp.int32(n), jnp.int32(slot), jnp.int32(off),
-                self._k, self._v, self._lengths,
-            )
+            if self._paged:
+                logits, self._k, self._v, self._lengths = self._prefill(
+                    self.params, jnp.asarray(padded),
+                    jnp.int32(n), jnp.int32(slot), jnp.int32(off),
+                    self._k, self._v, self._lengths, self._bt_dev,
+                )
+            else:
+                logits, self._k, self._v, self._lengths = self._prefill(
+                    self.params, jnp.asarray(padded),
+                    jnp.int32(n), jnp.int32(slot), jnp.int32(off),
+                    self._k, self._v, self._lengths,
+                )
             entry["offset"] = off + n
             if entry["offset"] < len(h.prompt):
                 continue
@@ -993,11 +1318,11 @@ class ContinuousBatchingEngine:
                 tok_dev.copy_to_host_async()
             except Exception:  # rtlint: disable=RT007 — optional prefetch; sharded layouts fetch below
                 pass
-            finished.append((slot, h, tok_dev))
+            finished.append((slot, h, tok_dev, entry))
         if not finished:
             return
-        toks_np = jax.device_get([t for _, _, t in finished])
-        for (slot, h, _), tok_arr in zip(finished, toks_np):
+        toks_np = jax.device_get([t for _, _, t, _ in finished])
+        for (slot, h, _, entry), tok_arr in zip(finished, toks_np):
             tok = int(tok_arr[0])
             h.produced = 1
             # admitted_at_step must be visible before the push wakes a
@@ -1009,10 +1334,23 @@ class ContinuousBatchingEngine:
                     else False) or h.produced >= h.max_new_tokens
             h._push(tok, done)
             with self._lock:
+                if self._paged and self._prefix_cache is not None:
+                    # Publish the prompt's full pages NOW (not at
+                    # request completion): a concurrent same-prefix
+                    # request admitted next tick already shares them.
+                    hashes = entry.get("hashes") or []
+                    if hashes:
+                        self._prefix_cache.insert(
+                            hashes, entry["pages"][:len(hashes)]
+                        )
                 del self._prefilling[slot]
                 if done:
                     self._free.append(slot)
+                    if self._paged:
+                        self._pool.release(entry["pages"])
                 else:
+                    if self._paged:
+                        self._slot_pages[slot] = entry["pages"]
                     self._slots[slot] = h
                     self._gen[slot] += 1
                     self._temps[slot] = h.temperature
@@ -1060,6 +1398,8 @@ class ContinuousBatchingEngine:
         while not self._stop_evt.is_set():
             try:
                 t_iter = time.perf_counter()
+                if self._paged:
+                    self._apply_kv_chaos()
                 with self._lock:
                     self._admit_locked()
                 # HOL watchdog: prefill passes (never the bare decode
@@ -1081,8 +1421,28 @@ class ContinuousBatchingEngine:
                 if snapshot:
                     if self._params_dirty:
                         self._upload_sampling_state()
+                    if self._paged and self._bt_dirty:
+                        self._upload_block_table()
                     t0 = time.perf_counter()
-                    if self._sampled_active:
+                    if self._paged:
+                        if self._sampled_active:
+                            self._rng, step_key = jax.random.split(self._rng)
+                            (next_dev, self._k, self._v,
+                             self._lengths) = self._decode_sampled(
+                                self.params, self._tokens_dev,
+                                self._k, self._v, self._lengths,
+                                self._active_dev, self._bt_dev,
+                                self._temps_dev, self._top_ks_dev,
+                                self._top_ps_dev, step_key,
+                            )
+                        else:
+                            (next_dev, self._k, self._v,
+                             self._lengths) = self._decode_greedy(
+                                self.params, self._tokens_dev,
+                                self._k, self._v, self._lengths,
+                                self._active_dev, self._bt_dev,
+                            )
+                    elif self._sampled_active:
                         self._rng, step_key = jax.random.split(self._rng)
                         (next_dev, self._k, self._v,
                          self._lengths) = self._decode_sampled(
@@ -1158,6 +1518,8 @@ class ContinuousBatchingEngine:
                                 self._top_ks[s] = 0
                                 self._top_ps[s] = 1.0
                                 self._params_dirty = True
+                                if self._paged:
+                                    self._release_slot_pages_locked(s)
                                 continue
                             tok = int(toks[s])
                             h.produced += 1
@@ -1179,6 +1541,8 @@ class ContinuousBatchingEngine:
                                 self._top_ks[s] = 0
                                 self._top_ps[s] = 1.0
                                 self._params_dirty = True
+                                if self._paged:
+                                    self._release_slot_pages_locked(s)
                 inflight = new_inflight
                 if snapshot:
                     host_s = max(
@@ -1191,6 +1555,8 @@ class ContinuousBatchingEngine:
                     m["host_ms"].observe(host_s * 1e3)
                     m["occupancy"].set(len(snapshot) / self.num_slots)
                     m["waiting"].set(float(self._waiting_n))
+                    if self._paged:
+                        m["kv_pages"].set(float(self._pool.in_use))
                     compiles = self._compile_count()
                     grew = compiles - self._last_compiles
                     if grew > 0:
@@ -1224,6 +1590,19 @@ class ContinuousBatchingEngine:
                     cache = self._fresh_cache()
                     self._k, self._v = cache["k"], cache["v"]
                     self._lengths = cache["lengths"]
+                    if self._paged:
+                        # Every outstanding page reference pointed into
+                        # the dead cache: reset the allocator, drop the
+                        # prefix cache WITHOUT releasing (the refs are
+                        # void), zero the table.
+                        self._bt_dev = cache["block_tables"]
+                        self._pool.reset()
+                        if self._prefix_cache is not None:
+                            self._prefix_cache.reset()
+                        self._slot_pages.clear()
+                        self._chaos_held = []
+                        self._bt_host[:] = 0
+                        self._bt_dirty = False
                     self._tokens_dev = jnp.zeros(
                         self.num_slots, dtype=jnp.int32
                     )
@@ -1245,7 +1624,9 @@ class LLMReplica:
     def __init__(self, model_loader, num_slots: int = 4, max_len: int = 256,
                  eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 32,
-                 prefill_chunk: int = 64):
+                 prefill_chunk: int = 64, kv_mode: Optional[str] = None,
+                 page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None):
         # The loader runs IN the replica process and may return
         # (params, cfg) or (params, cfg, mesh) — a Mesh cannot cross
         # the actor boundary as an argument, so tensor-parallel serving
@@ -1259,7 +1640,8 @@ class LLMReplica:
         self.engine = ContinuousBatchingEngine(
             params, cfg, num_slots=num_slots, max_len=max_len,
             eos_id=eos_id, default_max_new_tokens=default_max_new_tokens,
-            mesh=mesh, prefill_chunk=prefill_chunk,
+            mesh=mesh, prefill_chunk=prefill_chunk, kv_mode=kv_mode,
+            page_size=page_size, kv_pages=kv_pages,
         )
 
     def __call__(self, prompt, max_new_tokens: Optional[int] = None,
@@ -1310,7 +1692,9 @@ def llm_deployment(model_loader, *, num_slots: int = 4, max_len: int = 256,
                    default_max_new_tokens: int = 32, num_replicas: int = 1,
                    max_ongoing_requests: int = 64,
                    ray_actor_options: Optional[dict] = None,
-                   prefill_chunk: int = 64):
+                   prefill_chunk: int = 64, kv_mode: Optional[str] = None,
+                   page_size: Optional[int] = None,
+                   kv_pages: Optional[int] = None):
     """A ready-to-run continuous-batching LLM application.
 
         app = llm_deployment(lambda: (params, cfg), num_slots=8)
@@ -1333,5 +1717,6 @@ def llm_deployment(model_loader, *, num_slots: int = 4, max_len: int = 256,
     return dep.bind(
         model_loader, num_slots=num_slots, max_len=max_len, eos_id=eos_id,
         default_max_new_tokens=default_max_new_tokens,
-        prefill_chunk=prefill_chunk,
+        prefill_chunk=prefill_chunk, kv_mode=kv_mode,
+        page_size=page_size, kv_pages=kv_pages,
     )

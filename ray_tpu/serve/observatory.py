@@ -59,7 +59,10 @@ from ray_tpu._private.config import get_config
 from ray_tpu.util.lifecycle import SERVE_PHASE_ORDER
 
 #: ServeSignals document schema version (bump on breaking shape change).
-SIGNALS_SCHEMA_VERSION = 1
+#: v2 adds paged-KV fields (per-replica kv_util / prefix_hit_rate /
+#: prefill_tokens_skipped, per-app "kv" aggregate, target/running
+#: replica counts) — purely additive, v1 readers ignore them.
+SIGNALS_SCHEMA_VERSION = 2
 
 #: GCS KV key (ns="serve") the controller publishes ServeSignals under.
 SIGNALS_KEY = b"serve_signals"

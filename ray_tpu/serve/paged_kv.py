@@ -1,0 +1,430 @@
+"""Paged KV cache: the engine's memory plane as a first-class subsystem.
+
+The slotted cache (llm.init_slotted_cache) pins one whole `[max_len]`
+row per request: a 30-token chat holds the same HBM as a 4k-token
+document, concurrency is fixed at `num_slots` no matter the workload,
+and two requests sharing a prompt prefix each recompute and store it.
+This module replaces the row with PAGES — the vLLM PagedAttention idea
+(arXiv:2309.06180), built for the engine's TPU discipline of static
+shapes and zero steady-state host traffic:
+
+  * One page pool `[layers, pages, page_size, kv_heads, head_dim]` and
+    a per-slot block table `[slots, pages_per_slot]` resident on
+    device. Decode gathers K/V *through* the block table (one gather
+    per layer inside the jitted step); prefill scatters rows into the
+    pages the table names. Program shapes depend only on the pool and
+    table geometry, so compilation stays bounded exactly as before.
+  * A host-side free-list allocator with REFCOUNTED pages. Admission
+    reserves every page a request can ever touch up front
+    (ceil((prompt + max_new + 1) / page_size)); decode then never
+    allocates, so the block table uploads only at admission/eviction —
+    the same single-upload discipline as the sampling params, and the
+    steady-state decode loop keeps doing zero host->device transfers.
+  * A PREFIX CACHE: a token-hash trie over full-page runs (chain hash
+    per page, so a lookup is O(pages) dict probes). A request whose
+    prompt prefix is resident maps the shared pages into its block
+    table (refcount bump, no copy) and skips those prefill chunks
+    entirely. Pages are copy-on-write: the one case where a new
+    request must write into a shared page (its first recomputed token
+    lands mid-page) forks that page first. Cache entries hold their own
+    page references, so a donor request finishing — or being evicted —
+    never invalidates the sharers; under pool pressure the cache LRU-
+    releases entries back to the free list.
+
+Page 0 is reserved as the NULL/scratch page: block-table entries
+default to it, inactive-slot decode writes park in it, and prefill
+padding rows drop into it — it is never gathered unmasked, so its
+contents are never observable.
+
+Bit-exactness with the slotted path: when `max_len % page_size == 0`
+the gathered attention width equals `max_len`, gathered row i of a slot
+is absolute position i (pages are table-ordered), and masked lanes
+underflow to exact 0.0 in the f32 softmax — the decode outputs are
+bit-identical, which tests/test_paged_kv.py pins against
+`RT_SERVE_KV=slotted`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    _embed_tokens,
+    project_logits,
+)
+from ray_tpu.ops import rmsnorm, rope_frequencies
+
+# The reserved NULL/scratch page (see module docstring).
+NULL_PAGE = 0
+
+
+class OutOfPages(RuntimeError):
+    """The pool cannot cover an allocation. Admission-time only: the
+    engine requeues the request at the front of its tenant queue and
+    retries as decoding requests finish and release pages."""
+
+    def __init__(self, needed: int, free: int, total: int):
+        super().__init__(
+            f"page pool exhausted: need {needed} pages, {free} free of "
+            f"{total} usable"
+        )
+        self.needed = needed
+        self.free = free
+        self.total = total
+
+
+class PagePool:
+    """Host-side free-list allocator over the device page pool.
+
+    Pure bookkeeping — it never touches device memory. Refcounts make
+    prefix sharing safe: a page is returned to the free list only when
+    its last holder (request block table or prefix-cache entry)
+    releases it. Single-threaded by design: only the engine loop thread
+    allocates/releases (admission and eviction both happen there)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("PagePool needs >= 2 pages (page 0 is reserved)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: recently-freed pages are re-used first (their
+        # rows are about to be overwritten anyway).
+        self._free: List[int] = list(range(1, self.num_pages))
+        self._refs = np.zeros(self.num_pages, dtype=np.int32)
+
+    @property
+    def usable(self) -> int:
+        return self.num_pages - 1  # page 0 reserved
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.usable - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take `n` pages off the free list at refcount 1. All-or-
+        nothing: raises OutOfPages without allocating anything when the
+        list is short (partial grants would leak on the error path)."""
+        if n < 0:
+            raise ValueError("alloc of negative page count")
+        if n > len(self._free):
+            raise OutOfPages(n, len(self._free), self.usable)
+        pages = [self._free.pop() for _ in range(n)]
+        self._refs[pages] = 1
+        return pages
+
+    def ref(self, pages: Sequence[int]) -> None:
+        """Add one reference to each page (prefix sharing / cache insert)."""
+        for p in pages:
+            if self._refs[p] <= 0:
+                raise ValueError(f"ref of unallocated page {p}")
+            self._refs[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference from each page; pages reaching zero return
+        to the free list."""
+        for p in pages:
+            r = int(self._refs[p]) - 1
+            if r < 0:
+                raise ValueError(f"release of unallocated page {p}")
+            self._refs[p] = r
+            if r == 0:
+                self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        return int(self._refs[page])
+
+    def reset(self) -> None:
+        """Forget everything (engine failure recovery: the device cache
+        was rebuilt, so every outstanding reference is void)."""
+        self._free = list(range(1, self.num_pages))
+        self._refs[:] = 0
+
+
+class PrefixCache:
+    """Token-hash trie over full-page runs, flattened to one dict.
+
+    Each cached page is keyed by the CHAIN hash of the prompt prefix it
+    completes (h_i = blake2b(h_{i-1} || tokens of page i)), so a chain
+    key identifies the entire token prefix, not just one page's tokens
+    — matching is `for each key: dict probe`, longest resident prefix
+    wins, no tree pointers needed. The cache holds its OWN reference on
+    every resident page: donors finishing (or dying) cannot invalidate
+    sharers, and `evict_pages` under pool pressure releases LRU entries
+    deepest-first (an OrderedDict move-to-end on match keeps recency;
+    entries of one insertion land in chain order, so popping from the
+    front releases stale roots last — a child page is never left
+    resident without its parent chain being droppable first is NOT
+    required for correctness: a match simply stops at the first missing
+    link)."""
+
+    def __init__(self, pool: PagePool):
+        self._pool = pool
+        # chain-hash key -> (page, depth). Ordered: LRU at the front.
+        self._entries: "OrderedDict[str, Tuple[int, int]]" = OrderedDict()
+
+    @property
+    def pages_held(self) -> int:
+        return len(self._entries)
+
+    def match(self, keys: Sequence[str]) -> List[int]:
+        """Longest resident prefix of `keys`, as pages. The caller
+        receives ONE reference per returned page (release when the
+        request's block table drops them)."""
+        pages: List[int] = []
+        for k in keys:
+            hit = self._entries.get(k)
+            if hit is None:
+                break
+            self._entries.move_to_end(k)
+            pages.append(hit[0])
+        if pages:
+            self._pool.ref(pages)
+        return pages
+
+    def insert(self, keys: Sequence[str], pages: Sequence[int]) -> int:
+        """Publish a prompt's full pages under their chain keys (called
+        at prefill completion, so concurrent requests share as early as
+        possible). The cache takes its own reference on each newly
+        inserted page; keys already resident just refresh recency.
+        Returns the number of pages newly inserted."""
+        added = 0
+        for depth, (k, p) in enumerate(zip(keys, pages)):
+            if k in self._entries:
+                self._entries.move_to_end(k)
+                continue
+            self._pool.ref([p])
+            self._entries[k] = (int(p), depth)
+            added += 1
+        return added
+
+    def evict_pages(self, n: int) -> int:
+        """Release up to `n` LRU entries back toward the pool (allocation
+        pressure). Returns how many entries were dropped — the caller
+        retries its alloc; freed-page count can be lower when a sharer
+        still holds a reference."""
+        dropped = 0
+        while dropped < n and self._entries:
+            _, (page, _) = self._entries.popitem(last=False)
+            self._pool.release([page])
+            dropped += 1
+        return dropped
+
+    def flush(self) -> int:
+        """Drop every entry (chaos hook / tests). Returns entries dropped."""
+        return self.evict_pages(len(self._entries))
+
+    def reset(self) -> None:
+        """Forget entries WITHOUT releasing (engine failure recovery:
+        the pool was reset, the references no longer exist)."""
+        self._entries.clear()
+
+    def roots(self, limit: int = 64) -> List[str]:
+        """Most-recently-used depth-0 chain keys — the replica's
+        advertised prefix set for affinity routing. Depth 0 only: a
+        router match on the FIRST page is what predicts the rest of the
+        chain being resident, and it keeps the advertisement bounded."""
+        out = [k for k, (_, d) in self._entries.items() if d == 0]
+        return out[-limit:]
+
+
+def page_hashes(tokens, page_size: int) -> List[str]:
+    """Chain hashes of every FULL page of `tokens` (partial tail pages
+    are never cached — their rows would change as the request decodes).
+    Key i commits to tokens[0 : (i+1)*page_size]."""
+    arr = np.asarray(tokens, dtype=np.int32).reshape(-1)
+    out: List[str] = []
+    parent = b""
+    for i in range(len(arr) // page_size):
+        h = hashlib.blake2b(
+            parent + arr[i * page_size:(i + 1) * page_size].tobytes(),
+            digest_size=16,
+        )
+        parent = h.digest()
+        out.append(h.hexdigest())
+    return out
+
+
+def prefix_route_key(tokens, page_size: int) -> Optional[str]:
+    """The depth-0 chain key of a prompt (None when the prompt does not
+    fill one page) — what the handle matches against replicas'
+    advertised `roots` for prefix-affinity routing."""
+    arr = np.asarray(tokens, dtype=np.int32).reshape(-1)
+    if page_size < 1 or len(arr) < page_size:
+        return None
+    return hashlib.blake2b(
+        arr[:page_size].tobytes(), digest_size=16
+    ).hexdigest()
+
+
+def init_paged_cache(cfg: TransformerConfig, slots: int, num_pages: int,
+                     page_size: int, pages_per_slot: int,
+                     mesh=None) -> Dict:
+    """Device state of the paged cache: the page pool, per-slot lengths,
+    and the block table (all entries NULL_PAGE). Sharding matches the
+    slotted cache: KV heads over "tp", everything else replicated."""
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    cache = {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+        "lengths": jnp.zeros((slots,), dtype=jnp.int32),
+        "block_tables": jnp.zeros((slots, pages_per_slot),
+                                  dtype=jnp.int32),
+    }
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        kv_sharding = NamedSharding(mesh, P(None, None, None, "tp", None))
+        rep = NamedSharding(mesh, P())
+        cache = {
+            "k": jax.device_put(cache["k"], kv_sharding),
+            "v": jax.device_put(cache["v"], kv_sharding),
+            "lengths": jax.device_put(cache["lengths"], rep),
+            "block_tables": jax.device_put(cache["block_tables"], rep),
+        }
+    return cache
+
+
+def decode_paged(params, tokens, k_pages, v_pages, lengths, active,
+                 block_tables, temps, top_ks, top_ps, key,
+                 cfg: TransformerConfig, max_len: int):
+    """One decode step for every slot, K/V gathered through the block
+    table — the paged twin of llm._decode_slots (same contract: same
+    inputs plus the table, same outputs).
+
+    Each active slot writes its new K/V row into page
+    `block_tables[slot, lengths[slot] // page_size]` at row
+    `lengths[slot] % page_size`; inactive slots park the write in the
+    NULL page. Attention gathers the slot's whole table (width =
+    pages_per_slot * page_size) and masks by length, exactly like the
+    slotted step masks its `max_len` row."""
+    from ray_tpu.serve.llm import (  # local import: llm imports us too
+        _grouped_attention, _layer_body, _pick_tokens,
+    )
+
+    s_ = tokens.shape[0]
+    ps = k_pages.shape[2]
+    mp = block_tables.shape[1]
+    width = mp * ps
+    kvh, hd = k_pages.shape[3], k_pages.shape[4]
+    x = _embed_tokens(params, tokens[:, None], cfg)  # [S, 1, d]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    positions = lengths[:, None]
+    pos_w = jnp.where(active, jnp.minimum(lengths, max_len - 1), 0)
+    page_of = jnp.minimum(pos_w // ps, mp - 1)
+    rows_w = pos_w % ps
+    slot_idx = jnp.arange(s_)
+    pages_w = jnp.where(active, block_tables[slot_idx, page_of], NULL_PAGE)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (s_, 1, width), 2)
+    valid = k_pos <= positions[:, :, None]
+
+    def write_kv(kc, vc, k, v):
+        # kc [pages, ps, kvh, hd]: scatter one row per slot, then gather
+        # each slot's pages back as a contiguous [width] view. Inactive
+        # slots all target (NULL_PAGE, 0); whichever lands is never
+        # unmasked.
+        kc = kc.at[pages_w, rows_w].set(k[:, 0].astype(kc.dtype))
+        vc = vc.at[pages_w, rows_w].set(v[:, 0].astype(vc.dtype))
+        k_att = kc[block_tables].reshape(s_, width, kvh, hd)
+        v_att = vc[block_tables].reshape(s_, width, kvh, hd)
+        return kc, vc, k_att, v_att
+
+    def layer(carry, inputs):
+        x = carry
+        lp, k_cache_l, v_cache_l = inputs
+        x, k_cache_l, v_cache_l = _layer_body(
+            x, lp, k_cache_l, v_cache_l, cfg, cos, sin, positions,
+            write_kv, valid,
+        )
+        return x, (k_cache_l, v_cache_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages)
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = project_logits(x[:, -1], params, cfg)
+    new_lengths = jnp.where(active, lengths + 1, lengths)
+    if temps is None:
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_tokens = _pick_tokens(logits, temps, top_ks, top_ps, key)
+    return next_tokens, k_new, v_new, new_lengths
+
+
+def prefill_chunk_paged(params, tokens, n_valid, slot, offset, k_pages,
+                        v_pages, lengths, block_tables,
+                        cfg: TransformerConfig, max_len: int):
+    """Chunked prefill into pages — the paged twin of
+    llm._prefill_chunk. Chunk rows scatter into the pages the slot's
+    block-table row names (padding rows and anything past `max_len`
+    drop into the NULL page, the paged equivalent of mode="drop");
+    queries attend causally against the slot's gathered page run.
+
+    Prefix-cache resumption needs nothing special here: the engine
+    starts `offset` at the shared-prefix boundary and the gathered
+    pages already hold the donor's K/V rows below it."""
+    from ray_tpu.serve.llm import _layer_body  # local import (cycle)
+
+    _, c = tokens.shape
+    ps = k_pages.shape[2]
+    mp = block_tables.shape[1]
+    width = mp * ps
+    kvh, hd = k_pages.shape[3], k_pages.shape[4]
+    x = _embed_tokens(params, tokens, cfg)
+    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    positions = offset + jnp.arange(c, dtype=jnp.int32)[None, :]
+    q_pos = positions[:, :, None]                               # [1, C, 1]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, c, width), 2)
+    valid = (k_pos <= q_pos) & (k_pos < offset + n_valid)
+    bt_row = jax.lax.dynamic_slice_in_dim(block_tables, slot, 1, axis=0)[0]
+    pos = offset + jnp.arange(c, dtype=jnp.int32)
+    in_range = (pos < offset + n_valid) & (pos < max_len)
+    page_of = jnp.minimum(pos // ps, mp - 1)
+    pages_w = jnp.where(in_range, bt_row[page_of], NULL_PAGE)
+    rows_w = pos % ps
+
+    def write_kv(kc, vc, k, v):
+        kc = kc.at[pages_w, rows_w].set(k[0].astype(kc.dtype))
+        vc = vc.at[pages_w, rows_w].set(v[0].astype(vc.dtype))
+        k_att = kc[bt_row].reshape(1, width, kvh, hd)
+        v_att = vc[bt_row].reshape(1, width, kvh, hd)
+        return kc, vc, k_att, v_att
+
+    def layer(carry, inputs):
+        x = carry
+        lp, k_cache_l, v_cache_l = inputs
+        x, k_cache_l, v_cache_l = _layer_body(
+            x, lp, k_cache_l, v_cache_l, cfg, cos, sin, positions,
+            write_kv, valid,
+        )
+        return x, (k_cache_l, v_cache_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages)
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    last = jax.lax.dynamic_slice(x, (0, n_valid - 1, 0), (1, 1, x.shape[-1]))
+    logits = project_logits(last[:, 0], params, cfg)
+    new_lengths = lengths.at[slot].set(offset + n_valid)
+    return logits, k_new, v_new, new_lengths
+
+
+def cow_copy_page(k_pages, v_pages, src, dst):
+    """Copy one page's rows across all layers (the copy-on-write fork).
+    Jitted by the engine with donated buffers so it runs in place."""
+    k_pages = k_pages.at[:, dst].set(k_pages[:, src])
+    v_pages = v_pages.at[:, dst].set(v_pages[:, src])
+    return k_pages, v_pages

@@ -38,6 +38,7 @@ from ray_tpu._private.config import get_config
 from ray_tpu.exceptions import (
     ActorError,
     GetTimeoutError,
+    PromptTooLongError,
     RequestCancelledError,
     ServeOverloadedError,
     TaskError,
@@ -59,8 +60,9 @@ def _proxy_metrics() -> Dict:
             _metrics = {
                 "responses": _mx.get_or_create(
                     _mx.Counter, "serve_http_responses_total",
-                    "HTTP responses by status code (200 ok, 429 shed, "
-                    "503 replica death, 504 deadline, 500 other), per app",
+                    "HTTP responses by status code (200 ok, 413 prompt "
+                    "too long, 429 shed, 503 replica death, 504 deadline, "
+                    "500 other), per app",
                     tag_keys=("app", "code"),
                 ),
             }
@@ -84,6 +86,11 @@ def _classify_error(e: BaseException):
     if isinstance(cause, RequestCancelledError) or (
             cause_name == "RequestCancelledError"):
         return 504, None, "deadline"
+    if isinstance(cause, PromptTooLongError) or (
+            cause_name == "PromptTooLongError"):
+        # 413: structural rejection — retrying the same prompt against
+        # the same app cannot succeed, so no Retry-After.
+        return 413, None, "prompt_too_long"
     if isinstance(e, (ActorError, WorkerCrashedError)) or (
             cause_name in ("ActorDiedError", "ActorUnavailableError",
                            "WorkerCrashedError")):

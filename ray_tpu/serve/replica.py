@@ -404,6 +404,7 @@ class ReplicaActor:
                         "prefilling": es.get("prefilling"),
                         "occupancy": es.get("latency", {}).get("occupancy"),
                         "hol": es.get("hol"),
+                        "kv": es.get("kv"),
                     }
                 except Exception:  # rtlint: disable=RT007 — snapshot is best-effort
                     pass

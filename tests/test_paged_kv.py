@@ -1,0 +1,494 @@
+"""Paged KV engine: block-table allocator, prefix cache, affinity
+routing pieces, and the ServeSignals-driven autoscaler.
+
+Covers the PR's acceptance list: bit-exact paged-vs-slotted decode on
+mixed-length batches, zero page leak over 1k admit/evict cycles,
+prefix-share correctness when the donor's cache entries are evicted
+mid-share, typed prompt rejection (+ proxy 413 mapping), chaos KV
+hooks, autoscaler hysteresis with a fake clock, and the schema-v2
+signals surface (old readers keep working).
+"""
+
+import time
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve import paged_kv
+from ray_tpu.serve.paged_kv import (
+    NULL_PAGE,
+    OutOfPages,
+    PagePool,
+    PrefixCache,
+    page_hashes,
+    prefix_route_key,
+)
+
+
+def _tiny_model():
+    import jax
+
+    from ray_tpu.models import configs, init_params
+
+    cfg = replace(configs.tiny, dtype=np.float32)
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+# -- page pool ------------------------------------------------------------
+def test_page_pool_alloc_release_refcount():
+    pool = PagePool(9, 16)
+    assert pool.usable == 8 and pool.free_pages == 8 and pool.in_use == 0
+    pages = pool.alloc(3)
+    assert len(pages) == 3 and NULL_PAGE not in pages
+    assert pool.in_use == 3 and pool.free_pages == 5
+    # A second reference keeps the page allocated past one release.
+    pool.ref(pages[:1])
+    assert pool.refcount(pages[0]) == 2
+    pool.release(pages[:1])
+    assert pool.in_use == 3
+    pool.release(pages)
+    assert pool.in_use == 0 and pool.free_pages == 8
+    # Releasing an unallocated page is a bug, not a no-op.
+    with pytest.raises(ValueError):
+        pool.release(pages[:1])
+
+
+def test_page_pool_alloc_is_all_or_nothing():
+    pool = PagePool(5, 4)  # 4 usable
+    pool.alloc(3)
+    with pytest.raises(OutOfPages) as ei:
+        pool.alloc(2)
+    assert ei.value.needed == 2 and ei.value.free == 1
+    # The failed alloc must not leak its partial grab.
+    assert pool.free_pages == 1
+
+
+# -- prefix trie ----------------------------------------------------------
+def test_page_hashes_chain_and_route_key():
+    a = page_hashes(list(range(8)), 4)
+    b = page_hashes([0, 1, 2, 3, 9, 9, 9, 9], 4)
+    assert len(a) == 2
+    assert a[0] == b[0] and a[1] != b[1]  # chain hash: shared first page
+    # Only FULL pages hash; the partial tail never enters the trie.
+    assert len(page_hashes(list(range(5)), 4)) == 1
+    assert prefix_route_key(list(range(5)), 4) == a[0]
+    assert prefix_route_key([1, 2], 4) is None
+
+
+def test_prefix_cache_match_insert_evict():
+    pool = PagePool(17, 4)
+    cache = PrefixCache(pool)
+    keys = page_hashes(list(range(12)), 4)
+    pages = pool.alloc(3)
+    cache.insert(keys, pages)
+    pool.release(pages)  # cache holds its own refs
+    assert pool.in_use == 3 and cache.pages_held == 3
+    got = cache.match(keys)
+    assert got == pages  # one ref per matched page handed to the caller
+    pool.release(got)
+    part = cache.match(keys[:2] + ["not-a-real-key"])
+    assert part == pages[:2]
+    pool.release(part)
+    assert cache.match(page_hashes(list(range(100, 112)), 4)) == []
+    assert keys[0] in cache.roots()
+    # LRU eviction and flush both hand pages back to the pool.
+    assert cache.evict_pages(1) >= 1
+    cache.flush()
+    assert cache.pages_held == 0 and pool.in_use == 0
+
+
+# -- engine: bit-exactness ------------------------------------------------
+def test_paged_vs_slotted_bit_exact_mixed_lengths():
+    """The paged decode must produce token-for-token identical output to
+    the slotted baseline for a concurrent mixed-length batch."""
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    prompts = [[1, 2, 3], [5, 6, 7, 8, 9, 10, 11], [4],
+               [9, 9, 2, 1, 3, 3, 7, 7, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10]]
+    outs = {}
+    for mode in ("slotted", "paged"):
+        eng = ContinuousBatchingEngine(
+            params, cfg, num_slots=4, max_len=64, kv_mode=mode,
+            page_size=16,
+        )
+        try:
+            handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            outs[mode] = [h.result(timeout=180) for h in handles]
+        finally:
+            eng.shutdown()
+    assert outs["paged"] == outs["slotted"]
+
+
+# -- engine: page accounting ----------------------------------------------
+def test_zero_page_leak_over_1k_admit_evict_cycles():
+    """1000 admissions/evictions leave the pool exactly empty. Prompts
+    are shorter than a page, so nothing enters the prefix cache — every
+    page cycles through alloc -> release."""
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=8, max_len=16, kv_mode="paged", page_size=4,
+    )
+    try:
+        done = 0
+        while done < 1000:
+            wave = [
+                eng.submit([1 + (done + i) % 50, 7], max_new_tokens=1)
+                for i in range(50)
+            ]
+            for h in wave:
+                assert len(h.result(timeout=180)) == 1
+            done += len(wave)
+        deadline = time.monotonic() + 30
+        while eng.stats()["kv"]["pages_in_use"] != 0:
+            assert time.monotonic() < deadline, (
+                f"page leak after {done} cycles: "
+                f"{eng.stats()['kv']}"
+            )
+            time.sleep(0.02)
+        kv = eng.stats()["kv"]
+        assert kv["prefix_cache_pages"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_skips_prefill_for_shared_prompt():
+    """A repeat prompt hits the prefix cache, skips resident prefill
+    pages (the skipped-token counter says so) and still decodes the
+    same greedy tokens."""
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=2, max_len=64, kv_mode="paged", page_size=8,
+    )
+    try:
+        prompt = [(3 * i + 1) % 50 for i in range(20)]  # 2 full pages
+        cold = eng.submit(prompt, max_new_tokens=6).result(timeout=180)
+        # Wait for completion-side bookkeeping (insert happens at
+        # prefill end; release at eviction).
+        deadline = time.monotonic() + 10
+        while eng.stats()["kv"]["prefix_cache_pages"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        warm = eng.submit(prompt, max_new_tokens=6).result(timeout=180)
+        assert warm == cold
+        kv = eng.stats()["kv"]
+        assert kv["prefix_hits"] >= 1  # one hit event per request
+        assert kv["prefill_tokens_skipped"] >= 8
+        assert kv["prefix_hit_rate"] > 0
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_share_survives_donor_eviction_mid_share():
+    """Flush the prefix cache (chaos hook) while a sharer is actively
+    decoding off shared pages: the sharer's own page references keep the
+    pages alive, output stays correct, and the pool drains to zero
+    afterwards (no double release, no leak)."""
+    from ray_tpu._private import chaos
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=2, max_len=96, kv_mode="paged", page_size=8,
+    )
+    chaos.enable()
+    try:
+        prompt = [(7 * i + 3) % 50 for i in range(24)]  # 3 full pages
+        ref = eng.submit(prompt, max_new_tokens=30).result(timeout=180)
+        sharer = eng.submit(prompt, max_new_tokens=30)
+        # Let the sharer get mid-decode, then yank the donor pages' cache
+        # references out from under it.
+        deadline = time.monotonic() + 60
+        while eng.stats()["kv"]["prefix_hits"] < 1:
+            assert time.monotonic() < deadline, "sharer never hit the cache"
+            time.sleep(0.005)
+        chaos.flush_prefix_cache()
+        out = sharer.result(timeout=180)
+        assert out == ref
+        deadline = time.monotonic() + 30
+        while True:
+            kv = eng.stats()["kv"]
+            if kv["pages_in_use"] == 0 and kv["prefix_cache_pages"] == 0:
+                break
+            assert time.monotonic() < deadline, f"pages leaked: {kv}"
+            time.sleep(0.02)
+    finally:
+        chaos.disable()
+        eng.shutdown()
+
+
+def test_chaos_exhaust_kv_pages_blocks_then_releases_admission():
+    from ray_tpu._private import chaos
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=2, max_len=32, kv_mode="paged", page_size=8,
+    )
+    chaos.enable()
+    try:
+        chaos.exhaust_kv_pages(1.0)
+        h = eng.submit([1, 2, 3], max_new_tokens=2)
+        deadline = time.monotonic() + 30
+        while eng.stats()["kv"]["chaos_held_pages"] == 0:
+            assert time.monotonic() < deadline, "chaos never grabbed pages"
+            time.sleep(0.02)
+        # The request cannot be admitted while chaos holds the pool.
+        time.sleep(0.3)
+        st = eng.stats()
+        assert st["active"] == 0 and st["waiting"] == 1
+        chaos.exhaust_kv_pages(0.0)
+        assert len(h.result(timeout=180)) == 2
+    finally:
+        chaos.disable()
+        eng.shutdown()
+
+
+# -- typed prompt rejection ----------------------------------------------
+def test_prompt_too_long_is_typed_and_bounded_by_pool():
+    from ray_tpu.exceptions import PromptTooLongError
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    # Pool smaller than max_len: 3 usable pages x 8 = 24 positions.
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=1, max_len=64, kv_mode="paged", page_size=8,
+        kv_pages=4,
+    )
+    try:
+        with pytest.raises(PromptTooLongError) as ei:
+            eng.submit(list(range(1, 40)), max_new_tokens=2)
+        err = ei.value
+        assert isinstance(err, ValueError)  # historical contract
+        assert err.prompt_len == 39 and err.max_prompt_len == 22
+        assert "39" in str(err) and "22" in str(err) and "page pool" in str(err)
+        # An in-bound prompt still serves.
+        assert len(
+            eng.submit([5, 6, 7], max_new_tokens=2).result(timeout=180)
+        ) == 2
+    finally:
+        eng.shutdown()
+
+
+def test_proxy_maps_prompt_too_long_to_413():
+    from ray_tpu.exceptions import PromptTooLongError, TaskError
+    from ray_tpu.serve.proxy import _classify_error
+
+    err = PromptTooLongError("too long", prompt_len=99, max_prompt_len=10)
+    wrapped = TaskError("PromptTooLongError", "traceback...", cause=err)
+    assert _classify_error(wrapped) == (413, None, "prompt_too_long")
+    # Unpickleable cause: classification falls back to the class name.
+    nameonly = TaskError("PromptTooLongError", "traceback...", cause=None)
+    assert _classify_error(nameonly)[0] == 413
+
+
+# -- autoscaler -----------------------------------------------------------
+def _sig(ongoing_per_rep, n_reps, waiting=0, ttft_p99_s=None, burn=None):
+    sig = {
+        "replicas": [{"actor_id": f"r{i}", "ongoing": ongoing_per_rep}
+                     for i in range(n_reps)],
+        "waiting": waiting,
+        "ttft_s": {"p99": ttft_p99_s, "p50": ttft_p99_s, "n": 10},
+    }
+    if burn is not None:
+        sig["tenants"] = {
+            "t": {"slo_windows": {"60": {"ttft": {"burn": burn}}}}
+        }
+    return sig
+
+
+def test_autoscaler_hysteresis_with_fake_clock():
+    from ray_tpu.serve.autoscale import AutoscalerState, decide
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    acfg = AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=2.0,
+        upscale_delay_s=5.0, downscale_delay_s=20.0,
+    )
+    st = AutoscalerState()
+    now, target = 1000.0, 1
+
+    # Pressure must HOLD for upscale_delay_s before the target moves.
+    assert decide(_sig(5, 1), acfg, st, now, target, 1) == 1
+    assert decide(_sig(5, 1), acfg, st, now + 4.9, target, 1) == 1
+    target = decide(_sig(5, 1), acfg, st, now + 5.1, target, 1)
+    assert target == 2 and "ongoing" in st.last_reason
+
+    # One replica per move: immediately after, the cooldown blocks.
+    assert decide(_sig(5, 2), acfg, st, now + 5.2, target, 2) == 2
+    target = decide(_sig(5, 2), acfg, st, now + 11.0, target, 2)
+    assert target == 3
+    # Clamped at max_replicas no matter the pressure.
+    assert decide(_sig(50, 3), acfg, st, now + 60.0, target, 3) == 3
+
+    # A blip below the hold threshold resets the timer (no flapping).
+    st2 = AutoscalerState()
+    decide(_sig(5, 1), acfg, st2, 0.0, 1, 1)
+    decide(_sig(1, 1, waiting=0), acfg, st2, 3.0, 1, 1)  # pressure gone
+    assert decide(_sig(5, 1), acfg, st2, 6.0, 1, 1) == 1  # hold restarted
+
+    # Downscale needs a LONG quiet period and zero queue.
+    now2, target = now + 100.0, 3
+    assert decide(_sig(0, 3), acfg, st, now2, target, 3) == 3
+    assert decide(_sig(0, 3), acfg, st, now2 + 19.0, target, 3) == 3
+    target = decide(_sig(0, 3), acfg, st, now2 + 21.0, target, 3)
+    assert target == 2 and st.last_reason.startswith("down")
+    # Queued work vetoes downscale even with zero ongoing.
+    st3 = AutoscalerState()
+    assert decide(_sig(0, 2, waiting=5), acfg, st3, 0.0, 2, 2) == 2
+    assert st3.low_since is None
+
+    # Clamped at min_replicas.
+    st4 = AutoscalerState()
+    decide(_sig(0, 1), acfg, st4, 0.0, 1, 1)
+    assert decide(_sig(0, 1), acfg, st4, 100.0, 1, 1) == 1
+
+
+def test_autoscaler_optin_latency_and_burn_signals():
+    from ray_tpu.serve.autoscale import AutoscalerState, decide
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    acfg = AutoscalingConfig(
+        target_ongoing_requests=10.0, upscale_delay_s=1.0,
+        downscale_delay_s=1.0, max_replicas=4,
+        ttft_p99_high_ms=100.0, burn_rate_high=2.0,
+    )
+    st = AutoscalerState()
+    # TTFT p99 past the bound is upscale pressure on its own.
+    sig = _sig(1, 2, ttft_p99_s=0.5)
+    decide(sig, acfg, st, 0.0, 2, 2)
+    assert decide(sig, acfg, st, 2.0, 2, 2) == 3
+    assert "ttft" in st.last_reason
+    # Elevated burn blocks downscale even when traffic looks idle.
+    st2 = AutoscalerState()
+    hot = _sig(0, 2, burn=5.0)
+    decide(hot, acfg, st2, 0.0, 2, 2)
+    assert decide(hot, acfg, st2, 50.0, 2, 2) == 3  # upscale, not down
+    # Defaults (None) disable both signals entirely.
+    acfg_off = AutoscalingConfig(target_ongoing_requests=10.0,
+                                 upscale_delay_s=1.0)
+    st3 = AutoscalerState()
+    calm = _sig(1, 2, ttft_p99_s=9.9, burn=99.0)
+    decide(calm, acfg_off, st3, 0.0, 2, 2)
+    assert decide(calm, acfg_off, st3, 2.0, 2, 2) == 2
+
+
+# -- signals schema v2 ----------------------------------------------------
+def test_signals_schema_v2_and_old_reader_tolerance():
+    from ray_tpu.scripts.scripts import _render_serve
+    from ray_tpu.serve import observatory
+    from ray_tpu.serve.autoscale import extract_load
+
+    assert observatory.SIGNALS_SCHEMA_VERSION == 2
+
+    # A v1-shaped doc (no kv / target_replicas / kv_util) still renders.
+    old_doc = {
+        "schema": 1, "seq": 3, "ts": time.time(),
+        "apps": {"a": {
+            "replicas": [{"actor_id": "ab" * 8, "ongoing": 1,
+                          "total_served": 5}],
+            "qps": 1.0, "waiting": 0,
+            "ttft_s": {"p50": 0.01, "p99": 0.02, "n": 4},
+            "tpot_s": {"p50": 0.001, "p99": 0.002, "n": 4},
+        }},
+    }
+    out = _render_serve(old_doc)
+    assert "app a" in out and "kv:" not in out
+
+    # A v2 doc renders the new kv / replica-target columns.
+    new_doc = {
+        "schema": 2, "seq": 4, "ts": time.time(),
+        "apps": {"a": {
+            "replicas": [{"actor_id": "cd" * 8, "ongoing": 2,
+                          "total_served": 9, "kv_util": 0.25}],
+            "qps": 2.0, "waiting": 1,
+            "target_replicas": 2, "running_replicas": 1,
+            "kv": {"page_size": 16, "pages_total": 40, "pages_in_use": 10,
+                   "util": 0.25, "prefix_hit_rate": 0.5,
+                   "prefill_tokens_skipped": 128},
+        }},
+    }
+    out = _render_serve(new_doc)
+    assert "replicas=1/2" in out
+    assert "kv: pages 10/40 (25%)" in out
+    assert "prefix_hit=50%" in out and "kv=25%" in out
+
+    # The decision-side reader tolerates both shapes too.
+    assert extract_load(old_doc["apps"]["a"])["ongoing_mean"] == 1.0
+    assert extract_load({})["replicas"] == 0
+
+
+def test_engine_stats_expose_kv_plane_for_signals():
+    from ray_tpu.serve.llm import ContinuousBatchingEngine
+
+    params, cfg = _tiny_model()
+    eng = ContinuousBatchingEngine(
+        params, cfg, num_slots=2, max_len=32, kv_mode="paged", page_size=8,
+    )
+    try:
+        eng.submit([(11 * i + 1) % 40 for i in range(16)],
+                   max_new_tokens=2).result(timeout=180)
+        kv = eng.stats()["kv"]
+        assert kv["mode"] == "paged" and kv["page_size"] == 8
+        assert kv["pages_total"] == 2 * 4  # slotted-HBM parity
+        assert kv["prefix_cache_pages"] == 2  # the prompt's full pages
+        assert kv["roots"]  # advertised for affinity routing
+        assert 0.0 <= kv["util"] <= 1.0
+    finally:
+        eng.shutdown()
+
+    slotted = ContinuousBatchingEngine(
+        params, cfg, num_slots=2, max_len=32, kv_mode="slotted",
+    )
+    try:
+        assert slotted.stats()["kv"] == {"mode": "slotted", "page_size": 0}
+    finally:
+        slotted.shutdown()
+
+
+def test_handle_affinity_prefers_covering_replica():
+    """_pick_replica with a route_key must choose the replica whose
+    advertised prefix set covers it, not the P2C winner."""
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    class _Aid:
+        def __init__(self, b):
+            self._b = b
+
+        def binary(self):
+            return self._b
+
+        def hex(self):
+            return self._b.hex()
+
+    class _Rep:
+        def __init__(self, b):
+            self._actor_id = _Aid(b)
+
+    r1, r2 = _Rep(b"\x01" * 8), _Rep(b"\x02" * 8)
+    h = DeploymentHandle("app")
+    key = prefix_route_key(list(range(16)), 16)
+    s = h._shared
+    with s["lock"]:
+        s["replicas"] = [r1, r2]
+        s["version"] = 1
+        s["last_refresh"] = time.monotonic()
+        s["prefix"] = {r2._actor_id.hex(): {key}}
+        s["page_size"] = 16
+        # Bias load AGAINST the covering replica: affinity must still win.
+        s["inflight"] = {r2._actor_id.binary(): 5}
+    assert h._route_key((list(range(16)),)) == key
+    for _ in range(8):
+        assert h._pick_replica(route_key=key) is r2
+    # No coverage -> falls back to the load-based pick.
+    assert h._pick_replica(route_key="unknown") in (r1, r2)
+    # Short prompts / no advertised prefixes produce no route key.
+    assert h._route_key(([1, 2],)) is None
+    with s["lock"]:
+        s["prefix"] = {}
+    assert h._route_key((list(range(16)),)) is None
