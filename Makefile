@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs \
   bench-scale bench-serve-obs bench-serve-ft bench-collective \
-  bench-multitenant bench-paged-kv
+  bench-multitenant bench-paged-kv bench-serve-macro
 
 lint: rtlint sanitizers
 
@@ -61,6 +61,14 @@ bench-collective:
 # tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
 bench-paged-kv:
 	JAX_PLATFORMS=cpu $(PY) bench_paged_kv.py
+
+# Regenerates BENCH_SERVE_MACRO.json (the cluster witness: trace
+# record/replay byte identity, sustained-QPS client<->server latency
+# reconciliation, chaos replay with autoscaler tracking); the bench
+# asserts its own gates. Run tools/check_claims.py afterwards —
+# MIGRATION.md pins these numbers.
+bench-serve-macro:
+	JAX_PLATFORMS=cpu $(PY) bench_serve_macro.py
 
 sanitizers:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_sanitizers.py \

@@ -37,13 +37,24 @@ Injection table (all gated on RT_CHAOS=1):
   kill_victim_mid_drain()   | driver            | victim dies while draining
   flush_prefix_cache()      | replica process   | prefix-cache cold start
   exhaust_kv_pages(frac)    | replica process   | KV page-pool pressure
+  kill_replica_at(t, app)   | driver (sched)    | replica death at trace time t
+  drop_controller_at(t)     | driver (sched)    | controller crash at trace time t
+
+Schedule-anchored faults (`*_at`) fire at a fixed offset from an anchor
+set by `anchor_schedule()` — the same t=0 a recorded loadgen trace
+replays against, so a chaos scenario replays deterministically alongside
+the traffic that provoked it.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
-from typing import Iterable, Optional, Set
+import time
+from typing import Dict, Iterable, List, Optional, Set
+
+logger = logging.getLogger("ray_tpu.chaos")
 
 _ENV = "RT_CHAOS"
 
@@ -83,6 +94,12 @@ _dcn_bandwidth_cap_bps: float = 0.0
 # a memory squeeze, not an event). -1 = no injection.
 _flush_prefix_pending: bool = False
 _kv_exhaust_frac: float = -1.0
+# Schedule-anchored fault windows: entries fire at anchor + entry["t"]
+# on a daemon scheduler thread (started lazily, exits when the schedule
+# drains or clear() empties it).
+_sched_anchor: Optional[float] = None
+_sched_faults: List[Dict] = []
+_sched_thread_alive: bool = False
 
 
 def enabled() -> bool:
@@ -109,8 +126,13 @@ def clear():
     global _dispatch_delay_s, _dispatch_delays_left
     global _dcn_send_delay_s, _dcn_send_delays_left, _dcn_bandwidth_cap_bps
     global _flush_prefix_pending, _kv_exhaust_frac
+    global _sched_anchor
     with _lock:
         _injected_drain_ranks.clear()
+        _sched_anchor = None
+        # Emptying the list retires the scheduler thread at its next
+        # tick (it exits when nothing is pending).
+        _sched_faults.clear()
         _flush_prefix_pending = False
         _kv_exhaust_frac = -1.0
         _poll_delay_s = 0.0
@@ -522,3 +544,106 @@ def drop_controller(restart: bool = True):
     ctrl = rt.get_actor(CONTROLLER_NAME)
     rt.kill(ctrl, no_restart=not restart)
     return ctrl._actor_id.hex()
+
+
+# -- schedule-anchored fault windows ---------------------------------------
+def anchor_schedule(offset_s: float = 0.0) -> None:
+    """Pin t=0 of the fault schedule to ``now - offset_s`` — the same
+    origin a loadgen run (or trace replay) measures its arrival offsets
+    from. Registered ``*_at(t)`` faults then fire at schedule-relative
+    times, so a recorded chaos scenario replays deterministically
+    alongside the recorded traffic. Re-anchoring moves t=0 for every
+    not-yet-fired entry."""
+    _require_enabled("anchor_schedule")
+    global _sched_anchor
+    with _lock:
+        _sched_anchor = time.monotonic() - float(offset_s)
+    _ensure_sched_thread()
+
+
+def kill_replica_at(t: float, app: str, index: int = 0) -> None:
+    """Schedule kill_replica(app, index) at schedule time ``t`` seconds
+    (relative to the anchor_schedule origin). Registration is allowed
+    before anchoring; the fault arms once the anchor exists."""
+    _require_enabled("kill_replica_at")
+    _schedule_fault("kill_replica", t, {"app": app, "index": int(index)})
+
+
+def drop_controller_at(t: float, restart: bool = True) -> None:
+    """Schedule drop_controller(restart) at schedule time ``t`` seconds
+    (relative to the anchor_schedule origin)."""
+    _require_enabled("drop_controller_at")
+    _schedule_fault("drop_controller", t, {"restart": bool(restart)})
+
+
+def scheduled_faults() -> List[Dict]:
+    """JSON-safe copy of the fault schedule ({kind, t, kwargs, fired,
+    result}) — recorded next to a loadgen trace so replays re-register
+    the identical scenario."""
+    with _lock:
+        return [dict(e, kwargs=dict(e["kwargs"])) for e in _sched_faults]
+
+
+def _schedule_fault(kind: str, t: float, kwargs: Dict) -> None:
+    if t < 0:
+        raise ValueError(f"chaos schedule time must be >= 0, got {t}")
+    with _lock:
+        _sched_faults.append({
+            "kind": kind, "t": float(t), "kwargs": kwargs,
+            "fired": False, "result": None,
+        })
+    _ensure_sched_thread()
+
+
+def _ensure_sched_thread() -> None:
+    global _sched_thread_alive
+    with _lock:
+        if _sched_thread_alive:
+            return
+        _sched_thread_alive = True
+    threading.Thread(
+        target=_sched_loop, name="rt-chaos-scheduler", daemon=True,
+    ).start()
+
+
+def _sched_loop() -> None:
+    """Fire due faults every 20ms until the schedule drains (or clear()
+    empties it). Exit and the alive flag flip happen under the SAME lock
+    hold as the emptiness check, so a fault registered concurrently
+    either keeps this thread alive or starts a fresh one — never
+    stranded. Execution happens on this thread — the driver process,
+    where kill_replica/drop_controller expect to run."""
+    global _sched_thread_alive
+    while True:
+        due = []
+        with _lock:
+            pending = [e for e in _sched_faults if not e["fired"]]
+            if not pending or not enabled():
+                _sched_thread_alive = False
+                return
+            anchor = _sched_anchor
+            if anchor is not None:
+                now = time.monotonic() - anchor
+                for e in pending:
+                    if e["t"] <= now:
+                        e["fired"] = True
+                        due.append(e)
+        for e in due:
+            with _lock:
+                if e not in _sched_faults:  # clear() raced the firing
+                    continue
+            try:
+                if e["kind"] == "kill_replica":
+                    e["result"] = kill_replica(**e["kwargs"])
+                elif e["kind"] == "drop_controller":
+                    e["result"] = drop_controller(**e["kwargs"])
+            except Exception as err:  # noqa: BLE001 — a failed
+                # injection (app already gone, controller mid-restart)
+                # must not kill the scheduler or the run; the entry
+                # records what happened for the trace.
+                e["result"] = f"error: {err}"
+                logger.warning(
+                    "scheduled chaos fault %s(%s) at t=%.3f failed",
+                    e["kind"], e["kwargs"], e["t"], exc_info=True,
+                )
+        time.sleep(0.02)

@@ -240,6 +240,26 @@ _FLIGHT_RECORDER_PANELS = [
     ("Chip occupancy by tenant", [
         {"expr": "tenant_chip_occupancy", "legend": "{{tenant}}"},
     ], "short"),
+    # -- loadgen witness (macro harness) -----------------------------------
+    ("Loadgen offered vs achieved QPS", [
+        {"expr": "loadgen_offered_qps", "legend": "offered"},
+        {"expr": "rate(loadgen_requests_total[1m])",
+         "legend": "{{tenant}} {{outcome}}"},
+    ], "short"),
+    ("Client-observed latency p50/p99 (witness)", [
+        {"expr": "histogram_quantile(0.5, rate("
+                 "loadgen_client_e2e_seconds_bucket[1m]))", "legend": "p50"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "loadgen_client_e2e_seconds_bucket[1m]))", "legend": "p99"},
+        {"expr": "histogram_quantile(0.99, rate("
+                 "loadgen_client_ttfb_seconds_bucket[1m]))",
+         "legend": "ttfb p99"},
+    ], "s"),
+    ("Unattributed client<->server gap", [
+        {"expr": "loadgen_gap_fraction", "legend": "gap fraction {{q}}"},
+        {"expr": "loadgen_unattributed_gap_seconds",
+         "legend": "gap seconds {{q}}"},
+    ], "short"),
 ]
 
 
@@ -286,7 +306,7 @@ def generate_dashboard(
                 if token.startswith(("train_", "serve_", "device_", "data_",
                                      "rt_raylet_", "gcs_rpc_",
                                      "collective_", "preempt_",
-                                     "tenant_")):
+                                     "tenant_", "loadgen_")):
                     covered.add(token)
 
     for info in user_metrics:

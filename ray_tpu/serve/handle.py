@@ -126,8 +126,12 @@ class DeploymentResponse:
 
     def __init__(self, ref, on_done=None, redispatch=None, max_retries=2,
                  deadline_ts: float = 0.0, replica_key: bytes = b"",
-                 cb_ok=None, cb_fail=None):
+                 cb_ok=None, cb_fail=None, rid: str = ""):
         self.ref = ref
+        # Observatory request id: joins this response's client-side
+        # timing against the server's phase attribution (loadgen
+        # reconciler). "" when the observatory is disabled.
+        self.rid = rid
         self._redispatch = redispatch
         self._retries_left = max_retries
         self._deadline_ts = deadline_ts
@@ -182,6 +186,29 @@ class DeploymentResponse:
                     time.sleep(delay * (0.5 + 0.5 * random.random()))
             attempt += 1
             self.ref, self._replica_key = self._redispatch()
+
+
+class StreamingResponse:
+    """Iterator over a streaming call's chunks, carrying the request's
+    observatory ``rid`` so client-side witnesses (ray_tpu.loadgen) can
+    join their stamp cards against the server's phase attribution.
+    Behaves exactly like the bare generator it wraps — existing
+    ``for chunk in handle.remote(...)`` consumers are unaffected."""
+
+    __slots__ = ("rid", "_gen")
+
+    def __init__(self, gen, rid: str = ""):
+        self._gen = gen
+        self.rid = rid
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        self._gen.close()
 
 
 class DeploymentHandle:
@@ -601,6 +628,7 @@ class DeploymentHandle:
             deadline_ts=meta["deadline_ts"],
             replica_key=replica._actor_id.binary(),
             cb_ok=self._cb_ok, cb_fail=self._cb_fail,
+            rid=meta["rid"],
         )
 
     def _stream_call(self, args, kwargs):
@@ -701,7 +729,7 @@ class DeploymentHandle:
                     self._cb_ok(replica._actor_id.binary())
                     return
 
-        return gen()
+        return StreamingResponse(gen(), rid=meta["rid"])
 
     def __reduce__(self):
         # Router state (locks, in-flight counts) is process-local: a handle

@@ -118,7 +118,9 @@ def _engine_metrics() -> Dict:
                     "serve_llm_ttft_seconds",
                     "Time to first token: submit() to the first pushed "
                     "token, per request",
-                    boundaries=_mx.LATENCY_BOUNDARIES,
+                    # Wide tail: queue wait under macro load pushes TTFT
+                    # p99 multi-second; don't clamp it into +Inf.
+                    boundaries=_mx.LATENCY_BOUNDARIES_WIDE,
                 ),
                 "tpot_s": Histogram(
                     "serve_llm_tpot_seconds",

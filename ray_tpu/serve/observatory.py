@@ -49,6 +49,7 @@ gates the paired-median per-request overhead at < 2%.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -57,6 +58,8 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private.config import get_config
 from ray_tpu.util.lifecycle import SERVE_PHASE_ORDER
+
+logger = logging.getLogger("ray_tpu.serve")
 
 #: ServeSignals document schema version (bump on breaking shape change).
 #: v2 adds paged-KV fields (per-replica kv_util / prefix_hit_rate /
@@ -97,7 +100,11 @@ def _obs_metrics() -> Dict:
                     _mx.Histogram, "serve_request_e2e_seconds",
                     "End-to-end request wall (handle enqueue -> reply), "
                     "per deployment",
-                    boundaries=_mx.LATENCY_BOUNDARIES, tag_keys=("app",),
+                    # Wide tail: macro-load e2e p99s run multi-second and
+                    # must not clamp into +Inf (other serve histograms
+                    # keep LATENCY_BOUNDARIES).
+                    boundaries=_mx.LATENCY_BOUNDARIES_WIDE,
+                    tag_keys=("app",),
                 ),
                 "requests": _mx.get_or_create(
                     _mx.Counter, "serve_requests_total",
@@ -347,7 +354,15 @@ class RequestProfiler:
         cfg = get_config()
         self.app = app or "-"
         self.slo = slo
+        # Capacity comes from cfg.serve_obs_ring, overridable per process
+        # via RT_SERVE_OBS_RING — macro-load runs size it to hold the
+        # whole run so the reconciler can join every request.
         self._ring: deque = deque(maxlen=ring or cfg.serve_obs_ring)
+        # Overwrite accounting: a full ring silently drops the oldest
+        # finished-request record per append. Counted so sustained-QPS
+        # runs can tell (and warn) when phase records are being lost.
+        self._overwrites = 0
+        self._overwrite_warn_t = 0.0
         self._lock = threading.Lock()
         self._tenants: Dict[str, _TenantStats] = {}
         self._finish_ts: deque = deque(maxlen=2048)  # epoch, for QPS
@@ -397,7 +412,14 @@ class RequestProfiler:
         queue_s = (phases["handle_queue"] + phases["dispatch"]
                    + phases.get("engine_admission_wait", 0.0))
         verdicts = self._score_slo(ttft, tpot, e2e)
+        warn_overwrites = 0
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._overwrites += 1
+                now_m = time.monotonic()
+                if now_m - self._overwrite_warn_t >= 60.0:
+                    self._overwrite_warn_t = now_m
+                    warn_overwrites = self._overwrites
             self._ring.append(rec)
             self._requests += 1
             self._finish_ts.append(rec["ts"])
@@ -414,6 +436,17 @@ class RequestProfiler:
             t.queue_s += queue_s
             if verdicts:
                 t.outcomes.append((rec["ts"], verdicts))
+        if warn_overwrites:
+            # Rate-limited (once per minute per replica): sustained load
+            # past ring capacity silently evicts phase records, which
+            # starves the reconciler and ServeSignals of attribution.
+            logger.warning(
+                "observatory ring for app %r is overwriting finished-"
+                "request records (%d overwritten so far, capacity %d); "
+                "raise RT_SERVE_OBS_RING to keep full attribution for "
+                "macro runs", self.app, warn_overwrites,
+                self._ring.maxlen,
+            )
         self._observe_metrics(ctx, phases, e2e, queue_s, verdicts)
         if ctx.sampled:
             self._emit_lifecycle(ctx, phases, e2e)
@@ -527,6 +560,8 @@ class RequestProfiler:
             requests = self._requests
             shed = dict(self._shed)
             expired = dict(self._expired)
+            overwrites = self._overwrites
+            ring_cap = self._ring.maxlen or 0
         phase_agg: Dict[str, Dict[str, float]] = {}
         fractions: List[float] = []
         for rec in ring:
@@ -575,6 +610,16 @@ class RequestProfiler:
             "app": self.app,
             "ts": now,
             "requests_total": requests,
+            "ring": {
+                "capacity": ring_cap,
+                "len": len(ring),
+                "overwrites": overwrites,
+                # Fraction of finished requests whose record was evicted
+                # before this snapshot.
+                "overwrite_rate": (
+                    overwrites / requests if requests else 0.0
+                ),
+            },
             "qps": self.qps(),
             "phases": phase_agg,
             "phase_sum_fraction": (

@@ -15,7 +15,7 @@ import itertools
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import ray_tpu as rt
 from ray_tpu._private.config import get_config
@@ -382,6 +382,15 @@ class ReplicaActor:
             if sizes:
                 out["batch_sizes"] = sizes
         return out
+
+    def observatory_records(self) -> List[Dict]:
+        """Finished-request phase records from this replica's
+        observatory ring (bounded by RT_SERVE_OBS_RING). The loadgen
+        reconciler joins these by rid against client stamp cards to
+        compute per-request unattributed gaps."""
+        from ray_tpu.serve import observatory
+
+        return observatory.profiler().records()
 
     def observatory_snapshot(self) -> Dict:
         """Per-replica half of ServeSignals (controller merges these

@@ -32,6 +32,16 @@ LATENCY_BOUNDARIES = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# LATENCY_BOUNDARIES with a multi-second tail, for request-scale
+# histograms (e2e, TTFT) whose macro-load p99s run past 10s and would
+# otherwise clamp into the +Inf bucket. A separate tuple — NOT an edit
+# to LATENCY_BOUNDARIES — because the aggregator rejects re-registered
+# histograms whose boundaries changed; only metrics that have always
+# used this tuple may use it.
+LATENCY_BOUNDARIES_WIDE = LATENCY_BOUNDARIES + (
+    15.0, 25.0, 40.0, 60.0, 90.0, 120.0, 180.0, 300.0,
+)
+
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
 _flusher_started = False

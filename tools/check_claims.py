@@ -178,6 +178,15 @@ def _bench_paged_kv(metric_sub: str, field: str):
     return get
 
 
+def _bench_serve_macro(metric_sub: str, field: str):
+    def get():
+        for e in _load("BENCH_SERVE_MACRO.json"):
+            if metric_sub in e.get("metric", ""):
+                return e[field]
+        raise KeyError(f"no BENCH_SERVE_MACRO entry matching {metric_sub!r}")
+    return get
+
+
 def _bench_r(field: str, sub: str = None):
     def get():
         d = _load("BENCH_TPU_LIVE.json")
@@ -508,6 +517,36 @@ CLAIMS = [
     Claim("MIGRATION.md", r"exactly (\d+) pages in use",
           _bench_paged_kv("page-leak", "pages_in_use_after"),
           rel_tol=0.0),
+    # -- serve macro (cluster witness) claims -> BENCH_SERVE_MACRO.json
+    Claim("MIGRATION.md", r"sustains (\d+\.\d+) QPS achieved",
+          _bench_serve_macro("sustained macro", "achieved_qps"),
+          rel_tol=0.25),
+    Claim("MIGRATION.md", r"against (\d+\.\d+)\s*\n?\s*offered",
+          _bench_serve_macro("sustained macro", "offered_qps"),
+          rel_tol=0.25),
+    Claim("MIGRATION.md", r"unattributed gap p99 (\d+\.\d+) ms",
+          _bench_serve_macro("sustained macro", "gap_p99_ms"),
+          rel_tol=3.0, note="ms-scale dispatch jitter run to run"),
+    Claim("MIGRATION.md", r"gap fraction p99 (0\.\d+) against",
+          _bench_serve_macro("sustained macro", "gap_fraction_p99"),
+          rel_tol=3.0, note="ms-scale dispatch jitter run to run"),
+    Claim("MIGRATION.md", r"(\d+) lost non-shed\s*\n?\s*requests",
+          _bench_serve_macro("chaos macro", "lost_non_shed"),
+          rel_tol=0.0),
+    Claim("MIGRATION.md", r"out of (\d+), client TTFB",
+          _bench_serve_macro("chaos macro", "issued"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"client TTFB p99 held at (\d+) ms",
+          _bench_serve_macro("chaos macro", "client_ttfb_p99_ms"),
+          rel_tol=1.0),
+    Claim("MIGRATION.md", r"after the kill was (\d+\.\d+) s",
+          _bench_serve_macro("chaos macro", "recovery_s"),
+          rel_tol=3.0, note="respawn timing varies run to run"),
+    Claim("MIGRATION.md", r"tracked the ramp to (\d+) replicas",
+          _bench_serve_macro("chaos macro", "autoscaler_max_target"),
+          rel_tol=0.34, note="2-4 replica band is healthy"),
+    Claim("MIGRATION.md", r"regenerates the (\d+)-request",
+          _bench_serve_macro("record/replay", "requests"),
+          rel_tol=0.0, note="pure function of the committed seed"),
 ]
 
 
