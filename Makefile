@@ -1,17 +1,29 @@
 # Repo-level entry points. `make lint` is the pre-merge gate: the
-# rtlint static pass over ray_tpu/ (against the committed baseline)
-# plus the native store's sanitizer stress tests.
+# rtlint static pass over the default target set (ray_tpu/, tools/,
+# bench_*.py — against the committed baseline) plus the native store's
+# sanitizer stress tests.
 
 PY ?= python
+LINT_JOBS ?= 4
 
-.PHONY: lint rtlint sanitizers test fast-test bench-data bench-obs \
-  bench-scale bench-serve-obs bench-serve-ft bench-collective \
-  bench-multitenant bench-paged-kv bench-serve-macro
+.PHONY: lint rtlint lint-stats lint-changed sanitizers test fast-test \
+  bench-data bench-obs bench-scale bench-serve-obs bench-serve-ft \
+  bench-collective bench-multitenant bench-paged-kv bench-serve-macro
 
 lint: rtlint sanitizers
 
 rtlint:
-	$(PY) -m tools.rtlint ray_tpu/
+	$(PY) -m tools.rtlint --jobs $(LINT_JOBS)
+
+# Per-rule found/suppressed/baselined counts over the default targets;
+# MIGRATION.md pins these via tools/check_claims.py.
+lint-stats:
+	$(PY) -m tools.rtlint --jobs $(LINT_JOBS) --stats
+
+# Lint only files changed vs HEAD (plus untracked) — the fast
+# inner-loop variant of the gate.
+lint-changed:
+	$(PY) -m tools.rtlint --changed
 
 # Regenerates BENCH_DATA.json (data->device feed probes); run
 # tools/check_claims.py afterwards — MIGRATION.md pins these numbers.

@@ -182,7 +182,7 @@ def main():
     def feed_batches():
         n = 0
         for batch in feed_ds.iter_jax_batches(batch_size=1024):
-            _consume(batch).block_until_ready()
+            _consume(batch).block_until_ready()  # rtlint: disable=RT001 — the probe measures the consumer's per-batch sync on purpose
             n += 1
         return n
 

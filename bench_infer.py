@@ -111,7 +111,7 @@ def _engine_stepwise_probe(params, cfg, on_tpu):
         t0 = time.perf_counter()
         for _ in range(raw_steps):
             tok, c = jdecode(params, tok, c)
-        jax.device_get(tok)
+        jax.device_get(tok)  # rtlint: disable=RT001 — stepwise probe: the per-step sync IS the measured quantity
         raw_s = time.perf_counter() - t0
         raw_step_ms = min(raw_step_ms, raw_s / raw_steps * 1e3)
     raw_tps = num_slots / raw_step_ms * 1e3
@@ -226,18 +226,18 @@ def main():
             jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
         )
         cache = init_kv_cache(cfg, batch, max_len)
-        jprefill = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))
-        jdecode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
+        jprefill = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))  # rtlint: disable=RT002 — per-config rebuild is intended; each config needs its own wrapper
+        jdecode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))  # rtlint: disable=RT002 — per-config rebuild is intended
 
         # Warm both compilations.
         logits, cache1 = jprefill(params, prompt, cache)
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         _, cache2 = jdecode(params, tok, cache1)
-        jax.device_get(logits)
+        jax.device_get(logits)  # rtlint: disable=RT001 — timed section deliberately syncs to measure true step latency
 
         t0 = time.perf_counter()
         logits, cache1 = jprefill(params, prompt, init_kv_cache(cfg, batch, max_len))
-        jax.device_get(logits)
+        jax.device_get(logits)  # rtlint: disable=RT001 — timed section deliberately syncs
         prefill_s = time.perf_counter() - t0
 
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -246,7 +246,7 @@ def main():
         for _ in range(decode_steps):
             logits, c = jdecode(params, tok, c)
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        jax.device_get(tok)
+        jax.device_get(tok)  # rtlint: disable=RT001 — timed section deliberately syncs
         decode_s = time.perf_counter() - t0
 
         entry = {
@@ -286,7 +286,7 @@ def main():
     ))
     t0 = time.perf_counter()
     for p in prompts:
-        jax.device_get(generate(
+        jax.device_get(generate(  # rtlint: disable=RT001 — end-to-end timing requires draining the whole generation
             params, jnp.asarray([p], dtype=jnp.int32), cfg,
             max_new_tokens=n_tok,
         ))

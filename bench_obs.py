@@ -42,10 +42,10 @@ def _make_work(target_ms: float):
     x = jnp.ones((512, 512), dtype=jnp.float32)
     n = 1
     while True:
-        g = jax.jit(_matmul_chain, static_argnums=1)
-        g(x, n).block_until_ready()  # compile
+        g = jax.jit(_matmul_chain, static_argnums=1)  # rtlint: disable=RT002 — fresh wrapper per round generates the retrace events the observatory probe asserts on
+        g(x, n).block_until_ready()  # compile  # rtlint: disable=RT001 — warm-up/measured sync is the point of the probe
         t0 = time.perf_counter()
-        g(x, n).block_until_ready()
+        g(x, n).block_until_ready()  # rtlint: disable=RT001 — measured sync is the point
         dt_ms = (time.perf_counter() - t0) * 1e3
         if dt_ms >= target_ms or n >= 256:
             return g, x, n, dt_ms
@@ -62,7 +62,7 @@ def _steps_off(g, x, n, steps):
     out = []
     for _ in range(steps):
         t0 = time.perf_counter()
-        g(x, n).block_until_ready()
+        g(x, n).block_until_ready()  # rtlint: disable=RT001 — measured sync is the point
         out.append(time.perf_counter() - t0)
     return out
 

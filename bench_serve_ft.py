@@ -183,7 +183,7 @@ def probe_shed_latency(results, quick: bool):
         for _ in range(n):
             t0 = time.perf_counter()
             try:
-                h.remote(0.0)
+                h.remote(0.0)  # rtlint: disable=RT004 — fire-and-forget on purpose: the probe only cares about shed latency, not results
                 not_shed += 1
             except ServeOverloadedError:
                 shed_lat.append(time.perf_counter() - t0)

@@ -550,7 +550,7 @@ class Raylet:
                 self._zygote = get_shared_manager()
             proc = self._zygote.spawn(env)
         if proc is None:
-            proc = subprocess.Popen(
+            proc = subprocess.Popen(  # rtlint: disable=RT008 — fork+exec is bounded; worker spawn is a rare control-plane op and stdout is drained off-loop
                 [sys.executable, "-m", "ray_tpu._private.worker_main"],
                 env=env,
                 stdout=None,
@@ -1071,7 +1071,7 @@ class Raylet:
             )
             return
         try:
-            proc = subprocess.Popen(
+            proc = subprocess.Popen(  # rtlint: disable=RT008 — fork+exec is bounded; job launch is rare and the streaming reads below are executor-shipped
                 payload["entrypoint"],
                 shell=True,
                 env=env,

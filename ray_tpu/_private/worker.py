@@ -1477,7 +1477,7 @@ class CoreClient:
         pend = self._lc_pending.get(spec["task_id"])
         if pend is not None and "t_buf" in pend:
             dur = max(0.0, time.monotonic() - pend.pop("t_buf"))
-            pend["phases"]["submit_buffer"] = [time.time() - dur, dur]
+            pend["phases"]["submit_buffer"] = [time.time() - dur, dur]  # rtlint: disable=RT011 — deliberate wall anchor: [start_wall, dur] stitches this phase onto cross-process timelines
 
     def _lc_stamp_rpc_wait(self, task_id, t0_m):
         """Close a sampled task's rpc_wait mark: the submit RPC's full
@@ -1488,7 +1488,7 @@ class CoreClient:
         pend = self._lc_pending.get(task_id)
         if pend is not None:
             dur = max(0.0, time.monotonic() - t0_m)
-            pend["phases"]["rpc_wait"] = [time.time() - dur, dur]
+            pend["phases"]["rpc_wait"] = [time.time() - dur, dur]  # rtlint: disable=RT011 — deliberate wall anchor for cross-process phase stitching
 
     def _lc_complete(self, spec):
         """_complete_task: emit the client-hop LIFECYCLE_SPAN carrying
@@ -1594,7 +1594,7 @@ class CoreClient:
                 # round-trip when the pool grows) charged to every
                 # sampled task in the chunk that shared it.
                 dur = time.monotonic() - lc_t
-                wall = time.time() - dur
+                wall = time.time() - dur  # rtlint: disable=RT011 — deliberate wall anchor for cross-process phase stitching
                 for _spec, _f, _r in chunk:
                     pend = self._lc_pending.get(_spec["task_id"])
                     if pend is not None:

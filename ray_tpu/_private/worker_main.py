@@ -447,7 +447,7 @@ class WorkerProcess:
             if qw:
                 # Direct-transport queue time stamped by the push handler
                 # (the raylet is off the per-task path for leased tasks).
-                lc["queue_wait"] = [time.time() - qw, qw]
+                lc["queue_wait"] = [time.time() - qw, qw]  # rtlint: disable=RT011 — deliberate wall anchor: [start_wall, dur] lets the client stitch queue-wait onto its timeline
         try:
             if _wants_tpu(spec.get("resources")):
                 ensure_tpu_backend()

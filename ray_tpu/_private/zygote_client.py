@@ -56,14 +56,17 @@ class ZygoteProc:
         self.returncode: Optional[int] = None
         self._pending_signal: Optional[int] = None
 
-    def _assign(self, pid: int) -> None:
-        # Called under the manager lock.
+    def _assign_locked(self, pid: int) -> None:
+        # _locked suffix: only ever called with the manager lock held
+        # (the reader thread's fork-reply dispatch).
         self.pid = pid
         if self._pending_signal is not None:
             sig, self._pending_signal = self._pending_signal, None
-            self._kill(sig)
+            self._kill_locked(sig)
 
-    def _fail(self, rc: int) -> None:
+    def _fail_locked(self, rc: int) -> None:
+        # _locked suffix: caller (reader-thread EOF path) holds the
+        # manager lock; racing poll() writes the same field under it.
         if self.returncode is None:
             self.returncode = rc
 
@@ -74,7 +77,9 @@ class ZygoteProc:
         except (ProcessLookupError, PermissionError):
             pass
 
-    def _kill(self, sig: int) -> None:
+    def _kill_locked(self, sig: int) -> None:
+        # _locked suffix: only called from _assign_locked, with the
+        # manager lock held.
         if self.returncode is None and self.pid is not None:
             self._deliver(self.pid, sig)
 
@@ -85,7 +90,8 @@ class ZygoteProc:
             if self.pid is None:
                 self._pending_signal = sig
                 return
-        self._deliver(self.pid, sig)
+            pid = self.pid
+        self._deliver(pid, sig)
 
     def poll(self) -> Optional[int]:
         with self._mgr._lock:
@@ -103,11 +109,13 @@ class ZygoteProc:
 
     def wait(self, timeout: Optional[float] = None) -> int:
         deadline = None if timeout is None else time.monotonic() + timeout
-        while self.poll() is None:
+        while True:
+            rc = self.poll()
+            if rc is not None:
+                return rc
             if deadline is not None and time.monotonic() > deadline:
                 raise subprocess.TimeoutExpired("zygote-worker", timeout or 0)
             time.sleep(0.01)
-        return self.returncode  # type: ignore[return-value]
 
 
 class _Generation:
@@ -154,7 +162,7 @@ class ZygoteManager:
     # Kept for tests / introspection.
     @property
     def proc(self) -> Optional[subprocess.Popen]:
-        return self._gen.proc if self._gen is not None else None
+        return self._gen.proc if self._gen is not None else None  # rtlint: disable=RT010 — introspection-only racy read (tests)
 
     def alive(self) -> bool:
         return self._gen is not None and self._gen.alive()
@@ -206,7 +214,7 @@ class ZygoteManager:
                     # Pending forks never happened (retiring or not):
                     # their handles must resolve or callers poll forever.
                     while gen.pending:
-                        gen.pending.popleft()._fail(-1)
+                        gen.pending.popleft()._fail_locked(-1)
                     if self._gen is gen:
                         self._gen = None
                     if gen in self._old:
@@ -219,7 +227,7 @@ class ZygoteManager:
             with self._lock:
                 op = msg.get("op")
                 if op == "spawned" and gen.pending:
-                    gen.pending.popleft()._assign(msg["pid"])
+                    gen.pending.popleft()._assign_locked(msg["pid"])
                     gen.live += 1
                 elif op == "dead":
                     if len(self._dead) > 4096:  # unconsumed-notice backstop

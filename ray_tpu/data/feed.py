@@ -39,7 +39,7 @@ _STALL_SECONDS = _metrics.get_or_create(
     "data_feed_stall_seconds",
     "Consumer wait per feed stall (queue empty when the step loop "
     "asked for a batch)",
-    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0],
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
 )
 _BATCHES_TOTAL = _metrics.get_or_create(
     _metrics.Counter,

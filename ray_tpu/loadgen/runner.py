@@ -189,13 +189,13 @@ def run_trace(header: Dict, records: Sequence[Dict], call_fn: Callable,
         cards[rec["i"]] = card
         if m is not None:
             outcome = "ok" if card.ok else "error"
-            m["requests"].inc(1, tags={"tenant": card.tenant,
+            m["requests"].inc(1, tags={"tenant": card.tenant,  # rtlint: disable=RT013 — tenant set is bounded by the trace file's tenant column, fixed per run
                                        "outcome": outcome})
             if card.ok:
-                m["e2e_s"].observe(card.client_e2e_s,
+                m["e2e_s"].observe(card.client_e2e_s,  # rtlint: disable=RT013 — bounded: tenants are fixed per trace
                                    tags={"tenant": card.tenant})
                 if card.ttfb_s is not None:
-                    m["ttfb_s"].observe(card.ttfb_s,
+                    m["ttfb_s"].observe(card.ttfb_s,  # rtlint: disable=RT013 — bounded: tenants are fixed per trace
                                         tags={"tenant": card.tenant})
 
     t0_epoch = time.time()

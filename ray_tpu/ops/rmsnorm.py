@@ -156,7 +156,7 @@ _rmsnorm_pallas_core.defvjp(_pallas_core_fwd, _pallas_core_bwd)
 def _rmsnorm_pallas(x, w, eps, block_rows: int = 256, interpret: bool = False):
     orig_shape = x.shape
     d = x.shape[-1]
-    rows = int(np_prod(orig_shape[:-1]))
+    rows = int(np_prod(orig_shape[:-1]))  # rtlint: disable=RT001 — static shape math: fine at trace time
     out = _rmsnorm_pallas_core(x.reshape(rows, d), w, eps, block_rows, interpret)
     return out.reshape(orig_shape)
 
@@ -174,7 +174,7 @@ def rmsnorm(x: jax.Array, w: jax.Array, eps: float = 1e-6,
     if use_pallas is None:
         try:
             use_pallas = jax.devices()[0].platform == "tpu"
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # rtlint: disable=RT007 — backend probe: no TPU visible means fall back to XLA path
             use_pallas = False
     if (use_pallas or interpret):
         return _rmsnorm_pallas(x, w, eps, interpret=interpret)

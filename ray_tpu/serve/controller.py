@@ -136,24 +136,24 @@ class ServeController:
             )
             return
         now = time.monotonic()
-        for name, app in state.get("apps", {}).items():
-            self.apps[name] = {
-                "deployment": app["deployment"],
-                "init_args": app["init_args"],
-                "init_kwargs": app["init_kwargs"],
-                # Live replicas are re-adopted as-is; the first health
-                # pass reaps any that died while the controller was down
-                # and reconcile replaces them.
-                "replicas": list(app["replicas"]),
-                "version": app["version"] + 1,
-                "target": app["target"],
-                "last_scale_up": now,
-                "last_scale_down": now,
-            }
         # _restore runs in __init__ before the reconcile thread starts,
-        # but take the lock anyway so every _proxy_every_node write is
-        # uniformly guarded.
+        # but take the lock anyway so every apps/_proxy_every_node
+        # write is uniformly guarded.
         with self._lock:
+            for name, app in state.get("apps", {}).items():
+                self.apps[name] = {
+                    "deployment": app["deployment"],
+                    "init_args": app["init_args"],
+                    "init_kwargs": app["init_kwargs"],
+                    # Live replicas are re-adopted as-is; the first
+                    # health pass reaps any that died while the
+                    # controller was down and reconcile replaces them.
+                    "replicas": list(app["replicas"]),
+                    "version": app["version"] + 1,
+                    "target": app["target"],
+                    "last_scale_up": now,
+                    "last_scale_down": now,
+                }
             self._proxy_every_node = state.get("proxy_every_node", False)
             for nid, e in state.get("proxies", {}).items():
                 self._proxies[nid] = dict(e)

@@ -569,7 +569,7 @@ class DeploymentHandle:
         from ray_tpu.util import tracing
 
         if self._stream:
-            return self._stream_call(args, kwargs)
+            return self._stream_call(args, kwargs)  # rtlint: disable=RT009 — the streaming path builds its own meta inside _stream_call
         # Serve-path trace propagation: the caller's active span (or a
         # fresh root when tracing is enabled) rides the request so the
         # replica's execution joins the request's span tree.
@@ -701,7 +701,7 @@ class DeploymentHandle:
             while True:
                 try:
                     out = rt.get(
-                        replica.next_chunks.remote(sid, start),
+                        replica.next_chunks.remote(sid, start),  # rtlint: disable=RT009 — chunk pulls ride the stream registered with meta at start_stream; each pull is rpc-timeout bounded
                         timeout=get_config().serve_rpc_timeout_s,
                     )
                 except (ActorError, WorkerCrashedError, TaskError) as e:

@@ -701,7 +701,7 @@ class ContinuousBatchingEngine:
         )
         self._thread.start()
 
-    def _warmup(self):
+    def _warmup(self):  # rtlint: disable=RT010 — runs before the loop thread starts; Thread.start() is the happens-before
         """Compile every steady-state program up front — BOTH decode
         variants (greedy and sampled), the prefill chunk, and the
         prefill-token picker — so traffic flipping between greedy and
@@ -775,7 +775,7 @@ class ContinuousBatchingEngine:
     # Single-writer: every *_dev array is owned by the engine thread
     # (this runs on it); submit() only flips _params_dirty under
     # self._lock.
-    def _upload_sampling_state(self):  # rtlint: disable=RT006
+    def _upload_sampling_state(self):  # rtlint: disable=RT006,RT010 — loop-thread-only; the lock is for submit()-side visibility
         """ONE host->device refresh of sampling params + active mask.
         Called only when slot membership changed (admission/eviction) —
         the steady-state decode step reads the device-resident copies
@@ -790,7 +790,7 @@ class ContinuousBatchingEngine:
         _engine_metrics()["param_uploads"].inc(1)
 
     # Single-writer: _bt_dev is engine-thread-owned device state.
-    def _upload_block_table(self):  # rtlint: disable=RT006
+    def _upload_block_table(self):  # rtlint: disable=RT006,RT010 — loop-thread-only; the lock is for submit()-side visibility
         """ONE host->device refresh of the block table. Admission-
         reserved paging means the table only changes when slot
         membership does — never per decode step (the paged analog of
@@ -1331,7 +1331,7 @@ class ContinuousBatchingEngine:
             # consumer (a request finishing on its prefill token would
             # otherwise be observable with the -1 sentinel). _steps is
             # only written by this thread.
-            h.admitted_at_step = self._steps
+            h.admitted_at_step = self._steps  # rtlint: disable=RT010 — _steps is loop-thread-only (see comment)
             done = (tok == self.eos_id if self.eos_id is not None
                     else False) or h.produced >= h.max_new_tokens
             h._push(tok, done)
@@ -1409,7 +1409,7 @@ class ContinuousBatchingEngine:
                 # slots past serve_hol_threshold_s is recorded with the
                 # prefilling request(s) to blame. Zero cost when nothing
                 # is prefilling.
-                if self._prefilling:
+                if self._prefilling:  # rtlint: disable=RT010 — _prefilling is only mutated on this loop thread; the lock covers submit()-side readers
                     n_active = len(self._slots)
                     t_pf = time.perf_counter()
                     self._advance_prefills()
@@ -1556,7 +1556,7 @@ class ContinuousBatchingEngine:
                     m["fetch_ms"].observe(fetch_s * 1e3)
                     m["host_ms"].observe(host_s * 1e3)
                     m["occupancy"].set(len(snapshot) / self.num_slots)
-                    m["waiting"].set(float(self._waiting_n))
+                    m["waiting"].set(float(self._waiting_n))  # rtlint: disable=RT010 — gauge snapshot: a stale int is fine
                     if self._paged:
                         m["kv_pages"].set(float(self._pool.in_use))
                     compiles = self._compile_count()

@@ -526,7 +526,7 @@ class RequestProfiler:
             key = f"{tenant}|{reason}"
             self._shed[key] = self._shed.get(key, 0) + 1
         m = _obs_metrics()
-        m["shed"].inc(1, tags={"app": self.app, "tenant": tenant,
+        m["shed"].inc(1, tags={"app": self.app, "tenant": tenant,  # rtlint: disable=RT013 — tenant values are validated against the fixed admission table before reaching here
                                "reason": reason})
 
     def record_deadline_expired(self, hop: str) -> None:

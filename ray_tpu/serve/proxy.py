@@ -195,7 +195,7 @@ class ProxyActor:
                 except ValueError:
                     pass  # malformed header: no deadline
 
-            def dispatch(h):
+            def dispatch(h):  # rtlint: disable=RT009 — the deadline rides the handle itself via .options(deadline_s=budget_s) above
                 if isinstance(payload, dict):
                     return h.remote(**payload)
                 if payload is None:
@@ -312,7 +312,7 @@ class ProxyActor:
             loop = asyncio.get_event_loop()
             try:
                 response = await loop.run_in_executor(
-                    None, lambda: handle.remote(*args, **kwargs)
+                    None, lambda: handle.remote(*args, **kwargs)  # rtlint: disable=RT009 — deadline rides the handle via .options(deadline_s=...) above
                 )
                 result = await resolve(loop, response, deadline_ts)
             except Exception as e:  # noqa: BLE001

@@ -86,7 +86,7 @@ class ReplicaActor:
         instead of letting the backlog collapse."""
         from ray_tpu.serve import observatory
 
-        if self._draining:
+        if self._draining:  # rtlint: disable=RT010 — racy fast-path refusal by design: drain's lock-guarded ongoing check is the real fence
             observatory.record_shed(self._app_name, meta.tenant, "draining")
             raise ReplicaDrainingError(
                 f"replica for {self._app_name!r} is draining",
@@ -103,14 +103,14 @@ class ReplicaActor:
             bound = (self._max_ongoing
                      + get_config().serve_max_queued_per_replica)
             with self._lock:
-                over = self.ongoing >= bound
-            if over:
+                cur = self.ongoing
+            if cur >= bound:
                 observatory.record_shed(
                     self._app_name, meta.tenant, "queue_full"
                 )
                 raise ServeOverloadedError(
                     f"replica admission queue full "
-                    f"({self.ongoing} ongoing >= {bound})",
+                    f"({cur} ongoing >= {bound})",
                     app=self._app_name, tenant=meta.tenant,
                     reason="queue_full",
                 )
@@ -296,7 +296,11 @@ class ReplicaActor:
         latch (the producer thread notices at its next chunk boundary,
         closes the generator — engine streams free their decode slot via
         GeneratorExit -> GenerationHandle.cancel) and wakes any poller."""
-        buf = self._streams.get(stream_id)
+        # start_stream registers under the lock from other request
+        # threads; read under it too so a cancel can never miss a
+        # stream whose registration is mid-flight.
+        with self._lock:
+            buf = self._streams.get(stream_id)
         if buf is None:
             return False
         with buf.cond:
@@ -307,7 +311,10 @@ class ReplicaActor:
     def next_chunks(self, stream_id: int, start: int,
                     max_wait_s: float = 2.0) -> Dict:
         """Long-poll chunks [start:]; returns {chunks, done, error}."""
-        buf = self._streams.get(stream_id)
+        # Same rationale as cancel_stream: registration happens under
+        # the lock on another request thread.
+        with self._lock:
+            buf = self._streams.get(stream_id)
         if buf is None:
             return {"chunks": [], "done": True,
                     "error": f"unknown stream {stream_id}"}
@@ -337,7 +344,7 @@ class ReplicaActor:
 
     def queue_len(self) -> int:
         """Queue-length probe (reference: power-of-two router probes)."""
-        return self.ongoing
+        return self.ongoing  # rtlint: disable=RT010 — racy probe by design (power-of-two routing tolerates staleness)
 
     def drain(self, timeout_s: Optional[float] = None) -> Dict:
         """Graceful drain: stop admitting (new requests see
@@ -370,7 +377,7 @@ class ReplicaActor:
         return self._draining
 
     def stats(self) -> Dict:
-        out = {"ongoing": self.ongoing, "total_served": self.total_served}
+        out = {"ongoing": self.ongoing, "total_served": self.total_served}  # rtlint: disable=RT010 — stats snapshot: torn reads are acceptable
         # Batch-size observability for @serve.batch methods.
         if not self._is_function:
             sizes = {}

@@ -68,7 +68,11 @@ class GroupManager:
         return group
 
     def get(self, group_name: str):
-        g = self._groups.get(group_name)
+        # create/destroy mutate these maps under the lock from other
+        # threads (epoch bumps during fault recovery), so reads take it
+        # too — a torn create must not hand out a half-registered group.
+        with self._lock:
+            g = self._groups.get(group_name)
         if g is None:
             raise ValueError(
                 f"collective group {group_name!r} is not initialized in this "
@@ -77,7 +81,8 @@ class GroupManager:
         return g
 
     def meta(self, group_name: str) -> dict:
-        return self._meta[group_name]
+        with self._lock:
+            return self._meta[group_name]
 
     def destroy(self, group_name: str):
         with self._lock:

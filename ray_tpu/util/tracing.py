@@ -84,7 +84,7 @@ def _record(name: str, ctx: Dict[str, str], parent_id: str, start: float,
         client = worker_mod.get_client()
         node_id = client.node_id
         worker_id = client.client_id
-    except Exception:  # noqa: BLE001 — not connected: drop the span
+    except Exception:  # noqa: BLE001 — not connected: drop the span  # rtlint: disable=RT007 — tracing is best-effort garnish and must never break the traced op
         return
     base = {
         "task_id": bytes.fromhex(ctx["span_id"]) + os.urandom(8),

@@ -419,8 +419,9 @@ def test_baseline_stale_entries():
 def test_rule_catalog():
     ids = [r.id for r in ALL_RULES]
     assert ids == sorted(ids) and len(set(ids)) == len(ids)
-    assert ids == [f"RT00{i}" for i in range(1, 8)]
+    assert ids == [f"RT{i:03d}" for i in range(1, 14)]
     assert rule_by_id("rt003").id == "RT003"
+    assert rule_by_id("rt013").id == "RT013"
     for r in ALL_RULES:
         assert r.name and r.__doc__
 
@@ -452,3 +453,627 @@ def test_cli_exit_codes(tmp_path):
     assert "RT004" in dirty.stdout
     assert run("--explain", "RT006").returncode == 0
     assert run("--explain", "RT999").returncode == 2
+
+
+# -- RT008: blocking call in async ----------------------------------------
+RT008_POS = """
+    import time
+
+    async def handler():
+        time.sleep(1.0)
+"""
+
+RT008_NEG = """
+    import asyncio
+
+    async def handler():
+        await asyncio.sleep(1.0)
+"""
+
+
+def test_rt008_sleep_in_async():
+    assert "RT008" in rule_ids(RT008_POS)
+
+
+def test_rt008_negative_twin():
+    assert "RT008" not in rule_ids(RT008_NEG)
+
+
+def test_rt008_popen_in_async():
+    src = """
+        import subprocess
+
+        async def launch(cmd):
+            return subprocess.Popen(cmd)
+    """
+    assert "RT008" in rule_ids(src)
+
+
+def test_rt008_executor_shipped_ok():
+    src = """
+        import asyncio, time
+
+        async def handler(loop):
+            await loop.run_in_executor(None, time.sleep, 1.0)
+    """
+    assert "RT008" not in rule_ids(src)
+
+
+def test_rt008_suppression():
+    src = """
+        import time
+
+        async def handler():
+            time.sleep(1.0)  # rtlint: disable=RT008 — test hook
+    """
+    assert "RT008" not in rule_ids(src)
+
+
+# -- RT009: deadline taint drop -------------------------------------------
+RT009_POS = """
+    def dispatch(handle, payload, meta):
+        return handle.remote(payload)
+"""
+
+RT009_NEG = """
+    def dispatch(handle, payload, meta):
+        return handle.remote(payload, meta=meta)
+"""
+
+
+def test_rt009_dropped_meta():
+    assert "RT009" in rule_ids(RT009_POS)
+
+
+def test_rt009_negative_twin():
+    assert "RT009" not in rule_ids(RT009_NEG)
+
+
+def test_rt009_bind_counts_as_forwarding():
+    src = """
+        def dispatch(handle, payload, meta):
+            with bind(meta):
+                return handle.remote(payload)
+    """
+    assert "RT009" not in rule_ids(src)
+
+
+def test_rt009_local_deadline_taint():
+    src = """
+        import time
+
+        def handle_request(handle, payload, deadline_ms):
+            deadline_ts = time.time() + deadline_ms / 1000.0
+            return handle.remote(payload)
+    """
+    assert "RT009" in rule_ids(src)
+
+
+def test_rt009_closure_hop_is_outer_functions():
+    src = """
+        def handle_request(handle, payload, meta):
+            def go():
+                return handle.remote(payload)
+            return go()
+    """
+    assert "RT009" in rule_ids(src)
+
+
+def test_rt009_annotation_taint():
+    src = """
+        def dispatch(handle, payload, card: "RequestMeta"):
+            return handle.remote(payload)
+    """
+    assert "RT009" in rule_ids(src)
+
+
+def test_rt009_suppression():
+    src = """
+        def dispatch(handle, payload, meta):
+            return handle.remote(payload)  # rtlint: disable=RT009 — rides .options
+    """
+    assert "RT009" not in rule_ids(src)
+
+
+# -- RT010: lock discipline ------------------------------------------------
+RT010_POS = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+        def reset(self):
+            self.n = 0
+"""
+
+RT010_NEG = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+        def reset(self):
+            with self._lock:
+                self.n = 0
+"""
+
+
+def test_rt010_bare_access():
+    assert "RT010" in rule_ids(RT010_POS)
+
+
+def test_rt010_negative_twin():
+    assert "RT010" not in rule_ids(RT010_NEG)
+
+
+def test_rt010_locked_suffix_exempt():
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._reset_locked()
+                    self.n += 1
+
+            def _reset_locked(self):
+                self.n = 0
+    """
+    assert "RT010" not in rule_ids(src)
+
+
+def test_rt010_init_exempt():
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+    """
+    assert "RT010" not in rule_ids(src)
+
+
+def test_rt010_suppression():
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def peek(self):
+                return self.n  # rtlint: disable=RT010 — single-writer snapshot
+    """
+    assert "RT010" not in rule_ids(src)
+
+
+# -- RT011: clock domains --------------------------------------------------
+RT011_POS = """
+    import time
+
+    def elapsed(deadline_ts):
+        t0 = time.monotonic()
+        return deadline_ts - t0
+"""
+
+RT011_NEG = """
+    import time
+
+    def elapsed():
+        t0 = time.monotonic()
+        return time.monotonic() - t0
+"""
+
+
+def test_rt011_cross_domain_sub():
+    assert "RT011" in rule_ids(RT011_POS)
+
+
+def test_rt011_negative_twin():
+    assert "RT011" not in rule_ids(RT011_NEG)
+
+
+def test_rt011_monotonic_deadline_ok():
+    src = """
+        import time
+
+        def waiter(timeout):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                pass
+    """
+    assert "RT011" not in rule_ids(src)
+
+
+def test_rt011_wall_anchor_shape():
+    src = """
+        import time
+
+        def stamp(dur_unknowable):
+            return time.time() - dur_unknowable
+    """
+    assert "RT011" in rule_ids(src)
+
+
+def test_rt011_suppression():
+    src = """
+        import time
+
+        def stamp(mono_t):
+            return time.time() - mono_t  # rtlint: disable=RT011 — wall anchor
+    """
+    assert "RT011" not in rule_ids(src)
+
+
+# -- RT012: donated buffer reuse ------------------------------------------
+RT012_POS = """
+    import jax
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def loop(kv, x):
+        out = step(kv, x)
+        return kv.sum()
+"""
+
+RT012_NEG = """
+    import jax
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def loop(kv, x):
+        kv = step(kv, x)
+        return kv.sum()
+"""
+
+
+def test_rt012_use_after_donate():
+    assert "RT012" in rule_ids(RT012_POS)
+
+
+def test_rt012_negative_twin():
+    assert "RT012" not in rule_ids(RT012_NEG)
+
+
+def test_rt012_swallowing_handler_without_rebind():
+    src = """
+        import jax
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def loop(kv, x):
+            try:
+                kv = step(kv, x)
+            except RuntimeError:
+                log("oops")
+            return kv.sum()
+    """
+    assert "RT012" in rule_ids(src)
+
+
+def test_rt012_handler_rebuilds_donated_state():
+    src = """
+        import jax
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def loop(kv, x):
+            try:
+                kv = step(kv, x)
+            except RuntimeError:
+                kv = fresh_cache()
+            return kv.sum()
+    """
+    assert "RT012" not in rule_ids(src)
+
+
+def test_rt012_reraising_handler_ok():
+    src = """
+        import jax
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def loop(kv, x):
+            try:
+                kv = step(kv, x)
+            except RuntimeError:
+                raise
+            return kv.sum()
+    """
+    assert "RT012" not in rule_ids(src)
+
+
+def test_rt012_suppression():
+    src = """
+        import jax
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def loop(kv, x):
+            out = step(kv, x)
+            return kv.sum()  # rtlint: disable=RT012 — loop rebinds first
+    """
+    assert "RT012" not in rule_ids(src)
+
+
+# -- RT013: metrics discipline --------------------------------------------
+RT013_POS = """
+    BOUNDARIES = [0.1, 0.5, 1.0]
+
+    def widen():
+        BOUNDARIES.append(5.0)
+"""
+
+RT013_NEG = """
+    BOUNDARIES = (0.1, 0.5, 1.0)
+
+    def widen():
+        return BOUNDARIES + (5.0,)
+"""
+
+
+def test_rt013_boundary_mutation():
+    assert "RT013" in rule_ids(RT013_POS)
+
+
+def test_rt013_negative_twin():
+    assert "RT013" not in rule_ids(RT013_NEG)
+
+
+def test_rt013_boundaries_list_literal():
+    src = """
+        h = Histogram("latency", boundaries=[0.1, 0.5, 1.0])
+    """
+    assert "RT013" in rule_ids(src)
+
+
+def test_rt013_boundaries_tuple_ok():
+    src = """
+        h = Histogram("latency", boundaries=(0.1, 0.5, 1.0))
+    """
+    assert "RT013" not in rule_ids(src)
+
+
+def test_rt013_per_request_label():
+    src = """
+        def record(m, rid):
+            m.inc(1, tags={"rid": rid})
+    """
+    assert "RT013" in rule_ids(src)
+
+
+def test_rt013_bounded_label_ok():
+    src = """
+        def record(m, model):
+            m.inc(1, tags={"model": model})
+    """
+    assert "RT013" not in rule_ids(src)
+
+
+def test_rt013_suppression():
+    src = """
+        def record(m, tenant):
+            m.inc(1, tags={"tenant": tenant})  # rtlint: disable=RT013 — admission-bounded
+    """
+    assert "RT013" not in rule_ids(src)
+
+
+# -- project model / call graph -------------------------------------------
+def _write(tree, base):
+    for rel, src in tree.items():
+        p = base / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+
+def test_callgraph_actor_reach_across_files(tmp_path):
+    from tools.rtlint import analyze_paths
+    _write({
+        "helpers.py": """
+            import ray_tpu as rt
+
+            def fetch(ref):
+                return rt.get(ref)
+        """,
+        "actors.py": """
+            import ray_tpu as rt
+            from helpers import fetch
+
+            @rt.remote
+            class A:
+                def m(self, ref):
+                    return fetch(ref)
+        """,
+    }, tmp_path)
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    hits = [f for f in res.findings if f.rule == "RT003"]
+    assert hits and hits[0].path == "helpers.py"
+    assert "A.m" in hits[0].message
+
+
+def test_callgraph_reexport_and_self_method_resolution(tmp_path):
+    """One chain exercising both: `from pkg import work` resolves
+    through pkg/__init__'s re-export, and async context propagates
+    through a self-method call (`run` -> self._go -> work)."""
+    from tools.rtlint import analyze_paths
+    _write({
+        "pkg/__init__.py": "from pkg.impl import work\n",
+        "pkg/impl.py": """
+            import time
+
+            def work():
+                time.sleep(1)
+        """,
+        "loop.py": """
+            from pkg import work
+
+            class Srv:
+                async def run(self):
+                    self._go()
+
+                def _go(self):
+                    work()
+        """,
+    }, tmp_path)
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    hits = [f for f in res.findings if f.rule == "RT008"]
+    assert hits and hits[0].path == "pkg/impl.py"
+
+
+def test_callgraph_import_cycle_terminates(tmp_path):
+    from tools.rtlint import analyze_paths
+    _write({
+        "a_mod.py": """
+            import b_mod
+
+            def fa():
+                return b_mod.fb()
+        """,
+        "b_mod.py": """
+            import a_mod
+
+            def fb():
+                return a_mod.fa()
+        """,
+    }, tmp_path)
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    assert res.files == 2
+    assert not [f for f in res.findings if f.rule == "RT000"]
+
+
+def test_crash_safety_rt000_on_syntax_error(tmp_path):
+    from tools.rtlint import analyze_paths
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    (tmp_path / "fine.py").write_text("x = 1\n")
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    rt000 = [f for f in res.findings if f.rule == "RT000"]
+    assert len(rt000) == 1 and rt000[0].path == "broken.py"
+    assert res.files == 2
+
+
+# -- CLI: formats, jobs, cache, changed, stats -----------------------------
+def _cli(*args, cwd=REPO):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", *args],
+        capture_output=True, text=True, env=env, cwd=cwd,
+    )
+
+
+def test_cli_json_format(tmp_path):
+    bad = tmp_path / "x.py"
+    bad.write_text(textwrap.dedent(RT004_POS))
+    out = _cli("--no-baseline", "--no-cache", "--format", "json", str(bad))
+    assert out.returncode == 1
+    doc = json.loads(out.stdout)
+    assert doc["tool"] == "rtlint"
+    assert doc["new_findings"] and \
+        doc["new_findings"][0]["rule"] == "RT004"
+
+
+def test_cli_sarif_format(tmp_path):
+    bad = tmp_path / "x.py"
+    bad.write_text(textwrap.dedent(RT004_POS))
+    out = _cli("--no-baseline", "--no-cache", "--format", "sarif", str(bad))
+    assert out.returncode == 1
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert results and results[0]["ruleId"] == "RT004"
+    assert results[0]["partialFingerprints"]["rtlint/v1"]
+
+
+def test_cli_jobs_matches_serial(tmp_path):
+    serial = _cli("--no-baseline", "--no-cache", "--format", "json",
+                  "ray_tpu/serve/")
+    par = _cli("--no-baseline", "--no-cache", "--format", "json",
+               "--jobs", "4", "ray_tpu/serve/")
+    assert serial.returncode == par.returncode
+    a, b = json.loads(serial.stdout), json.loads(par.stdout)
+    key = lambda f: (f["rule"], f["path"], f["line"])  # noqa: E731
+    assert sorted(map(key, a["new_findings"])) == \
+        sorted(map(key, b["new_findings"]))
+    assert a["total_findings"] == b["total_findings"]
+
+
+def test_cli_cache_warm_run_consistent(tmp_path):
+    cache = tmp_path / "cache.json"
+    cold = _cli("--no-baseline", "--cache", str(cache), "ray_tpu/util/")
+    assert cache.exists()
+    warm = _cli("--no-baseline", "--cache", str(cache), "ray_tpu/util/")
+    assert cold.stdout == warm.stdout
+    assert cold.returncode == warm.returncode
+
+
+def test_cli_changed_mode(tmp_path):
+    git = lambda *a: subprocess.run(  # noqa: E731
+        ["git", *a], cwd=tmp_path, capture_output=True, text=True,
+        env=dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                 GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t"),
+    )
+    git("init", "-q")
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+    # no changed files: exits clean without linting anything
+    out = _cli("--no-baseline", "--no-cache", "--changed", str(tmp_path),
+               "--root", str(tmp_path), cwd=str(tmp_path))
+    assert out.returncode == 0 and "no changed" in out.stdout
+    # an untracked offender is picked up by --changed
+    (tmp_path / "bad.py").write_text(textwrap.dedent(RT004_POS))
+    out = _cli("--no-baseline", "--no-cache", "--changed", str(tmp_path),
+               "--root", str(tmp_path), cwd=str(tmp_path))
+    assert out.returncode == 1 and "RT004" in out.stdout
+
+
+def test_cli_stats(tmp_path):
+    bad = tmp_path / "x.py"
+    bad.write_text(textwrap.dedent(RT004_POS))
+    out = _cli("--no-baseline", "--no-cache", "--stats", str(bad))
+    assert out.returncode == 1
+    assert "RT004" in out.stdout and "total" in out.stdout
+
+
+def test_cli_usage_errors():
+    assert _cli("--jobs", "0").returncode == 2
+    assert _cli("--rules", "RT999").returncode == 2
+
+
+def test_default_targets_cover_tools_and_benches():
+    from tools.rtlint import DEFAULT_TARGETS
+    assert "ray_tpu" in DEFAULT_TARGETS
+    assert "tools" in DEFAULT_TARGETS
+    assert any(t.startswith("bench_") for t in DEFAULT_TARGETS)
+
+
+def test_repo_default_targets_clean_against_baseline():
+    """The full gate over the v2 default target set (ray_tpu/, tools/,
+    bench_*.py), exactly what `make lint` runs."""
+    out = _cli("--no-cache")
+    assert out.returncode == 0, out.stdout + out.stderr

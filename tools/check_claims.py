@@ -14,6 +14,7 @@ Run directly for a report:  python tools/check_claims.py
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import re
@@ -210,6 +211,40 @@ def _rtlint_baseline_size():
     def get():
         data = _load(os.path.join("tools", "rtlint", "baseline.json"))
         return sum(data["findings"].values())
+    return get
+
+
+_RTLINT_RUN = {}
+
+
+def _rtlint_run():
+    """One live engine run over the default targets, shared by every
+    suppression-count claim (MIGRATION.md's triage table must track
+    the code, not a hand-maintained tally)."""
+    if not _RTLINT_RUN:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from tools.rtlint import DEFAULT_TARGETS, analyze_paths
+
+        targets = [os.path.join(REPO, t) for t in DEFAULT_TARGETS
+                   if "*" not in t]
+        targets += glob.glob(os.path.join(REPO, "bench_*.py"))
+        _RTLINT_RUN["result"] = analyze_paths(targets, root=REPO)
+    return _RTLINT_RUN["result"]
+
+
+def _rtlint_suppressed(rule: str = None):
+    def get():
+        res = _rtlint_run()
+        if rule is None:
+            return sum(res.suppressed.values())
+        return res.suppressed.get(rule, 0)
+    return get
+
+
+def _rtlint_found(rule: str):
+    def get():
+        return sum(1 for f in _rtlint_run().findings if f.rule == rule)
     return get
 
 
@@ -436,6 +471,25 @@ CLAIMS = [
     Claim("MIGRATION.md", r"lint pass\s*\n?\s*with (\d+) rules",
           _rtlint_rule_count(), rel_tol=0.0),
     Claim("MIGRATION.md", r"holds (\d+) known findings",
+          _rtlint_baseline_size(), rel_tol=0.0),
+    # v2 dogfood triage table <- a live engine run over the default
+    # targets (exact pins: drift means a suppression was added or
+    # removed without updating the doc).
+    Claim("MIGRATION.md", r"RT008: (\d+) suppressed",
+          _rtlint_suppressed("RT008"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"RT009: (\d+) suppressed",
+          _rtlint_suppressed("RT009"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"RT010: (\d+) suppressed",
+          _rtlint_suppressed("RT010"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"RT011: (\d+) suppressed",
+          _rtlint_suppressed("RT011"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"RT012: (\d+) findings",
+          _rtlint_found("RT012"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"RT013: (\d+) suppressed",
+          _rtlint_suppressed("RT013"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"suppresses (\d+) findings across",
+          _rtlint_suppressed(), rel_tol=0.0),
+    Claim("MIGRATION.md", r"carries (\d+) baselined findings",
           _rtlint_baseline_size(), rel_tol=0.0),
     # Control-plane profiler <- BENCH_SCALE.json lifecycle probes.
     # Loose tolerances on the absolute µs (wall timings on a shared

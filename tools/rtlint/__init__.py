@@ -20,17 +20,25 @@ with ``# rtlint: disable=RT001`` (comma-separate for several rules; on a
 """
 
 from tools.rtlint.engine import (  # noqa: F401
+    AnalysisResult,
     Baseline,
+    DEFAULT_TARGETS,
     Finding,
+    analyze_paths,
     lint_paths,
     lint_source,
 )
+from tools.rtlint.project import ProjectModel  # noqa: F401
 from tools.rtlint.rules import ALL_RULES, rule_by_id  # noqa: F401
 
 __all__ = [
     "ALL_RULES",
+    "AnalysisResult",
     "Baseline",
+    "DEFAULT_TARGETS",
     "Finding",
+    "ProjectModel",
+    "analyze_paths",
     "lint_paths",
     "lint_source",
     "rule_by_id",

@@ -1,31 +1,68 @@
 """rtlint CLI.
 
-    python -m tools.rtlint ray_tpu/              lint against the baseline
-    python -m tools.rtlint --no-baseline PATH    report every finding
-    python -m tools.rtlint --write-baseline PATH regenerate the baseline
-    python -m tools.rtlint --list-rules          one-line rule catalog
-    python -m tools.rtlint --explain RT003       full rule rationale
+    python -m tools.rtlint                        lint the default targets
+    python -m tools.rtlint ray_tpu/ tools/        lint explicit paths
+    python -m tools.rtlint --no-baseline PATH     report every finding
+    python -m tools.rtlint --write-baseline       regenerate the baseline
+    python -m tools.rtlint --changed              git-diff-scoped pass 2
+    python -m tools.rtlint --jobs 8               parallel analysis
+    python -m tools.rtlint --format json|sarif    machine-readable output
+    python -m tools.rtlint --stats                per-rule counts
+    python -m tools.rtlint --list-rules           one-line rule catalog
+    python -m tools.rtlint --explain RT003        full rule rationale
 
-Exit codes: 0 clean, 1 new findings (or stale-baseline with --strict-
-baseline), 2 usage error.
+With no paths, the default target set is linted: ray_tpu/, tools/, and
+the root bench_*.py harnesses, resolved against the repo root (the
+directory holding tools/rtlint/). Exit codes: 0 clean, 1 new findings
+(or stale baseline with --strict-baseline), 2 usage error.
 """
 
 from __future__ import annotations
 
 import argparse
-import collections
 import os
+import subprocess
 import sys
 
-from tools.rtlint.engine import Baseline, lint_paths
+from tools.rtlint.engine import (Baseline, DEFAULT_TARGETS, analyze_paths)
+from tools.rtlint.formats import render_json, render_sarif, render_text
 from tools.rtlint.rules import ALL_RULES, rule_by_id
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _changed_files(root: str):
+    """Repo-relative .py files touched vs HEAD (staged, unstaged, and
+    untracked). Returns None when git itself fails — callers fall back
+    to a full pass 2 rather than silently linting nothing."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--", "*.py"],
+            capture_output=True, text=True, cwd=root, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--", "*.py"],
+            capture_output=True, text=True, cwd=root, timeout=30)
+        if diff.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out = set()
+    for blob in (diff.stdout, untracked.stdout):
+        for line in blob.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(line)
+    return sorted(out)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rtlint", add_help=True)
-    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint (default: "
+                         + ", ".join(DEFAULT_TARGETS) + ")")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file (default: tools/rtlint/baseline.json)")
     ap.add_argument("--no-baseline", action="store_true",
@@ -37,6 +74,25 @@ def main(argv=None) -> int:
                          "(debt paid off: refresh the baseline)")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--format", default="text",
+                    choices=["text", "json", "sarif"], dest="fmt",
+                    help="output format (default: text)")
+    ap.add_argument("--jobs", "-j", type=int, default=1, metavar="N",
+                    help="worker processes for both analysis passes")
+    ap.add_argument("--changed", action="store_true",
+                    help="restrict findings to files changed vs HEAD "
+                         "(+ untracked); the project model still covers "
+                         "the full target set")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule finding/suppression/baseline "
+                         "counts instead of findings")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the content-hash cache")
+    ap.add_argument("--cache", default=None, metavar="FILE",
+                    help="cache file (default: <root>/.rtlint_cache.json)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative finding paths "
+                         "(default: the checkout containing rtlint)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--explain", metavar="RTxxx")
     args = ap.parse_args(argv)
@@ -55,10 +111,6 @@ def main(argv=None) -> int:
         print(f"{r.id} ({r.name})\n")
         print((r.__doc__ or "").strip())
         return 0
-    if not args.paths:
-        ap.print_usage(sys.stderr)
-        print("rtlint: no paths given", file=sys.stderr)
-        return 2
 
     rules = None
     if args.rules:
@@ -67,12 +119,40 @@ def main(argv=None) -> int:
         except KeyError as e:
             print(f"unknown rule {e.args[0]!r}", file=sys.stderr)
             return 2
+    if args.jobs < 1:
+        print("rtlint: --jobs must be >= 1", file=sys.stderr)
+        return 2
 
-    findings = lint_paths(args.paths, rules)
+    # Explicit paths are resolved against the cwd (so `rtlint pkg/`
+    # works from anywhere); the default target set is anchored at the
+    # repo root regardless of cwd.
+    root = os.path.abspath(args.root or REPO_ROOT)
+    if args.paths:
+        paths = [os.path.abspath(p) for p in args.paths]
+    else:
+        paths = list(DEFAULT_TARGETS)
+
+    only_files = None
+    if args.changed:
+        only_files = _changed_files(root)
+        if only_files is not None and not only_files:
+            print("rtlint: clean (no changed .py files)")
+            return 0
+
+    cache_path = None
+    if not args.no_cache:
+        cache_path = args.cache or os.path.join(root, ".rtlint_cache.json")
+
+    result = analyze_paths(paths, rules=rules, root=root, jobs=args.jobs,
+                           cache_path=cache_path, only_files=only_files)
+    findings = result.findings
 
     if args.write_baseline:
-        Baseline.from_findings(findings).save(args.baseline)
-        by_rule = collections.Counter(f.rule for f in findings)
+        bl = Baseline.from_findings(findings)
+        bl.save(args.baseline)
+        by_rule = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         summary = ", ".join(f"{r}:{n}" for r, n in sorted(by_rule.items()))
         print(f"wrote {len(findings)} findings to {args.baseline} "
               f"({summary or 'clean'})")
@@ -81,22 +161,53 @@ def main(argv=None) -> int:
     baseline = (Baseline() if args.no_baseline
                 else Baseline.load(args.baseline))
     new = baseline.new_findings(findings)
-    for f in new:
-        print(f)
     stale = [] if args.no_baseline else baseline.stale_entries(findings)
-    if stale and (args.strict_baseline or not new):
-        print(f"note: {len(stale)} baselined finding(s) no longer exist — "
-              f"debt paid; refresh with --write-baseline", file=sys.stderr)
+
+    if args.stats:
+        _print_stats(findings, new, result.suppressed, baseline,
+                     rules or ALL_RULES)
+        return 1 if new else 0
+
+    nrules = len(ALL_RULES) if rules is None else len(rules)
+    meta = dict(total=len(findings), files=result.files, rules=nrules,
+                baselined_absorbed=len(findings) - len(new), stale=stale)
+    if args.fmt == "json":
+        print(render_json(new, suppressed=result.suppressed, **meta))
+    elif args.fmt == "sarif":
+        docs = {r.id: (r.__doc__ or "").strip() for r in ALL_RULES}
+        docs["RT000"] = "analyzer degradation note"
+        print(render_sarif(new, rule_docs=docs))
+    else:
+        print(render_text(new, **meta))
     if new:
-        by_rule = collections.Counter(f.rule for f in new)
-        summary = ", ".join(f"{r}:{n}" for r, n in sorted(by_rule.items()))
-        print(f"rtlint: {len(new)} new finding(s) [{summary}] "
-              f"({len(findings) - len(new)} baselined/suppressed absorbed)",
-              file=sys.stderr)
         return 1
-    print(f"rtlint: clean ({len(findings)} baselined finding(s), "
-          f"{len(ALL_RULES) if rules is None else len(rules)} rules)")
     return 1 if (stale and args.strict_baseline) else 0
+
+
+def _print_stats(findings, new, suppressed, baseline, rules):
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    base_by_rule = {}
+    for fp, n in baseline.counts.items():
+        rid = fp.split("|", 1)[0]
+        base_by_rule[rid] = base_by_rule.get(rid, 0) + n
+    new_by_rule = {}
+    for f in new:
+        new_by_rule[f.rule] = new_by_rule.get(f.rule, 0) + 1
+    ids = sorted({r.id for r in rules} | set(by_rule) | set(base_by_rule)
+                 | set(suppressed))
+    print(f"{'rule':8s} {'found':>6s} {'new':>6s} {'baseline':>9s} "
+          f"{'suppressed':>11s}")
+    for rid in ids:
+        print(f"{rid:8s} {by_rule.get(rid, 0):6d} "
+              f"{new_by_rule.get(rid, 0):6d} "
+              f"{base_by_rule.get(rid, 0):9d} "
+              f"{suppressed.get(rid, 0):11d}")
+    tot = (sum(by_rule.values()), sum(new_by_rule.values()),
+           sum(base_by_rule.values()), sum(suppressed.values()))
+    print(f"{'total':8s} {tot[0]:6d} {tot[1]:6d} {tot[2]:9d} "
+          f"{tot[3]:11d}")
 
 
 if __name__ == "__main__":

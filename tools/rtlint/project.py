@@ -1,0 +1,549 @@
+"""rtlint pass 1: project symbol table and call graph.
+
+``summarize_module`` reduces one parsed file to a plain-dict summary
+(JSON-serializable, so it caches and crosses process boundaries for
+``--jobs``): its imports, classes, functions with their runtime context
+(async, actor method, jit/donate decoration, thread-target), and the
+call edges each function makes, recorded as the dotted names written at
+the call sites.
+
+``ProjectModel`` joins the summaries: it derives module names from
+paths, resolves call-site names through import aliases, ``from``
+imports and re-export chains (with a cycle guard), resolves ``self.m``
+through the class and its project-local bases, and computes the context
+closures pass-2 rules consume:
+
+- ``traced``   — functions whose bodies run under jit tracing (jit
+  roots plus functions every project caller of which is traced),
+- ``in_async`` — functions running on an event loop (``async def``
+  roots plus sync helpers only ever called from async context, minus
+  thread targets),
+- ``actor_reach`` / ``control_reach`` — functions reachable from
+  @rt.remote actor methods / control-plane modules via the call graph,
+  each with a witness root for the diagnostic message,
+- ``hoppers`` / ``deadline_aware`` — functions that (transitively)
+  dispatch downstream work, and those that already handle RequestMeta
+  (parameter, thread-local read, or bind), for the RT009 taint rule.
+
+Function identity is ``"<path>::<qualname>"`` — path-keyed so renames
+of modules churn fingerprints but edits inside a file do not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_JIT_NAMES = {"jit", "pjit"}
+
+# Downstream dispatch: submitting work / bytes to another component.
+HOP_ATTRS = {"remote", "submit", "sendall", "redispatch", "_stream_call"}
+
+# Parameter names (or annotation substrings) that carry request
+# deadline/meta taint for RT009.
+META_PARAMS = {"meta", "request_meta", "deadline_ts"}
+META_ANNOTATIONS = ("RequestMeta",)
+
+
+def module_name_of(path: str) -> str:
+    """'ray_tpu/serve/llm.py' -> 'ray_tpu.serve.llm'; __init__ folds."""
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x not in ("", ".")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call):
+        inner = _dotted(cur.func)
+        if inner:
+            parts.append(inner + "()")
+    return ".".join(reversed(parts))
+
+
+def _annotation_str(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+class _ModuleSummarizer(ast.NodeVisitor):
+    """One pass over a module tree producing the summary dict."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.summary: Dict = {
+            "path": path,
+            "module": module_name_of(path),
+            "imports": {},        # local alias -> module
+            "from_imports": {},   # local name -> [module, original name]
+            "defs": {},           # qualname -> func dict
+            "classes": {},        # class name -> class dict
+            "jit_passed": [],     # local function names passed to jit()
+            "thread_targets": [],  # dotted names given to Thread/executor
+        }
+        self._stack: List[Tuple[str, ast.AST]] = []  # (qualname, node)
+        self._class_stack: List[str] = []
+
+    # -- imports ----------------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            local = a.asname or a.name.split(".")[0]
+            self.summary["imports"][local] = (a.name if a.asname
+                                              else a.name.split(".")[0])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                self.summary["from_imports"][a.asname or a.name] = [
+                    node.module, a.name]
+        elif node.level:  # relative: resolve against this module's package
+            pkg = self.summary["module"].split(".")
+            # level=1 strips the module's own leaf (or nothing for
+            # __init__, whose module name *is* the package).
+            is_pkg = self.path.endswith("__init__.py")
+            up = node.level - (1 if is_pkg else 0)
+            base = pkg[:len(pkg) - up] if up <= len(pkg) else []
+            mod = ".".join(base + ([node.module] if node.module else []))
+            if mod:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.summary["from_imports"][a.asname or a.name] = [
+                        mod, a.name]
+        self.generic_visit(node)
+
+    # -- defs -------------------------------------------------------------
+    def _qual(self, name: str) -> str:
+        return (f"{self._stack[-1][0]}.{name}" if self._stack else name)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        qual = self._qual(node.name)
+        decorators = [_dotted(d.func if isinstance(d, ast.Call) else d)
+                      for d in node.decorator_list]
+        is_actor = any(d.split(".")[-1] == "remote" for d in decorators)
+        if not self._class_stack:  # only index top-level-ish classes
+            self.summary["classes"][node.name] = {
+                "qualname": qual,
+                "bases": [_dotted(b) for b in node.bases],
+                "decorators": decorators,
+                "is_actor": is_actor,
+                "methods": [n.name for n in node.body
+                            if isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))],
+            }
+        self._stack.append((qual, node))
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+        self._stack.pop()
+
+    def _visit_func(self, node, is_async: bool):
+        qual = self._qual(node.name)
+        decorators = [_dotted(d.func if isinstance(d, ast.Call) else d)
+                      for d in node.decorator_list]
+        params = [a.arg for a in (node.args.posonlyargs + node.args.args
+                                  + node.args.kwonlyargs)]
+        annos = {a.arg: _annotation_str(a.annotation)
+                 for a in (node.args.posonlyargs + node.args.args
+                           + node.args.kwonlyargs)}
+        self.summary["defs"][qual] = {
+            "name": node.name,
+            "qualname": qual,
+            "lineno": node.lineno,
+            "is_async": is_async,
+            "params": params,
+            "decorators": decorators,
+            "class": self._class_stack[-1] if self._class_stack else "",
+            "is_jit": any(d.split(".")[-1] in _JIT_NAMES
+                          for d in decorators),
+            "meta_params": sorted(
+                {p for p in params if p in META_PARAMS}
+                | {p for p, an in annos.items()
+                   if any(m in an for m in META_ANNOTATIONS)}),
+            "calls": [],
+            "hops": False,
+            "reads_ctx": False,
+            "binds_meta": False,
+        }
+        self._stack.append((qual, node))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_func(node, is_async=True)
+
+    # -- calls ------------------------------------------------------------
+    def _owner(self) -> Optional[Dict]:
+        for qual, node in reversed(self._stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self.summary["defs"][qual]
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        owner = self._owner()
+        if owner is not None and dotted:
+            owner["calls"].append([dotted, node.lineno])
+            leaf = dotted.rsplit(".", 1)[-1]
+            if isinstance(node.func, ast.Attribute) and leaf in HOP_ATTRS:
+                owner["hops"] = True
+            if leaf == "current" and ("context" in dotted
+                                      or dotted == "current"):
+                owner["reads_ctx"] = True
+            if leaf in {"bind", "make_wire_ctx", "set_request_meta"}:
+                owner["binds_meta"] = True
+        # jit(f) — f becomes a traced root; Thread(target=self.m) /
+        # run_in_executor(ex, f) / to_thread(f) — f runs off-loop.
+        leaf = dotted.rsplit(".", 1)[-1] if dotted else ""
+        if leaf in _JIT_NAMES and node.args:
+            fn = node.args[0]
+            if isinstance(fn, ast.Name):
+                self.summary["jit_passed"].append(
+                    self._qual(fn.id) if self._stack else fn.id)
+            elif isinstance(fn, ast.Attribute):
+                self.summary["jit_passed"].append(_dotted(fn))
+        if leaf == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    t = _dotted(kw.value)
+                    if t:
+                        self.summary["thread_targets"].append(t)
+        elif leaf in {"run_in_executor", "to_thread", "submit"}:
+            idx = 1 if leaf == "run_in_executor" else 0
+            if len(node.args) > idx:
+                t = _dotted(node.args[idx])
+                if t:
+                    self.summary["thread_targets"].append(t)
+        self.generic_visit(node)
+
+
+def summarize_module(tree: ast.AST, path: str) -> Dict:
+    s = _ModuleSummarizer(path)
+    s.visit(tree)
+    return s.summary
+
+
+def empty_summary(path: str) -> Dict:
+    """Fallback when a file cannot be parsed/summarized: the project
+    model still has an entry, so resolution degrades instead of dying."""
+    return {"path": path, "module": module_name_of(path), "imports": {},
+            "from_imports": {}, "defs": {}, "classes": {},
+            "jit_passed": [], "thread_targets": []}
+
+
+# -- the project model ----------------------------------------------------
+CONTROL_SCOPES = ("serve/", "train/", "util/collective/")
+
+
+def func_id(path: str, qualname: str) -> str:
+    return f"{path}::{qualname}"
+
+
+class ProjectModel:
+    """Symbol table + call graph over a set of module summaries."""
+
+    def __init__(self, summaries: Sequence[Dict]):
+        self.modules: Dict[str, Dict] = {}   # module name -> summary
+        self.by_path: Dict[str, Dict] = {}   # path -> summary
+        for s in summaries:
+            self.by_path[s["path"]] = s
+            self.modules[s["module"]] = s
+        self._resolve_memo: Dict[Tuple[str, str], Optional[str]] = {}
+        self.edges: Dict[str, Set[str]] = {}     # caller fid -> callee fids
+        self.redges: Dict[str, Set[str]] = {}    # callee fid -> caller fids
+        self._build_graph()
+        self.thread_target_ids = self._resolve_thread_targets()
+        self.traced = self._exclusive_closure(self._traced_roots())
+        self.in_async = self._exclusive_closure(
+            self._async_roots(), exclude=self.thread_target_ids)
+        self.actor_reach = self._witnessed_reach(self._actor_roots())
+        self.control_reach = self._witnessed_reach(self._control_roots())
+        self.hoppers = self._transitive_flag("hops")
+        self.deadline_aware = self._transitive_flag("_aware")
+
+    # -- symbol resolution ------------------------------------------------
+    def resolve(self, module: str, name: str,
+                _seen: Optional[Set] = None) -> Optional[str]:
+        """Resolve a module-level `name` in `module` to a function id,
+        following from-import re-export chains. Cycle-safe."""
+        key = (module, name)
+        if key in self._resolve_memo:
+            return self._resolve_memo[key]
+        _seen = _seen or set()
+        if key in _seen:           # import cycle: give up quietly
+            return None
+        _seen.add(key)
+        out: Optional[str] = None
+        ms = self.modules.get(module)
+        if ms is not None:
+            if name in ms["defs"]:
+                out = func_id(ms["path"], name)
+            elif name in ms["from_imports"]:
+                src_mod, src_name = ms["from_imports"][name]
+                out = self.resolve(src_mod, src_name, _seen)
+                if out is None and src_mod in self.modules:
+                    # `from pkg import mod` pulls in a module object.
+                    sub = f"{src_mod}.{src_name}"
+                    if sub in self.modules:
+                        out = f"<module>::{sub}"
+            elif name in ms["imports"]:
+                tgt = ms["imports"][name]
+                if tgt in self.modules:
+                    out = f"<module>::{tgt}"
+        self._resolve_memo[key] = out
+        return out
+
+    def resolve_class(self, module: str, name: str) -> Optional[Dict]:
+        """Resolve a class name visible in `module` to its summary dict
+        (annotated with its defining module), following imports."""
+        seen = set()
+        while True:
+            if (module, name) in seen:
+                return None
+            seen.add((module, name))
+            ms = self.modules.get(module)
+            if ms is None:
+                return None
+            if name in ms["classes"]:
+                cls = dict(ms["classes"][name])
+                cls["_module"] = module
+                cls["_path"] = ms["path"]
+                return cls
+            if name in ms["from_imports"]:
+                module, name = ms["from_imports"][name]
+                continue
+            return None
+
+    def resolve_method(self, module: str, cls_name: str,
+                       method: str) -> Optional[str]:
+        """Resolve Class.method through the class and its project-local
+        bases (method resolution through self)."""
+        seen: Set[Tuple[str, str]] = set()
+        queue = [(module, cls_name)]
+        while queue:
+            mod, cname = queue.pop(0)
+            if (mod, cname) in seen:
+                continue
+            seen.add((mod, cname))
+            cls = self.resolve_class(mod, cname)
+            if cls is None:
+                continue
+            qual = f"{cls['qualname']}.{method}"
+            ms = self.modules.get(cls["_module"])
+            if ms and qual in ms["defs"]:
+                return func_id(cls["_path"], qual)
+            for base in cls["bases"]:
+                queue.append((cls["_module"], base.split(".")[-1]))
+        return None
+
+    def resolve_call(self, summary: Dict, fn: Dict,
+                     dotted: str) -> Optional[str]:
+        """Resolve one call-site dotted name written inside `fn`."""
+        parts = dotted.split(".")
+        module = summary["module"]
+        if parts[0] == "self" and len(parts) == 2 and fn["class"]:
+            return self.resolve_method(module, fn["class"], parts[1])
+        if len(parts) == 1:
+            # nested def in the same function first, then module scope
+            nested = f"{fn['qualname']}.{parts[0]}"
+            if nested in summary["defs"]:
+                return func_id(summary["path"], nested)
+            return self.resolve(module, parts[0])
+        head = self.resolve(module, parts[0])
+        if head is None:
+            return None
+        if head.startswith("<module>::"):
+            mod = head.split("::", 1)[1]
+            if len(parts) == 2:
+                return self.resolve(mod, parts[1])
+            if len(parts) == 3:  # mod.Class.method
+                return self.resolve_method(mod, parts[1], parts[2])
+            return None
+        # head is a function/class id: Class.method / Class().method
+        path, qual = head.split("::", 1)
+        ms = self.by_path.get(path)
+        if ms and len(parts) == 2 and qual in ms["classes"]:
+            return self.resolve_method(ms["module"], qual, parts[1])
+        return None
+
+    # -- graph ------------------------------------------------------------
+    def _build_graph(self):
+        for s in self.by_path.values():
+            for qual, fn in s["defs"].items():
+                fid = func_id(s["path"], qual)
+                out = self.edges.setdefault(fid, set())
+                for dotted, _ in fn["calls"]:
+                    callee = self.resolve_call(s, fn, dotted)
+                    if callee and "::" in callee and \
+                            not callee.startswith("<module>::"):
+                        out.add(callee)
+        for caller, callees in self.edges.items():
+            for c in callees:
+                self.redges.setdefault(c, set()).add(caller)
+
+    def func(self, fid: str) -> Optional[Dict]:
+        path, qual = fid.split("::", 1)
+        ms = self.by_path.get(path)
+        return ms["defs"].get(qual) if ms else None
+
+    def _all_funcs(self):
+        for s in self.by_path.values():
+            for qual, fn in s["defs"].items():
+                yield func_id(s["path"], qual), s, fn
+
+    # -- roots ------------------------------------------------------------
+    def _traced_roots(self) -> Set[str]:
+        roots: Set[str] = set()
+        for fid, s, fn in self._all_funcs():
+            if fn["is_jit"]:
+                roots.add(fid)
+        for s in self.by_path.values():
+            for name in s["jit_passed"]:
+                tgt = None
+                if name.startswith("self."):
+                    continue  # method handles via is_jit decorators
+                if name in s["defs"]:
+                    tgt = func_id(s["path"], name)
+                else:
+                    tgt = self.resolve(s["module"], name.split(".")[-1])
+                if tgt and not tgt.startswith("<module>::"):
+                    roots.add(tgt)
+        return roots
+
+    def _async_roots(self) -> Set[str]:
+        return {fid for fid, s, fn in self._all_funcs() if fn["is_async"]}
+
+    def _actor_roots(self) -> Set[str]:
+        roots = set()
+        for fid, s, fn in self._all_funcs():
+            cls = s["classes"].get(fn["class"]) if fn["class"] else None
+            if cls and cls["is_actor"]:
+                roots.add(fid)
+        return roots
+
+    def _control_roots(self) -> Set[str]:
+        return {fid for fid, s, fn in self._all_funcs()
+                if any(scope in s["path"] for scope in CONTROL_SCOPES)}
+
+    def _resolve_thread_targets(self) -> Set[str]:
+        out: Set[str] = set()
+        for s in self.by_path.values():
+            for dotted in s["thread_targets"]:
+                parts = dotted.split(".")
+                if parts[0] == "self" and len(parts) == 2:
+                    # any class in this module with that method
+                    for cname in s["classes"]:
+                        m = self.resolve_method(s["module"], cname,
+                                                parts[1])
+                        if m:
+                            out.add(m)
+                else:
+                    fid = self.resolve(s["module"], parts[-1])
+                    if fid and not fid.startswith("<module>::"):
+                        out.add(fid)
+        return out
+
+    # -- closures ---------------------------------------------------------
+    def _exclusive_closure(self, roots: Set[str],
+                           exclude: Set[str] = frozenset()) -> Set[str]:
+        """Roots plus functions reachable from them — but a reached
+        function with any caller *outside* the closure is dropped
+        (context is ambiguous; do not over-flag)."""
+        closure = set(roots)
+        frontier = list(roots)
+        while frontier:
+            for callee in sorted(self.edges.get(frontier.pop(), ())):
+                if callee in closure or callee in exclude:
+                    continue
+                closure.add(callee)
+                frontier.append(callee)
+        for fid in sorted(closure - roots):
+            callers = self.redges.get(fid, set())
+            if any(c not in closure for c in callers):
+                closure.discard(fid)
+        return closure
+
+    def _witnessed_reach(self, roots: Set[str]) -> Dict[str, str]:
+        """fid -> witness root for everything reachable from `roots`."""
+        reach: Dict[str, str] = {fid: fid for fid in roots}
+        frontier = sorted(roots)
+        while frontier:
+            cur = frontier.pop(0)
+            for callee in sorted(self.edges.get(cur, ())):
+                if callee not in reach:
+                    reach[callee] = reach[cur]
+                    frontier.append(callee)
+        return reach
+
+    def _transitive_flag(self, key: str) -> Set[str]:
+        """Functions where `key` holds directly or in any callee.
+        key="_aware" is the synthetic deadline-aware predicate."""
+        direct = set()
+        for fid, s, fn in self._all_funcs():
+            if key == "_aware":
+                if fn["meta_params"] or fn["reads_ctx"] or fn["binds_meta"]:
+                    direct.add(fid)
+            elif fn.get(key):
+                direct.add(fid)
+        out = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in self.edges.items():
+                if caller not in out and any(c in out for c in callees):
+                    out.add(caller)
+                    changed = True
+        return out
+
+    # -- per-file views consumed by rules ---------------------------------
+    def _file_quals(self, path: str, fids) -> Dict[str, str]:
+        out = {}
+        prefix = f"{path}::"
+        for fid in fids:
+            if fid.startswith(prefix):
+                val = fids[fid] if isinstance(fids, dict) else fid
+                out[fid[len(prefix):]] = val
+        return out
+
+    def traced_quals(self, path: str) -> Set[str]:
+        return set(self._file_quals(path, self.traced))
+
+    def async_quals(self, path: str) -> Set[str]:
+        return set(self._file_quals(path, self.in_async))
+
+    def actor_reach_quals(self, path: str) -> Dict[str, str]:
+        return self._file_quals(path, self.actor_reach)
+
+    def control_reach_quals(self, path: str) -> Dict[str, str]:
+        return self._file_quals(path, self.control_reach)
+
+    def digest_src(self) -> str:
+        """Stable serialization of everything pass 2 depends on."""
+        import json
+        return json.dumps(
+            sorted((s["path"], sorted(s["defs"]))
+                   for s in self.by_path.values()),
+            separators=(",", ":")) + "|" + ",".join(sorted(
+                self.traced | self.in_async
+                | set(self.actor_reach) | set(self.control_reach)
+                | self.hoppers | self.deadline_aware))

@@ -1,0 +1,109 @@
+"""Shared rule machinery: the Rule base class and jit detection.
+
+Rules receive a ``FileContext`` whose ``.project`` (when run through
+``analyze_paths``/``lint_source``) is the pass-1 ``ProjectModel``;
+interprocedural rules consult its context closures, falling back to
+purely lexical behavior when the model is absent or degraded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.rtlint.engine import FileContext, Finding
+
+# Names that mean "this code runs under jax.jit tracing".
+_JIT_NAMES = {"jit", "pjit"}
+
+
+class Rule:
+    id: str = ""
+    name: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str,
+                token: str, scope: Optional[str] = None) -> Finding:
+        return Finding(
+            self.id, ctx.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), message,
+            scope=scope if scope is not None else ctx.scope_of(node),
+            token=token,
+        )
+
+
+def _dotted(func: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('jax.jit', 'rt.get')."""
+    parts: List[str] = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_expr(ctx: FileContext, node: ast.AST) -> bool:
+    """Does this expression denote jax.jit / jit / pjit (possibly through
+    functools.partial)?"""
+    if isinstance(node, ast.Name):
+        return (node.id in _JIT_NAMES
+                and ctx.from_imports.get(node.id, "").startswith("jax"))
+    if isinstance(node, ast.Attribute):
+        return (node.attr in _JIT_NAMES
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ctx.jax_aliases)
+    if isinstance(node, ast.Call):
+        if _is_jit_expr(ctx, node.func):
+            return True
+        # functools.partial(jax.jit, ...) — the partial IS a jit wrapper.
+        if _dotted(node.func) in {"partial", "functools.partial"}:
+            return any(_is_jit_expr(ctx, a) for a in node.args)
+    return False
+
+
+def _jit_call_sites(ctx: FileContext) -> Iterator[ast.Call]:
+    for node in ctx.walk():
+        if isinstance(node, ast.Call) and _is_jit_expr(ctx, node.func):
+            yield node
+
+
+def _traced_bodies(ctx: FileContext) -> List[ast.AST]:
+    """Function/lambda nodes whose bodies run under jit tracing: defs
+    decorated with jit, callables passed directly to a jit call, and —
+    via the project call graph — defs every project caller of which is
+    itself traced."""
+    traced: List[ast.AST] = []
+    local_defs: Dict[Tuple[str, str], ast.AST] = {}
+    for node in ctx.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs[(ctx.scope_of(node), node.name)] = node
+            if any(_is_jit_expr(ctx, d) for d in node.decorator_list):
+                traced.append(node)
+    for call in _jit_call_sites(ctx):
+        if not call.args:
+            continue
+        fn = call.args[0]
+        if isinstance(fn, ast.Lambda):
+            traced.append(fn)
+        elif isinstance(fn, ast.Name):
+            target = local_defs.get((ctx.scope_of(call), fn.id))
+            if target is not None:
+                traced.append(target)
+    if ctx.project is not None:
+        quals = ctx.project.traced_quals(ctx.path)
+        for node in ctx.walk():
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and ctx.qualname_of(node) in quals
+                    and node not in traced):
+                traced.append(node)
+    return traced
+
+
+def no_timeout(call: ast.Call) -> bool:
+    """True when the call carries neither timeout= nor **kwargs."""
+    names = {kw.arg for kw in call.keywords}
+    return "timeout" not in names and None not in names

@@ -1,0 +1,255 @@
+"""Blocking-call rules: RT003 actor-side gets, RT008 event-loop blocks.
+
+RT003 is the PR 3 actor-deadlock class, now call-graph-aware: helpers
+*reachable from* actor methods are in actor context even when they live
+in another file. RT008 encodes the CoreClient/serve event-loop class:
+a synchronous sleep/socket/get inside an ``async def`` stalls every
+coroutine sharing the loop — heartbeats miss, deadlines fire late, and
+the whole client looks dead while one handler naps.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule, _dotted, no_timeout
+
+
+class ActorBlockingRule(Rule):
+    """RT003: unbounded blocking get inside an actor method.
+
+    An actor method that calls ``rt.get``/``rt.wait`` (or
+    ``response.result()``) with no ``timeout=`` can deadlock the whole
+    actor: if the awaited task (transitively) needs *this* actor — or
+    its worker died without the GCS noticing yet — the method never
+    returns and every queued caller hangs behind it. The same applies
+    to control-plane helpers (serve/train/collective modules) and — v2,
+    via the project call graph — to any function *reachable from* an
+    actor method, whatever file it lives in (``_private/`` runtime
+    internals excluded: the core client manages its own deadlines).
+    Thread a deadline through (RT_COLLECTIVE_OP_TIMEOUT_S-style
+    config), and handle GetTimeoutError.
+    """
+
+    id = "RT003"
+    name = "actor-blocking-get"
+
+    # Control-plane modules whose free functions execute in actor
+    # context (same scoping as RT007).
+    _SCOPES = ("serve/", "train/", "util/collective/")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        in_control_plane = any(s in ctx.path for s in self._SCOPES)
+        seen: set = set()
+        for cls in ctx.walk():
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(self._is_remote_decorator(ctx, d)
+                       for d in cls.decorator_list):
+                continue
+            for node in ctx.walk(cls):
+                if not isinstance(node, ast.Call):
+                    continue
+                op = self._blocking_op(ctx, node)
+                if op is None:
+                    continue
+                seen.add(id(node))
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` without timeout= inside actor "
+                    f"`{cls.name}` — a dead or self-dependent callee "
+                    f"deadlocks this actor and everything queued on it; "
+                    f"pass a deadline and handle GetTimeoutError",
+                    token=op)
+        if in_control_plane:
+            for node in ctx.walk():
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                op = self._blocking_op(ctx, node)
+                if op is None:
+                    continue
+                seen.add(id(node))
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` without timeout= in a control-plane module — "
+                    f"this helper runs inside actors (collective bootstrap, "
+                    f"serve/train plumbing) where an unbounded block "
+                    f"deadlocks the caller; pass a deadline and handle "
+                    f"GetTimeoutError",
+                    token=op)
+            return
+        # v2: functions reachable from actor methods through the call
+        # graph, outside the runtime's own _private/ internals.
+        if ctx.project is None or "_private/" in ctx.path:
+            return
+        reach = ctx.project.actor_reach_quals(ctx.path)
+        if not reach:
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                continue
+            qual = ctx.qualname_of(fn)
+            if qual not in reach:
+                continue
+            op = self._blocking_op(ctx, node)
+            if op is None:
+                continue
+            root = reach[qual].split("::", 1)[-1]
+            yield self.finding(
+                ctx, node,
+                f"`{op}` without timeout= in `{qual}`, which is "
+                f"reachable from actor method `{root}` via the call "
+                f"graph — an unbounded block there deadlocks the actor; "
+                f"pass a deadline and handle GetTimeoutError",
+                token=op)
+
+    @staticmethod
+    def _is_remote_decorator(ctx: FileContext, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        if isinstance(dec, ast.Attribute):
+            return (dec.attr == "remote" and isinstance(dec.value, ast.Name)
+                    and dec.value.id in ctx.rt_aliases)
+        if isinstance(dec, ast.Name):
+            return (dec.id == "remote"
+                    and ctx.from_imports.get(dec.id, "") == "ray_tpu")
+        return False
+
+    @staticmethod
+    def _blocking_op(ctx: FileContext, call: ast.Call) -> Optional[str]:
+        if not no_timeout(call):
+            return None
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            if func.value.id in ctx.rt_aliases and func.attr in {"get",
+                                                                 "wait"}:
+                return f"rt.{func.attr}"
+        if (isinstance(func, ast.Name) and func.id in {"get", "wait"}
+                and ctx.from_imports.get(func.id, "") == "ray_tpu"):
+            return func.id
+        if (isinstance(func, ast.Attribute) and func.attr == "result"
+                and not call.args):
+            return ".result()"
+        return None
+
+
+class AsyncBlockingRule(Rule):
+    """RT008: synchronous blocking call on an event loop.
+
+    ``time.sleep``, socket recv/accept/sendall, ``subprocess.run``,
+    unbounded ``rt.get``/``.result()`` or blocking ``queue.get()``
+    inside an ``async def`` freezes the whole event loop, not just the
+    calling coroutine: on the CoreClient loop that stalls every
+    in-flight pull and deadline timer; on the serve loop it stalls every
+    request on the replica (the exact head-of-line shape the PR 7
+    watchdog measures). Use ``await asyncio.sleep``, loop executors
+    (``run_in_executor``/``to_thread``) for truly blocking work, or the
+    async variants. v2 is call-graph-aware: a *sync* helper only ever
+    called from async context is flagged too, unless it is handed to a
+    thread/executor.
+    """
+
+    id = "RT008"
+    name = "blocking-call-in-async"
+
+    # Popen is included: the fork+exec itself stalls the loop (page-
+    # cache misses, audit hooks), and the usual next line is a blocking
+    # .wait()/.communicate(). asyncio.create_subprocess_exec is the
+    # loop-safe spelling.
+    _SUBPROCESS = {"run", "call", "check_output", "check_call", "Popen"}
+    _SOCKET_ATTRS = {"recv", "recv_into", "accept", "sendall"}
+    _SOCKET_HINTS = ("sock", "conn")
+    _QUEUE_HINTS = ("queue", "_q")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        async_quals: Set[str] = set()
+        if ctx.project is not None:
+            async_quals = ctx.project.async_quals(ctx.path)
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                continue
+            qual = ctx.qualname_of(fn)
+            is_direct = isinstance(fn, ast.AsyncFunctionDef)
+            if not is_direct and qual not in async_quals:
+                continue
+            if self._is_awaited(ctx, node) or self._off_loop(ctx, node):
+                continue
+            op = self._blocking_op(ctx, node)
+            if op is None:
+                continue
+            where = ("an `async def`" if is_direct else
+                     f"`{qual}`, a sync helper only called from async "
+                     f"context")
+            yield self.finding(
+                ctx, node,
+                f"`{op}` inside {where} blocks the whole event loop — "
+                f"every coroutine sharing it (request handlers, "
+                f"deadline timers, heartbeats) stalls; use the await-"
+                f"able form or push it to an executor thread",
+                token=op)
+
+    @staticmethod
+    def _is_awaited(ctx: FileContext, call: ast.Call) -> bool:
+        return isinstance(ctx.parent(call), ast.Await)
+
+    @staticmethod
+    def _off_loop(ctx: FileContext, call: ast.Call) -> bool:
+        """Is this call an *argument* being shipped to an executor
+        (run_in_executor(None, f, ...)) rather than invoked here?"""
+        parent = ctx.parent(call)
+        if isinstance(parent, ast.Call):
+            leaf = _dotted(parent.func).rsplit(".", 1)[-1]
+            if leaf in {"run_in_executor", "to_thread", "submit",
+                        "Thread"}:
+                return True
+        return False
+
+    def _blocking_op(self, ctx: FileContext,
+                     call: ast.Call) -> Optional[str]:
+        func = call.func
+        dotted = _dotted(func)
+        leaf = dotted.rsplit(".", 1)[-1] if dotted else ""
+        # time.sleep (or bare sleep imported from time)
+        if isinstance(func, ast.Attribute) and func.attr == "sleep" \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ctx.time_aliases:
+            return "time.sleep"
+        if isinstance(func, ast.Name) and func.id == "sleep" \
+                and ctx.from_imports.get("sleep", "") == "time":
+            return "sleep"
+        # subprocess / os.system
+        if dotted in {f"subprocess.{m}" for m in self._SUBPROCESS} \
+                or dotted == "os.system":
+            return dotted
+        # unbounded rt.get / rt.wait / .result()
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            if (func.value.id in ctx.rt_aliases
+                    and func.attr in {"get", "wait"}
+                    and no_timeout(call)):
+                return f"rt.{func.attr}"
+        if isinstance(func, ast.Attribute) and func.attr == "result" \
+                and not call.args and no_timeout(call):
+            return ".result()"
+        # socket ops on sock-ish receivers
+        if isinstance(func, ast.Attribute) \
+                and func.attr in self._SOCKET_ATTRS:
+            base = _dotted(func.value).lower()
+            if any(h in base for h in self._SOCKET_HINTS):
+                return f".{func.attr}()"
+        # blocking queue.get() on queue-ish receivers
+        if isinstance(func, ast.Attribute) and func.attr == "get" \
+                and not call.args and no_timeout(call):
+            base = _dotted(func.value).lower()
+            if "queue" in base or base.endswith("_q"):
+                return ".get()"
+        return None

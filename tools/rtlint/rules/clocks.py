@@ -1,0 +1,209 @@
+"""RT011: clock-domain misuse.
+
+The runtime runs two clocks: ``time.time()`` (wall, cross-process
+comparable, steps under NTP) and ``time.monotonic()`` /
+``time.perf_counter()`` (per-process, duration-safe, meaningless across
+processes). The loadgen/latency work (PR 12) was explicit that
+perf_counter values are "never differenced against server clocks";
+deadline_ts (PR 8) is wall-clock by contract. Mixing domains in one
+subtraction produces garbage that *looks* like a duration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule, _dotted
+
+
+_WALL_CALLS = {"time.time", "time"}
+_MONO_CALLS = {"time.monotonic", "monotonic", "time.perf_counter",
+               "perf_counter", "time.monotonic_ns", "monotonic_ns",
+               "time.perf_counter_ns", "perf_counter_ns"}
+
+# name-shape fallbacks when we can't see the producing call
+# NB: bare "deadline" is NOT a wall hint — repo convention computes
+# local deadlines as monotonic() + timeout; only the _ts suffix (the
+# PR 8 wire field deadline_ts) marks a wall epoch.
+_WALL_HINTS = ("deadline_ts", "_ts", "wall", "epoch_s", "mtime")
+_MONO_HINTS = ("mono", "perf", "_t0", "_t1")
+
+
+def _clock_of_call(node: ast.Call) -> Optional[str]:
+    dotted = _dotted(node.func)
+    leaf = dotted.rsplit(".", 1)[-1]
+    if dotted in _MONO_CALLS or leaf in {"monotonic", "perf_counter",
+                                         "monotonic_ns",
+                                         "perf_counter_ns"}:
+        return "mono"
+    if dotted == "time.time" or (leaf == "time"
+                                 and dotted.endswith("time.time")):
+        return "wall"
+    if dotted == "time" or leaf == "time":
+        # bare time() — only trust it when the receiver is the module
+        if dotted in ("time", "time.time"):
+            return "wall"
+    return None
+
+
+def _clock_of_expr(node: ast.AST) -> Optional[str]:
+    """Domain of an arbitrary value expression: the domain of the clock
+    calls it contains, when they all agree (``monotonic() + timeout`` is
+    mono; ``time.time() + budget`` is wall; a mix resolves to nothing)."""
+    if isinstance(node, ast.Call):
+        d = _clock_of_call(node)
+        if d:
+            return d
+    found = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = _clock_of_call(sub)
+            if d:
+                found.add(d)
+    return found.pop() if len(found) == 1 else None
+
+
+def _name_of(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class ClockDomainRule(Rule):
+    """RT011: wall clock differenced against a monotonic clock.
+
+    Tracks, per function, which clock produced each local: an assignment
+    from ``time.time()`` is wall; from ``monotonic()``/``perf_counter()``
+    is mono. Parameters and attributes fall back to name shape —
+    ``deadline_ts``/``*_ts`` are wall by repo convention (PR 8),
+    ``*mono*``/``*perf*`` are monotonic. Any ``a - b`` or comparison
+    where the two operands provably live in different domains is flagged:
+    the result is the offset between two unrelated clocks, not a
+    duration, and it drifts with NTP steps. Also flags the inline form
+    ``time.time() - monotonic_value`` and deadline checks done against
+    the wrong clock. Values that really do bridge domains (a wall epoch
+    captured once to stitch cross-process timelines) should carry a
+    suppression comment explaining the stitching.
+    """
+
+    id = "RT011"
+    name = "clock-domain"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        funcs = [n for n in ctx.walk()
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            yield from self._check_function(ctx, fn)
+
+    def _check_function(self, ctx: FileContext, fn) -> Iterator[Finding]:
+        domains: Dict[str, str] = {}
+        # parameters by name shape
+        for a in (fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs):
+            d = self._hint_domain(a.arg)
+            if d:
+                domains[a.arg] = d
+        # assignments from clock calls (last-writer-wins, in line order)
+        assigns = []
+        for node in ctx.walk(fn):
+            if isinstance(node, ast.Assign):
+                d = _clock_of_expr(node.value)
+                if d:
+                    for tgt in node.targets:
+                        name = _name_of(tgt)
+                        if name:
+                            assigns.append((node.lineno, name, d))
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                d = _clock_of_expr(node.value)
+                name = _name_of(node.target)
+                if d and name:
+                    assigns.append((node.lineno, name, d))
+        for _, name, d in sorted(assigns, key=lambda t: t[0]):
+            domains[name] = d
+
+        for node in ctx.walk(fn):
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.Sub):
+                yield from self._check_pair(ctx, domains, node,
+                                            node.left, node.right,
+                                            "differenced")
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Lt, ast.LtE,
+                                                 ast.Gt, ast.GtE)):
+                yield from self._check_pair(ctx, domains, node,
+                                            node.left,
+                                            node.comparators[0],
+                                            "compared")
+
+    def _check_pair(self, ctx: FileContext, domains: Dict[str, str],
+                    site: ast.AST, left: ast.AST, right: ast.AST,
+                    verb: str) -> Iterator[Finding]:
+        dl = self._domain_of(domains, left)
+        dr = self._domain_of(domains, right)
+        if dl and dr and dl != dr:
+            wall = left if dl == "wall" else right
+            mono = left if dl == "mono" else right
+            yield self.finding(
+                ctx, site,
+                f"wall-clock value `{self._pretty(wall)}` {verb} "
+                f"against monotonic value `{self._pretty(mono)}` — the "
+                f"result is the offset between two unrelated clocks, "
+                f"not a duration, and it moves with NTP steps; keep "
+                f"deadlines on time.time() and durations on "
+                f"monotonic/perf_counter",
+                token="clock-mix")
+            return
+        # wall-anchor shape: a *direct* time.time() call minus a local
+        # whose clock domain is not evident. Durations belong on the
+        # monotonic clock; if this is an intentional wall anchor for
+        # cross-process stitching, say so with a suppression.
+        if verb != "differenced":
+            return
+        for wall_side, other in ((left, right), (right, left)):
+            if (isinstance(wall_side, ast.Call)
+                    and _clock_of_call(wall_side) == "wall"
+                    and isinstance(other, ast.Name)
+                    and self._domain_of(domains, other) is None):
+                yield self.finding(
+                    ctx, site,
+                    f"direct `time.time()` differenced against "
+                    f"`{other.id}`, whose clock domain is not evident — "
+                    f"if `{other.id}` is a duration or monotonic value "
+                    f"this mixes clock domains (use monotonic for "
+                    f"durations); if it is a deliberate wall anchor for "
+                    f"cross-process stitching, suppress with that "
+                    f"rationale",
+                    token="wall-anchor")
+                return
+
+    def _domain_of(self, domains: Dict[str, str],
+                   node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            return _clock_of_call(node)
+        name = _name_of(node)
+        if name is None:
+            return None
+        if name in domains:
+            return domains[name]
+        return self._hint_domain(name)
+
+    @staticmethod
+    def _hint_domain(name: str) -> Optional[str]:
+        low = name.lower()
+        if any(h in low for h in _MONO_HINTS):
+            return "mono"
+        if any(low.endswith(h) or h in low for h in _WALL_HINTS):
+            return "wall"
+        return None
+
+    @staticmethod
+    def _pretty(node: ast.AST) -> str:
+        try:
+            return ast.unparse(node)
+        except Exception:
+            return "<expr>"

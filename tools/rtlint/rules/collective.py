@@ -1,0 +1,46 @@
+"""RT005: unfenced collective groups."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule, _dotted
+
+
+class CollectiveFenceRule(Rule):
+    """RT005: DCN collective group without a gang-epoch fence.
+
+    Collective rings rebuilt after a gang failure MUST be epoch-stamped:
+    without ``epoch=``, a zombie rank from the torn-down attempt can
+    find the new ring's rendezvous keys and splice into it, corrupting
+    every survivor's collective results (PR 2's fault model). Group
+    constructors default to epoch=0 — correct only for groups that are
+    never rebuilt, which a call site must assert by passing it
+    explicitly.
+    """
+
+    id = "RT005"
+    name = "unfenced-collective"
+
+    _CTORS = {"init_collective_group", "create_collective_group",
+              "DcnGroup", "HierarchicalGroup"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func).rsplit(".", 1)[-1]
+            if name not in self._CTORS:
+                continue
+            kwarg_names = {kw.arg for kw in node.keywords}
+            if "epoch" in kwarg_names or None in kwarg_names:  # **kwargs
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`{name}(...)` without an explicit gang-epoch fence "
+                f"(epoch=...): a stale rank from a torn-down gang can "
+                f"splice into the rebuilt ring — thread the gang epoch "
+                f"through (pass epoch=0 only for never-rebuilt groups)",
+                token=name)

@@ -1,0 +1,204 @@
+"""RT009: deadline/RequestMeta taint must flow into downstream hops.
+
+PR 8 built absolute-deadline propagation handle→proxy→replica→engine;
+its hardest bugs were *drops*: a function that received the deadline
+and then dispatched downstream work without it, silently converting a
+bounded request into an unbounded one. This rule is the interprocedural
+encoding: receiving ``deadline_ts``/``meta``/``RequestMeta`` makes a
+function responsible for every hop it performs or delegates.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule, _dotted
+from tools.rtlint.project import HOP_ATTRS, META_ANNOTATIONS, META_PARAMS
+
+
+class DeadlineTaintRule(Rule):
+    """RT009: received deadline/RequestMeta not forwarded downstream.
+
+    A function that *holds* the request deadline — a parameter named
+    ``deadline_ts``/``meta``/``request_meta``, a parameter annotated
+    ``RequestMeta``, or a local it constructs under one of those names
+    — and then performs a downstream hop
+    (``.remote(...)``, engine ``submit``, socket ``sendall``,
+    ``redispatch``/``_stream_call``) without the tainted value anywhere
+    in the hop's arguments has dropped the deadline: the downstream work
+    runs unbounded and cancel chains break mid-request (the PR 8 bug
+    class). Binding the thread-local card (``with bind(meta):`` /
+    ``make_wire_ctx``) counts as forwarding — the hop reads it
+    implicitly. Interprocedurally, calling a project function that
+    (transitively) hops *and advertises a meta parameter* without
+    passing the taint is the same drop, flagged at the delegating call
+    — that call site is the one place the deadline could have flowed.
+    """
+
+    id = "RT009"
+    name = "deadline-drop"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            taints = self._tainted_params(node) \
+                | self._tainted_locals(node)
+            if not taints:
+                continue
+            yield from self._check_function(ctx, node, taints)
+
+    @staticmethod
+    def _tainted_params(fn) -> Set[str]:
+        out: Set[str] = set()
+        for a in (fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs):
+            if a.arg in META_PARAMS:
+                out.add(a.arg)
+            elif a.annotation is not None:
+                try:
+                    anno = ast.unparse(a.annotation)
+                except Exception:
+                    anno = ""
+                if any(m in anno for m in META_ANNOTATIONS):
+                    out.add(a.arg)
+        return out
+
+    @staticmethod
+    def _tainted_locals(fn) -> Set[str]:
+        """Constructing the deadline locally (``deadline_ts = ...``,
+        ``meta = RequestMeta(...)``) makes the function just as
+        responsible for forwarding it as receiving it would. Own body
+        only: a nested def that builds its own deadline owns it (and is
+        analyzed on its own visit)."""
+        out: Set[str] = set()
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in META_PARAMS:
+                    out.add(t.id)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _check_function(self, ctx: FileContext, fn,
+                        taints: Set[str]) -> Iterator[Finding]:
+        if self._binds(ctx, fn, taints):
+            return  # thread-local card bound: hops read it implicitly
+        qual = ctx.qualname_of(fn)
+        for node in ctx.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_function(node) is not fn and \
+                    not self._same_body(ctx, fn, node):
+                continue
+            func = node.func
+            # direct hop without the taint in its arguments
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in HOP_ATTRS:
+                if not self._mentions_taint(node, taints):
+                    pretty = _dotted(func) or f".{func.attr}"
+                    yield self.finding(
+                        ctx, node,
+                        f"`{qual}` received the request deadline "
+                        f"({'/'.join(sorted(taints))}) but dispatches "
+                        f"`{pretty}(...)` without it — downstream work "
+                        f"runs unbounded and the cancel chain breaks; "
+                        f"forward the meta (or bind the thread-local "
+                        f"card first)",
+                        token=f".{func.attr}")
+                continue
+            # delegated hop: project callee that hops but cannot see
+            # the deadline, called without the taint
+            yield from self._check_delegation(ctx, fn, qual, node, taints)
+
+    def _check_delegation(self, ctx: FileContext, fn, qual: str,
+                          node: ast.Call,
+                          taints: Set[str]) -> Iterator[Finding]:
+        project = ctx.project
+        if project is None:
+            return
+        summary = project.by_path.get(ctx.path)
+        if summary is None:
+            return
+        fsum = summary["defs"].get(qual)
+        if fsum is None:
+            return
+        dotted = _dotted(node.func)
+        if not dotted or dotted.rsplit(".", 1)[-1] in HOP_ATTRS:
+            return
+        callee = project.resolve_call(summary, fsum, dotted)
+        if not callee or callee.startswith("<module>::"):
+            return
+        if callee not in project.hoppers:
+            return
+        # Only deadline-aware callees are a drop when called bare: they
+        # advertise a meta parameter (or read the bound card), so this
+        # call site is the one place the taint could have flowed.
+        # Hoppers that take no meta are routinely control-plane helpers
+        # (routing refresh, membership probes) whose traffic does not
+        # carry the request deadline by design.
+        if callee not in project.deadline_aware:
+            return
+        if self._mentions_taint(node, taints):
+            return
+        cname = callee.split("::", 1)[-1]
+        yield self.finding(
+            ctx, node,
+            f"`{qual}` received the request deadline "
+            f"({'/'.join(sorted(taints))}) but calls `{cname}` — "
+            f"which dispatches downstream work and accepts the "
+            f"meta — without passing it; the deadline is dropped "
+            f"at this hop boundary",
+            token=dotted.rsplit(".", 1)[-1])
+
+    # -- helpers ----------------------------------------------------------
+    @classmethod
+    def _same_body(cls, ctx: FileContext, fn, node) -> bool:
+        """node's enclosing function is fn itself, a lambda inside fn,
+        or a nested closure that receives no meta of its own — such a
+        closure sees fn's locals, so its hops are fn's hops. Nested
+        defs with their own tainted parameters own their analysis."""
+        cur = ctx.enclosing_function(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and cls._tainted_params(cur):
+                return False
+            cur = ctx.enclosing_function(cur)
+        return cur is fn
+
+    @staticmethod
+    def _binds(ctx: FileContext, fn, taints: Set[str]) -> bool:
+        for node in ctx.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _dotted(node.func).rsplit(".", 1)[-1]
+            if leaf in {"bind", "make_wire_ctx", "set_request_meta"}:
+                if any(isinstance(a, ast.Name) and a.id in taints
+                       for a in node.args) or not node.args:
+                    return True
+                # bind(meta.something) / bind(RequestMeta(...))
+                for a in node.args:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Name) and sub.id in taints:
+                            return True
+        return False
+
+    @staticmethod
+    def _mentions_taint(call: ast.Call, taints: Set[str]) -> bool:
+        for part in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(part):
+                if isinstance(sub, ast.Name) and sub.id in taints:
+                    return True
+        return False

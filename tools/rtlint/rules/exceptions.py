@@ -1,0 +1,85 @@
+"""RT007: swallowed control-plane exceptions (call-graph-aware)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule
+
+
+class SwallowRule(Rule):
+    """RT007: broad except that swallows control-plane errors.
+
+    In serve/train/collective modules, ``except Exception: pass`` (or a
+    constant-return/constant-assign body) silently eats
+    ``TrainingFailedError``, ``CollectiveTimeoutError``, actor-death
+    errors — exactly the signals fault tolerance is built on. v2 is
+    call-graph-aware: a helper in any module *reachable from* control-
+    plane code is in scope too (``_private/`` runtime internals
+    excluded), because its swallow eats the same signals when called
+    from serve/train paths. Narrow the type to what the block can
+    actually handle, or log at warning with the rank/replica identity
+    before falling through.
+    """
+
+    id = "RT007"
+    name = "swallowed-exception"
+
+    _SCOPES = ("serve/", "train/", "util/collective/")
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        in_scope = any(s in ctx.path for s in self._SCOPES)
+        reach = {}
+        if (not in_scope and ctx.project is not None
+                and "_private/" not in ctx.path):
+            reach = ctx.project.control_reach_quals(ctx.path)
+        if not in_scope and not reach:
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if not all(self._swallows(stmt) for stmt in node.body):
+                continue
+            suffix = ""
+            if not in_scope:
+                fn = ctx.enclosing_function(node)
+                qual = ctx.qualname_of(fn) if fn is not None else None
+                if qual is None or qual not in reach:
+                    continue
+                root = reach[qual].split("::", 1)[-1]
+                suffix = (f" (this helper is reachable from control-"
+                          f"plane code via `{root}`)")
+            yield self.finding(
+                ctx, node,
+                "broad except with a swallow-only body: "
+                "TrainingFailedError / CollectiveTimeoutError / actor "
+                "death would vanish here — narrow the exception type or "
+                "log at warning with the rank/replica identity" + suffix,
+                token="swallow")
+
+    @classmethod
+    def _is_broad(cls, type_node) -> bool:
+        if type_node is None:  # bare except
+            return True
+        nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        return any(isinstance(n, ast.Name) and n.id in cls._BROAD
+                   for n in nodes)
+
+    @staticmethod
+    def _swallows(stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            return True
+        if isinstance(stmt, ast.Return):
+            return stmt.value is None or isinstance(
+                stmt.value, (ast.Constant, ast.Name))
+        if isinstance(stmt, ast.Assign):
+            return isinstance(stmt.value, (ast.Constant, ast.Name,
+                                           ast.List, ast.Dict, ast.Set,
+                                           ast.Tuple))
+        return False

@@ -1,0 +1,342 @@
+"""jit-related rules: RT001 host-sync, RT002 retrace, RT012 donation.
+
+RT001 and RT002 are the PR 1 bug classes (the 27x-slow eager serving
+loop); RT012 encodes the paged-KV donated-buffer hazard from PR 11:
+``cow_copy_page``/``decode_paged`` donate their KV operands, so reusing
+the donated python name after the call reads a deleted buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import (Rule, _is_jit_expr, _jit_call_sites,
+                                     _traced_bodies)
+
+# Host-sync operations: each forces (or implies) a device->host transfer
+# the TPU pipeline must drain for.
+_SYNC_ATTRS = {"item", "block_until_ready", "copy_to_host"}
+_NP_CONVERTERS = {"asarray", "array"}
+
+
+class HostSyncRule(Rule):
+    """RT001: device->host sync reachable from traced or hot-loop code.
+
+    Inside a jit-traced function, ``.item()`` / ``float()`` / ``int()``
+    on arrays, ``np.asarray``, ``jax.device_get`` and
+    ``block_until_ready`` either fail at trace time or silently force a
+    sync on every call. Outside traced code, the same syncs inside a
+    ``for``/``while`` body are the per-step host round trips that made
+    the serving engine 27x slower than its raw decode floor (PR 1).
+    v2: "traced" is call-graph-aware — a helper every project caller of
+    which is jit-traced counts as traced too, even across files.
+    """
+
+    id = "RT001"
+    name = "host-sync-in-hot-path"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        traced = _traced_bodies(ctx)
+        traced_nodes: Set[int] = set()
+        for t in traced:
+            for node in ctx.walk(t):
+                traced_nodes.add(id(node))
+
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            op = self._sync_op(ctx, node, in_traced=id(node) in traced_nodes)
+            if op is None:
+                continue
+            if id(node) in traced_nodes:
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` inside a jit-traced function (or a helper "
+                    f"only ever called from traced code) forces a "
+                    f"device->host sync (or fails at trace time); hoist "
+                    f"it out of the traced body",
+                    token=op)
+            elif ctx.in_loop(node):
+                yield self.finding(
+                    ctx, node,
+                    f"`{op}` inside a loop body syncs host<->device every "
+                    f"iteration — batch it, move it off-step, or fetch "
+                    f"async (copy_to_host_async) and drain once",
+                    token=op)
+
+    @staticmethod
+    def _sync_op(ctx: FileContext, call: ast.Call,
+                 in_traced: bool) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_ATTRS:
+                return f".{func.attr}()"
+            if (isinstance(func.value, ast.Name)
+                    and func.value.id in ctx.jax_aliases
+                    and func.attr in {"device_get", "block_until_ready"}):
+                return f"jax.{func.attr}"
+            # np.asarray/np.array only matter under tracing (outside,
+            # numpy conversions in loops are ordinary host code).
+            if (in_traced and isinstance(func.value, ast.Name)
+                    and func.value.id in ctx.np_aliases
+                    and func.attr in _NP_CONVERTERS):
+                return f"np.{func.attr}"
+        elif (in_traced and isinstance(func, ast.Name)
+                and func.id in {"float", "int", "bool"}
+                and len(call.args) == 1
+                and not isinstance(call.args[0], ast.Constant)):
+            return f"{func.id}()"
+        return None
+
+
+class RetraceRule(Rule):
+    """RT002: jit retrace risk.
+
+    ``jax.jit(...)`` evaluated inside a loop body builds a *fresh*
+    compiled-function cache every iteration — every call recompiles
+    (this, not the math, was most of the serving engine's original 27x
+    gap). A ``@jit`` decorator on a def nested in a loop is the same bug.
+    A mutable (list/set/dict) ``static_argnums``/``static_argnames``
+    spec can be mutated between calls, changing the cache key and
+    silently retracing.
+    """
+
+    id = "RT002"
+    name = "retrace-risk"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for call in _jit_call_sites(ctx):
+            if ctx.in_loop(call):
+                yield self.finding(
+                    ctx, call,
+                    "jax.jit called inside a loop body: each iteration "
+                    "builds a fresh jit wrapper with an empty cache, so "
+                    "every call recompiles — hoist the jit out of the "
+                    "loop",
+                    token="jit-in-loop")
+            for kw in call.keywords:
+                if (kw.arg in {"static_argnums", "static_argnames"}
+                        and isinstance(kw.value,
+                                       (ast.List, ast.Set, ast.Dict))):
+                    yield self.finding(
+                        ctx, kw.value,
+                        f"{kw.arg} given a mutable {type(kw.value).__name__.lower()} "
+                        f"literal — mutation between calls changes the "
+                        f"cache key and silently retraces; pass a tuple",
+                        token=f"static-{kw.arg}")
+        for node in ctx.walk():
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and ctx.in_loop(node)
+                    and any(_is_jit_expr(ctx, d)
+                            for d in node.decorator_list)):
+                yield self.finding(
+                    ctx, node,
+                    f"@jit-decorated def `{node.name}` inside a loop body "
+                    f"re-wraps (and re-traces) every iteration — define "
+                    f"it once outside the loop",
+                    token="jit-def-in-loop")
+
+
+class DonatedReuseRule(Rule):
+    """RT012: donated buffer used again after the jitted call.
+
+    A jit wrapper built with ``donate_argnums`` *deletes* the donated
+    operands when called: XLA reuses their memory for the outputs. Using
+    the donated python name again before rebinding it reads a dead
+    buffer — jax raises on CPU but on TPU with async dispatch this can
+    surface as silent corruption (the paged-KV ``cow_copy_page``/
+    ``decode_paged`` hazard, PR 11). The safe idiom rebinds at the call:
+    ``kv = self._decode(kv, ...)``. Rebinding kills the taint; a use in
+    an earlier loop iteration than the call is not tracked (the rule is
+    flow-insensitive across loop back-edges — suppress with a comment
+    if the loop rebinds before the use).
+    """
+
+    id = "RT012"
+    name = "donated-buffer-reuse"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        wrappers = self._donating_wrappers(ctx)
+        if not wrappers:
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            key = self._wrapper_key(node.func)
+            if key not in wrappers:
+                continue
+            donated = wrappers[key]
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                continue
+            for idx in donated:
+                if idx >= len(node.args):
+                    continue
+                arg = node.args[idx]
+                name = self._trackable(arg)
+                if name is None:
+                    continue
+                pretty = name[1] if name[0] == "name" \
+                    else f"self.{name[1]}"
+                use = self._use_after(ctx, fn, node, name)
+                if use is not None:
+                    yield self.finding(
+                        ctx, use,
+                        f"`{pretty}` was donated to `{key[1]}` (donate_"
+                        f"argnums) on line {node.lineno} and used again "
+                        f"here without rebinding — the buffer was "
+                        f"deleted at the call; rebind the result "
+                        f"(`{pretty} = {key[1]}(...)`) or drop "
+                        f"donation for this operand",
+                        token=pretty)
+                    continue
+                use = self._except_path_use(ctx, fn, node, name)
+                if use is not None:
+                    yield self.finding(
+                        ctx, use,
+                        f"`{pretty}` was donated to `{key[1]}` inside "
+                        f"a try whose except handler swallows the "
+                        f"failure without rebinding it — on the "
+                        f"exception path the donated buffer may already "
+                        f"be deleted, so this use reads dead memory; "
+                        f"rebuild `{pretty}` in the handler or re-raise",
+                        token=pretty)
+
+    # -- wrapper discovery ------------------------------------------------
+    @staticmethod
+    def _donate_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    out = []
+                    for e in v.elts:
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, int):
+                            out.append(e.value)
+                    return tuple(out)
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+        return None
+
+    def _donating_wrappers(self, ctx: FileContext) -> Dict:
+        """('name', x) / ('attr', x) -> donated index tuple, for every
+        `x = jit(..., donate_argnums=...)` / `self.x = jit(...)`."""
+        wrappers: Dict = {}
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _is_jit_expr(ctx, node.value)):
+                continue
+            donated = self._donate_indices(node.value)
+            if not donated:
+                continue
+            for tgt in node.targets:
+                key = self._wrapper_key(tgt)
+                if key is not None:
+                    wrappers[key] = donated
+        return wrappers
+
+    @staticmethod
+    def _wrapper_key(node: ast.AST):
+        if isinstance(node, ast.Name):
+            return ("name", node.id)
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return ("attr", node.attr)
+        return None
+
+    @staticmethod
+    def _trackable(arg: ast.AST):
+        if isinstance(arg, ast.Name):
+            return ("name", arg.id)
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"):
+            return ("attr", arg.attr)
+        return None
+
+    @staticmethod
+    def _except_path_use(ctx: FileContext, fn: ast.AST, call: ast.Call,
+                         name) -> Optional[ast.AST]:
+        """Donating call inside a try whose except handler neither
+        re-raises nor rebinds the donated name: on the exception path
+        the normal-path rebind never ran, so a use in the handler or
+        after the try reads a (possibly) dead buffer."""
+        cur: ast.AST = call
+        parent = ctx.parent(cur)
+        enclosing: Optional[ast.Try] = None
+        while parent is not None and not isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+            if isinstance(parent, ast.Try) and any(
+                    cur is stmt for stmt in parent.body):
+                enclosing = parent
+                break
+            cur, parent = parent, ctx.parent(parent)
+        if enclosing is None or not enclosing.handlers:
+            return None
+
+        def stores(scope) -> bool:
+            for n in ast.walk(scope):
+                if isinstance(n, (ast.Name, ast.Attribute)) \
+                        and isinstance(n.ctx, ast.Store) \
+                        and DonatedReuseRule._trackable(n) == name:
+                    return True
+            return False
+
+        swallowing = [h for h in enclosing.handlers
+                      if not any(isinstance(n, ast.Raise)
+                                 for n in ast.walk(h))
+                      and not stores(h)]
+        if not swallowing:
+            return None
+        # a use inside a swallowing handler is the sharpest evidence
+        for h in swallowing:
+            for n in ast.walk(h):
+                if isinstance(n, (ast.Name, ast.Attribute)) \
+                        and isinstance(getattr(n, "ctx", None), ast.Load) \
+                        and DonatedReuseRule._trackable(n) == name:
+                    return n
+        # otherwise: first use after the try completes
+        try_end = enclosing.end_lineno or enclosing.lineno
+        after = [(n.lineno, n) for n in ctx.walk(fn)
+                 if isinstance(n, (ast.Name, ast.Attribute))
+                 and isinstance(getattr(n, "ctx", None), ast.Load)
+                 and DonatedReuseRule._trackable(n) == name
+                 and n.lineno > try_end]
+        if not after:
+            return None
+        after.sort(key=lambda t: t[0])
+        return after[0][1]
+
+    @staticmethod
+    def _use_after(ctx: FileContext, fn: ast.AST, call: ast.Call,
+                   name) -> Optional[ast.AST]:
+        """First Load of `name` after `call` within `fn` not preceded by
+        a rebinding store. Line-ordered (flow-insensitive in loops)."""
+        call_end = call.end_lineno or call.lineno
+        kills: List[int] = []
+        uses: List[Tuple[int, ast.AST]] = []
+        for node in ctx.walk(fn):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                key = DonatedReuseRule._trackable(node)
+                if key != name:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    kills.append(node.lineno)
+                elif isinstance(node.ctx, ast.Load) and \
+                        node.lineno > call_end:
+                    # skip the donated arg itself / same-call uses
+                    uses.append((node.lineno, node))
+        if not uses:
+            return None
+        uses.sort()
+        for line, node in uses:
+            if any(call.lineno <= k <= line for k in kills):
+                return None   # rebound before (line-wise) this use
+            return node
+        return None

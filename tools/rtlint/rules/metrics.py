@@ -1,0 +1,196 @@
+"""RT013: metrics discipline — stable boundaries, bounded label sets.
+
+``ray_tpu.util.metrics`` aggregates histograms by *identity* of their
+boundary tuples and exports one time series per distinct tag set.
+Mutating a shared boundary sequence corrupts every histogram already
+bucketed against it; tagging a metric with a per-request value (rid,
+idem_key, prompt text, raw hash) makes series cardinality grow with
+traffic until the registry is effectively an unbounded log.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule, _dotted
+
+
+_METRIC_FNS = {"inc", "set", "observe", "inc_keyed", "set_keyed",
+               "observe_keyed", "labels"}
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "sort", "reverse", "__setitem__"}
+# identifiers that are per-request by repo convention; "tenant" rides
+# in on every trace header, so it is unbounded unless validated against
+# a fixed admission table (the suppression case).
+_REQUEST_IDS = {"rid", "request_id", "req_id", "idem_key", "trace_id",
+                "prompt", "span_id", "tenant", "tenant_id"}
+_HASHERS = {"hash", "hexdigest", "md5", "sha1", "sha256", "uuid4",
+            "uuid1", "token_hex"}
+
+
+class MetricsDisciplineRule(Rule):
+    """RT013: mutated histogram boundaries / unbounded metric labels.
+
+    Two shapes. (a) Boundary mutation: any in-place mutation of a
+    ``*BOUNDARIES*``-named sequence (``.append``/``.sort``/subscript
+    store/augassign) or passing a mutable ``boundaries=[...]`` list
+    literal — boundaries are aggregation keys and must be immutable
+    tuples frozen at import. (b) Cardinality: a metric call (``inc``/
+    ``set``/``observe``/``*_keyed``/``labels``) whose tag *value* is a
+    per-request identifier (``rid``/``request_id``/``idem_key``/
+    ``trace_id``/``prompt``…), an f-string or ``str()`` of one, or a
+    fresh hash/uuid — each request mints a new time series and the
+    registry grows without bound. Tag with the bounded dimension
+    (tenant *from admission config*, model, replica role) instead; a
+    deliberately-bounded value that merely looks per-request (e.g. a
+    tenant id validated against a fixed admission table) is the
+    suppression case — say where the bound comes from.
+    """
+
+    id = "RT013"
+    name = "metrics-discipline"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk():
+            if isinstance(node, ast.Call):
+                yield from self._check_boundary_mutation_call(ctx, node)
+                yield from self._check_boundary_literal(ctx, node)
+                yield from self._check_cardinality(ctx, node)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                yield from self._check_boundary_store(ctx, node)
+
+    # -- (a) boundary mutation -------------------------------------------
+    @staticmethod
+    def _is_boundary_name(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name) and "BOUNDARIES" in node.id.upper():
+            return node.id
+        if isinstance(node, ast.Attribute) and \
+                "BOUNDARIES" in node.attr.upper():
+            return node.attr
+        return None
+
+    def _check_boundary_mutation_call(self, ctx: FileContext,
+                                      node: ast.Call) -> Iterator[Finding]:
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _MUTATORS):
+            return
+        name = self._is_boundary_name(func.value)
+        if name is None:
+            return
+        yield self.finding(
+            ctx, node,
+            f"`{name}.{func.attr}(...)` mutates histogram boundaries "
+            f"in place — boundaries are aggregation keys shared by "
+            f"every histogram bucketed against them; build a new tuple "
+            f"instead",
+            token=name)
+
+    def _check_boundary_store(self, ctx: FileContext,
+                              node) -> Iterator[Finding]:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            if isinstance(tgt, ast.Subscript):
+                name = self._is_boundary_name(tgt.value)
+                if name is not None:
+                    yield self.finding(
+                        ctx, node,
+                        f"subscript store into `{name}` rewrites a "
+                        f"bucket edge under live histograms — "
+                        f"boundaries must stay frozen; build a new "
+                        f"tuple and re-register",
+                        token=name)
+            elif isinstance(node, ast.AugAssign):
+                name = self._is_boundary_name(tgt)
+                if name is not None:
+                    yield self.finding(
+                        ctx, node,
+                        f"augmented assignment to `{name}` mutates "
+                        f"shared histogram boundaries — build a new "
+                        f"tuple instead",
+                        token=name)
+
+    def _check_boundary_literal(self, ctx: FileContext,
+                                node: ast.Call) -> Iterator[Finding]:
+        for kw in node.keywords:
+            if kw.arg == "boundaries" and isinstance(kw.value, ast.List):
+                yield self.finding(
+                    ctx, node,
+                    "boundaries= passed as a mutable list literal — "
+                    "histograms key aggregation on the boundary object; "
+                    "pass a tuple so it cannot be mutated after "
+                    "registration",
+                    token="boundaries")
+
+    # -- (b) label cardinality -------------------------------------------
+    def _check_cardinality(self, ctx: FileContext,
+                           node: ast.Call) -> Iterator[Finding]:
+        func = node.func
+        leaf = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        if leaf not in _METRIC_FNS:
+            return
+        # collect candidate tag values: tags={...} dict values,
+        # labels(**) keyword values, key= for *_keyed
+        values = []
+        for kw in node.keywords:
+            if kw.arg in ("tags", "labels") and \
+                    isinstance(kw.value, ast.Dict):
+                values.extend((v, self._dict_key(k))
+                              for k, v in zip(kw.value.keys,
+                                              kw.value.values))
+            elif kw.arg == "key":
+                values.append((kw.value, "key"))
+            elif leaf == "labels" and kw.arg is not None:
+                values.append((kw.value, kw.arg))
+        for value, label in values:
+            why = self._per_request(value)
+            if why is None:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"metric tag `{label}` is fed a per-request value "
+                f"({why}) — every request mints a new time series and "
+                f"the registry grows without bound; tag with a bounded "
+                f"dimension (tenant from admission config, model, "
+                f"replica role) and put request ids in logs/traces",
+                token=str(label))
+
+    @staticmethod
+    def _dict_key(k) -> str:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            return k.value
+        return "<tag>"
+
+    @classmethod
+    def _per_request(cls, value: ast.AST) -> Optional[str]:
+        """Returns a human reason if the expression is per-request."""
+        def leaf_id(n) -> Optional[str]:
+            if isinstance(n, ast.Name):
+                return n.id
+            if isinstance(n, ast.Attribute):
+                return n.attr
+            return None
+
+        name = leaf_id(value)
+        if name is not None and name.lower() in _REQUEST_IDS:
+            return f"`{name}`"
+        if isinstance(value, ast.JoinedStr):
+            for part in ast.walk(value):
+                if isinstance(part, ast.FormattedValue):
+                    inner = leaf_id(part.value)
+                    if inner and inner.lower() in _REQUEST_IDS:
+                        return f"f-string of `{inner}`"
+        if isinstance(value, ast.Call):
+            dotted = _dotted(value.func)
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf in _HASHERS:
+                return f"fresh `{leaf}(...)` value"
+            if leaf == "str" and value.args:
+                inner = leaf_id(value.args[0])
+                if inner and inner.lower() in _REQUEST_IDS:
+                    return f"str() of `{inner}`"
+        return None

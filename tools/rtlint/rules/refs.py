@@ -1,0 +1,43 @@
+"""RT004: discarded ObjectRefs."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule, _dotted
+
+
+class RefLeakRule(Rule):
+    """RT004: ObjectRef created and immediately discarded.
+
+    A bare ``f.remote(...)`` statement creates an ObjectRef nobody will
+    ever get() or store: the task's error (if any) is silently dropped,
+    and until the ref is GC'd its result pins object-store memory. Store
+    the ref, get() it, or — for intentional fire-and-forget — suppress
+    with a comment saying so.
+    """
+
+    id = "RT004"
+    name = "discarded-objectref"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            func = node.value.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr == "remote"):
+                continue
+            target = (func.value.attr
+                      if isinstance(func.value, ast.Attribute)
+                      else _dotted(func.value) or "<call>")
+            yield self.finding(
+                ctx, node,
+                f"ObjectRef from `{target}.remote(...)` is discarded — "
+                f"its error is silently dropped and its result pins "
+                f"store memory until GC; store/get the ref (or suppress "
+                f"if fire-and-forget is intended)",
+                token=target)

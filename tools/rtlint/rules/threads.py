@@ -1,0 +1,223 @@
+"""Thread-safety rules: RT006 cross-thread races, RT010 lock discipline.
+
+RT006 (PR 3) catches classes that share bare attributes with their own
+background thread. RT010 generalizes the ``dcn_group._accepted`` and
+PR 12 alive-flag incidents: once a class protects an attribute with
+``with self._lock`` on *any* write, every other method touching it bare
+is claiming a happens-before relationship the lock was bought to
+provide — usually wrongly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule, _dotted
+
+
+def _self_accesses(ctx: FileContext, method: ast.AST):
+    """Yields (attr, 'read'|'write', node, locked) for self.X uses.
+    A subscript/augmented store through self.X counts as a write of
+    X's contents."""
+    for node in ctx.walk(method):
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            continue
+        kind = "read"
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+        else:
+            parent = ctx.parent(node)
+            if (isinstance(parent, ast.Subscript)
+                    and isinstance(parent.ctx, (ast.Store, ast.Del))):
+                kind = "write"
+            elif isinstance(parent, ast.AugAssign) and \
+                    parent.target is node:
+                kind = "write"
+        yield node.attr, kind, node, ctx.under_lock(node)
+
+
+_SYNC_HINTS = ("lock", "event", "cond", "sem", "mutex")
+
+
+class ThreadRaceRule(Rule):
+    """RT006: unlocked cross-thread attribute access.
+
+    For every class that starts a ``threading.Thread`` on one of its own
+    methods, partition methods into thread-side (the target and
+    everything it transitively calls on self) and caller-side. An
+    attribute *written* without a lock on one side and *accessed*
+    without a lock on the other is a data race candidate. ``__init__``
+    writes are exempt (they happen-before the thread start); attributes
+    whose names say lock/event/cond are synchronization primitives, not
+    shared data.
+    """
+
+    id = "RT006"
+    name = "cross-thread-race"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls in ctx.walk():
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        targets = self._thread_targets(cls) & set(methods)
+        if not targets:
+            return
+        calls = {name: self._self_calls(ctx, node) & set(methods)
+                 for name, node in methods.items()}
+        thread_side = set(targets)
+        frontier = list(targets)
+        while frontier:
+            for callee in calls.get(frontier.pop(), ()):
+                if callee not in thread_side:
+                    thread_side.add(callee)
+                    frontier.append(callee)
+        # attr -> side -> {"write": [(node, locked)], "read": [...]}
+        access: Dict[str, Dict[str, Dict[str, List]]] = {}
+        for name, node in methods.items():
+            if name == "__init__":
+                continue  # happens-before thread start
+            side = "thread" if name in thread_side else "caller"
+            for attr, kind, anode, locked in _self_accesses(ctx, node):
+                if any(h in attr.lower() for h in _SYNC_HINTS):
+                    continue
+                access.setdefault(attr, {})[side] = slot = \
+                    access.setdefault(attr, {}).get(side,
+                                                    {"write": [],
+                                                     "read": []})
+                slot[kind].append((anode, locked))
+        for attr in sorted(access):
+            sides = access[attr]
+            if "thread" not in sides or "caller" not in sides:
+                continue
+            for wside, oside in (("thread", "caller"), ("caller", "thread")):
+                writes = [n for n, locked in sides[wside]["write"]
+                          if not locked]
+                others = [n for kind in ("write", "read")
+                          for n, locked in sides[oside][kind] if not locked]
+                if writes and others:
+                    node = min(writes, key=lambda n: n.lineno)
+                    yield self.finding(
+                        ctx, node,
+                        f"`self.{attr}` is written on the "
+                        f"{'thread' if wside == 'thread' else 'caller'} "
+                        f"side and accessed on the other side of "
+                        f"`{cls.name}`'s background thread with no lock "
+                        f"in scope on either access — take the class "
+                        f"lock (or make it an Event/queue)",
+                        token=attr, scope=ctx.scope_of(node))
+                    break  # one finding per attribute
+
+    @staticmethod
+    def _thread_targets(cls: ast.ClassDef) -> Set[str]:
+        targets: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func).rsplit(".", 1)[-1]
+            if name != "Thread":
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "target"
+                        and isinstance(kw.value, ast.Attribute)
+                        and isinstance(kw.value.value, ast.Name)
+                        and kw.value.value.id == "self"):
+                    targets.add(kw.value.attr)
+        return targets
+
+    @staticmethod
+    def _self_calls(ctx: FileContext, method: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ctx.walk(method):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                out.add(node.func.attr)
+        return out
+
+
+class LockDisciplineRule(Rule):
+    """RT010: attribute locked in one method, touched bare in another.
+
+    If any method writes ``self.X`` under ``with self._lock`` (or any
+    lock/cond), the class has declared X shared mutable state — so a
+    *different* method writing or reading X with no lock in scope is a
+    race: it can observe torn multi-field updates, or lose its write
+    entirely (the ``dcn_group._accepted`` incident, and PR 12's
+    alive-flag, which had to flip under the same lock as the pending-
+    faults check). Closures and thread-target bodies nested in a method
+    count as that method. ``__init__``/``__del__`` are exempt
+    (single-threaded construction/teardown), and so are methods whose
+    name ends in ``_locked`` — the repo-wide convention that the CALLER
+    holds the lock (the method is only ever invoked from inside a
+    ``with self._lock`` block). Attributes named like synchronization
+    primitives are skipped. Single-writer designs where a bare read is
+    intentionally racy (a stats snapshot, a fast-path hint) should say
+    so with a suppression comment.
+    """
+
+    id = "RT010"
+    name = "lock-discipline"
+
+    _EXEMPT = {"__init__", "__del__", "__enter__", "__exit__"}
+
+    @staticmethod
+    def _held_by_contract(name: str) -> bool:
+        return name.endswith("_locked")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls in ctx.walk():
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                   and n.name not in self._EXEMPT]
+        # attr -> {"locked_writers": {method}, "bare": [(line, node,
+        #          method, kind)]}
+        table: Dict[str, Dict] = {}
+        for m in methods:
+            held = self._held_by_contract(m.name)
+            for attr, kind, node, locked in _self_accesses(ctx, m):
+                if any(h in attr.lower() for h in _SYNC_HINTS):
+                    continue
+                locked = locked or held
+                slot = table.setdefault(attr, {"locked_writers": set(),
+                                               "bare": []})
+                if locked and kind == "write":
+                    slot["locked_writers"].add(m.name)
+                elif not locked:
+                    slot["bare"].append((node.lineno, node, m.name, kind))
+        for attr in sorted(table):
+            slot = table[attr]
+            if not slot["locked_writers"]:
+                continue
+            bare = [(ln, nd, meth, kind)
+                    for ln, nd, meth, kind in slot["bare"]
+                    if meth not in slot["locked_writers"]]
+            if not bare:
+                continue
+            bare.sort(key=lambda t: t[0])
+            ln, node, meth, kind = bare[0]
+            writers = ", ".join(sorted(slot["locked_writers"]))
+            yield self.finding(
+                ctx, node,
+                f"`self.{attr}` is written under lock in "
+                f"`{cls.name}.{writers}` but {'written' if kind == 'write' else 'read'} "
+                f"bare here in `{meth}` — the lock's happens-before "
+                f"does not cover this access; take the same lock (or "
+                f"suppress with the single-writer justification)",
+                token=attr, scope=ctx.scope_of(node))
