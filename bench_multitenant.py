@@ -32,6 +32,17 @@ SIMULTANEOUSLY — then takes the chips away and gives them back:
      force-released, spike placed, no wedged placement groups. Gates:
      release within grace + slack, outcome "hard_kill", spike placed,
      zero PENDING groups at the end.
+  5. elastic resize vs evict-and-restart: the same 8->4->8 partial
+     reclamation (chaos claims 4 of the gang's 8 chips, holds them,
+     lets go) hits two identical training runs. The elastic gang
+     shrinks in place (survivor keeps stepping on 4 chips, state
+     re-sharded through the object store) and grows back on the fence
+     lift; the fixed gang checkpoints, evicts, and sits idle until the
+     chips return. Gates: elastic run's step history is gapless across
+     both resizes (zero lost steps), its victim record closes with the
+     elastic outcome "resized", final loss matches the evict-restart
+     run exactly, and goodput (steps per wall-second through the
+     incident) beats the evict-and-restart baseline.
 
 Run: python bench_multitenant.py [--quick]  (--quick: shorter phases,
 no artifact). Exits non-zero when a gate fails.
@@ -76,6 +87,48 @@ def _train_loop(config):
     return
 
 
+def _elastic_vs_restart_loop(config):
+    """One loop, two failure modes. Elastic gangs resize through
+    train.sync_resize (live state handoff); fixed gangs checkpoint every
+    step and honor should_stop (the PR 2 migrate path). Reporting and
+    checkpoint cadence are identical so the goodput comparison is
+    fair."""
+    import time as _t
+
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint
+
+    state = {"w": np.zeros(4, dtype=np.float64), "steps_done": 0}
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        d = ckpt.to_dict()
+        state = {"w": np.asarray(d["w"]), "steps_done": d["steps_done"]}
+    shards = train.shard_state(
+        {"m": np.arange(32, dtype=np.float64)}, name="opt")
+    while state["steps_done"] < config["steps"]:
+        ev = train.sync_resize(state, shards)
+        if ev.exiting:
+            return  # departing rank: slice persisted, exit clean
+        state, shards = ev.state, ev.shards
+        _t.sleep(config["step_s"])
+        state["w"] += 1.0
+        state["steps_done"] += 1
+        ck = Checkpoint.from_dict(
+            {"w": state["w"].tolist(), "steps_done": state["steps_done"]})
+        if train.get_world_rank() == 0:
+            train.report(
+                {"step": state["steps_done"], "world": ev.world_size,
+                 "loss": abs(float(state["w"].mean())
+                             - state["steps_done"])},
+                checkpoint=ck)
+        else:
+            train.report({"step": state["steps_done"]})
+        if train.should_stop():
+            return  # fixed-size path: checkpointed above, migrate
+
+
 def _wait_for(pred, timeout, what):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -96,6 +149,7 @@ def main():
     from ray_tpu.train.backend import JaxConfig
     from ray_tpu.train.config import (
         FailureConfig,
+        ResizePolicy,
         RunConfig,
         ScalingConfig,
     )
@@ -295,6 +349,110 @@ def main():
         "gate": "8/8 chips free, no node draining/fenced, zero open "
                 "preemption records",
         "pass": returned and not open_recs,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+
+    # -- probe 5: elastic resize vs evict-and-restart --------------------
+    el_steps = 60 if quick else 100
+    el_step_s = 0.08
+    el_hold_s = 2.0 if quick else 4.0
+
+    def _ckpt_count(path):
+        try:
+            with open(path) as f:
+                return len(json.load(f))
+        except (OSError, ValueError):
+            return 0
+
+    def run_incident(name, elastic):
+        """One training run through the same reclamation incident:
+        warm up, chaos claims half the chips, holds them el_hold_s,
+        lets go. Returns the run's scorecard."""
+        trainer = DataParallelTrainer(
+            _elastic_vs_restart_loop,
+            train_loop_config={"steps": el_steps, "step_s": el_step_s},
+            backend_config=JaxConfig(dp_sync="none"),
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 1, "TPU": 4},
+                priority=0,
+                elastic=ResizePolicy(min_world_size=1) if elastic
+                else None,
+            ),
+            run_config=RunConfig(
+                name=name, storage_path=trial_dir,
+                failure_config=FailureConfig(max_failures=6, backoff_s=0.2,
+                                             backoff_max_s=1.0),
+            ),
+        )
+        holder = {}
+        th = threading.Thread(
+            target=lambda: holder.update(r=trainer.fit()), daemon=True)
+        t0 = time.perf_counter()
+        th.start()
+        idx = os.path.join(trial_dir, name, "checkpoints",
+                           "checkpoints.json")
+        _wait_for(lambda: _ckpt_count(idx) >= 3, timeout=60,
+                  what=f"{name}: warm-up steps before reclamation")
+        victims = chaos.reclaim_chips(4, bundle_chips=4)
+        time.sleep(el_hold_s)
+        chaos.lift_fence()
+        th.join(timeout=180)
+        wall = time.perf_counter() - t0
+        r = holder.get("r")
+        history = r.metrics_history if r else []
+        steps_seen = [m["step"] for m in history if "step" in m]
+        rec = gcs.preemptions.get(victims[0]["victim_pg_id"]) if victims \
+            else None
+        return {
+            "wall_s": round(wall, 3),
+            "goodput_steps_per_s": round(el_steps / wall, 2),
+            "final_step": max(steps_seen, default=-1),
+            "steps_lost": el_steps - len(set(steps_seen)),
+            "steps_replayed": len(steps_seen) - len(set(steps_seen)),
+            "worlds": sorted({m["world"] for m in history
+                              if "world" in m}),
+            "final_loss": next((m["loss"] for m in reversed(history)
+                                if "loss" in m), None),
+            "victim_outcome": rec["outcome"] if rec else None,
+            "error": str(r.error) if r and r.error else None,
+        }
+
+    chaos.enable()
+    try:
+        el = run_incident("elastic_gang", elastic=True)
+        ev = run_incident("evict_gang", elastic=False)
+    finally:
+        chaos.disable()
+        chaos.clear()
+    goodput_ratio = (
+        round(el["goodput_steps_per_s"] / ev["goodput_steps_per_s"], 2)
+        if ev["goodput_steps_per_s"] else None
+    )
+    entry = {
+        "metric": "elastic resize vs evict-and-restart under partial "
+                  "reclamation",
+        "steps": el_steps,
+        "chips_held_s": el_hold_s,
+        "elastic": el,
+        "evict_restart": ev,
+        "goodput_ratio": goodput_ratio,
+        "gate": "elastic: zero lost steps, gapless history through "
+                "2->1->2, victim outcome 'resized', final loss matches "
+                "the evict-restart run; goodput_ratio > 1.0",
+        "pass": bool(
+            el["error"] is None and ev["error"] is None
+            and el["steps_lost"] == 0 and el["steps_replayed"] == 0
+            and el["final_step"] == el_steps
+            and ev["final_step"] == el_steps
+            and el["worlds"] == [1, 2]
+            and el["victim_outcome"] == "resized"
+            and el["final_loss"] is not None
+            and ev["final_loss"] is not None
+            and abs(el["final_loss"] - ev["final_loss"]) < 1e-9
+            and goodput_ratio is not None and goodput_ratio > 1.0
+        ),
     }
     print(json.dumps(entry))
     results.append(entry)

@@ -34,6 +34,8 @@ Injection table (all gated on RT_CHAOS=1):
   delay_dcn_send(s, n)      | calling process   | DCN per-message latency
   cap_dcn_bandwidth(B/s)    | calling process   | DCN bandwidth ceiling
   preempt_node(node_id)     | driver (GCS RPC)  | node-scope chip reclaim
+  reclaim_chips(n)          | driver (GCS RPC)  | partial chip reclaim (elastic shrink)
+  lift_fence()              | driver (GCS RPC)  | claimant releases (elastic grow-back)
   kill_victim_mid_drain()   | driver            | victim dies while draining
   flush_prefix_cache()      | replica process   | prefix-cache cold start
   exhaust_kv_pages(frac)    | replica process   | KV page-pool pressure
@@ -452,6 +454,50 @@ def preempt_node(node_id: bytes):
             f"chaos.preempt_node: {resp.get('error', 'preempt_node failed')}"
         )
     return [v.hex() for v in resp.get("victims", [])]
+
+
+def reclaim_chips(amount: float, resource: str = "TPU",
+                  bundle_chips: Optional[float] = None,
+                  priority: int = 1_000_000):
+    """Partial chip reclamation (a serve spike claiming k < gang_size
+    chips): runs the GCS's real reclamation pass under a synthetic
+    top-priority claimant that needs `amount` of `resource`, split into
+    bundles of `bundle_chips` each (default: one bundle of `amount`).
+    The claimed victim bundles drain; an elastic gang sheds exactly
+    those ranks and keeps training. The sentinel claimant never places,
+    so the chips stay fenced until lift_fence(). Deterministic: fires
+    the pass inline, no health-loop timing involved. Returns the victim
+    list: [{"victim_pg_id", "partial", "bundle_indices"}, ...]."""
+    _require_enabled("reclaim_chips")
+    from ray_tpu._private import worker as worker_mod
+
+    client = worker_mod.get_client()
+    req = {"amount": float(amount), "resource": resource,
+           "priority": int(priority)}
+    if bundle_chips is not None:
+        req["bundle_chips"] = float(bundle_chips)
+    resp = client._run(client._gcs_call("chaos_reclaim_chips", req))
+    if not resp.get("ok"):
+        raise RuntimeError(
+            f"chaos.reclaim_chips: {resp.get('error', 'reclaim failed')}"
+        )
+    return resp.get("victims", [])
+
+
+def lift_fence():
+    """Release every chaos reclamation claim (the synthetic claimant
+    goes away): still-draining chaos evictions are cancelled, armed
+    resize obligations flip to lifted — the grow-back signal elastic
+    trainers poll — and the fences clear. Returns the number of
+    obligations lifted."""
+    _require_enabled("lift_fence")
+    from ray_tpu._private import worker as worker_mod
+
+    client = worker_mod.get_client()
+    resp = client._run(client._gcs_call("chaos_lift_fence", {}))
+    if not resp.get("ok"):
+        raise RuntimeError("chaos.lift_fence failed")
+    return int(resp.get("lifted", 0))
 
 
 def kill_victim_mid_drain():

@@ -354,6 +354,15 @@ class Config:
     # How long a drain-requested gang gets to checkpoint and exit before
     # the restart proceeds with whatever checkpoint is registered.
     train_drain_grace_s: float = 30.0
+    # Bound on one elastic resize: every rank must reach the
+    # sync_resize barrier, hand off shards, and apply the new world
+    # size within this window or the resize aborts (gang unchanged,
+    # caller falls back to checkpoint-and-restart).
+    train_resize_timeout_s: float = 60.0
+    # Partial reclamation: a claimant needing fewer chips than a whole
+    # victim gang drains only the bundles it needs (the victim resizes
+    # instead of dying). Off → whole-gang eviction always.
+    preempt_partial_enabled: bool = True
 
     # -- preemption ------------------------------------------------------
     # Master switch for the GCS reclamation pass: infeasible higher-priority

@@ -70,6 +70,7 @@ _WRITE_METHODS = {
     "register_actor", "actor_ready", "kill_actor", "worker_dead",
     "register_job", "submit_job", "job_update", "job_log_append", "stop_job",
     "create_placement_group", "remove_placement_group",
+    "release_pg_bundles", "reserve_pg_bundles",
     "object_location_add", "object_location_remove", "object_spilled",
     "objects_freed",
 }
@@ -129,6 +130,17 @@ class GcsServer:
         # fences and drains are re-derived after a GCS restart by the next
         # reclamation pass.
         self.preemptions: Dict[bytes, dict] = {}
+        # Resize obligations: victim_pg_id -> record. Born "armed" when a
+        # partially-reclaimed gang releases exactly the claimed bundles
+        # (elastic shrink instead of eviction); flips to "lifted" when the
+        # claimant releases — the fence-lift signal the trainer's
+        # grow-back path polls via get_resize_state. Dropped once the
+        # victim re-reserves the bundles (or is itself removed).
+        self.resize_obligations: Dict[bytes, dict] = {}
+        # Sentinel claimant ids minted by chaos.reclaim_chips: they hold
+        # their reclamation fences (the fence sweep treats them as
+        # forever-waiting) until chaos.lift_fence clears them.
+        self.chaos_claims: Set[bytes] = set()
         # preempt_total{tenant,reason} counter state, exported as a
         # synthetic series from h_metrics_snapshot like gcs_rpc_*.
         self.preempt_counts: Dict[tuple, float] = {}
@@ -208,9 +220,15 @@ class GcsServer:
         r("remove_placement_group", self.h_remove_pg)
         r("get_placement_group", self.h_get_pg)
         r("list_placement_groups", self.h_list_pgs)
+        # elastic resize (partial bundle release / grow-back)
+        r("release_pg_bundles", self.h_release_pg_bundles)
+        r("reserve_pg_bundles", self.h_reserve_pg_bundles)
+        r("get_resize_state", self.h_get_resize_state)
         # preemption
         r("get_preemptions", self.h_get_preemptions)
         r("preempt_node", self.h_preempt_node)
+        r("chaos_reclaim_chips", self.h_chaos_reclaim_chips)
+        r("chaos_lift_fence", self.h_chaos_lift_fence)
         # pubsub
         r("subscribe", self.h_subscribe)
         r("publish", self.h_publish)
@@ -1577,7 +1595,216 @@ class GcsServer:
         if rec is not None and rec["state"] == "draining":
             self._finish_preemption(rec, outcome="graceful")
         self._cancel_preemptions_for_claimant(d["pg_id"])
+        # Resize-obligation hooks: a removed claimant releases the chips
+        # it partially reclaimed (the victim may now grow back); a
+        # removed victim no longer has anything to grow back into.
+        self._lift_resize_obligations(d["pg_id"])
+        self.resize_obligations.pop(d["pg_id"], None)
         return {"ok": True}
+
+    async def h_release_pg_bundles(self, d, conn):
+        """Elastic shrink: a CREATED gang gives individual bundles back.
+
+        The chips are credited to their nodes immediately. When the
+        release satisfies a partial-reclamation drain (the record's
+        bundle_indices are all released), the eviction record closes
+        with outcome "resized" and a *resize obligation* is recorded so
+        the victim can reclaim exactly these bundles after the claimant
+        releases — the gang resized instead of dying.
+        """
+        pg = self.placement_groups.get(d["pg_id"])
+        if not pg or pg["state"] != "CREATED":
+            return {"ok": False, "error": "placement group not CREATED"}
+        indices = sorted({int(i) for i in d.get("indices") or []})
+        if not indices:
+            return {"ok": False, "error": "no bundle indices"}
+        released: List[int] = pg.setdefault("released_bundles", [])
+        bad = [
+            i for i in indices
+            if i < 0 or i >= len(pg["bundles"]) or i in released
+            or pg["bundle_nodes"][i] is None
+        ]
+        if bad:
+            return {"ok": False, "error": f"invalid bundle index(es) {bad}"}
+        homes: Dict[int, bytes] = pg.setdefault("released_nodes", {})
+        for i in indices:
+            nid = pg["bundle_nodes"][i]
+            info = self.nodes.get(nid)
+            if info and info["state"] == "ALIVE":
+                for k, v in pg["bundles"][i].items():
+                    info["resources_available"][k] = (
+                        info["resources_available"].get(k, 0) + v
+                    )
+                node_conn = self.node_conns.get(nid)
+                if node_conn:
+                    await node_conn.push(
+                        "cancel_bundle",
+                        {"pg_id": d["pg_id"], "bundle_index": i},
+                    )
+            homes[i] = nid
+            pg["bundle_nodes"][i] = None
+            released.append(i)
+        released.sort()
+        rec = self.preemptions.get(d["pg_id"])
+        if (
+            rec is not None and rec["state"] == "draining"
+            and rec.get("partial")
+            and set(rec.get("bundle_indices") or []) <= set(released)
+        ):
+            self._finish_preemption(rec, outcome="resized")
+            if rec.get("claimant") is not None:
+                self.resize_obligations[d["pg_id"]] = {
+                    "victim": d["pg_id"],
+                    "claimant": rec["claimant"],
+                    "claimant_tenant": rec.get("claimant_tenant") or "",
+                    "bundle_indices": sorted(rec["bundle_indices"]),
+                    "state": "armed",
+                    "created": time.monotonic(),
+                    "lifted_at": None,
+                }
+            from ray_tpu.util.event import record_event
+
+            record_event(
+                "gcs",
+                f"tenant {rec['victim_tenant']!r} resized instead of "
+                f"evicting: released bundle(s) {sorted(rec['bundle_indices'])} "
+                f"to {rec.get('claimant_tenant') or 'claimant'!r}",
+                pg_id=d["pg_id"].hex(),
+            )
+        return {"ok": True, "released": released}
+
+    async def h_reserve_pg_bundles(self, d, conn):
+        """Elastic grow-back: re-reserve previously released bundles.
+
+        Refused while a resize obligation is still armed (the claimant
+        holds the chips) or while the chips are fenced/occupied. Each
+        bundle prefers its original node; STRICT_SPREAD groups keep
+        node-distinctness."""
+        pg = self.placement_groups.get(d["pg_id"])
+        if not pg or pg["state"] != "CREATED":
+            return {"ok": False, "error": "placement group not CREATED"}
+        indices = sorted({int(i) for i in d.get("indices") or []})
+        released = pg.get("released_bundles") or []
+        bad = [i for i in indices if i not in released]
+        if bad:
+            return {"ok": False, "error": f"bundle(s) {bad} not released"}
+        ob = self.resize_obligations.get(d["pg_id"])
+        if (
+            ob is not None and ob["state"] == "armed"
+            and set(indices) & set(ob["bundle_indices"])
+        ):
+            return {
+                "ok": False,
+                "error": "resize obligation not lifted: claimant "
+                         f"{ob['claimant_tenant'] or 'claimant'!r} still "
+                         "holds the chips",
+            }
+        homes = pg.get("released_nodes") or {}
+        distinct = pg["strategy"] == "STRICT_SPREAD"
+        placed: List[tuple] = []
+
+        async def rollback():
+            for j, njd in placed:
+                info = self.nodes.get(njd)
+                if info:
+                    for k, v in pg["bundles"][j].items():
+                        info["resources_available"][k] = (
+                            info["resources_available"].get(k, 0) + v
+                        )
+                node_conn = self.node_conns.get(njd)
+                if node_conn:
+                    await node_conn.push(
+                        "cancel_bundle",
+                        {"pg_id": d["pg_id"], "bundle_index": j},
+                    )
+                pg["bundle_nodes"][j] = None
+
+        for i in indices:
+            b = pg["bundles"][i]
+            orig = homes.get(i)
+            candidates = ([orig] if orig is not None else []) + [
+                n for n in self.nodes if n != orig
+            ]
+            nid = None
+            for cand in candidates:
+                info = self.nodes.get(cand)
+                if (not info or info["state"] != "ALIVE"
+                        or info.get("draining")):
+                    continue
+                fence = info.get("fenced_for")
+                if fence is not None and fence != d["pg_id"]:
+                    continue
+                if distinct and cand in pg["bundle_nodes"]:
+                    continue
+                avail = info["resources_available"]
+                if all(avail.get(k, 0) + 1e-9 >= v for k, v in b.items()):
+                    nid = cand
+                    break
+            if nid is None:
+                await rollback()
+                return {"ok": False,
+                        "error": f"bundle {i} cannot place anywhere"}
+            info = self.nodes[nid]
+            for k, v in b.items():
+                info["resources_available"][k] = (
+                    info["resources_available"].get(k, 0) - v
+                )
+            node_conn = self.node_conns.get(nid)
+            if node_conn:
+                await node_conn.push(
+                    "reserve_bundle",
+                    {"pg_id": d["pg_id"], "bundle_index": i, "resources": b},
+                )
+            pg["bundle_nodes"][i] = nid
+            homes.pop(i, None)
+            placed.append((i, nid))
+        pg["released_bundles"] = [x for x in released if x not in set(indices)]
+        if ob is not None:
+            remaining = sorted(set(ob["bundle_indices"]) - set(indices))
+            if remaining:
+                ob["bundle_indices"] = remaining
+            else:
+                self.resize_obligations.pop(d["pg_id"], None)
+        return {"ok": True,
+                "bundle_nodes": [pg["bundle_nodes"][i] for i in indices]}
+
+    async def h_get_resize_state(self, d, conn):
+        """Resize obligations + released bundles for one group — the
+        trainer's grow-back path polls this for the fence-lift signal."""
+        pg = self.placement_groups.get(d["pg_id"])
+        ob = self.resize_obligations.get(d["pg_id"])
+        out = []
+        if ob is not None:
+            now = time.monotonic()
+            out.append({
+                "claimant": ob["claimant"],
+                "claimant_tenant": ob["claimant_tenant"],
+                "bundle_indices": list(ob["bundle_indices"]),
+                "state": ob["state"],
+                "age_s": now - ob["created"],
+            })
+        return {
+            "obligations": out,
+            "released_bundles": sorted(pg.get("released_bundles") or [])
+            if pg else [],
+        }
+
+    def _lift_resize_obligations(self, claimant_id: bytes):
+        """The claimant released its chips (group removed or actor dead):
+        flip its obligations to "lifted" — the victims' grow-back signal."""
+        for ob in self.resize_obligations.values():
+            if ob["state"] == "armed" and ob.get("claimant") == claimant_id:
+                ob["state"] = "lifted"
+                ob["lifted_at"] = time.monotonic()
+                from ray_tpu.util.event import record_event
+
+                record_event(
+                    "gcs",
+                    f"resize obligation lifted: claimant "
+                    f"{ob['claimant_tenant'] or 'claimant'!r} released "
+                    f"bundle(s) {ob['bundle_indices']} back to tenant",
+                    pg_id=ob["victim"].hex(),
+                )
 
     async def h_get_pg(self, d, conn):
         pg = self.placement_groups.get(d["pg_id"])
@@ -1654,27 +1881,46 @@ class GcsServer:
         cands.sort(
             key=lambda p: (int(p.get("priority") or 0), -p.get("seq", 0))
         )
-        chosen = []
+        # Partial reclamation: credit victim bundles ONE at a time,
+        # highest index first (trailing ranks hold the trailing data
+        # shards — the cheapest for an elastic victim to shed), and stop
+        # at the first bundle whose release makes the claimant feasible.
+        # A victim losing k < gang_size bundles gets a partial record:
+        # only those bundles' nodes drain, and releasing them counts as
+        # honoring the eviction (the gang resizes instead of dying).
+        partial_ok = cfg.preempt_partial_enabled
+        chosen: List[tuple] = []  # (pg, [credited bundle indices])
+        feasible = False
         for pg in cands:
-            freed = False
-            for i, nid in enumerate(pg["bundle_nodes"]):
-                if nid in hyp:
-                    for k, v in pg["bundles"][i].items():
-                        hyp[nid][k] = hyp[nid].get(k, 0) + v
-                    freed = True
-            if not freed:
+            indices: List[int] = []
+            for i in range(len(pg["bundle_nodes"]) - 1, -1, -1):
+                nid = pg["bundle_nodes"][i]
+                if nid not in hyp:
+                    continue
+                for k, v in pg["bundles"][i].items():
+                    hyp[nid][k] = hyp[nid].get(k, 0) + v
+                indices.append(i)
+                if partial_ok and self._place_bundles(
+                        bundles, strategy, avail_override=hyp) is not None:
+                    feasible = True
+                    break
+            if not indices:
                 continue
-            chosen.append(pg)
-            if self._place_bundles(bundles, strategy,
-                                   avail_override=hyp) is not None:
+            chosen.append((pg, sorted(indices)))
+            if not feasible and self._place_bundles(
+                    bundles, strategy, avail_override=hyp) is not None:
+                feasible = True
+            if feasible:
                 break
-        else:
+        if not feasible:
             return False  # no victim set makes the claimant feasible
-        for pg in chosen:
+        for pg, indices in chosen:
+            partial = partial_ok and len(indices) < len(pg["bundles"])
             self._register_preemption(
                 pg, reason="priority", claimant=owner_id,
                 claimant_tenant=tenant, claimant_priority=priority,
                 fence_for=owner_id,
+                bundle_indices=indices if partial else None,
             )
         return True
 
@@ -1683,16 +1929,30 @@ class GcsServer:
                              claimant_tenant: str = "",
                              claimant_priority: int = 0,
                              fence_for: Optional[bytes] = None,
-                             only_node: Optional[bytes] = None):
-        """Mark one victim gang draining and open its eviction record."""
+                             only_node: Optional[bytes] = None,
+                             bundle_indices: Optional[List[int]] = None):
+        """Mark one victim gang draining and open its eviction record.
+
+        bundle_indices (partial reclamation): only those bundles' nodes
+        drain, and the victim honors the eviction by releasing exactly
+        those bundles (release_pg_bundles) instead of its whole group —
+        an elastic gang resizes; the hard-kill deadline still covers the
+        whole gang if it does neither in time.
+        """
         cfg = get_config()
         now = time.monotonic()
+        wanted = (
+            {pg["bundle_nodes"][i] for i in bundle_indices}
+            if bundle_indices is not None else None
+        )
         # Refcount semantics: the record lists every node it needs drained
         # (idempotently re-marking already-draining ones); a node is
         # un-drained only when no draining record still lists it.
         nodes_marked = []
         for nid in dict.fromkeys(pg["bundle_nodes"]):
             if only_node is not None and nid != only_node:
+                continue
+            if wanted is not None and nid not in wanted:
                 continue
             info = self.nodes.get(nid)
             if not info or info["state"] != "ALIVE" or info.get("is_head"):
@@ -1717,6 +1977,10 @@ class GcsServer:
             "released_at": None,
             "outcome": None,
         }
+        if bundle_indices is not None:
+            rec = self.preemptions[pg["pg_id"]]
+            rec["partial"] = True
+            rec["bundle_indices"] = sorted(bundle_indices)
         self._count_preempt(tenant, reason)
         from ray_tpu.util.event import record_event
 
@@ -1845,6 +2109,9 @@ class GcsServer:
             waiting = (
                 owner in self.pending_pgs
                 or owner in self.pending_actors
+                # Chaos sentinel claimants hold their fences until
+                # chaos.lift_fence releases them.
+                or owner in self.chaos_claims
                 or any(
                     r["state"] == "draining" and r.get("claimant") == owner
                     for r in self.preemptions.values()
@@ -1852,6 +2119,29 @@ class GcsServer:
             )
             if not waiting:
                 self._clear_fences(owner)
+        # Obligation sweep: an armed resize obligation whose claimant is
+        # gone (actor died, group removed through a path that missed the
+        # inline lift) flips to lifted so the victim can grow back.
+        for ob in list(self.resize_obligations.values()):
+            if (ob["state"] == "armed"
+                    and not self._claimant_active(ob["claimant"])):
+                self._lift_resize_obligations(ob["claimant"])
+
+    def _claimant_active(self, owner: Optional[bytes]) -> bool:
+        """Does this claimant still hold (or await) the chips it
+        reclaimed? Chaos sentinels count as active until lifted."""
+        if owner is None:
+            return False
+        if owner in self.chaos_claims:
+            return True
+        pg = self.placement_groups.get(owner)
+        if pg is not None and pg["state"] in ("PENDING", "CREATED"):
+            return True
+        a = self.actors.get(owner)
+        if a is not None and a["state"] in ("PENDING", "ALIVE",
+                                            "RESTARTING"):
+            return True
+        return False
 
     def _preemption_view(self, rec: dict) -> dict:
         now = time.monotonic()
@@ -1873,6 +2163,9 @@ class GcsServer:
                 else 0.0
             ),
         }
+        if rec.get("partial"):
+            out["partial"] = True
+            out["bundle_indices"] = list(rec.get("bundle_indices") or [])
         if rec["state"] == "draining":
             # Victim actors still alive mid-drain — chaos's
             # kill_victim_mid_drain picks from these.
@@ -1922,6 +2215,56 @@ class GcsServer:
         # a node that is being reclaimed.
         info["draining"] = True
         return {"ok": True, "victims": victims}
+
+    async def h_chaos_reclaim_chips(self, d, conn):
+        """Chaos: reclaim `amount` chips through the real partial-
+        reclamation pass under a synthetic top-priority claimant.
+
+        The sentinel claimant never places, so its fences (and any armed
+        resize obligations it produces) persist until chaos_lift_fence —
+        a deterministic serve-spike stand-in for elastic-resize tests.
+        """
+        amount = float(d["amount"])
+        resource = d.get("resource") or "TPU"
+        per = float(d.get("bundle_chips") or amount)
+        count = max(1, int(amount // per) + (1 if amount % per else 0))
+        sentinel = b"chaos_claim:" + os.urandom(8)
+        ok = self._maybe_preempt(
+            sentinel, "chaos_reclaim",
+            int(d.get("priority") or 1_000_000),
+            [{resource: per} for _ in range(count)], "SPREAD",
+        )
+        if not ok:
+            return {"ok": False,
+                    "error": "no victim set frees the requested chips"}
+        self.chaos_claims.add(sentinel)
+        victims = [
+            {
+                "victim_pg_id": rec["victim"],
+                "partial": bool(rec.get("partial")),
+                "bundle_indices": list(rec.get("bundle_indices") or []),
+            }
+            for rec in self.preemptions.values()
+            if rec["state"] == "draining"
+            and rec.get("claimant") == sentinel
+        ]
+        return {"ok": True, "claim_id": sentinel, "victims": victims}
+
+    async def h_chaos_lift_fence(self, d, conn):
+        """Chaos: release every chaos reclamation claim — cancel
+        still-draining chaos records, lift armed obligations, clear
+        fences. The grow-back signal for elastic victims."""
+        lifted = 0
+        for sentinel in list(self.chaos_claims):
+            self.chaos_claims.discard(sentinel)
+            self._cancel_preemptions_for_claimant(sentinel)
+            for ob in self.resize_obligations.values():
+                if (ob["state"] == "armed"
+                        and ob.get("claimant") == sentinel):
+                    lifted += 1
+            self._lift_resize_obligations(sentinel)
+            self._clear_fences(sentinel)
+        return {"ok": True, "lifted": lifted}
 
     # -- pubsub ----------------------------------------------------------
     #: Channels clients may publish to. System channels (actor_update:*,

@@ -69,6 +69,13 @@ _FLIGHT_RECORDER_PANELS = [
          "legend": "p95 skew"},
         {"expr": "train_straggler_rank", "legend": "straggler rank"},
     ], "s"),
+    ("Elastic gang size vs reclaimed chips", [
+        {"expr": "train_gang_size", "legend": "gang world size"},
+        {"expr": "sum(rate(preempt_total[5m])) by (reason)",
+         "legend": "preemptions/s {{reason}}"},
+        {"expr": "rate(train_resize_total[5m])",
+         "legend": "resizes/s {{direction}}"},
+    ], "short"),
     ("Training throughput / MFU", [
         {"expr": "train_tokens_per_s", "legend": "rank {{rank}} tok/s"},
         {"expr": "train_step_mfu", "legend": "rank {{rank}} MFU"},

@@ -12,15 +12,19 @@ from ray_tpu.train.checkpoint import (
     AsyncCheckpointer,
     Checkpoint,
     CheckpointManager,
+    ShardRemapPlan,
+    ShardedState,
 )
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
+    ResizePolicy,
     Result,
     RunConfig,
     ScalingConfig,
 )
 from ray_tpu.train.session import (
+    ResizeEvent,
     get_checkpoint,
     get_dataset_shard,
     get_local_rank,
@@ -30,9 +34,11 @@ from ray_tpu.train.session import (
     get_world_rank,
     get_world_size,
     report,
+    shard_state,
     should_stop,
+    sync_resize,
 )
-from ray_tpu.train.backend_executor import TrainingFailedError
+from ray_tpu.train.backend_executor import ResizeError, TrainingFailedError
 from ray_tpu.train.flight_recorder import StepProfiler, compute_skew
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from ray_tpu.train.data_config import DataConfig
@@ -71,4 +77,11 @@ __all__ = [
     "StepProfiler",
     "compute_skew",
     "TrainingFailedError",
+    "ResizeError",
+    "ResizeEvent",
+    "ResizePolicy",
+    "ShardRemapPlan",
+    "ShardedState",
+    "shard_state",
+    "sync_resize",
 ]

@@ -32,6 +32,12 @@ class Backend:
     def on_shutdown(self, worker_group, backend_config):
         pass
 
+    def on_resize(self, worker_group, backend_config):
+        """Rebuild the backend's collective state after an elastic
+        resize: the worker group already holds the new ranks/world size
+        and a bumped gang epoch."""
+        pass
+
 
 @dataclass
 class BackendConfig:
@@ -84,6 +90,33 @@ class _JaxBackend(Backend):
                 worker_group.execute(_destroy_dcn_group)
             except Exception:
                 pass
+
+    def on_resize(self, worker_group, backend_config: JaxConfig):
+        """Tear down and rebuild the DCN ring at the new world size.
+
+        The group is destroyed on every surviving rank (tolerant — a
+        joiner has nothing to destroy) and re-created under the bumped
+        gang epoch, so a departed rank still parked in the old
+        rendezvous can never join the new ring. The collective layer's
+        topology model re-selects ring/rd/hier per op for the new size.
+        jax.distributed has no live-resize path — elastic gangs require
+        distributed=False (the eager DCN data plane).
+        """
+        if backend_config.distributed:
+            raise RuntimeError(
+                "elastic resize is not supported with "
+                "JaxConfig(distributed=True): jax.distributed cannot "
+                "re-initialize a live coordinator at a new world size"
+            )
+        if backend_config.dp_sync != "dcn":
+            return
+        n = len(worker_group)
+        worker_group.execute(_destroy_dcn_group)
+        if n > 1:
+            worker_group.execute_with_rank(
+                _init_dcn_group, world_size=n,
+                epoch=getattr(worker_group, "epoch", 0),
+            )
 
 
 def _get_host_ip():

@@ -73,8 +73,40 @@ class TrainingFailedError(RuntimeError):
         super().__init__(message)
 
 
+class ResizeError(RuntimeError):
+    """An elastic resize could not complete (loop not elastic-aware,
+    worker died mid-handoff, bundles still fenced). The gang is left
+    running at its old size; the caller falls back to the
+    checkpoint-and-restart path."""
+
+
 def _classify(rank: int, exc: Exception) -> str:
     return f"rank {rank}: {type(exc).__name__}: {exc}"
+
+
+def _resize_metrics():
+    """train_resize_total{direction} / train_gang_size /
+    train_resize_seconds — lazy for the same reason as the trainer's
+    fault metrics (importing must not start the flusher)."""
+    from ray_tpu.util import metrics as rt_metrics
+
+    return (
+        rt_metrics.get_or_create(
+            rt_metrics.Counter, "train_resize_total",
+            "Elastic gang resizes completed, by direction (shrink/grow).",
+            tag_keys=("direction",),
+        ),
+        rt_metrics.get_or_create(
+            rt_metrics.Gauge, "train_gang_size",
+            "Current world size of the training gang.",
+        ),
+        rt_metrics.get_or_create(
+            rt_metrics.Histogram, "train_resize_seconds",
+            "Wall seconds from resize start to the gang running at the "
+            "new world size.",
+            boundaries=rt_metrics.LATENCY_BOUNDARIES_WIDE,
+        ),
+    )
 
 
 class BackendExecutor:
@@ -91,6 +123,11 @@ class BackendExecutor:
         # DCN rendezvous so stale ranks can't join the new ring.
         self.epoch = 0
         self._last_drain_check = 0.0
+        # (train_fn, config, checkpoint, trial_dir) from start_training —
+        # replayed for joiner workers on elastic grow.
+        self._train_args = None
+        self._last_fence_check = 0.0
+        self._fence_lifted_cache = False
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -116,8 +153,9 @@ class BackendExecutor:
         """Tear the whole gang down and rebuild it one epoch later
         (reference: _restart backend_executor.py:701). Survivor actors
         are killed — after one rank dies the others' collective state is
-        garbage — and the placement group is released so a drained node's
-        resources aren't re-reserved."""
+        garbage — and the placement group release is VERIFIED before the
+        respawn: a silently surviving group keeps a gang's worth of
+        chips reserved on every repeated restart."""
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group, self.backend_config)
@@ -127,7 +165,13 @@ class BackendExecutor:
                     "(epoch %d); proceeding with kill-and-rebuild",
                     self.epoch, exc_info=True,
                 )
-            self.worker_group.shutdown()
+            try:
+                self.worker_group.shutdown(verify=True)
+            except PlacementGroupSchedulingError as e:
+                self.worker_group = None
+                raise TrainingFailedError(
+                    f"gang restart blocked: {e}", retryable=True, cause=e
+                ) from e
             self.worker_group = None
         self.epoch += 1
         self.start()
@@ -148,6 +192,9 @@ class BackendExecutor:
         dataset_shards: Optional[List[Any]] = None,
     ):
         self.backend.on_training_start(self.worker_group, self.backend_config)
+        # Remembered for elastic grow: joiner workers run the same loop
+        # (they adopt live state through their pre-armed resize ticket).
+        self._train_args = (train_fn, config, checkpoint, trial_dir)
         refs = []
         for i, w in enumerate(self.worker_group.workers):
             shard = dataset_shards[i] if dataset_shards else None
@@ -157,6 +204,237 @@ class BackendExecutor:
             )
         self._get_per_rank(refs, get_config().train_start_timeout_s,
                            what="start_training")
+
+    # -- elastic resize --------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return self.worker_group.num_workers if self.worker_group else 0
+
+    def resize(self, target_world_size: int,
+               departing_ranks: Optional[List[int]] = None,
+               dataset_shards: Optional[List[Any]] = None):
+        """Resize the live gang instead of restarting it.
+
+        Shrink: departing ranks publish their state slices, checkpoint
+        their shards, and exit through the drain plane; their bundles
+        are released back to the GCS (completing a partial-reclamation
+        drain); survivors renumber, rebuild DCN collectives at the new
+        size under a bumped gang epoch (the epoch fence keeps the
+        departed ranks out of the new rendezvous), and re-shard state
+        through the object store via the deterministic ShardRemapPlan.
+
+        Grow: previously released bundles are re-reserved (fails while
+        the claimant's fence holds — raise ResizeError, retry later),
+        joiners spawn into them with pre-armed resize tickets, and every
+        rank re-shards to the new world size.
+
+        Raises ResizeError with the gang still running at the OLD size;
+        the caller falls back to checkpoint-and-restart.
+        """
+        wg = self.worker_group
+        if wg is None:
+            raise ResizeError("no worker group to resize")
+        old_n, new_n = wg.num_workers, int(target_world_size)
+        if new_n < 1:
+            raise ResizeError(f"cannot resize to world size {new_n}")
+        if new_n == old_n:
+            return
+        t0 = time.monotonic()
+        direction = "shrink" if new_n < old_n else "grow"
+        old_epoch = self.epoch
+        self.epoch += 1
+        wg.epoch = self.epoch
+        try:
+            if new_n < old_n:
+                self._resize_shrink(new_n, departing_ranks, dataset_shards)
+            else:
+                self._resize_grow(new_n, dataset_shards)
+        except ResizeError:
+            self.epoch = old_epoch
+            wg.epoch = old_epoch
+            self._abort_resize_all()
+            raise
+        except Exception as e:  # noqa: BLE001 — normalize for the caller
+            self.epoch = old_epoch
+            wg.epoch = old_epoch
+            self._abort_resize_all()
+            raise ResizeError(f"resize {old_n}→{new_n} failed: {e}") from e
+        total, gang_gauge, seconds = _resize_metrics()
+        total.inc(1.0, tags={"direction": direction})
+        gang_gauge.set(float(new_n))
+        seconds.observe(time.monotonic() - t0)
+        logger.info("gang resized %d→%d (%s) in %.3fs, epoch %d",
+                    old_n, new_n, direction, time.monotonic() - t0,
+                    self.epoch)
+
+    def _resize_shrink(self, new_n: int,
+                       departing_ranks: Optional[List[int]],
+                       dataset_shards: Optional[List[Any]]):
+        wg = self.worker_group
+        old_n = wg.num_workers
+        cfg = get_config()
+        timeout = cfg.train_resize_timeout_s
+        departing = sorted(set(departing_ranks or []))[: old_n - new_n]
+        if len(departing) < old_n - new_n:
+            # Default victims: highest ranks first (they hold the
+            # trailing data shards — the cheapest to rebalance).
+            pool = [r for r in range(old_n - 1, -1, -1)
+                    if r not in departing]
+            departing += pool[: old_n - new_n - len(departing)]
+            departing = sorted(departing)
+        spec = {"old_world": old_n, "new_world": new_n,
+                "departing": departing, "timeout_s": timeout,
+                "epoch": self.epoch}
+        self._arm_resize(wg.workers, spec)
+        outboxes = self._collect_outboxes(
+            {r: wg.workers[r] for r in range(old_n)}, timeout)
+        survivors_old = [r for r in range(old_n) if r not in set(departing)]
+        payload_shards = self._merge_shard_refs(outboxes)
+        state_ref = outboxes[survivors_old[0]]["state_ref"]
+        # Departing loops have published; reap them and hand their
+        # bundles back (this is the moment a partial reclamation's
+        # claimant has been waiting for).
+        rank_map = wg.shrink(departing)
+        for old_rank in survivors_old:
+            w = wg.workers[rank_map[old_rank]]
+            rt.get(w.set_rank.remote(rank_map[old_rank], new_n),
+                   timeout=cfg.train_probe_timeout_s)
+        # DCN groups die and rebuild at the new size — the topology
+        # model re-selects ring/rd/hier per op for the new world.
+        self.backend.on_resize(wg, self.backend_config)
+        base = {"old_world": old_n, "new_world": new_n,
+                "rank_map": rank_map, "shards": payload_shards,
+                "state_ref": state_ref}
+        self._deliver_resize(wg, base, dataset_shards, timeout)
+
+    def _resize_grow(self, new_n: int,
+                     dataset_shards: Optional[List[Any]]):
+        wg = self.worker_group
+        old_n = wg.num_workers
+        cfg = get_config()
+        timeout = cfg.train_resize_timeout_s
+        spec = {"old_world": old_n, "new_world": new_n, "departing": [],
+                "timeout_s": timeout, "epoch": self.epoch}
+        # Re-reserve freed bundles FIRST: while the claimant's fence
+        # holds this fails cleanly and nothing was disturbed.
+        try:
+            wg.grow(new_n)
+        except PlacementGroupSchedulingError as e:
+            raise ResizeError(f"grow blocked: {e}") from e
+        self._arm_resize(wg.workers[:old_n], spec)
+        for r in range(old_n):
+            rt.get(wg.workers[r].set_rank.remote(r, new_n),
+                   timeout=cfg.train_probe_timeout_s)
+        # Joiners run the same loop with a pre-armed ticket: their first
+        # sync_resize adopts the live replicated state and builds their
+        # slice of the sharded state from the survivors' refs.
+        if self._train_args is None:
+            raise ResizeError("cannot grow before start_training")
+        train_fn, config, checkpoint, trial_dir = self._train_args
+        join_spec = dict(spec, joining=True)
+        start_refs = []
+        for rank in range(old_n, new_n):
+            shard = dataset_shards[rank] if dataset_shards else None
+            start_refs.append(wg.workers[rank].start_training.remote(
+                train_fn, config, checkpoint, trial_dir, shard,
+                resize_join=join_spec,
+            ))
+        self._get_per_rank(start_refs, cfg.train_start_timeout_s,
+                           what="resize_grow start_training")
+        outboxes = self._collect_outboxes(
+            {r: wg.workers[r] for r in range(old_n)}, timeout)
+        payload_shards = self._merge_shard_refs(outboxes)
+        state_ref = outboxes[0]["state_ref"]
+        self.backend.on_resize(wg, self.backend_config)
+        base = {"old_world": old_n, "new_world": new_n,
+                "rank_map": {r: r for r in range(old_n)},
+                "shards": payload_shards, "state_ref": state_ref}
+        self._deliver_resize(wg, base, dataset_shards, timeout)
+
+    def _arm_resize(self, workers, spec):
+        refs = [w.begin_resize.remote(spec) for w in workers]
+        self._get_per_rank(refs, get_config().train_probe_timeout_s,
+                           what="begin_resize")
+
+    def _collect_outboxes(self, workers: Dict[int, Any],
+                          timeout: float) -> Dict[int, Dict]:
+        """Wait until every listed rank's loop has hit the resize
+        barrier and published its shard refs. A loop that finishes (or
+        errors, or dies) without reaching sync_resize aborts the resize."""
+        deadline = time.monotonic() + timeout
+        out: Dict[int, Dict] = {}
+        probe = get_config().train_probe_timeout_s
+        while True:
+            missing = [r for r in workers if r not in out]
+            if not missing:
+                return out
+            if time.monotonic() >= deadline:
+                raise ResizeError(
+                    f"rank(s) {missing} did not reach the resize barrier "
+                    f"within {timeout:.0f}s (loop not elastic-aware?)"
+                )
+            for r in missing:
+                try:
+                    st = rt.get(workers[r].poll_resize.remote(),
+                                timeout=probe)
+                except _GANG_FATAL as e:
+                    raise ResizeError(
+                        f"rank {r} died mid-resize: {e}") from e
+                if st.get("outbox") is not None:
+                    out[r] = st["outbox"]
+                elif st.get("loop_done"):
+                    raise ResizeError(
+                        f"rank {r}'s loop finished before the resize "
+                        f"barrier")
+            time.sleep(0.05)
+
+    @staticmethod
+    def _merge_shard_refs(outboxes: Dict[int, Dict]) -> Dict[str, Dict]:
+        merged: Dict[str, Dict] = {}
+        for rank, ob in outboxes.items():
+            for name, ref in (ob.get("shards") or {}).items():
+                merged.setdefault(name, {})[rank] = ref
+        return merged
+
+    def _deliver_resize(self, wg, base: Dict,
+                        dataset_shards: Optional[List[Any]],
+                        timeout: float):
+        refs = []
+        for rank, w in enumerate(wg.workers):
+            payload = dict(base)
+            if dataset_shards is not None:
+                payload["dataset_shards"] = dataset_shards[rank]
+            refs.append(w.complete_resize.remote(payload))
+        self._get_per_rank(refs, get_config().train_probe_timeout_s,
+                           what="complete_resize")
+        # Confirm application: the gang must be consistent at the new
+        # size before the executor reports the resize done.
+        deadline = time.monotonic() + timeout
+        pending = set(range(len(wg.workers)))
+        while pending and time.monotonic() < deadline:
+            for r in list(pending):
+                st = rt.get(wg.workers[r].poll_resize.remote(),
+                            timeout=get_config().train_probe_timeout_s)
+                if st.get("applied") or st.get("loop_done"):
+                    pending.discard(r)
+            if pending:
+                time.sleep(0.05)
+        if pending:
+            raise ResizeError(
+                f"rank(s) {sorted(pending)} did not apply the resize "
+                f"within {timeout:.0f}s")
+
+    def _abort_resize_all(self):
+        if self.worker_group is None:
+            return
+        for rank, w in enumerate(self.worker_group.workers):
+            try:
+                rt.get(w.abort_resize.remote(),
+                       timeout=get_config().train_probe_timeout_s)
+            except Exception as e:  # noqa: BLE001 — best-effort unwind
+                logger.warning("resize abort not delivered to rank %d "
+                               "(%s); the rank unblocks via its own "
+                               "resize timeout", rank, e)
 
     def poll(self) -> List[Dict]:
         """One poll of every worker: list of per-rank status dicts.
@@ -241,18 +519,70 @@ class BackendExecutor:
     def _gcs_draining_ranks(self) -> Set[int]:
         if self.worker_group is None:
             return set()
+        ranks: Set[int] = set()
+        # Partial-reclamation records name the exact bundles being
+        # drained — map those to ranks directly, and keep their nodes
+        # out of the node-scope sweep below so co-located ranks (PACK)
+        # aren't swept up with the claimed ones.
+        partial_nodes: Set = set()
+        pg_id = self.worker_group.pg_id
+        from ray_tpu._private import worker as worker_mod
+
+        client = worker_mod.get_client()
+        resp = client._run(client._gcs_call("get_preemptions", {}))
+        for rec in resp.get("preemptions", []):
+            if rec.get("state") != "draining":
+                continue
+            if rec.get("victim_pg_id") != pg_id:
+                continue
+            if rec.get("partial"):
+                idxs = rec.get("bundle_indices") or []
+                ranks |= set(self.worker_group.ranks_for_bundles(idxs))
+                partial_nodes |= set(rec.get("nodes") or [])
         draining_nodes = {
             n["node_id"]
             for n in rt.nodes()
             if n.get("draining") and n["state"] == "ALIVE"
-        }
-        if not draining_nodes:
-            return set()
-        return {
+        } - partial_nodes
+        ranks |= {
             i
             for i, nid in enumerate(self.worker_group.node_ids())
             if nid in draining_nodes
         }
+        return ranks
+
+    def fence_lifted(self) -> bool:
+        """True once every resize obligation recorded against this gang
+        is lifted (the partial-reclamation claimant released the chips)
+        and there are released bundles to grow back into. This is the
+        trainer's grow-back signal; throttled like the drain poll."""
+        wg = self.worker_group
+        if wg is None or not wg._released_bundles:
+            return False
+        now = time.monotonic()
+        if now - self._last_fence_check < get_config().train_drain_poll_interval_s:
+            return self._fence_lifted_cache
+        self._last_fence_check = now
+        lifted = False
+        try:
+            from ray_tpu.util.placement_group import (
+                placement_group_resize_state,
+            )
+
+            st = placement_group_resize_state(wg._pg)
+            obligations = st.get("obligations") or []
+            if obligations:
+                lifted = all(o.get("state") == "lifted"
+                             for o in obligations)
+            else:
+                # Voluntary shrink (no claimant holds the chips): free
+                # to grow back whenever capacity allows.
+                lifted = True
+        except Exception:  # noqa: BLE001 — control-plane hiccup; retry
+            logger.warning("resize-state poll failed; retrying",
+                           exc_info=True)
+        self._fence_lifted_cache = lifted
+        return lifted
 
     def request_stop_all(self):
         """Ask every rank to checkpoint and return at the next

@@ -180,6 +180,209 @@ def _json_safe(d: Dict) -> Dict:
     return out
 
 
+class ShardRemapPlan:
+    """Deterministic old_world → new_world re-shard assignment.
+
+    Every pytree leaf is flattened to 1-D and cut into `world` contiguous
+    slices with np.array_split boundaries (the first ``size % world``
+    ranks get one extra element), so the slice a rank owns is a pure
+    function of (leaf size, world, rank). A plan between two world sizes
+    is then a bijection on element positions by construction: each new
+    rank's slice is assembled from the (at most two, for any divisor or
+    non-divisor pair) old slices it overlaps, and reassembling all new
+    slices yields the original tree bit-for-bit.
+
+    Elastic resize executes this plan through the object store: each old
+    rank publishes its slices once, each new rank fetches only
+    ``sources_for(new_rank)`` — no full gather, no disk round trip.
+    """
+
+    def __init__(self, old_world: int, new_world: int, leaf_sizes: List[int],
+                 leaf_dtypes: Optional[List] = None):
+        if old_world < 1 or new_world < 1:
+            raise ValueError("world sizes must be >= 1")
+        self.old_world = old_world
+        self.new_world = new_world
+        self.leaf_sizes = [int(s) for s in leaf_sizes]
+        # Per-leaf dtypes keep empty slices typed (a rank whose cut of a
+        # scalar leaf is empty has no source shard to infer from).
+        self.leaf_dtypes = leaf_dtypes
+
+    @staticmethod
+    def bounds(size: int, world: int) -> List[tuple]:
+        """(start, stop) of each rank's slice of a flat leaf of `size`."""
+        base, extra = divmod(size, world)
+        out, start = [], 0
+        for r in range(world):
+            stop = start + base + (1 if r < extra else 0)
+            out.append((start, stop))
+            start = stop
+        return out
+
+    @classmethod
+    def for_tree(cls, tree: Any, old_world: int,
+                 new_world: int) -> "ShardRemapPlan":
+        import jax
+        import numpy as np
+
+        leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+        return cls(old_world, new_world, [int(l.size) for l in leaves],
+                   [l.dtype for l in leaves])
+
+    def segments_for(self, new_rank: int) -> List[tuple]:
+        """(leaf, old_rank, src_lo, src_hi, dst_lo) segments that build
+        `new_rank`'s slice of every leaf. src offsets are relative to the
+        old rank's slice start; dst to the new rank's."""
+        segs = []
+        for leaf, size in enumerate(self.leaf_sizes):
+            old_b = self.bounds(size, self.old_world)
+            ds, de = self.bounds(size, self.new_world)[new_rank]
+            for old_rank, (os_, oe) in enumerate(old_b):
+                lo, hi = max(ds, os_), min(de, oe)
+                if lo >= hi:
+                    continue
+                segs.append((leaf, old_rank, lo - os_, hi - os_, lo - ds))
+        return segs
+
+    def sources_for(self, new_rank: int) -> List[int]:
+        """Old ranks whose slices `new_rank` needs (sorted, deduped)."""
+        return sorted({s[1] for s in self.segments_for(new_rank)})
+
+    def remap(self, new_rank: int, old_shards: Dict[int, List]) -> List:
+        """Assemble `new_rank`'s per-leaf slices from old ranks' slices.
+
+        old_shards maps old_rank → per-leaf 1-D arrays (only the ranks in
+        sources_for(new_rank) need be present).
+        """
+        import numpy as np
+
+        out = []
+        for leaf, size in enumerate(self.leaf_sizes):
+            ds, de = self.bounds(size, self.new_world)[new_rank]
+            buf = None
+            for l, old_rank, src_lo, src_hi, dst_lo in self.segments_for(new_rank):
+                if l != leaf:
+                    continue
+                src = np.asarray(old_shards[old_rank][leaf])
+                if buf is None:
+                    buf = np.empty(de - ds, dtype=src.dtype)
+                buf[dst_lo:dst_lo + (src_hi - src_lo)] = src[src_lo:src_hi]
+            if buf is None:
+                dt = (self.leaf_dtypes[leaf]
+                      if self.leaf_dtypes is not None else np.float32)
+                buf = np.empty(de - ds, dtype=dt)
+            out.append(buf)
+        return out
+
+
+class ShardedState:
+    """One rank's slice of a sharded pytree (ZeRO-style optimizer state).
+
+    Holds the full tree's structure + leaf shapes/dtypes (the meta every
+    rank shares) and this rank's contiguous 1-D slice of each leaf. The
+    elastic resize path (train.sync_resize) republishes these slices
+    through the object store and rebuilds them under the new world size
+    via ShardRemapPlan — bit-for-bit, since remapping only moves bytes.
+    """
+
+    def __init__(self, meta: Dict, rank: int, world: int, slices: List):
+        self.meta = meta  # {"treedef", "shapes", "dtypes", "sizes", "scalars"}
+        self.rank = rank
+        self.world = world
+        self.slices = slices  # per-leaf 1-D np arrays (this rank's cut)
+
+    @classmethod
+    def create(cls, tree: Any, rank: int, world: int) -> "ShardedState":
+        """Shard a full pytree: rank keeps only its slice of each leaf."""
+        import jax
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        flats = [np.asarray(l).reshape(-1) for l in leaves]
+        meta = {
+            "treedef": treedef,
+            "shapes": [np.asarray(l).shape for l in leaves],
+            "dtypes": [f.dtype for f in flats],
+            "sizes": [f.size for f in flats],
+            "scalars": [isinstance(l, (int, float, bool)) for l in leaves],
+        }
+        bounds = [ShardRemapPlan.bounds(f.size, world)[rank] for f in flats]
+        slices = [f[lo:hi].copy() for f, (lo, hi) in zip(flats, bounds)]
+        return cls(meta, rank, world, slices)
+
+    def plan_to(self, new_world: int) -> ShardRemapPlan:
+        return ShardRemapPlan(self.world, new_world, self.meta["sizes"],
+                              self.meta["dtypes"])
+
+    def remapped(self, new_rank: int, new_world: int,
+                 old_shards: Dict[int, List]) -> "ShardedState":
+        """This state's meta + new_rank's slices under new_world,
+        assembled from old ranks' published slices."""
+        plan = self.plan_to(new_world)
+        return ShardedState(self.meta, new_rank, new_world,
+                            plan.remap(new_rank, old_shards))
+
+    @staticmethod
+    def assemble(meta: Dict, shards_by_rank: Dict[int, List]) -> Any:
+        """Rebuild the full pytree from every rank's slices."""
+        import jax
+        import numpy as np
+
+        world = len(shards_by_rank)
+        leaves = []
+        for i, size in enumerate(meta["sizes"]):
+            flat = np.concatenate(
+                [np.asarray(shards_by_rank[r][i]) for r in range(world)]
+            ) if size else np.empty(0, dtype=meta["dtypes"][i])
+            leaf = flat.astype(meta["dtypes"][i], copy=False).reshape(
+                meta["shapes"][i])
+            # tolist() on the 0-d array recovers the python scalar
+            # (these are host numpy buffers, never device arrays).
+            leaves.append(leaf.reshape(()).tolist()
+                          if meta["scalars"][i] else leaf)
+        return jax.tree_util.tree_unflatten(meta["treedef"], leaves)
+
+    def full(self, shards_by_rank: Dict[int, List]) -> Any:
+        return self.assemble(self.meta, shards_by_rank)
+
+    # -- partial-shard save/load ----------------------------------------
+    # Departing ranks persist exactly their slice before exiting through
+    # the drain plane; a cold restore (or a debugging session) can
+    # reassemble the full tree from whatever subset of ranks survived to
+    # disk plus the live remap refs.
+
+    def save(self, directory: str) -> str:
+        import cloudpickle
+
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"shard_{self.rank:05d}.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(
+                {"meta": self.meta, "rank": self.rank, "world": self.world,
+                 "slices": self.slices}, f)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, directory: str, rank: int) -> "ShardedState":
+        import cloudpickle
+
+        path = os.path.join(directory, f"shard_{rank:05d}.pkl")
+        with open(path, "rb") as f:
+            d = cloudpickle.load(f)
+        return cls(d["meta"], d["rank"], d["world"], d["slices"])
+
+    @classmethod
+    def load_all(cls, directory: str) -> Dict[int, "ShardedState"]:
+        out = {}
+        for name in sorted(os.listdir(directory)):
+            if name.startswith("shard_") and name.endswith(".pkl"):
+                rank = int(name[len("shard_"):-len(".pkl")])
+                out[rank] = cls.load(directory, rank)
+        return out
+
+
 class AsyncCheckpointer:
     """Asynchronous pytree checkpointing: save() returns once the arrays
     are snapshotted to host memory and serialization continues in

@@ -14,6 +14,25 @@ from typing import Any, Dict, Optional
 
 
 @dataclass
+class ResizePolicy:
+    """Bounds on elastic gang resizing (no reference analog — the
+    reference restarts; ray_tpu resizes).
+
+    min_world_size: never shrink below this many workers; a reclamation
+      that would need more chips falls back to checkpoint-and-restart
+      (full eviction).
+    resize_cooldown_s: minimum wall seconds between resizes, bounding
+      thrash when reclamation pressure flaps.
+    grow_back: poll the GCS fence-lift signal after a shrink and grow
+      back to the original world size once the claimant releases.
+    """
+
+    min_world_size: int = 1
+    resize_cooldown_s: float = 0.0
+    grow_back: bool = True
+
+
+@dataclass
 class ScalingConfig:
     """How to scale training (reference: air/config.py:101).
 
@@ -21,6 +40,9 @@ class ScalingConfig:
     use_tpu / tpus_per_worker: chips each worker owns (whole-host = all).
     mesh: optional parallel.MeshConfig describing the global mesh the
       workers jointly build (dp/fsdp/tp/sp/pp/ep factorization).
+    elastic: opt into resize-instead-of-restart under partial
+      reclamation (requires an elastic-aware loop calling
+      train.sync_resize at step boundaries).
     """
 
     num_workers: int = 1
@@ -32,6 +54,7 @@ class ScalingConfig:
     # Preemption tier of the gang's placement group: lower-priority gangs
     # are the first evicted when higher-priority demand cannot place.
     priority: int = 0
+    elastic: Optional[ResizePolicy] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -16,20 +16,28 @@ training_loop :432). Differences by design:
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.train.backend import BackendConfig, JaxConfig
-from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    ResizeError,
+    TrainingFailedError,
+)
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
+    ResizePolicy,
     Result,
     RunConfig,
     ScalingConfig,
 )
+
+logger = logging.getLogger("ray_tpu.train")
 
 
 def _fault_metrics():
@@ -71,6 +79,44 @@ def _skew_metrics():
             "Rank with the highest mean step wall time right now.",
         ),
     )
+
+
+class _ResizeGovernor:
+    """Applies a ResizePolicy to resize decisions: floors the shrink at
+    min_world_size, spaces resizes by resize_cooldown_s (thrash bound
+    when reclamation pressure flaps), and drives grow-back toward the
+    configured world size. The clock is injectable for deterministic
+    tests."""
+
+    def __init__(self, policy: ResizePolicy, baseline_world: int,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy
+        self.baseline = baseline_world
+        self.clock = clock
+        self._last_resize_t: Optional[float] = None
+
+    def shrink_target(self, world: int, draining_count: int) -> Optional[int]:
+        """World size to shrink to, or None when the policy forbids it
+        (caller falls back to checkpoint-and-restart migration)."""
+        target = world - draining_count
+        if target < max(1, self.policy.min_world_size):
+            return None
+        if not self._cooled_down():
+            return None
+        return target
+
+    def want_grow(self, world: int) -> bool:
+        return (self.policy.grow_back and world < self.baseline
+                and self._cooled_down())
+
+    def note_resized(self):
+        self._last_resize_t = self.clock()
+
+    def _cooled_down(self) -> bool:
+        if self._last_resize_t is None:
+            return True
+        return (self.clock() - self._last_resize_t
+                >= self.policy.resize_cooldown_s)
 
 
 def _mean_breakdown(records: List[Dict]) -> Dict[str, float]:
@@ -225,7 +271,14 @@ class DataParallelTrainer(BaseTrainer):
                     if backoff:
                         time.sleep(backoff)
                     t0 = time.monotonic()
-                    executor.restart()
+                    try:
+                        executor.restart()
+                    except TrainingFailedError as e2:
+                        # Restart itself failed (e.g. the old placement
+                        # group's release could not be confirmed —
+                        # respawning would leak a gang of chips).
+                        last_error = e2
+                        break
                     restarts.inc()
                     recovery.observe(time.monotonic() - t0)
         finally:
@@ -295,6 +348,11 @@ class DataParallelTrainer(BaseTrainer):
         trial_dir: str,
     ) -> Result:
         dataset_shards = self._shard_datasets(self.scaling_config.num_workers)
+        policy = self.scaling_config.elastic
+        governor = (
+            _ResizeGovernor(policy, self.scaling_config.num_workers)
+            if policy is not None else None
+        )
         try:
             executor.start_training(
                 self.train_loop_per_worker,
@@ -315,10 +373,43 @@ class DataParallelTrainer(BaseTrainer):
                     self._ingest(executor.poll(), manager)  # final drain
                     break
                 draining = executor.draining_ranks()
+                draining &= set(range(executor.world_size))
                 if draining:
-                    self._migrate_before_preemption(
-                        executor, manager, draining
+                    # Elastic-first: shed exactly the claimed ranks and
+                    # keep training; checkpoint-and-restart only when
+                    # the policy forbids the shrink or the gang's loop
+                    # turns out not to be elastic-aware.
+                    target = (
+                        governor.shrink_target(executor.world_size,
+                                               len(draining))
+                        if governor is not None else None
                     )
+                    new_shards = (
+                        self._elastic_resize(executor, target,
+                                             sorted(draining))
+                        if target is not None else None
+                    )
+                    if new_shards is not None:
+                        governor.note_resized()
+                        self._stop_shards(dataset_shards)
+                        dataset_shards = (new_shards
+                                          if new_shards != [] else None)
+                    else:
+                        self._migrate_before_preemption(
+                            executor, manager, draining
+                        )
+                elif (governor is not None
+                      and governor.want_grow(executor.world_size)
+                      and executor.fence_lifted()):
+                    # The partial-reclamation claimant released the
+                    # chips: grow back without a restart.
+                    new_shards = self._elastic_resize(
+                        executor, governor.baseline)
+                    if new_shards is not None:
+                        governor.note_resized()
+                        self._stop_shards(dataset_shards)
+                        dataset_shards = (new_shards
+                                          if new_shards != [] else None)
                 time.sleep(0.05)
         finally:
             self._stop_shards(dataset_shards)
@@ -329,6 +420,26 @@ class DataParallelTrainer(BaseTrainer):
             path=trial_dir,
             metrics_history=self._metrics_history,
         )
+
+    def _elastic_resize(self, executor, target: int,
+                        departing: Optional[List[int]] = None):
+        """Resize the gang in place, rebalancing data shards at the
+        boundary. Returns the new shard list on success ([] when the run
+        has no datasets), or None when the resize could not complete —
+        the gang is unchanged and the caller falls back to the
+        checkpoint-and-restart path."""
+        new_shards = self._shard_datasets(target)
+        try:
+            executor.resize(target, departing_ranks=departing,
+                            dataset_shards=new_shards)
+        except ResizeError as e:
+            logger.warning(
+                "elastic resize to %d worker(s) failed (%s); falling "
+                "back to checkpoint-and-restart", target, e,
+            )
+            self._stop_shards(new_shards)
+            return None
+        return new_shards if new_shards is not None else []
 
     def _migrate_before_preemption(self, executor, manager, draining):
         """A node hosting part of the gang is draining: ask every rank to

@@ -120,3 +120,59 @@ def remove_placement_group(pg: PlacementGroup):
     client._run(
         client.gcs.call("remove_placement_group", {"pg_id": pg.id.binary()})
     )
+
+
+def placement_group_state(pg: PlacementGroup) -> Optional[str]:
+    """Current GCS state of the group (None once it is forgotten)."""
+    client = worker_mod.get_client()
+    info = client._run(
+        client.gcs.call("get_placement_group", {"pg_id": pg.id.binary()})
+    )["pg"]
+    return info["state"] if info else None
+
+
+def release_placement_group_bundles(pg: PlacementGroup, indices: List[int]):
+    """Give individual bundles of a CREATED group back to the cluster
+    (elastic shrink): their chips are credited and, when the release
+    satisfies a partial-reclamation drain, the GCS records a *resize
+    obligation* so the gang can reclaim exactly these bundles later."""
+    client = worker_mod.get_client()
+    resp = client._run(
+        client.gcs.call(
+            "release_pg_bundles",
+            {"pg_id": pg.id.binary(), "indices": [int(i) for i in indices]},
+        )
+    )
+    if not resp.get("ok"):
+        raise PlacementGroupSchedulingError(
+            f"bundle release failed for pg {pg.id.hex()}: "
+            f"{resp.get('error', 'unknown error')}"
+        )
+
+
+def reserve_placement_group_bundles(pg: PlacementGroup, indices: List[int]):
+    """Re-reserve previously released bundles (elastic grow-back).
+    Fails while the chips are fenced for another claimant or occupied."""
+    client = worker_mod.get_client()
+    resp = client._run(
+        client.gcs.call(
+            "reserve_pg_bundles",
+            {"pg_id": pg.id.binary(), "indices": [int(i) for i in indices]},
+        )
+    )
+    if not resp.get("ok"):
+        raise PlacementGroupSchedulingError(
+            f"bundle re-reserve failed for pg {pg.id.hex()}: "
+            f"{resp.get('error', 'unknown error')}"
+        )
+
+
+def placement_group_resize_state(pg: PlacementGroup) -> Dict:
+    """Resize obligations recorded against this group: the bundles it
+    gave up to a partial reclamation and whether the claimant has
+    released them (state \"lifted\" — the fence-lift signal the trainer's
+    grow-back path polls)."""
+    client = worker_mod.get_client()
+    return client._run(
+        client.gcs.call("get_resize_state", {"pg_id": pg.id.binary()})
+    )

@@ -466,6 +466,20 @@ CLAIMS = [
     Claim("MIGRATION.md", r"saw\s*\n?\s*(\d+) lost non-shed",
           _bench_multitenant("three-tenant SLO accounting",
                              "lost_non_shed"), rel_tol=0.0),
+    # Elastic training <- the elastic-vs-evict probe of the same
+    # artifact. Steps lost and the step target are exact pins; the
+    # goodput ratio is wall-clock so it gets a loose tolerance.
+    Claim("MIGRATION.md", r"holds them for (\d+) s",
+          _bench_multitenant("elastic resize", "chips_held_s"),
+          rel_tol=0.0),
+    Claim("MIGRATION.md", r"finished all (\d+)\s*\n?\s*steps",
+          _bench_multitenant("elastic resize", "steps"), rel_tol=0.0),
+    Claim("MIGRATION.md", r"losing (\d+) steps",
+          lambda: _bench_multitenant("elastic resize", "elastic")()
+          ["steps_lost"], rel_tol=0.0),
+    Claim("MIGRATION.md", r"delivered (\d+\.\d+)× the goodput",
+          _bench_multitenant("elastic resize", "goodput_ratio"),
+          rel_tol=0.5, note="wall-clock dependent; gate is > 1.0"),
     # Static-analysis section <- rtlint itself. Exact pins (rel_tol=0):
     # adding a rule or regenerating the baseline must update the doc.
     Claim("MIGRATION.md", r"lint pass\s*\n?\s*with (\d+) rules",
