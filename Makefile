@@ -6,14 +6,22 @@
 PY ?= python
 LINT_JOBS ?= 4
 
-.PHONY: lint rtlint lint-stats lint-changed sanitizers test fast-test \
+.PHONY: lint rtlint lint-stats lint-changed lint-fix sanitizers test \
+  fast-test \
   bench-data bench-obs bench-scale bench-serve-obs bench-serve-ft \
   bench-collective bench-multitenant bench-paged-kv bench-serve-macro
 
 lint: rtlint sanitizers
 
+# The gate also drops a SARIF artifact for code-scanning upload.
+RTLINT_SARIF ?= rtlint.sarif
 rtlint:
-	$(PY) -m tools.rtlint --jobs $(LINT_JOBS)
+	$(PY) -m tools.rtlint --jobs $(LINT_JOBS) --sarif-out $(RTLINT_SARIF)
+
+# Apply the mechanical autofixes (RT004 ref leash, RT013 boundary
+# tuple-freeze) in place, then report what is left for a human.
+lint-fix:
+	$(PY) -m tools.rtlint --jobs $(LINT_JOBS) --fix
 
 # Per-rule found/suppressed/baselined counts over the default targets;
 # MIGRATION.md pins these via tools/check_claims.py.

@@ -41,6 +41,7 @@ Injection table (all gated on RT_CHAOS=1):
   exhaust_kv_pages(frac)    | replica process   | KV page-pool pressure
   kill_replica_at(t, app)   | driver (sched)    | replica death at trace time t
   drop_controller_at(t)     | driver (sched)    | controller crash at trace time t
+  anchor_schedule(off)      | driver (sched)    | pins t=0 for the *_at faults
 
 Schedule-anchored faults (`*_at`) fire at a fixed offset from an anchor
 set by `anchor_schedule()` — the same t=0 a recorded loadgen trace

@@ -47,6 +47,7 @@ Survival plane (PR 8) layered on the router:
 
 from __future__ import annotations
 
+import logging
 import os
 import random
 import threading
@@ -66,6 +67,9 @@ from ray_tpu.exceptions import (
     TaskError,
     WorkerCrashedError,
 )
+
+
+logger = logging.getLogger("ray_tpu.serve.handle")
 
 
 def _is_death(err: BaseException) -> bool:
@@ -296,7 +300,9 @@ class DeploymentHandle:
                 f"serve_routes:{self.app_name}", on_push
             )
         except Exception:  # noqa: BLE001 — polling still works
-            pass
+            logger.debug("route-invalidation push subscribe failed for "
+                         "app %r; falling back to TTL polling",
+                         self.app_name, exc_info=True)
 
     def _refresh(self, force: bool = False):
         self._subscribe_invalidation()

@@ -177,7 +177,10 @@ class ProxyActor:
             )
             try:
                 payload = await request.json()
-            except Exception:
+            except Exception:  # noqa: BLE001 — body may be empty/non-JSON
+                logger.debug("request body for %s is not JSON; "
+                             "forwarding an empty payload", app_name,
+                             exc_info=True)
                 payload = None
             loop = asyncio.get_event_loop()
             handle = get_handle(app_name)

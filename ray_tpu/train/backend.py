@@ -18,8 +18,11 @@ worker). The TPU-native backend instead:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Dict, Optional
+
+logger = logging.getLogger("ray_tpu.train.backend")
 
 
 class Backend:
@@ -88,8 +91,10 @@ class _JaxBackend(Backend):
         if backend_config.dp_sync == "dcn" and len(worker_group) > 1:
             try:
                 worker_group.execute(_destroy_dcn_group)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — workers may already be gone
+                logger.warning("DCN collective group teardown failed on "
+                               "shutdown (workers may already be dead)",
+                               exc_info=True)
 
     def on_resize(self, worker_group, backend_config: JaxConfig):
         """Tear down and rebuild the DCN ring at the new world size.

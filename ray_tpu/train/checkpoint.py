@@ -10,10 +10,13 @@ so every host persists only its shards.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import tempfile
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu.train.checkpoint")
 
 
 class Checkpoint:
@@ -156,7 +159,11 @@ class CheckpointManager:
                 try:
                     self.storage.delete(e["storage_name"])
                 except Exception:  # noqa: BLE001 — best-effort cleanup
-                    pass
+                    # Silent failure here grows remote storage without
+                    # bound, so at least leave a trace.
+                    logger.warning("retention could not delete %r from "
+                                   "storage", e["storage_name"],
+                                   exc_info=True)
             self.registered.remove(e)
 
     def _write_index(self):
@@ -412,5 +419,6 @@ class AsyncCheckpointer:
     def close(self):
         try:
             self._ckptr.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — close is best-effort
+            logger.debug("async checkpointer close failed",
+                         exc_info=True)

@@ -13,11 +13,14 @@ the JAX path.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Optional
 
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.trainer import DataParallelTrainer
+
+logger = logging.getLogger("ray_tpu.train.torch")
 
 
 @dataclass
@@ -49,7 +52,9 @@ class _TorchBackend(Backend):
         try:
             worker_group.execute(_torch_process_group_destroy)
         except Exception:  # noqa: BLE001 — workers may already be gone
-            pass
+            logger.debug("torch process-group destroy failed on "
+                         "shutdown (workers may already be dead)",
+                         exc_info=True)
 
 
 def _get_host_ip():

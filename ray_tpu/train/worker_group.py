@@ -13,6 +13,7 @@ group over {TPU: chips_per_host} bundles.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -29,6 +30,8 @@ from ray_tpu.util.placement_group import (
     reserve_placement_group_bundles,
 )
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+logger = logging.getLogger("ray_tpu.train.worker_group")
 
 
 @rt.remote
@@ -334,8 +337,9 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 rt.kill(w)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — already-dead is expected
+                logger.debug("kill of train worker failed (already "
+                             "dead?)", exc_info=True)
         if self._pg is None:
             return
         pg, self._pg = self._pg, None
@@ -344,7 +348,7 @@ class WorkerGroup:
             try:
                 remove_placement_group(pg)
                 last_error = None
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # rtlint: disable=RT007 — carried into the PlacementGroupSchedulingError raised below
                 last_error = e
             if not verify:
                 return

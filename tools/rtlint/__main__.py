@@ -7,6 +7,8 @@
     python -m tools.rtlint --changed              git-diff-scoped pass 2
     python -m tools.rtlint --jobs 8               parallel analysis
     python -m tools.rtlint --format json|sarif    machine-readable output
+    python -m tools.rtlint --sarif-out FILE       sarif artifact alongside text
+    python -m tools.rtlint --fix                  apply mechanical autofixes
     python -m tools.rtlint --stats                per-rule counts
     python -m tools.rtlint --list-rules           one-line rule catalog
     python -m tools.rtlint --explain RT003        full rule rationale
@@ -93,6 +95,13 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=None,
                     help="repo root for relative finding paths "
                          "(default: the checkout containing rtlint)")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply mechanical autofixes (RT004 leash, "
+                         "RT013 boundary tuple-freeze) in place, then "
+                         "re-lint")
+    ap.add_argument("--sarif-out", default=None, metavar="FILE",
+                    help="also write a SARIF artifact of the new "
+                         "findings to FILE (independent of --format)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--explain", metavar="RTxxx")
     args = ap.parse_args(argv)
@@ -147,6 +156,16 @@ def main(argv=None) -> int:
                            cache_path=cache_path, only_files=only_files)
     findings = result.findings
 
+    if args.fix:
+        nfixed = _apply_fixes(findings, root)
+        if nfixed:
+            # Re-lint so the report (and exit code) reflects the
+            # post-fix tree; the content-hash cache skips the rest.
+            result = analyze_paths(paths, rules=rules, root=root,
+                                   jobs=args.jobs, cache_path=cache_path,
+                                   only_files=only_files)
+            findings = result.findings
+
     if args.write_baseline:
         bl = Baseline.from_findings(findings)
         bl.save(args.baseline)
@@ -171,6 +190,11 @@ def main(argv=None) -> int:
     nrules = len(ALL_RULES) if rules is None else len(rules)
     meta = dict(total=len(findings), files=result.files, rules=nrules,
                 baselined_absorbed=len(findings) - len(new), stale=stale)
+    if args.sarif_out:
+        docs = {r.id: (r.__doc__ or "").strip() for r in ALL_RULES}
+        docs["RT000"] = "analyzer degradation note"
+        with open(args.sarif_out, "w", encoding="utf-8") as fh:
+            fh.write(render_sarif(new, rule_docs=docs))
     if args.fmt == "json":
         print(render_json(new, suppressed=result.suppressed, **meta))
     elif args.fmt == "sarif":
@@ -182,6 +206,44 @@ def main(argv=None) -> int:
     if new:
         return 1
     return 1 if (stale and args.strict_baseline) else 0
+
+
+def _apply_fixes(findings, root: str) -> int:
+    """Rewrite files for fixable findings; returns files changed.
+
+    Driven by the analyzer's (suppression-filtered) findings rather
+    than a raw re-scan, so `# rtlint:` suppressed sites — e.g. an
+    intentional fire-and-forget — are never touched.
+    """
+    from tools.rtlint.fix import FIXABLE_RULES, fix_source
+    by_path = {}
+    for f in findings:
+        if f.rule in FIXABLE_RULES:
+            by_path.setdefault(f.path, {}).setdefault(
+                f.rule, set()).add(f.line)
+    changed = 0
+    for rel, rule_lines in sorted(by_path.items()):
+        abspath = os.path.join(root, rel.replace("/", os.sep))
+        try:
+            with open(abspath, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            print(f"rtlint: --fix cannot read {rel}: {e}",
+                  file=sys.stderr)
+            continue
+        out, notes = fix_source(
+            src, rel,
+            rt004_lines=rule_lines.get("RT004", set()),
+            rt013_lines=rule_lines.get("RT013", set()))
+        for note in notes:
+            print(f"rtlint: fix: {note}")
+        if out != src:
+            with open(abspath, "w", encoding="utf-8") as fh:
+                fh.write(out)
+            changed += 1
+    if changed:
+        print(f"rtlint: --fix rewrote {changed} file(s)")
+    return changed
 
 
 def _print_stats(findings, new, suppressed, baseline, rules):

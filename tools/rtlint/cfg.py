@@ -1,0 +1,415 @@
+"""rtlint v3: per-function control-flow graphs.
+
+``build_cfg(func_def)`` lowers one ``def``/``async def`` body to a
+statement-level CFG: one node per simple statement (plus entry/exit
+markers), edges for branches, loop back-edges, ``break``/``continue``,
+early ``return``, and — the part the lifecycle rules live on —
+*exception edges*. Any statement that can raise (an explicit ``raise``,
+an ``assert``, or any statement containing a call) gets an edge to the
+innermost enclosing ``except``/``finally`` construct, or to the
+function's ``raise_exit`` when nothing encloses it. ``finally`` bodies
+are duplicated (a normal-completion copy and an exceptional copy that
+keeps propagating afterwards) so a path through ``finally`` reads
+correctly in both directions. ``with contextlib.suppress(...)`` routes
+body exceptions to the statement *after* the with, modelling the
+swallow.
+
+The graph is deliberately statement-grained rather than basic-block
+grained: findings report the exact line sequence of the leaking path,
+and statements are the natural unit for that.
+
+Nodes are integers; ``CFG.stmts[i]`` is the AST statement (None for the
+entry/exit markers), ``CFG.succ[i]`` the outgoing ``(target, label)``
+edges with label in {"next", "true", "false", "loop", "exc", "raise"}.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+# Calls to these bare names are assumed non-raising for exception-edge
+# purposes: flagging "len() might raise" would drown every real leak.
+SAFE_CALLS = {
+    "len", "min", "max", "abs", "sum", "int", "float", "str", "bool",
+    "bytes", "list", "dict", "set", "tuple", "frozenset", "sorted",
+    "reversed", "enumerate", "zip", "range", "repr", "id", "type",
+    "isinstance", "issubclass", "getattr", "hasattr", "format", "print",
+    "iter", "next", "round", "divmod", "hash", "callable", "vars",
+}
+# Method leaves assumed non-raising (container plumbing).
+SAFE_METHODS = {
+    "append", "extend", "add", "discard", "update", "setdefault",
+    "keys", "values", "items", "get", "pop", "popleft", "clear",
+    "copy", "join", "split", "strip", "startswith", "endswith",
+    "lower", "upper", "format", "encode", "decode", "count", "index",
+    "debug", "info", "warning", "error", "exception", "critical",
+    "monotonic", "time", "perf_counter", "sleep", "suppress",
+}
+
+
+class CFG:
+    ENTRY = 0
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.stmts: List[Optional[ast.stmt]] = [None]  # 0 = entry
+        self.kinds: List[str] = ["entry"]
+        self.succ: Dict[int, List[Tuple[int, str]]] = {0: []}
+        # Synthetic exits, created lazily via _marker().
+        self.exit = self._marker("exit")          # return / fall-off-end
+        self.raise_exit = self._marker("raise")   # uncaught exception
+
+    def _marker(self, kind: str) -> int:
+        idx = len(self.stmts)
+        self.stmts.append(None)
+        self.kinds.append(kind)
+        self.succ[idx] = []
+        return idx
+
+    def add(self, stmt: ast.stmt, kind: str = "stmt") -> int:
+        idx = len(self.stmts)
+        self.stmts.append(stmt)
+        self.kinds.append(kind)
+        self.succ[idx] = []
+        return idx
+
+    def edge(self, src: int, dst: int, label: str = "next"):
+        if (dst, label) not in self.succ[src]:
+            self.succ[src].append((dst, label))
+
+    def line(self, idx: int) -> int:
+        stmt = self.stmts[idx]
+        return getattr(stmt, "lineno", 0) if stmt is not None else 0
+
+    def is_exit(self, idx: int) -> bool:
+        return idx in (self.exit, self.raise_exit)
+
+
+def _expr_may_raise(*nodes: ast.AST) -> bool:
+    for root in nodes:
+        if root is None:
+            continue
+        for node in ast.walk(root):
+            if isinstance(node, (ast.Await, ast.YieldFrom)):
+                return True
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in SAFE_CALLS:
+                continue
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in SAFE_METHODS:
+                continue
+            return True
+    return False
+
+
+def may_raise(stmt: ast.stmt) -> bool:
+    """Can executing this statement's *own* evaluation raise (not its
+    nested body, for compound statements)? Conservative-but-calibrated:
+    explicit raise/assert always; otherwise any embedded call whose
+    target is not on the safe list. Attribute/subscript access alone is
+    not counted — counting it flags every line of real code."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, ast.If):
+        return _expr_may_raise(stmt.test)
+    if isinstance(stmt, ast.While):
+        return _expr_may_raise(stmt.test)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return _expr_may_raise(stmt.iter)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return _expr_may_raise(*[i.context_expr for i in stmt.items])
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Try)):
+        return False
+    return _expr_may_raise(stmt)
+
+
+def _is_suppress_with(stmt: ast.stmt) -> bool:
+    if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return False
+    for item in stmt.items:
+        expr = item.context_expr
+        target = expr.func if isinstance(expr, ast.Call) else expr
+        leaf = None
+        if isinstance(target, ast.Attribute):
+            leaf = target.attr
+        elif isinstance(target, ast.Name):
+            leaf = target.id
+        if leaf == "suppress":
+            return True
+    return False
+
+
+class _Builder:
+    """Recursive statement-list lowering.
+
+    ``exc_targets`` is a stack; each entry is a list of node ids that a
+    raised exception inside the region flows to (handler heads and/or
+    the exceptional finally copy). An empty stack means exceptions leave
+    the function via ``raise_exit``.
+    """
+
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        self.exc_targets: List[List[int]] = []
+        # (continue_target, break_sinks) per enclosing loop. break_sinks
+        # is a mutable list the loop collects exits from.
+        self.loops: List[Tuple[int, List[int]]] = []
+        # Statements that leave the function normally (return) route
+        # through enclosing finally blocks; each frame is the id of the
+        # normal-copy finally head to pass through, or None.
+        self.finally_heads: List[Optional[int]] = []
+
+    # -- exception plumbing ----------------------------------------------
+    def _raise_edges(self, idx: int):
+        if self.exc_targets and self.exc_targets[-1]:
+            for tgt in self.exc_targets[-1]:
+                self.cfg.edge(idx, tgt, "exc")
+        else:
+            self.cfg.edge(idx, self.cfg.raise_exit, "exc")
+
+    def _route_return(self, idx: int):
+        """A return passes through enclosing finally bodies (innermost
+        first) via their dedicated return-path copies; with none, it
+        reaches the function exit directly."""
+        for head in reversed(self.finally_heads):
+            if head is not None:
+                self.cfg.edge(idx, head, "next")
+                return  # the return-copy's tail continues the routing
+        self.cfg.edge(idx, self.cfg.exit, "next")
+
+    # -- main lowering ----------------------------------------------------
+    def build(self, body: List[ast.stmt], frontier: List[int],
+              ) -> List[int]:
+        """Lower `body`; `frontier` is the set of nodes whose fall-
+        through enters the list. Returns the new frontier (nodes that
+        fall through past the end of the list)."""
+        for stmt in body:
+            if not frontier:
+                break  # unreachable code after return/raise/break
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _join(self, frontier: List[int], idx: int, label: str = "next"):
+        for f in frontier:
+            self.cfg.edge(f, idx, label)
+
+    def _stmt(self, stmt: ast.stmt, frontier: List[int]) -> List[int]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            idx = cfg.add(stmt, "branch")
+            self._join(frontier, idx)
+            if may_raise(stmt):
+                self._raise_edges(idx)
+            then = self.build(stmt.body, [idx])
+            els = self.build(stmt.orelse, [idx]) if stmt.orelse else [idx]
+            return then + els
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = cfg.add(stmt, "loop")
+            self._join(frontier, head)
+            if may_raise(stmt):
+                self._raise_edges(head)
+            breaks: List[int] = []
+            self.loops.append((head, breaks))
+            tail = self.build(stmt.body, [head])
+            self.loops.pop()
+            self._join(tail, head, "loop")
+            out = self.build(stmt.orelse, [head]) if stmt.orelse else [head]
+            return out + breaks
+
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            idx = cfg.add(stmt, "with")
+            self._join(frontier, idx)
+            if may_raise(stmt):
+                self._raise_edges(idx)
+            if _is_suppress_with(stmt):
+                # Body exceptions are swallowed by __exit__ and control
+                # resumes after the with block: route them to a
+                # synthetic join node that becomes part of the frontier.
+                join = cfg._marker("suppress-join")
+                self.exc_targets.append([join])
+                tail = self.build(stmt.body, [idx])
+                self.exc_targets.pop()
+                return tail + [join]
+            tail = self.build(stmt.body, [idx])
+            return tail
+
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            idx = cfg.add(stmt, "def")   # nested defs: opaque statement
+            self._join(frontier, idx)
+            return [idx]
+
+        if isinstance(stmt, ast.Return):
+            idx = cfg.add(stmt, "return")
+            self._join(frontier, idx)
+            if may_raise(stmt):
+                self._raise_edges(idx)
+            self._route_return(idx)
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            idx = cfg.add(stmt, "raise")
+            self._join(frontier, idx)
+            self._raise_edges(idx)
+            return []
+
+        if isinstance(stmt, ast.Break):
+            idx = cfg.add(stmt, "break")
+            self._join(frontier, idx)
+            if self.loops:
+                self.loops[-1][1].append(idx)
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            idx = cfg.add(stmt, "continue")
+            self._join(frontier, idx)
+            if self.loops:
+                cfg.edge(idx, self.loops[-1][0], "loop")
+            return []
+
+        # Simple statement (Assign, Expr, AugAssign, Assert, ...).
+        idx = cfg.add(stmt)
+        self._join(frontier, idx)
+        if may_raise(stmt):
+            self._raise_edges(idx)
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, (ast.Yield, ast.YieldFrom)):
+            # A generator can be closed at any yield: GeneratorExit
+            # leaves the function through finally/raise machinery.
+            self._raise_edges(idx)
+        return [idx]
+
+    # -- try/except/finally ------------------------------------------------
+    def _try(self, stmt: ast.Try, frontier: List[int]) -> List[int]:
+        cfg = self.cfg
+        out: List[int] = []
+
+        # Exceptional finally copy first, so handler-less raises and
+        # handler-internal raises have somewhere to land.
+        exc_finally_head: Optional[int] = None
+        exc_finally_tail: List[int] = []
+        if stmt.finalbody:
+            marker = cfg.add(stmt, "finally")
+            exc_finally_head = marker
+            saved_loops, self.loops = self.loops, []
+            exc_finally_tail = self.build(stmt.finalbody, [marker])
+            self.loops = saved_loops
+            # After the exceptional copy, the exception keeps going.
+            for t in exc_finally_tail:
+                if self.exc_targets and self.exc_targets[-1]:
+                    for tgt in self.exc_targets[-1]:
+                        cfg.edge(t, tgt, "exc")
+                else:
+                    cfg.edge(t, cfg.raise_exit, "exc")
+
+        # Handler heads: body exceptions dispatch to every handler (we
+        # do not model type matching) and, with no handler, straight to
+        # the exceptional finally / outward.
+        handler_heads: List[int] = []
+        handler_nodes: List[Tuple[int, ast.ExceptHandler]] = []
+        for handler in stmt.handlers:
+            h = cfg.add(handler, "except")
+            handler_heads.append(h)
+            handler_nodes.append((h, handler))
+        body_exc: List[int] = list(handler_heads)
+        if not body_exc and exc_finally_head is not None:
+            body_exc = [exc_finally_head]
+        # A raise that no local handler matches still escapes: when
+        # handlers exist AND a finally exists, the finally is also a
+        # target (unmatched-type path).
+        if handler_heads and exc_finally_head is not None:
+            body_exc.append(exc_finally_head)
+
+        self.exc_targets.append(body_exc)
+        if stmt.finalbody:
+            # returns inside the body route through a dedicated
+            # return-path copy of the finally (built after the body)
+            # whose tail keeps unwinding — NOT through the fall-through
+            # copy, which would wrongly rejoin the post-try code.
+            return_head: Optional[int] = cfg._marker("finally")
+        else:
+            return_head = None
+        self.finally_heads.append(return_head)
+
+        body_tail = self.build(stmt.body, frontier)
+        body_tail = self.build(stmt.orelse, body_tail) \
+            if stmt.orelse else body_tail
+        self.exc_targets.pop()
+
+        # Handlers run with the *outer* exception context (a raise in a
+        # handler propagates out, or into the exceptional finally); a
+        # return in a handler still unwinds through this finally, so
+        # the finally frame stays pushed.
+        handler_tails: List[int] = []
+        for h, handler in handler_nodes:
+            targets = ([exc_finally_head] if exc_finally_head is not None
+                       else list(self.exc_targets[-1])
+                       if self.exc_targets else [])
+            self.exc_targets.append(targets)
+            tail = self.build(handler.body, [h])
+            self.exc_targets.pop()
+            handler_tails.extend(tail)
+        self.finally_heads.pop()
+
+        if return_head is not None:
+            saved_loops, self.loops = self.loops, []
+            ret_tail = self.build(stmt.finalbody, [return_head])
+            self.loops = saved_loops
+            for t in ret_tail:
+                self._route_return(t)
+
+        # Normal finally copy: body + handler fall-throughs pass
+        # through it, then continue after the try.
+        if stmt.finalbody:
+            normal_head = cfg.add(stmt, "finally")
+            self._join(body_tail + handler_tails, normal_head)
+            saved_loops, self.loops = self.loops, []
+            tail = self.build(stmt.finalbody, [normal_head])
+            self.loops = saved_loops
+            out.extend(tail)
+        else:
+            out.extend(body_tail + handler_tails)
+        return out
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef body."""
+    cfg = CFG(func)
+    b = _Builder(cfg)
+    tail = b.build(list(getattr(func, "body", [])), [CFG.ENTRY])
+    for t in tail:
+        cfg.edge(t, cfg.exit, "next")
+    return cfg
+
+
+def iter_paths(cfg: CFG, start: int = CFG.ENTRY, max_states: int = 20000):
+    """Debug/test helper: DFS enumeration of (node sequence) paths from
+    `start` to either exit, with a visited-state bound. Used by the CFG
+    unit tests; the lifecycle analysis does its own stateful walk."""
+    paths = []
+    stack = [(start, [start])]
+    steps = 0
+    seen = set()
+    while stack and steps < max_states:
+        steps += 1
+        node, path = stack.pop()
+        if cfg.is_exit(node):
+            paths.append(path)
+            continue
+        for dst, _label in cfg.succ.get(node, ()):
+            if (node, dst) in zip(path, path[1:]):
+                continue  # do not retake the same edge within one path
+            key = (dst, tuple(path[-3:]))
+            if key in seen and len(path) > 64:
+                continue
+            seen.add(key)
+            stack.append((dst, path + [dst]))
+    return paths

@@ -40,8 +40,35 @@ from tools.rtlint.project import (ProjectModel, empty_summary,
 
 _SUPPRESS_RE = re.compile(r"#\s*rtlint:\s*disable(?:=([A-Za-z0-9_,\s]+))?")
 
-# Bump when rule logic changes: invalidates cached pass-2 findings.
-ENGINE_VERSION = "2.0"
+# Engine/summary-shape version: invalidates the whole cache on bump.
+# Rule-logic edits are caught automatically by _rulepack_digest(), which
+# hashes the linter's own sources into every findings-cache key — before
+# that, editing a rule silently served stale findings until the *target*
+# file changed.
+ENGINE_VERSION = "3.0"
+
+_RULEPACK_DIGEST: Optional[str] = None
+
+
+def _rulepack_digest() -> str:
+    """Content hash of the rule pack itself (every .py under
+    tools/rtlint). Memoized per process."""
+    global _RULEPACK_DIGEST
+    if _RULEPACK_DIGEST is None:
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        for dirpath, dirs, files in os.walk(pkg):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    h.update(fn.encode())
+                    try:
+                        with open(os.path.join(dirpath, fn), "rb") as f:
+                            h.update(f.read())
+                    except OSError:
+                        pass
+        _RULEPACK_DIGEST = h.hexdigest()[:16]
+    return _RULEPACK_DIGEST
 
 # The repo-wide default target set (relative to the lint root): the
 # runtime, the tooling (rtlint lints itself), and the root benches.
@@ -407,14 +434,19 @@ class _Cache:
 
     def __init__(self, path: Optional[str]):
         self.path = path
-        self.data = {"version": ENGINE_VERSION, "summaries": {},
+        # The rule-pack digest is part of the cache version: editing any
+        # linter source (rules OR summarizer) invalidates everything.
+        # Summaries are keyed only by target-file sha, so without this a
+        # summarizer change would silently serve stale pass-1 output.
+        version = f"{ENGINE_VERSION}|{_rulepack_digest()}"
+        self.data = {"version": version, "summaries": {},
                      "findings": {}}
         self.dirty = False
         if path and os.path.exists(path):
             try:
                 with open(path) as f:
                     loaded = json.load(f)
-                if loaded.get("version") == ENGINE_VERSION:
+                if loaded.get("version") == version:
                     self.data = loaded
             except Exception:
                 pass  # corrupt cache == cold cache
@@ -601,7 +633,8 @@ def analyze_paths(paths: Sequence[str],
                            if only_files is None
                            or f.path in set(only_files)
                            or f.path == "<project>")
-    key = f"{digest}|{ENGINE_VERSION}|{','.join(rule_ids)}"
+    key = (f"{digest}|{ENGINE_VERSION}|{_rulepack_digest()}"
+           f"|{','.join(rule_ids)}")
     todo: List[str] = []
     for rel in lint_rels:
         hit = cache.findings(rel, f"{shas[rel]}|{key}")

@@ -1,0 +1,156 @@
+"""rtlint --fix: mechanical autofixes for the two fully-local shapes.
+
+Only rewrites whose correctness is decidable from the statement alone
+are automated; everything else stays a finding for a human.
+
+- RT004 ``f.remote(...)`` as a bare expression statement: the ref (and
+  the task's error) is silently dropped. Rewritten to the leash idiom
+  RULES.md prescribes — assign the ref, then reap it with a
+  zero-timeout ``rt.wait`` so errors stay observable::
+
+      f.remote(x)
+  ->
+      _reaped = f.remote(x)
+      rt.wait([_reaped], timeout=0)
+
+  Applied only when the module binds the name ``rt`` via an import;
+  otherwise the fix is skipped (and reported) rather than introducing
+  an undefined name.
+
+- RT013 ``boundaries=[...]`` list literal in a metric registration:
+  histograms key aggregation on the boundary object, so the literal is
+  frozen in place — ``[`` / ``]`` become ``(`` / ``)``. Single-element
+  lists grow a trailing comma so the result stays a tuple.
+
+Both fixes are idempotent: the rewritten form no longer matches the
+rule, so a second pass is a no-op (tests assert fix(fix(s)) == fix(s)).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+__all__ = ["fix_source", "FIXABLE_RULES"]
+
+FIXABLE_RULES = ("RT004", "RT013")
+
+
+def _module_binds_rt(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if bound == "rt":
+                    return True
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if (alias.asname or alias.name) == "rt":
+                    return True
+    return False
+
+
+def _boundary_lists(tree: ast.Module) -> List[Tuple[ast.Call, ast.List]]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "boundaries" and isinstance(kw.value, ast.List):
+                out.append((node, kw.value))
+    return out
+
+
+def _bare_remote_stmts(tree: ast.Module) -> List[ast.Expr]:
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "remote"):
+            out.append(node)
+    return out
+
+
+def _restrict(nodes: Iterable[ast.AST],
+              lines: Optional[Set[int]]) -> List[ast.AST]:
+    if lines is None:
+        return list(nodes)
+    return [n for n in nodes if n.lineno in lines]
+
+
+def fix_source(source: str, path: str = "<fix>",
+               rt004_lines: Optional[Set[int]] = None,
+               rt013_lines: Optional[Set[int]] = None,
+               ) -> Tuple[str, List[str]]:
+    """Rewrite `source`; returns (new_source, human-readable notes).
+
+    `rt004_lines` / `rt013_lines` restrict each fix to findings at
+    those 1-based lines (None fixes every match — used by tests);
+    passing the analyzer's finding lines keeps suppressed and
+    intentionally fire-and-forget sites untouched.
+    """
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source, []
+    lines = source.splitlines(keepends=True)
+    notes: List[str] = []
+
+    # RT013 first: pure character replacements, line numbers never move.
+    # The rule anchors its finding at the registration *call*, so the
+    # restriction matches any line from the call head through the list.
+    boundary = _boundary_lists(tree)
+    if rt013_lines is not None:
+        boundary = [
+            (call, lst) for call, lst in boundary
+            if rt013_lines & set(range(call.lineno, lst.end_lineno + 1))]
+    for _call, lst in boundary:
+        open_ln, open_col = lst.lineno - 1, lst.col_offset
+        close_ln, close_col = lst.end_lineno - 1, lst.end_col_offset - 1
+        if (lines[open_ln][open_col] != "["
+                or lines[close_ln][close_col] != "]"):
+            continue
+        comma = ""
+        if len(lst.elts) == 1:
+            # (x) is not a tuple; (x,) is.
+            comma = ","
+        lines[close_ln] = (lines[close_ln][:close_col] + comma + ")"
+                           + lines[close_ln][close_col + 1:])
+        lines[open_ln] = (lines[open_ln][:open_col] + "("
+                          + lines[open_ln][open_col + 1:])
+        notes.append(f"{path}:{lst.lineno}: RT013 froze boundaries "
+                     f"list literal to a tuple")
+
+    # RT004: line insertions — apply bottom-up so earlier linenos stay
+    # valid.
+    targets = _restrict(_bare_remote_stmts(tree), rt004_lines)
+    if targets and not _module_binds_rt(tree):
+        notes.append(f"{path}: skipped {len(targets)} discarded-"
+                     f"ObjectRef fix(es) — module does not import `rt`, "
+                     f"cannot emit the rt.wait leash")
+        targets = []
+    reap = "_reaped"
+    while targets and reap in source:
+        reap += "_"
+    ref_notes: List[str] = []
+    for node in sorted(targets, key=lambda n: n.lineno, reverse=True):
+        first = lines[node.lineno - 1]
+        indent = first[:node.col_offset]
+        if indent.strip():
+            # Not alone on its line (`x; f.remote()`): leave for a human.
+            ref_notes.append(f"{path}:{node.lineno}: skipped discarded-"
+                             f"ObjectRef fix — statement shares its line")
+            continue
+        lines[node.lineno - 1] = (indent + f"{reap} = "
+                                  + first[node.col_offset:])
+        last = node.end_lineno - 1
+        if not lines[last].endswith("\n"):
+            lines[last] += "\n"
+        lines.insert(last + 1,
+                     f"{indent}rt.wait([{reap}], timeout=0)\n")
+        ref_notes.append(f"{path}:{node.lineno}: RT004 leashed "
+                         f"discarded ObjectRef (`{reap} = ...; "
+                         f"rt.wait(..., timeout=0)`)")
+    notes.extend(reversed(ref_notes))
+    return "".join(lines), notes

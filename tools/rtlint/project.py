@@ -94,6 +94,8 @@ class _ModuleSummarizer(ast.NodeVisitor):
             "classes": {},        # class name -> class dict
             "jit_passed": [],     # local function names passed to jit()
             "thread_targets": [],  # dotted names given to Thread/executor
+            "metric_defs": [],    # metric names registered in this module
+            "panel_exprs": [],    # grafana (expr, lineno) pairs
         }
         self._stack: List[Tuple[str, ast.AST]] = []  # (qualname, node)
         self._class_stack: List[str] = []
@@ -181,6 +183,9 @@ class _ModuleSummarizer(ast.NodeVisitor):
             "hops": False,
             "reads_ctx": False,
             "binds_meta": False,
+            "ret_calls": _returned_calls(node),
+            "gcs_handler": _handler_info(node),
+            "gcs": _gcs_client_info(node),
         }
         self._stack.append((qual, node))
         self.generic_visit(node)
@@ -222,6 +227,14 @@ class _ModuleSummarizer(ast.NodeVisitor):
                     self._qual(fn.id) if self._stack else fn.id)
             elif isinstance(fn, ast.Attribute):
                 self.summary["jit_passed"].append(_dotted(fn))
+        if leaf in {"Counter", "Gauge", "Histogram"} and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            self.summary["metric_defs"].append(node.args[0].value)
+        elif leaf == "get_or_create" and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            self.summary["metric_defs"].append(node.args[1].value)
         if leaf == "Thread":
             for kw in node.keywords:
                 if kw.arg == "target":
@@ -237,9 +250,204 @@ class _ModuleSummarizer(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _returned_calls(node) -> List[str]:
+    """Dotted call names whose results this def may return, directly
+    (``return pool.alloc(n)``) or through one simple local
+    (``x = pool.alloc(n) ... return x``). Nested defs are skipped —
+    their returns are their own."""
+    assigned: Dict[str, str] = {}
+    rets: List[str] = []
+    todo: List[ast.stmt] = list(node.body)
+    while todo:
+        st = todo.pop(0)
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and isinstance(st.value, ast.Call):
+            d = _dotted(st.value.func)
+            if d:
+                assigned[st.targets[0].id] = d
+        if isinstance(st, ast.Return) and st.value is not None:
+            v = st.value
+            if isinstance(v, ast.Call):
+                d = _dotted(v.func)
+                if d and d not in rets:
+                    rets.append(d)
+            elif isinstance(v, ast.Name) and v.id in assigned:
+                if assigned[v.id] not in rets:
+                    rets.append(assigned[v.id])
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                todo.append(child)
+            elif isinstance(child, (ast.Try, ast.If, ast.For, ast.While,
+                                    ast.With)):
+                todo.append(child)
+            elif hasattr(child, "body") and isinstance(
+                    getattr(child, "body", None), list):
+                todo.extend(c for c in child.body
+                            if isinstance(c, ast.stmt))
+    return rets
+
+
+def _handler_info(node) -> Optional[Dict]:
+    """Request/response field surface of one GCS ``h_*`` handler: which
+    payload keys it requires (``d["k"]``), reads optionally
+    (``d.get("k")``), and which keys its dict-literal responses carry.
+    ``req_open``/``resp_open`` mark surfaces we cannot see statically
+    (``d`` forwarded whole, non-literal returns)."""
+    params = [a.arg for a in node.args.posonlyargs + node.args.args]
+    if not node.name.startswith("h_") or "d" not in params[:3]:
+        return None
+    req, opt, resp = set(), set(), set()
+    req_open = resp_open = False
+    # Subscripts under a conditional (if/try/loop body) are reads the
+    # handler may never reach — optional from the client's view.
+    parent: Dict[int, ast.AST] = {}
+    for p in ast.walk(node):
+        for child in ast.iter_child_nodes(p):
+            parent[id(child)] = p
+
+    def _conditional(n) -> bool:
+        cur = n
+        while id(cur) in parent and cur is not node:
+            cur = parent[id(cur)]
+            if isinstance(cur, (ast.If, ast.Try, ast.While, ast.For,
+                                ast.AsyncFor, ast.IfExp, ast.BoolOp)):
+                return True
+        return False
+
+    for n in ast.walk(node):
+        if isinstance(n, ast.Subscript) \
+                and isinstance(n.value, ast.Name) and n.value.id == "d":
+            sl = n.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                (opt if _conditional(n) else req).add(sl.value)
+            else:
+                req_open = True
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if (isinstance(f, ast.Attribute) and f.attr == "get"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "d" and n.args
+                    and isinstance(n.args[0], ast.Constant)
+                    and isinstance(n.args[0].value, str)):
+                opt.add(n.args[0].value)
+            elif any(isinstance(a, ast.Name) and a.id == "d"
+                     for a in n.args):
+                req_open = True     # d forwarded whole to a helper
+        elif isinstance(n, ast.Compare) and isinstance(
+                n.left, ast.Constant) and isinstance(n.left.value, str) \
+                and len(n.ops) == 1 \
+                and isinstance(n.ops[0], (ast.In, ast.NotIn)) \
+                and isinstance(n.comparators[0], ast.Name) \
+                and n.comparators[0].id == "d":
+            # `"k" in d` guard: reads of d["k"] are conditional.
+            opt.add(n.left.value)
+        elif isinstance(n, ast.Return) and n.value is not None:
+            v = n.value
+            if isinstance(v, ast.Dict) and v.keys and all(
+                    k is not None and isinstance(k, ast.Constant)
+                    and isinstance(k.value, str) for k in v.keys):
+                resp.update(k.value for k in v.keys)
+            else:
+                resp_open = True
+    return {"required": sorted(req - opt), "optional": sorted(opt),
+            "resp": sorted(resp), "req_open": req_open,
+            "resp_open": resp_open}
+
+
+def _unwrap_gcs_method(expr) -> Optional[str]:
+    """Method name when `expr` is (an await/_run wrapper around) a
+    ``_gcs_call("m", ...)``."""
+    if isinstance(expr, ast.Await):
+        return _unwrap_gcs_method(expr.value)
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        leaf = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else "")
+        if leaf == "_gcs_call" and expr.args \
+                and isinstance(expr.args[0], ast.Constant) \
+                and isinstance(expr.args[0].value, str):
+            return expr.args[0].value
+        if leaf == "_run" and expr.args:
+            return _unwrap_gcs_method(expr.args[0])
+    return None
+
+
+def _gcs_client_info(node) -> Dict:
+    """Call sites + response-key uses of ``_gcs_call`` inside one def."""
+    calls: List = []
+    resp_uses: List = []
+    var_methods: Dict[str, str] = {}
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            leaf = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else "")
+            if leaf == "_gcs_call" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                method = n.args[0].value
+                keys, literal = None, False
+                if len(n.args) < 2:
+                    payload = next((kw.value for kw in n.keywords
+                                    if kw.arg == "payload"), None)
+                else:
+                    payload = n.args[1]
+                if payload is None:
+                    keys, literal = [], True
+                elif isinstance(payload, ast.Dict) and all(
+                        k is not None and isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        for k in payload.keys):
+                    keys = [k.value for k in payload.keys]
+                    literal = True
+                calls.append({"method": method, "keys": keys,
+                              "literal": literal, "lineno": n.lineno})
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name):
+            m = _unwrap_gcs_method(n.value)
+            if m:
+                var_methods[n.targets[0].id] = m
+        if isinstance(n, ast.Subscript):
+            sl = n.slice
+            if not (isinstance(sl, ast.Constant)
+                    and isinstance(sl.value, str)):
+                continue
+            m = _unwrap_gcs_method(n.value)
+            if m is None and isinstance(n.value, ast.Name):
+                m = var_methods.get(n.value.id)
+            if m:
+                resp_uses.append([m, sl.value, n.lineno])
+    return {"calls": calls, "resp_uses": resp_uses}
+
+
 def summarize_module(tree: ast.AST, path: str) -> Dict:
     s = _ModuleSummarizer(path)
     s.visit(tree)
+    if "dashboard/" in path:
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Dict):
+                for k, v in zip(n.keys, n.values):
+                    if (isinstance(k, ast.Constant) and k.value == "expr"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        s.summary["panel_exprs"].append(
+                            [v.value, v.lineno])
+    # Synthetic metric series emitted as dict documents (the GCS builds
+    # its surface this way) count as definitions too.
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Dict):
+            keys = {k.value for k in n.keys
+                    if isinstance(k, ast.Constant)}
+            if "name" in keys and "type" in keys:
+                for k, v in zip(n.keys, n.values):
+                    if (isinstance(k, ast.Constant) and k.value == "name"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        s.summary["metric_defs"].append(v.value)
     return s.summary
 
 
@@ -248,7 +456,8 @@ def empty_summary(path: str) -> Dict:
     model still has an entry, so resolution degrades instead of dying."""
     return {"path": path, "module": module_name_of(path), "imports": {},
             "from_imports": {}, "defs": {}, "classes": {},
-            "jit_passed": [], "thread_targets": []}
+            "jit_passed": [], "thread_targets": [], "metric_defs": [],
+            "panel_exprs": []}
 
 
 # -- the project model ----------------------------------------------------
@@ -538,12 +747,31 @@ class ProjectModel:
         return self._file_quals(path, self.control_reach)
 
     def digest_src(self) -> str:
-        """Stable serialization of everything pass 2 depends on."""
+        """Stable serialization of everything pass 2 depends on —
+        including the v3 cross-file surfaces (GCS handler fields,
+        client payloads, metric defs, panel exprs, resource-returning
+        helpers), so editing only a handler invalidates its clients'
+        cached findings."""
         import json
+        cross = []
+        for s in sorted(self.by_path.values(), key=lambda x: x["path"]):
+            for qual in sorted(s["defs"]):
+                fn = s["defs"][qual]
+                h = fn.get("gcs_handler")
+                g = fn.get("gcs") or {}
+                if h or g.get("calls") or g.get("resp_uses") \
+                        or fn.get("ret_calls"):
+                    cross.append([s["path"], qual, h, g.get("calls"),
+                                  g.get("resp_uses"),
+                                  fn.get("ret_calls")])
+            if s.get("metric_defs") or s.get("panel_exprs"):
+                cross.append([s["path"], s.get("metric_defs"),
+                              s.get("panel_exprs")])
         return json.dumps(
             sorted((s["path"], sorted(s["defs"]))
                    for s in self.by_path.values()),
             separators=(",", ":")) + "|" + ",".join(sorted(
                 self.traced | self.in_async
                 | set(self.actor_reach) | set(self.control_reach)
-                | self.hoppers | self.deadline_aware))
+                | self.hoppers | self.deadline_aware)) + "|" + \
+            json.dumps(cross, separators=(",", ":"), sort_keys=True)

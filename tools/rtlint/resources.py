@@ -1,0 +1,161 @@
+"""rtlint v3: the linear-resource catalog.
+
+Each :class:`ResourceSpec` teaches the lifecycle engine (rules RT014/
+RT015/RT016) one acquire/release protocol from the runtime, each
+encoding a bug class this repo actually shipped:
+
+- ``pages``   — PagePool pages: ``alloc``/``ref`` ↔ ``release`` with
+  all-or-nothing rollback (the PR 11 PagePool leak class),
+- ``bundles`` — placement-group bundles: ``reserve*`` ↔ ``release*``/
+  ``cancel_bundle``, double-release = the PR 10 double-credit bug,
+- ``fence``   — GCS fences / resize obligations: ``arm*`` ↔ ``lift*``
+  on every claimant exit path (the PR 14 obligation protocol),
+- ``ref``     — ObjectRefs: ``.remote()`` results that must be awaited,
+  gotten, or stored (the RT004 class, now path-sensitive),
+- ``lock``    — explicit ``.acquire()`` without ``.release()`` on some
+  path (``with`` blocks release structurally and are exempt).
+
+Recognition is (method leaf name, receiver-name hint) so `pool.alloc`
+matches and `mmap.alloc` does not. Release recognition accepts the
+tracked value as an argument, as an element of an iterated release
+(``for p in pages: pool.release([p])``), or — via the interprocedural
+summaries — as an argument to a helper known to release that kind.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import FrozenSet, Tuple
+
+# Receiver-name hints: substring match on the lowercase receiver leaf
+# ("self._pool" -> "_pool"). Empty = any receiver.
+POOL_HINTS = ("pool", "pages", "pagepool", "kv")
+BUNDLE_HINTS = ()       # module-level functions; leaf names are unique
+FENCE_HINTS = ()
+LOCK_HINTS = ("lock", "mutex", "sem", "cond")
+
+
+@dataclass(frozen=True)
+class ResourceSpec:
+    kind: str
+    rule: str
+    noun: str                       # human name used in messages
+    # Value-binding acquires: `x = recv.leaf(...)` makes x held.
+    acquire_value: FrozenSet[str] = frozenset()
+    acquire_hints: Tuple[str, ...] = ()
+    # Receivers that do NOT acquire despite the leaf name matching
+    # (`rt.remote(cls)` wraps a class; `Actor.remote()` builds a
+    # handle, not an ObjectRef). When set, the receiver must also be
+    # non-empty and lowercase (a capitalized receiver is a class).
+    acquire_recv_deny: Tuple[str, ...] = ()
+    # Argument-obligation acquires: `recv.leaf(x)` makes x held
+    # (incref/ref/arm: the protocol owes a matching release on x).
+    acquire_arg: FrozenSet[str] = frozenset()
+    # Release leaves: `recv.leaf(x)` / `leaf(x)` releases x.
+    release: FrozenSet[str] = frozenset()
+    release_hints: Tuple[str, ...] = ()
+    # Consumers: like releases but also fire when the value is the
+    # *receiver* (`ref.cancel()`) or awaited (`await ref`).
+    consume: FrozenSet[str] = frozenset()
+    double_release: bool = False
+    # Whether passing the acquired token to another call transfers the
+    # obligation (incref'd pages handed to their table: yes; fence
+    # tokens are plain ids passed around freely: no).
+    escape_transfers: bool = True
+    # Whether an uncaught exception edge counts as a leak for this kind
+    # (pages/bundles/fences: yes — that IS the shipped bug shape; refs:
+    # no, a propagating error usually abandons the whole call anyway).
+    leak_on_raise: bool = True
+    advice: str = ""
+
+
+PAGES = ResourceSpec(
+    kind="pages", rule="RT014", noun="PagePool pages",
+    acquire_value=frozenset({"alloc"}),
+    acquire_hints=POOL_HINTS,
+    acquire_arg=frozenset({"ref", "incref"}),
+    release=frozenset({"release", "free", "decref", "evict_pages"}),
+    release_hints=POOL_HINTS + ("cache", "prefix"),
+    double_release=True,
+    advice=("wrap the post-alloc steps in try/except and release on "
+            "the error path (all-or-nothing rollback), or hand the "
+            "pages to their owning table before anything can raise"),
+)
+
+BUNDLES = ResourceSpec(
+    kind="bundles", rule="RT015", noun="placement-group bundles",
+    acquire_value=frozenset({"reserve_placement_group_bundles",
+                             "reserve_pg_bundles", "reserve_bundles"}),
+    release=frozenset({"release_placement_group_bundles",
+                       "release_pg_bundles", "release_bundles",
+                       "cancel_bundle", "remove_placement_group"}),
+    double_release=True,
+    advice=("release reserved bundles exactly once per exit path — "
+            "the PR 10 cancel_bundle double-credit corrupted node "
+            "accounting by crediting bundle AND node"),
+)
+
+FENCES = ResourceSpec(
+    kind="fence", rule="RT015", noun="fence/resize obligation",
+    acquire_arg=frozenset({"arm_fence", "arm_obligation",
+                           "arm_resize_obligation", "register_fence"}),
+    release=frozenset({"lift_fence", "lift_obligation",
+                       "lift_resize_obligations", "release_fence",
+                       "unfence"}),
+    double_release=False,
+    escape_transfers=False,
+    advice=("every claimant exit path (including exception edges) must "
+            "lift the obligation it armed, or reservations wedge "
+            "forever (PR 14 resize-obligation protocol)"),
+)
+
+REFS = ResourceSpec(
+    kind="ref", rule="RT016", noun="ObjectRef",
+    acquire_value=frozenset({"remote"}),
+    acquire_recv_deny=("rt", "ray"),
+    release=frozenset({"get", "wait", "cancel", "prefetch"}),
+    release_hints=("rt", "ray"),
+    consume=frozenset({"result", "cancel"}),
+    double_release=False,
+    leak_on_raise=False,
+    advice=("await/get the ref, store it somewhere it will be reaped, "
+            "or pass it to rt.get/rt.wait — a dropped ref silently "
+            "discards the task's error and pins its result in the "
+            "object store until GC"),
+)
+
+LOCKS = ResourceSpec(
+    kind="lock", rule="RT016", noun="lock",
+    acquire_arg=frozenset(),
+    acquire_value=frozenset(),
+    # populated dynamically: `recv.acquire()` with a lock-ish receiver
+    # tracks the receiver itself; see lifecycle.py.
+    release=frozenset({"release"}),
+    release_hints=LOCK_HINTS,
+    double_release=False,
+    advice=("prefer `with lock:` — an explicit acquire() must be "
+            "released on every exit path including exceptions"),
+)
+
+ALL_SPECS = (PAGES, BUNDLES, FENCES, REFS, LOCKS)
+
+
+def receiver_matches(leaf_receiver: str, hints: Tuple[str, ...]) -> bool:
+    if not hints:
+        return True
+    low = leaf_receiver.lower()
+    return any(h in low for h in hints)
+
+
+def acquire_receiver_ok(spec: ResourceSpec, leaf_receiver: str) -> bool:
+    """Receiver check for value-binding acquires, honoring the spec's
+    deny list (class constructors and module-level wrappers that share
+    the acquire leaf name but return a different thing)."""
+    if not receiver_matches(leaf_receiver, spec.acquire_hints):
+        return False
+    if spec.acquire_recv_deny:
+        if not leaf_receiver or leaf_receiver in spec.acquire_recv_deny:
+            return False
+        if leaf_receiver.lstrip("_")[:1].isupper():
+            return False
+    return True

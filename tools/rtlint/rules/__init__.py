@@ -1,4 +1,4 @@
-"""Rule catalog for rtlint v2.
+"""Rule catalog for rtlint v3.
 
 One module per concern; every rule subclasses :class:`Rule` from
 ``rules.base`` and is instantiated exactly once here, in id order.
@@ -31,6 +31,12 @@ from tools.rtlint.rules.exceptions import SwallowRule
 from tools.rtlint.rules.deadline import DeadlineTaintRule
 from tools.rtlint.rules.clocks import ClockDomainRule
 from tools.rtlint.rules.metrics import MetricsDisciplineRule
+from tools.rtlint.rules.lifecycle import (
+    BundleLifecycleRule,
+    PageLifecycleRule,
+    RefLockLifecycleRule,
+)
+from tools.rtlint.rules.protocol import ProtocolConformanceRule
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),          # RT001
@@ -46,6 +52,10 @@ ALL_RULES: List[Rule] = [
     ClockDomainRule(),       # RT011
     DonatedReuseRule(),      # RT012
     MetricsDisciplineRule(),  # RT013
+    PageLifecycleRule(),     # RT014
+    BundleLifecycleRule(),   # RT015
+    RefLockLifecycleRule(),  # RT016
+    ProtocolConformanceRule(),  # RT017
 ]
 
 

@@ -1,0 +1,551 @@
+"""RT014/RT015/RT016: path-sensitive resource-lifecycle verification.
+
+One shared analysis walks each function's CFG (``tools.rtlint.cfg``)
+tracking which local names hold a linear resource (``resources.py``
+specs), and reports the exact line sequence on which a resource can
+reach a function exit — normal or exceptional — still held, plus
+double-releases (the PR 10 ``cancel_bundle`` double-credit shape) and
+rebind-while-held loop-carried leaks. Three thin Rule classes split the
+findings by resource family:
+
+- **RT014** PagePool pages — the PR 11 leak class: ``alloc`` then an
+  exception before the pages are handed to their table.
+- **RT015** placement-group bundles and GCS fences/resize obligations —
+  the PR 14 release-leak and PR 10 double-credit incidents.
+- **RT016** ObjectRefs bound but never awaited/stored (path-sensitive
+  superset of RT004's bare-statement case) and explicit lock
+  ``acquire()`` without ``release()`` on some path, including locks
+  held across ``yield``.
+
+Precision strategy (what keeps the dogfood sweep green): any *use* of a
+held name that is not a recognized release — returning it, yielding it,
+storing it into an attribute/container, passing it to any call —
+transfers ownership and kills tracking. The interprocedural summaries
+(``summaries.py``) let ``pages = self._grab(n)`` start tracking and
+``self._cleanup(pages)`` count as the release.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.rtlint.cfg import CFG, build_cfg
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.resources import (ALL_SPECS, LOCK_HINTS, ResourceSpec,
+                                    acquire_receiver_ok, receiver_matches)
+from tools.rtlint.rules.base import Rule, _dotted
+from tools.rtlint.summaries import build_summaries
+
+_MAX_STATES = 20000       # per-function walk budget
+
+
+def _recv_leaf(func: ast.AST) -> str:
+    """Leaf name of a call receiver: `self._pool.alloc` -> '_pool'."""
+    if isinstance(func, ast.Attribute):
+        v = func.value
+        if isinstance(v, ast.Attribute):
+            return v.attr
+        if isinstance(v, ast.Name):
+            return v.id
+        if isinstance(v, ast.Call):
+            return _recv_leaf(v.func)
+    return ""
+
+
+def _unwrap_await(expr: ast.AST) -> ast.AST:
+    return expr.value if isinstance(expr, ast.Await) else expr
+
+
+def _arg_names(call: ast.Call) -> Set[str]:
+    """Simple Name arguments, looking through list/tuple/starred
+    wrappers (`pool.release([p])`, `rt.get(*refs)`)."""
+    out: Set[str] = set()
+    todo: List[ast.AST] = list(call.args) + [kw.value
+                                             for kw in call.keywords]
+    while todo:
+        a = todo.pop()
+        if isinstance(a, ast.Name):
+            out.add(a.id)
+        elif isinstance(a, (ast.List, ast.Tuple, ast.Set)):
+            todo.extend(a.elts)
+        elif isinstance(a, ast.Starred):
+            todo.append(a.value)
+    return out
+
+
+def _shallow_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """Expression roots evaluated by this statement *itself* (compound
+    statements contribute only their heads — the CFG hands us their
+    bodies as separate nodes)."""
+    if isinstance(stmt, ast.If) or isinstance(stmt, ast.While):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return list(stmt.body)      # closure capture = escape
+    if isinstance(stmt, ast.ClassDef):
+        return list(stmt.body)
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, ast.ExceptHandler):
+        # The handler *head* evaluates only its type expression; the
+        # body arrives as separate CFG nodes.
+        return [stmt.type] if stmt.type is not None else []
+    return [stmt]
+
+
+class _Events:
+    """Per-CFG-node lifecycle events, precomputed once."""
+
+    __slots__ = ("acquires", "releases", "release_any", "release_kinds",
+                 "used", "assigned", "is_yield", "line")
+
+    def __init__(self):
+        self.acquires: List[Tuple[str, ResourceSpec]] = []
+        # (var, spec) releases by name; "<any>" releases the kind's
+        # synthetic (non-name-bound) obligations.
+        self.releases: List[Tuple[str, ResourceSpec]] = []
+        self.release_any: Set[str] = set()     # kinds released w/o a name
+        self.release_kinds: Set[str] = set()   # coarse helper-kill kinds
+        self.used: Set[str] = set()            # names read (escape check)
+        self.assigned: Set[str] = set()        # simple Name targets
+        self.is_yield = False
+        self.line = 0
+
+
+def _extract_events(cfg: CFG, idx: int, ctx: FileContext,
+                    summary: Optional[Dict], fn_sum: Optional[Dict],
+                    summaries) -> _Events:
+    ev = _Events()
+    stmt = cfg.stmts[idx]
+    if stmt is None:
+        return ev
+    ev.line = getattr(stmt, "lineno", 0)
+    roots = _shallow_exprs(stmt)
+    # Nested defs/classes contribute only *reads* (closure capture is
+    # an escape); their internal calls run later, not at the def site.
+    opaque = isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                ev.assigned.add(t.id)
+    elif isinstance(stmt, ast.AugAssign) and isinstance(
+            stmt.target, ast.Name):
+        ev.assigned.add(stmt.target.id)
+    for root in roots:
+        for n in ast.walk(root):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                ev.used.add(n.id)
+            if opaque:
+                continue
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                ev.is_yield = True
+            if isinstance(n, ast.Await) and isinstance(
+                    n.value, ast.Name):
+                # `await ref` consumes the ref.
+                ev.releases.append((n.value.id, _REF_SPEC))
+    if opaque:
+        return ev
+
+    # Calls: releases / consumes / arg-form acquires / helper summaries.
+    calls: List[ast.Call] = []
+    for root in roots:
+        for n in ast.walk(root):
+            if isinstance(n, ast.Call):
+                calls.append(n)
+    for call in calls:
+        func = call.func
+        leaf = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else "")
+        if not leaf:
+            continue
+        recv = _recv_leaf(func)
+        names = _arg_names(call)
+        for spec in ALL_SPECS:
+            if leaf in spec.release and receiver_matches(
+                    recv, spec.release_hints):
+                if names:
+                    for nm in names:
+                        ev.releases.append((nm, spec))
+                else:
+                    ev.release_any.add(spec.kind)
+            if leaf in spec.consume and isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name):
+                ev.releases.append((func.value.id, spec))
+            if leaf in spec.acquire_arg and receiver_matches(
+                    recv, spec.acquire_hints):
+                # Arg-form acquires (incref, arm_fence) create an
+                # *obligation on a token*, not ownership of the name —
+                # tracked as a synthetic var so later uses of the token
+                # don't count as ownership transfer.
+                if names:
+                    for nm in sorted(names):
+                        ev.acquires.append(
+                            (f"<{spec.kind}:{nm}@{ev.line}>", spec))
+                else:
+                    ev.acquires.append((f"<{spec.kind}@{ev.line}>", spec))
+        # Explicit lock acquire: `lock.acquire()` tracks the receiver.
+        if leaf == "acquire" and isinstance(func, ast.Attribute):
+            recv_dotted = _dotted(func.value)
+            if recv_dotted and receiver_matches(
+                    recv_dotted.split(".")[-1], LOCK_HINTS):
+                ev.acquires.append((recv_dotted, _LOCK_SPEC))
+        if leaf == "release" and isinstance(func, ast.Attribute):
+            recv_dotted = _dotted(func.value)
+            if recv_dotted:
+                ev.releases.append((recv_dotted, _LOCK_SPEC))
+        # Interprocedural: a project helper known to release kind K.
+        if summaries is not None and summary is not None \
+                and fn_sum is not None:
+            dotted = _dotted(func)
+            if dotted:
+                kinds = summaries.call_releases(summary, fn_sum, dotted)
+                if kinds:
+                    if names:
+                        for spec in ALL_SPECS:
+                            if spec.kind in kinds:
+                                for nm in names:
+                                    ev.releases.append((nm, spec))
+                    else:
+                        ev.release_kinds |= kinds
+
+    # Value-binding acquires: `x = [await] recv.leaf(...)`.
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+            and isinstance(stmt.targets[0], ast.Name):
+        value = _unwrap_await(stmt.value)
+        if isinstance(value, ast.Call):
+            func = value.func
+            leaf = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else "")
+            recv = _recv_leaf(func)
+            var = stmt.targets[0].id
+            for spec in ALL_SPECS:
+                if leaf in spec.acquire_value and acquire_receiver_ok(
+                        spec, recv):
+                    ev.acquires.append((var, spec))
+            if summaries is not None and summary is not None \
+                    and fn_sum is not None:
+                dotted = _dotted(func)
+                if dotted:
+                    for kind in summaries.call_returns_fresh(
+                            summary, fn_sum, dotted):
+                        for spec in ALL_SPECS:
+                            if spec.kind == kind and not any(
+                                    v == var for v, _ in ev.acquires):
+                                ev.acquires.append((var, spec))
+    return ev
+
+
+_REF_SPEC = next(s for s in ALL_SPECS if s.kind == "ref")
+_LOCK_SPEC = next(s for s in ALL_SPECS if s.kind == "lock")
+_SPEC_BY_KIND = {s.kind: s for s in ALL_SPECS}
+
+
+class _Held:
+    __slots__ = ("kind", "line", "released")
+
+    def __init__(self, kind: str, line: int, released: int = 0):
+        self.kind = kind
+        self.line = line
+        self.released = released   # line of the release, 0 = held
+
+    def sig(self):
+        return (self.kind, self.line, self.released)
+
+
+class _RawFinding:
+    __slots__ = ("rule", "var", "kind", "acq_line", "line", "shape",
+                 "path")
+
+    def __init__(self, rule, var, kind, acq_line, line, shape, path):
+        self.rule = rule
+        self.var = var
+        self.kind = kind
+        self.acq_line = acq_line
+        self.line = line
+        self.shape = shape     # leak / leak-raise / double / rebind / yield
+        self.path = path
+
+
+def _walk(cfg: CFG, events: Dict[int, _Events]) -> List[_RawFinding]:
+    """DFS over (node, state) pairs; state maps var -> _Held."""
+    out: List[_RawFinding] = []
+    reported: Set[Tuple] = set()
+
+    def report(rule, var, h: "_Held", line, shape, path):
+        key = (rule, var, h.kind, h.line, shape)
+        if key in reported:
+            return
+        reported.add(key)
+        out.append(_RawFinding(rule, var, h.kind, h.line, line, shape,
+                               path))
+
+    seen: Set[Tuple] = set()
+    # (node, state, path-lines)
+    stack: List[Tuple[int, Dict[str, _Held], List[int]]] = [
+        (CFG.ENTRY, {}, [])]
+    steps = 0
+    while stack and steps < _MAX_STATES:
+        steps += 1
+        node, state, path = stack.pop()
+        sig = (node, tuple(sorted((v, h.sig()) for v, h in
+                                  state.items())))
+        if sig in seen:
+            continue
+        seen.add(sig)
+
+        if node == cfg.exit:
+            for var, h in state.items():
+                if not h.released:
+                    spec = _SPEC_BY_KIND[h.kind]
+                    report(spec.rule, var, h, h.line, "leak", path)
+            continue
+        if node == cfg.raise_exit:
+            for var, h in state.items():
+                spec = _SPEC_BY_KIND[h.kind]
+                if not h.released and spec.leak_on_raise:
+                    report(spec.rule, var, h, h.line, "leak-raise", path)
+            continue
+
+        ev = events[node]
+        line = ev.line
+        npath = path + [line] if line else path
+        if len(npath) > 80:
+            npath = npath[-80:]
+
+        # -- exceptional post-state: releases/escapes apply, acquires
+        # and rebinds do not (the raise may precede the bind).
+        exc_state: Optional[Dict[str, _Held]] = None
+        has_exc = any(lbl in ("exc", "raise")
+                      for _t, lbl in cfg.succ.get(node, ()))
+
+        def apply_uses(st: Dict[str, _Held]) -> Dict[str, _Held]:
+            st = dict(st)
+            released_here: Set[str] = set()
+            for var, spec in ev.releases:
+                # A named release lifts both the named binding and any
+                # synthetic obligation armed on that token.
+                targets = [var] + [v for v in st
+                                   if v.startswith(f"<{spec.kind}:{var}@")]
+                for v in targets:
+                    h = st.get(v)
+                    if h is None or h.kind != spec.kind:
+                        continue
+                    if h.released and spec.double_release:
+                        report(spec.rule, v, h, line, "double", npath)
+                    st[v] = _Held(h.kind, h.line, released=line)
+                    released_here.add(v)
+            for kind in ev.release_any | ev.release_kinds:
+                for var, h in list(st.items()):
+                    if h.kind == kind and var.startswith("<"):
+                        st[var] = _Held(h.kind, h.line, released=line)
+                        released_here.add(var)
+                if kind in ev.release_kinds:
+                    # coarse helper kill: stop tracking the kind
+                    for var, h in list(st.items()):
+                        if h.kind == kind:
+                            del st[var]
+            # Escapes: any other read of a held name transfers
+            # ownership — stop tracking. Synthetic obligations
+            # transfer when their *token* is handed to another call,
+            # unless the spec says the token is a plain id.
+            for var in list(st.keys()):
+                if st[var].released or var in released_here:
+                    continue
+                if var.startswith("<"):
+                    spec = _SPEC_BY_KIND[st[var].kind]
+                    tok = var.strip("<>").split("@")[0]
+                    tok = tok.split(":", 1)[1] if ":" in tok else ""
+                    if spec.escape_transfers and tok \
+                            and tok in ev.used:
+                        del st[var]
+                elif var in ev.used:
+                    del st[var]
+            return st
+
+        nstate = apply_uses(state)
+        if has_exc:
+            exc_state = nstate
+
+        # Locks across yield: report before the acquire step.
+        if ev.is_yield:
+            for var, h in nstate.items():
+                if h.kind == "lock" and not h.released:
+                    report("RT016", var, h, line, "yield", npath)
+
+        # Rebinds and acquires (normal successors only).
+        for var in ev.assigned:
+            h = nstate.get(var)
+            if h is not None and not h.released \
+                    and not any(v == var for v, _ in ev.acquires):
+                spec = _SPEC_BY_KIND[h.kind]
+                report(spec.rule, var, h, line, "rebind", npath)
+                nstate = dict(nstate)
+                del nstate[var]
+        for var, spec in ev.acquires:
+            h = nstate.get(var)
+            if h is not None and not h.released:
+                if var in ev.assigned:
+                    # rebind-with-fresh-acquire over a held resource
+                    report(spec.rule, var, h, line, "rebind", npath)
+            nstate = dict(nstate)
+            nstate[var] = _Held(spec.kind, line)
+
+        for dst, lbl in cfg.succ.get(node, ()):
+            st = exc_state if (lbl in ("exc", "raise")
+                               and exc_state is not None) else nstate
+            stack.append((dst, st, npath))
+    return out
+
+
+def _analyze(ctx: FileContext) -> List[_RawFinding]:
+    cached = getattr(ctx, "_lifecycle_findings", None)
+    if cached is not None:
+        return cached
+    model = ctx.project
+    summaries = None
+    summary = None
+    if model is not None:
+        summaries = getattr(model, "_lifecycle_summaries", None)
+        if summaries is None:
+            summaries = build_summaries(model)
+            model._lifecycle_summaries = summaries
+        summary = model.by_path.get(ctx.path)
+
+    out: List[_RawFinding] = []
+    for node in ctx.walk():
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fn_sum = None
+        if summary is not None:
+            fn_sum = summary["defs"].get(ctx.qualname_of(node))
+        try:
+            cfg = build_cfg(node)
+        except RecursionError:       # pathological nesting: skip
+            continue
+        events = {i: _extract_events(cfg, i, ctx, summary, fn_sum,
+                                     summaries)
+                  for i in range(len(cfg.stmts))}
+        if not any(e.acquires for e in events.values()):
+            continue
+        raws = _walk(cfg, events)
+        # A lock held across yield already reports the yield finding;
+        # the GeneratorExit raise-path leak it implies is the same bug.
+        yielded = {(r.var, r.acq_line) for r in raws
+                   if r.shape == "yield"}
+        for raw in raws:
+            if raw.kind == "lock" and raw.shape == "leak-raise" \
+                    and (raw.var, raw.acq_line) in yielded:
+                continue
+            raw.path = raw.path or [raw.acq_line]
+            out.append(raw)
+    ctx._lifecycle_findings = out
+    return out
+
+
+def _fmt_path(path: List[int], acq_line: int) -> str:
+    lines: List[int] = []
+    for ln in path:
+        if ln and (not lines or lines[-1] != ln) and ln >= acq_line:
+            lines.append(ln)
+    if len(lines) > 8:
+        lines = lines[:3] + [0] + lines[-4:]
+    return " -> ".join("..." if ln == 0 else str(ln) for ln in lines) \
+        or str(acq_line)
+
+
+class _LifecycleRule(Rule):
+    """Shared reporting for the three lifecycle families."""
+
+    def _node_for_line(self, ctx: FileContext, line: int) -> ast.AST:
+        best = ctx.tree
+        for n in ctx.walk():
+            if getattr(n, "lineno", None) == line and isinstance(
+                    n, ast.stmt):
+                return n
+        return best
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for raw in _analyze(ctx):
+            if raw.rule != self.id:
+                continue
+            spec = _SPEC_BY_KIND[raw.kind]
+            node = self._node_for_line(ctx, raw.acq_line)
+            pretty = raw.var
+            if pretty.startswith("<"):    # synthetic obligation token
+                inner = pretty.strip("<>").split("@")[0]
+                pretty = inner.split(":", 1)[1] if ":" in inner else inner
+            p = _fmt_path(raw.path, raw.acq_line)
+            if raw.shape == "leak":
+                msg = (f"{spec.noun} `{pretty}` acquired at line "
+                       f"{raw.acq_line} reaches function exit still "
+                       f"held (path {p}); {spec.advice}")
+            elif raw.shape == "leak-raise":
+                msg = (f"{spec.noun} `{pretty}` acquired at line "
+                       f"{raw.acq_line} leaks on an exception path "
+                       f"(path {p}); {spec.advice}")
+            elif raw.shape == "double":
+                msg = (f"{spec.noun} `{pretty}` released twice on one "
+                       f"path (second release at line {raw.line}, path "
+                       f"{p}) — the double-credit shape corrupts "
+                       f"accounting; release exactly once per exit path")
+            elif raw.shape == "rebind":
+                msg = (f"`{pretty}` rebound at line {raw.line} while "
+                       f"still holding {spec.noun} from line "
+                       f"{raw.acq_line} (loop-carried leak); release "
+                       f"before reacquiring")
+            else:  # yield
+                msg = (f"lock `{pretty}` acquired at line "
+                       f"{raw.acq_line} is held across a yield at line "
+                       f"{raw.line} — the consumer controls when (or "
+                       f"whether) the generator resumes; release "
+                       f"first or use `with` inside the loop")
+            yield self.finding(ctx, node, msg, token=pretty)
+
+
+class PageLifecycleRule(_LifecycleRule):
+    """RT014: PagePool pages leak/double-free on some path.
+
+    The PR 11 incident class: ``alloc`` (or ``ref``/``incref``)
+    succeeds, a later step on the same path raises or returns early,
+    and the pages are never released — the pool's free list shrinks
+    forever under churn. All-or-nothing rollback on the error path is
+    the contract.
+    """
+
+    id = "RT014"
+    name = "pagepool-lifecycle"
+
+
+class BundleLifecycleRule(_LifecycleRule):
+    """RT015: placement-group bundles / fences leak or double-release.
+
+    Encodes two shipped bugs: the PR 14 release leak (reserved bundles
+    never released on an error path, wedging the placement group) and
+    the PR 10 ``cancel_bundle`` double-credit (bundle credited twice,
+    corrupting node accounting). Fences/resize obligations follow the
+    same shape: armed on entry, must be lifted on *every* claimant
+    exit path.
+    """
+
+    id = "RT015"
+    name = "bundle-fence-lifecycle"
+
+
+class RefLockLifecycleRule(_LifecycleRule):
+    """RT016: ObjectRefs bound-then-dropped; locks leaked across paths.
+
+    Path-sensitive superset of RT004: a ref assigned to a local that no
+    path ever awaits, gets, cancels, stores, or returns silently drops
+    the task's error and pins its result in the object store. Also
+    flags explicit lock ``acquire()`` with a release-free path and
+    locks held across ``yield`` (the consumer controls resumption).
+    """
+
+    id = "RT016"
+    name = "ref-lock-lifecycle"

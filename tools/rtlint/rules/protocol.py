@@ -1,0 +1,304 @@
+"""RT017: cross-process protocol conformance.
+
+Four consistency checks no single-file rule can do, each encoding a
+drift class that ships as a runtime error, not a test failure:
+
+1. **GCS request/response field drift** — every ``_gcs_call("m",
+   {...})`` payload is checked against the ``h_m`` handler's required/
+   optional keys (from the pass-1 summaries), and every subscript of
+   the response against the handler's dict-literal return keys. A
+   client missing a required key is a guaranteed ``KeyError`` inside
+   the GCS; a response key the handler never returns is a guaranteed
+   ``KeyError`` in the client — both only discovered when that RPC
+   path finally runs.
+2. **Chaos hook table** — ``_private/chaos.py`` documents its
+   injection hooks in a module-docstring table; every public hook
+   (calls ``_require_enabled``) must appear in the table and every
+   table row must name a real module function, so the chaos-suite
+   authors' index never rots.
+3. **Grafana panel queries** — every metric name referenced by a
+   dashboard panel's PromQL ``expr`` must be registered somewhere in
+   the project (``Counter``/``Gauge``/``Histogram``/``get_or_create``
+   or a synthetic ``{"name": ..., "type": ...}`` series document), so
+   renaming a metric cannot silently blank a panel.
+4. **Schema-version literals** — readers/writers of versioned
+   documents must compare against the shared ``*_VERSION`` constant,
+   not a hardcoded int: a bump that forgets a literal-comparing reader
+   silently rejects (or accepts) every document.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.rtlint.engine import FileContext, Finding
+from tools.rtlint.rules.base import Rule
+
+# PromQL functions/keywords/labels that look like metric names.
+_PROMQL_STOP = {
+    "rate", "irate", "increase", "sum", "avg", "min", "max", "count",
+    "by", "without", "on", "ignoring", "le", "quantile", "bottomk",
+    "topk", "abs", "ceil", "floor", "round", "delta", "idelta", "label",
+    "histogram_quantile", "label_replace", "label_join", "count_values",
+    "avg_over_time", "max_over_time", "min_over_time", "sum_over_time",
+    "group_left", "group_right", "offset", "bool", "and", "or", "unless",
+}
+# Series emitted outside the metrics registry (raylet/dashboard text
+# exposition) — anything under these prefixes is assumed real.
+_SERIES_PREFIX_ALLOW = ("rt_",)
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_VERSION_KEYS = {"schema", "schema_version"}
+
+_NAME_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _handler_map(model) -> Dict[str, Dict]:
+    """method name -> handler field info, over the whole project."""
+    cached = getattr(model, "_rt017_handlers", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, Dict] = {}
+    for s in model.by_path.values():
+        for qual, fn in s["defs"].items():
+            h = fn.get("gcs_handler")
+            if h and fn["name"].startswith("h_"):
+                out[fn["name"][2:]] = dict(h, _path=s["path"],
+                                           _line=fn["lineno"])
+    model._rt017_handlers = out
+    return out
+
+
+def _metric_defs(model) -> Set[str]:
+    cached = getattr(model, "_rt017_metrics", None)
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for s in model.by_path.values():
+        out.update(s.get("metric_defs", ()))
+    model._rt017_metrics = out
+    return out
+
+
+def _expr_metric_names(expr: str) -> List[str]:
+    """Candidate metric names in one PromQL expression: identifiers
+    containing an underscore that are not functions/keywords and not
+    label names (inside ``{...}`` selectors or ``by (...)`` clauses)."""
+    out: List[str] = []
+    depth_brace = 0
+    grouping = False
+    for m in _NAME_RE.finditer(expr):
+        name = m.group(0)
+        prefix = expr[:m.start()]
+        depth_brace = prefix.count("{") - prefix.count("}")
+        if depth_brace > 0:
+            continue                       # label matcher
+        gm = re.search(r"(?:by|without)\s*\([^)]*$", prefix)
+        grouping = gm is not None
+        if grouping:
+            continue                       # grouping label
+        if name in _PROMQL_STOP or "_" not in name:
+            continue
+        if name not in out:
+            out.append(name)
+    return out
+
+
+class ProtocolConformanceRule(Rule):
+    """RT017: GCS field drift, chaos-table rot, dashboard/metric drift,
+    hardcoded schema versions. See module docstring."""
+
+    id = "RT017"
+    name = "protocol-conformance"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._check_gcs_clients(ctx)
+        yield from self._check_chaos_table(ctx)
+        yield from self._check_panels(ctx)
+        yield from self._check_version_literals(ctx)
+
+    # -- 1. GCS client vs handler ----------------------------------------
+    def _check_gcs_clients(self, ctx: FileContext) -> Iterator[Finding]:
+        model = ctx.project
+        if model is None:
+            return
+        summary = model.by_path.get(ctx.path)
+        if summary is None:
+            return
+        handlers = _handler_map(model)
+        if not handlers:
+            return
+        for qual, fn in summary["defs"].items():
+            g = fn.get("gcs") or {}
+            for call in g.get("calls", ()):
+                method = call["method"]
+                h = handlers.get(method)
+                node = _line_anchor(ctx, call["lineno"])
+                if h is None:
+                    yield self.finding(
+                        ctx, node,
+                        f"`_gcs_call(\"{method}\", ...)` has no matching "
+                        f"`h_{method}` handler in the project — typo'd "
+                        f"method or handler removed without its callers",
+                        token=method, scope=qual)
+                    continue
+                if not call["literal"] or call["keys"] is None:
+                    continue
+                keys = set(call["keys"])
+                missing = sorted(set(h["required"]) - keys)
+                if missing:
+                    yield self.finding(
+                        ctx, node,
+                        f"payload for GCS `{method}` omits key(s) "
+                        f"{missing} that the handler reads "
+                        f"unconditionally (d[...] at "
+                        f"{h['_path']}:{h['_line']}) — guaranteed "
+                        f"KeyError inside the GCS",
+                        token=f"{method}:missing", scope=qual)
+                if not h["req_open"]:
+                    unknown = sorted(
+                        keys - set(h["required"]) - set(h["optional"]))
+                    if unknown:
+                        yield self.finding(
+                            ctx, node,
+                            f"payload for GCS `{method}` sends key(s) "
+                            f"{unknown} the handler never reads — stale "
+                            f"field or typo (handler at "
+                            f"{h['_path']}:{h['_line']})",
+                            token=f"{method}:unknown", scope=qual)
+            for method, key, lineno in g.get("resp_uses", ()):
+                h = handlers.get(method)
+                if h is None or h["resp_open"]:
+                    continue
+                if key not in h["resp"]:
+                    yield self.finding(
+                        ctx, _line_anchor(ctx, lineno),
+                        f"response of GCS `{method}` is subscripted "
+                        f"with '{key}' but the handler only returns "
+                        f"keys {h['resp']} (handler at "
+                        f"{h['_path']}:{h['_line']})",
+                        token=f"{method}:{key}", scope=qual)
+
+    # -- 2. chaos docstring table ----------------------------------------
+    def _check_chaos_table(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.path.endswith("_private/chaos.py"):
+            return
+        doc = ast.get_docstring(ctx.tree) or ""
+        table: Set[str] = set()
+        for line in doc.splitlines():
+            m = re.match(r"\s{0,4}([a-z_][a-z0-9_]*)\(.*\|", line)
+            if m:
+                table.add(m.group(1))
+        if not table:
+            return
+        hooks: Dict[str, ast.AST] = {}
+        names: Set[str] = set()
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+                for n in ast.walk(node):
+                    if isinstance(n, ast.Call) and isinstance(
+                            n.func, ast.Name) \
+                            and n.func.id == "_require_enabled":
+                        hooks[node.name] = node
+                        break
+        for name, node in sorted(hooks.items()):
+            if name not in table:
+                yield self.finding(
+                    ctx, node,
+                    f"chaos hook `{name}` is gated on RT_CHAOS but "
+                    f"missing from the module-docstring injection "
+                    f"table — chaos-suite authors index faults there",
+                    token=name)
+        for name in sorted(table - names):
+            yield self.finding(
+                ctx, ctx.tree.body[0] if ctx.tree.body else ctx.tree,
+                f"injection table documents `{name}()` but no such "
+                f"function exists in this module — stale row",
+                token=name)
+
+    # -- 3. grafana panels vs metric registrations -----------------------
+    def _check_panels(self, ctx: FileContext) -> Iterator[Finding]:
+        model = ctx.project
+        if model is None:
+            return
+        summary = model.by_path.get(ctx.path)
+        if summary is None or not summary.get("panel_exprs"):
+            return
+        defined = _metric_defs(model)
+        if not defined:
+            return
+        for expr, lineno in summary["panel_exprs"]:
+            for name in _expr_metric_names(expr):
+                if name in defined:
+                    continue
+                base = name
+                for suf in _HIST_SUFFIXES + ("_total",):
+                    if name.endswith(suf):
+                        base = name[:-len(suf)]
+                        break
+                if base in defined:
+                    continue
+                if name.startswith(_SERIES_PREFIX_ALLOW):
+                    continue
+                yield self.finding(
+                    ctx, _line_anchor(ctx, lineno),
+                    f"panel query references metric `{name}` but no "
+                    f"Counter/Gauge/Histogram registration or synthetic "
+                    f"series emits it — the panel will render empty",
+                    token=name)
+
+    # -- 4. schema-version literals --------------------------------------
+    def _check_version_literals(self, ctx: FileContext
+                                ) -> Iterator[Finding]:
+        for node in ctx.walk():
+            # reader: doc.get("schema") ==/!= 2
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                key = _version_key_of(node.left)
+                other = node.comparators[0]
+                if key and isinstance(other, ast.Constant) \
+                        and isinstance(other.value, int):
+                    yield self.finding(
+                        ctx, node,
+                        f"'{key}' compared against hardcoded "
+                        f"{other.value} — use the shared *_VERSION "
+                        f"constant so a schema bump cannot forget "
+                        f"this reader",
+                        token=key)
+            # writer: {"schema": 2, ...}
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) \
+                            and k.value in _VERSION_KEYS \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, int):
+                        yield self.finding(
+                            ctx, v,
+                            f"document written with hardcoded "
+                            f"'{k.value}': {v.value} — use the shared "
+                            f"*_VERSION constant so writer and readers "
+                            f"bump together",
+                            token=str(k.value))
+
+
+def _version_key_of(expr: ast.AST) -> Optional[str]:
+    """'schema' when `expr` is d.get("schema")/d["schema"]."""
+    if isinstance(expr, ast.Call) and isinstance(
+            expr.func, ast.Attribute) and expr.func.attr == "get" \
+            and expr.args and isinstance(expr.args[0], ast.Constant) \
+            and expr.args[0].value in _VERSION_KEYS:
+        return expr.args[0].value
+    if isinstance(expr, ast.Subscript) and isinstance(
+            expr.slice, ast.Constant) \
+            and expr.slice.value in _VERSION_KEYS:
+        return expr.slice.value
+    return None
+
+
+def _line_anchor(ctx: FileContext, line: int) -> ast.AST:
+    for n in ctx.walk():
+        if getattr(n, "lineno", None) == line:
+            return n
+    return ctx.tree

@@ -1,0 +1,136 @@
+"""rtlint v3: interprocedural acquire/release summaries.
+
+The lifecycle rules (RT014–RT016) walk one function's CFG at a time;
+this pass gives that walk eyes across call boundaries. Over the
+existing :class:`~tools.rtlint.project.ProjectModel` call graph it
+computes, per function id, two facts per resource kind:
+
+- ``releases``      — calling this function (with the resource as an
+  argument, or on ``self``) releases the resource: it calls a release
+  leaf for that kind directly, or calls a helper that does. Lets
+  ``self._cleanup(pages)`` count as the release instead of a leak.
+- ``returns_fresh`` — this function may *return* a freshly acquired
+  resource (its ``ret_calls`` reach an acquire leaf or a helper that
+  returns fresh). Lets ``pages = self._grab_pages(n)`` start tracking
+  even though ``alloc`` happened two frames down.
+
+Both are may-analyses propagated to a fixed point over the call
+graph, so helper-mediated protocols are understood without any
+per-function annotation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from .resources import ALL_SPECS, acquire_receiver_ok, receiver_matches
+
+
+class LifecycleSummaries:
+    """Per-function release / returns-fresh facts over a ProjectModel."""
+
+    def __init__(self, model):
+        self.model = model
+        # fid -> set of kinds
+        self.releases: Dict[str, Set[str]] = {}
+        self.returns_fresh: Dict[str, Set[str]] = {}
+        if model is not None:
+            self._compute()
+
+    # -- queries ----------------------------------------------------------
+    def call_releases(self, summary: Dict, fn: Dict,
+                      dotted: str) -> Set[str]:
+        """Kinds released by the call-site `dotted` written inside `fn`,
+        via project-local resolution. Empty set when unresolvable."""
+        if self.model is None:
+            return set()
+        fid = self.model.resolve_call(summary, fn, dotted)
+        if not fid or fid.startswith("<module>::"):
+            return set()
+        return self.releases.get(fid, set())
+
+    def call_returns_fresh(self, summary: Dict, fn: Dict,
+                           dotted: str) -> Set[str]:
+        """Kinds freshly acquired by the value returned from the
+        call-site `dotted` written inside `fn`."""
+        if self.model is None:
+            return set()
+        fid = self.model.resolve_call(summary, fn, dotted)
+        if not fid or fid.startswith("<module>::"):
+            return set()
+        return self.returns_fresh.get(fid, set())
+
+    # -- computation ------------------------------------------------------
+    def _compute(self):
+        model = self.model
+        # Seed with direct facts from each function's summarized calls.
+        for fid, summary, fn in model._all_funcs():
+            rel: Set[str] = set()
+            for dotted, _lineno in fn.get("calls", ()):
+                leaf = dotted.split(".")[-1]
+                recv = dotted.split(".")[-2] if "." in dotted else ""
+                for spec in ALL_SPECS:
+                    if leaf in spec.release and receiver_matches(
+                            recv, spec.release_hints):
+                        rel.add(spec.kind)
+            if rel:
+                self.releases[fid] = rel
+
+            fresh: Set[str] = set()
+            for dotted in fn.get("ret_calls", ()):
+                parts = [p.replace("()", "") for p in dotted.split(".")]
+                leaf = parts[-1]
+                recv = parts[-2] if len(parts) > 1 else ""
+                for spec in ALL_SPECS:
+                    if leaf not in spec.acquire_value:
+                        continue
+                    if not acquire_receiver_ok(spec, recv):
+                        continue
+                    # A capitalized segment anywhere in the chain means
+                    # a class constructor (`Cls.options().remote()` is
+                    # a handle, not a fresh resource).
+                    if spec.acquire_recv_deny and any(
+                            p.lstrip("_")[:1].isupper()
+                            for p in parts[:-1]):
+                        continue
+                    fresh.add(spec.kind)
+            if fresh:
+                self.returns_fresh[fid] = fresh
+
+        # Fixed point: releases flow caller-ward along call edges;
+        # returns-fresh flows along *returned* calls only.
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in model.edges.items():
+                have = self.releases.setdefault(caller, set())
+                before = len(have)
+                for c in callees:
+                    have |= self.releases.get(c, set())
+                if len(have) != before:
+                    changed = True
+        # Drop empty entries so .get(fid, set()) stays cheap to reason
+        # about in tests.
+        self.releases = {k: v for k, v in self.releases.items() if v}
+
+        changed = True
+        while changed:
+            changed = False
+            for fid, summary, fn in model._all_funcs():
+                ret_calls = fn.get("ret_calls", ())
+                if not ret_calls:
+                    continue
+                have = self.returns_fresh.setdefault(fid, set())
+                before = len(have)
+                for dotted in ret_calls:
+                    callee = model.resolve_call(summary, fn, dotted)
+                    if callee and not callee.startswith("<module>::"):
+                        have |= self.returns_fresh.get(callee, set())
+                if len(have) != before:
+                    changed = True
+        self.returns_fresh = {
+            k: v for k, v in self.returns_fresh.items() if v}
+
+
+def build_summaries(model) -> LifecycleSummaries:
+    return LifecycleSummaries(model)
