@@ -9,7 +9,8 @@ LINT_JOBS ?= 4
 .PHONY: lint rtlint lint-stats lint-changed lint-fix sanitizers test \
   fast-test \
   bench-data bench-obs bench-scale bench-serve-obs bench-serve-ft \
-  bench-collective bench-multitenant bench-paged-kv bench-serve-macro
+  bench-collective bench-multitenant bench-paged-kv bench-serve-macro \
+  bench-rollup
 
 lint: rtlint sanitizers
 
@@ -42,6 +43,11 @@ bench-data:
 # tools/check_claims.py afterwards — MIGRATION.md pins these numbers.
 bench-obs:
 	JAX_PLATFORMS=cpu $(PY) bench_obs.py
+
+# Appends one bench_rollup trajectory record (every BENCH_*.json gate
+# headline) to PROGRESS.jsonl.
+bench-rollup:
+	$(PY) bench.py --rollup
 
 # Regenerates BENCH_SCALE.json (scalability envelope + control-plane
 # profiler decomposition); run tools/check_claims.py afterwards —

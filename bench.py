@@ -499,7 +499,71 @@ def worker(platform: str) -> None:
     print(json.dumps(out), flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Roll-up: one per-PR trajectory record over every bench artifact.
+# ---------------------------------------------------------------------------
+# Headline fields, in preference order: the number each bench's gate
+# actually reads. A metric entry contributes its first match (or its
+# first numeric field as a fallback) so the roll-up stays one line.
+_ROLLUP_HEADLINE_KEYS = (
+    "overhead_pct", "vs_baseline", "value", "ok", "p99_ms", "p50_ms",
+    "e2e_sum_ok", "tokens_per_s", "emit_us", "cost_us_per_step",
+)
+
+
+def rollup() -> int:
+    """Aggregate every BENCH_*.json's gate numbers into one trajectory
+    record appended to PROGRESS.jsonl (kind="bench_rollup" distinguishes
+    it from the driver's wall-clock records)."""
+    import glob
+
+    gates = {}
+    for path in sorted(glob.glob("BENCH_*.json")):
+        name = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            gates[name] = {"error": "unreadable"}
+            continue
+        entries = doc if isinstance(doc, list) else [doc]
+        file_gates = {}
+        for e in entries:
+            if not isinstance(e, dict):
+                continue
+            metric = str(e.get("metric") or e.get("name") or "?")
+            headline = None
+            for k in _ROLLUP_HEADLINE_KEYS:
+                if isinstance(e.get(k), (int, float, bool)):
+                    headline = {k: e[k]}
+                    break
+            if headline is None:
+                headline = next(
+                    ({k: v} for k, v in e.items()
+                     if k not in ("ts", "steps", "rounds")
+                     and isinstance(v, (int, float))
+                     and not isinstance(v, bool)),
+                    {},
+                )
+            file_gates[metric] = headline
+        gates[name] = file_gates
+    rec = {
+        "ts": time.time(),
+        "kind": "bench_rollup",
+        "files": len(gates),
+        "metrics": sum(len(g) for g in gates.values()),
+        "gates": gates,
+    }
+    with open("PROGRESS.jsonl", "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps({"kind": "bench_rollup", "files": rec["files"],
+                      "metrics": rec["metrics"]}), flush=True)
+    return 0
+
+
 def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--rollup":
+        return rollup()
     if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
         platform = sys.argv[2] if len(sys.argv) > 2 else "tpu"
         try:

@@ -14,7 +14,11 @@ one unified memory sample:
      pins overhead_pct < 2% from this entry.
   2. recorder cost in isolation: zero-work steps — the absolute
      per-step price (record + ring append + metrics), in microseconds.
-  3. memory accountant: one sample_once() walking a few hundred live
+  3. journal overhead: the same calibrated step bare vs emitting one
+     cluster-black-box journal event per step (util/journal.py), plus
+     emit() priced in isolation. MIGRATION.md pins overhead_pct < 2%
+     from this entry.
+  4. memory accountant: one sample_once() walking a few hundred live
      arrays and publishing the per-device gauges.
 
 Run: python bench_obs.py [--quick]   (--quick: fewer steps, no artifact)
@@ -131,6 +135,73 @@ def probe_recorder_overhead(results, quick: bool):
     results.append(entry)
 
 
+def _steps_journal(g, x, n, steps):
+    from ray_tpu.util import journal
+
+    out = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        g(x, n).block_until_ready()  # rtlint: disable=RT001 — measured sync is the point
+        journal.emit("train.step", step=i, wall_s=0.005, compiles=0,
+                     tokens=1024)
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+def probe_journal_overhead(results, quick: bool):
+    """Cluster-black-box cost on the train step: the same calibrated
+    ~5ms jitted step bare vs emitting one journal event per step (the
+    exact record flight_recorder._finish appends). Paired medians over
+    interleaved arms; MIGRATION.md pins overhead_pct < 2% from this
+    entry. Also prices emit() in isolation (ring append + HLC tick +
+    keyed counter), in nanoseconds-scale microseconds."""
+    from ray_tpu.util import journal
+
+    steps = 50 if quick else STEPS
+    rounds = 2 if quick else ROUNDS
+    g, x, n, work_ms = _make_work(TARGET_WORK_MS)
+
+    _steps_off(g, x, n, 5)
+    _steps_journal(g, x, n, 5)
+    off_ts, on_ts = [], []
+    for _ in range(rounds):
+        off_ts.extend(_steps_off(g, x, n, steps))
+        on_ts.extend(_steps_journal(g, x, n, steps))
+
+    off_med = statistics.median(off_ts)
+    on_med = statistics.median(on_ts)
+    overhead_pct = (on_med - off_med) / off_med * 100.0
+    entry = {
+        "metric": "journal overhead",
+        "steps_per_arm": len(off_ts),
+        "work_ms_calibrated": round(work_ms, 3),
+        "off_ms_per_step_p50": round(off_med * 1e3, 4),
+        "on_ms_per_step_p50": round(on_med * 1e3, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "journal_cost_us_per_step": round((on_med - off_med) * 1e6, 2),
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+
+    # emit() in isolation: the absolute per-event price.
+    m = 2000 if quick else 20000
+    t0 = time.perf_counter()
+    for i in range(m):
+        journal.emit("bench.tick", i=i)
+    emit_us = (time.perf_counter() - t0) / m * 1e6
+    events, dropped = journal.counts()
+    entry = {
+        "metric": "journal emit cost",
+        "emits": m,
+        "emit_us": round(emit_us, 3),
+        "ring": journal._ring_max,
+        "events_total": events,
+        "dropped_total": dropped,
+    }
+    print(json.dumps(entry))
+    results.append(entry)
+
+
 def probe_memory_sample(results, quick: bool):
     import jax.numpy as jnp
 
@@ -158,6 +229,7 @@ def main():
     quick = "--quick" in sys.argv
     results = []
     probe_recorder_overhead(results, quick)
+    probe_journal_overhead(results, quick)
     probe_memory_sample(results, quick)
     if not quick:
         with open("BENCH_OBS.json", "w") as f:

@@ -362,6 +362,64 @@ def probe_chaos_macro(results, quick: bool):
     }
     print(json.dumps(entry))
     results.append(entry)
+
+    # -- black-box postmortem: the dead replica must have produced an
+    # AUTOMATIC bundle (controller replace_dead / breaker-open trigger),
+    # and assembling it must reconstruct the injection -> client-observed
+    # causal chain across >= 4 distinct processes in one HLC order.
+    from ray_tpu.util import journal as journal_mod
+
+    bundle = None
+    for _ in range(20):
+        try:
+            client = worker_mod.get_client()
+            pms = client._run(client._gcs_call("get_postmortems", {}))
+            cands = [p for p in pms.get("postmortems", [])
+                     if p["ts"] >= result.t0_epoch]
+            if cands:
+                bundle = cands[-1]["bundle"]
+                break
+        except Exception:  # noqa: BLE001 — controller/GCS still
+            # recovering from the injected faults; retry.
+            pass
+        time.sleep(0.5)
+    events, metas, chain = [], [], []
+    if bundle:
+        # Processes dump asynchronously on the pubsub push; wait for
+        # the bundle to stop growing.
+        deadline = time.monotonic() + 8.0
+        last_n, last_change = -1, time.monotonic()
+        while time.monotonic() < deadline:
+            try:
+                n = len([f for f in os.listdir(bundle)
+                         if f.endswith(".jsonl")])
+            except OSError:
+                n = 0
+            if n != last_n:
+                last_n, last_change = n, time.monotonic()
+            elif n > 0 and time.monotonic() - last_change >= 0.6:
+                break
+            time.sleep(0.1)
+        events, metas = journal_mod.load_bundle(bundle)
+        chain = journal_mod.causal_chain(events)
+    procs = {(m.get("proc"), m.get("pid")) for m in metas}
+    chain_kinds = [e.get("kind") for e in chain]
+    entry = {
+        "metric": "chaos postmortem: auto-captured causal chain",
+        "bundle": os.path.basename(bundle) if bundle else None,
+        "events": len(events),
+        "processes": len(procs),
+        "process_labels": sorted(str(p[0]) for p in procs),
+        "chain": chain_kinds,
+        "gate": "auto bundle exists, >= 4 processes in one HLC-merged "
+                "timeline, chain seeds at the chaos injection",
+        "pass": (bundle is not None and len(procs) >= 4
+                 and len(chain) >= 3
+                 and bool(chain_kinds)
+                 and chain_kinds[0].startswith("chaos.")),
+    }
+    print(json.dumps(entry))
+    results.append(entry)
     serve.delete("Macro")
 
 

@@ -42,6 +42,12 @@ Injection table (all gated on RT_CHAOS=1):
   kill_replica_at(t, app)   | driver (sched)    | replica death at trace time t
   drop_controller_at(t)     | driver (sched)    | controller crash at trace time t
   anchor_schedule(off)      | driver (sched)    | pins t=0 for the *_at faults
+  postmortem(reason)        | driver (GCS RPC)  | manual black-box dump trigger
+
+Every hook journals a ``chaos.injected`` event at fire time (the
+cluster black box, util/journal.py), so an assembled postmortem
+timeline starts at the injection that provoked it — the causal chain
+is reconstructable without cross-referencing the test source.
 
 Schedule-anchored faults (`*_at`) fire at a fixed offset from an anchor
 set by `anchor_schedule()` — the same t=0 a recorded loadgen trace
@@ -56,6 +62,8 @@ import os
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Set
+
+from ray_tpu.util import journal
 
 logger = logging.getLogger("ray_tpu.chaos")
 
@@ -159,6 +167,9 @@ def _require_enabled(what: str):
             f"chaos.{what} called without RT_CHAOS=1 — call chaos.enable() "
             f"first (fault injection is refused in production)"
         )
+    # Every armed injection leaves a journal fingerprint at fire time, so
+    # a postmortem timeline opens with the fault that provoked it.
+    journal.emit("chaos.injected", hook=what)
 
 
 # -- cross-process / cross-attempt determinism ---------------------------
@@ -354,6 +365,8 @@ def kill_replica(app: str, index: int = 0):
     if not replicas:
         raise RuntimeError(f"chaos.kill_replica: app {app!r} has no replicas")
     victim = replicas[index % len(replicas)]
+    journal.emit("chaos.kill_replica", app=app, index=int(index),
+                 actor_id=victim._actor_id.hex())
     rt.kill(victim)
     return victim._actor_id.hex()
 
@@ -589,8 +602,32 @@ def drop_controller(restart: bool = True):
     from ray_tpu.serve.controller import CONTROLLER_NAME
 
     ctrl = rt.get_actor(CONTROLLER_NAME)
+    journal.emit("chaos.drop_controller", restart=bool(restart),
+                 actor_id=ctrl._actor_id.hex())
     rt.kill(ctrl, no_restart=not restart)
     return ctrl._actor_id.hex()
+
+
+def postmortem(reason: str = "chaos.postmortem") -> str:
+    """Force a cluster-wide black-box dump NOW (bypasses the failure
+    cooldown): every connected process freezes its journal ring into a
+    bundle directory that `rt postmortem` can assemble. Deterministic
+    capture point for chaos suites — inject a fault, let the cluster
+    react, then snapshot exactly when the scenario says to. Returns the
+    bundle directory path."""
+    _require_enabled("postmortem")
+    from ray_tpu._private import worker as worker_mod
+
+    client = worker_mod.get_client()
+    resp = client._run(
+        client._gcs_call(
+            "journal_trigger",
+            {"reason": reason, "source": "chaos", "force": True},
+        )
+    )
+    if not resp.get("triggered"):
+        raise RuntimeError("chaos.postmortem: trigger suppressed")
+    return resp["bundle"]
 
 
 # -- schedule-anchored fault windows ---------------------------------------
@@ -680,6 +717,8 @@ def _sched_loop() -> None:
                 if e not in _sched_faults:  # clear() raced the firing
                     continue
             try:
+                journal.emit("chaos.scheduled_fire", fault=e["kind"],
+                             t=e["t"], kwargs=dict(e["kwargs"]))
                 if e["kind"] == "kill_replica":
                     e["result"] = kill_replica(**e["kwargs"])
                 elif e["kind"] == "drop_controller":

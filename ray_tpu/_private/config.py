@@ -227,6 +227,22 @@ class Config:
     # GCS (replaces the old one-RPC-per-span eager flush).
     trace_flush_delay_s: float = 0.25
 
+    # -- event journal (cluster black box, util/journal.py) --------------
+    # Always-on per-process event journal with HLC stamps. Disabling also
+    # drops the HLC field from RPC frames.
+    journal_enabled: bool = True
+    # Per-process ring capacity (events); oldest overwritten first.
+    journal_ring: int = 4096
+    # Seconds of ring history a postmortem dump freezes per process.
+    journal_window_s: float = 30.0
+    # Postmortem bundle root ($TMPDIR/ray_tpu/postmortem when empty).
+    journal_dir: str = ""
+    # Typed failure observers may publish cluster-wide dump triggers.
+    journal_autodump: bool = True
+    # Minimum spacing between dump triggers (per process AND GCS-wide):
+    # a failure storm becomes one bundle, not a dump storm.
+    journal_cooldown_s: float = 30.0
+
     # -- wire protocol ---------------------------------------------------
     # Frames at/above this size bypass coalescing and await drain.
     rpc_direct_write_threshold: int = 64 * 1024

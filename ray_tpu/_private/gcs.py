@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from ray_tpu._private.config import get_config
 from ray_tpu._private.protocol import RpcServer, ServerConnection
+from ray_tpu.util import journal
 
 #: Bucket boundaries (seconds) for the per-method server-side RPC latency
 #: histograms — matches util.metrics.LATENCY_BOUNDARIES so gcs_rpc_*
@@ -174,6 +175,14 @@ class GcsServer:
         # profile_config pubsub channel (server-originated; clients may
         # not publish to it).
         self.profile_config: Dict[str, Any] = {}
+        # Postmortem bundles minted by journal_trigger (cluster black
+        # box): the GCS is the single trigger authority so a cluster-wide
+        # failure storm collapses into one bundle per cooldown window.
+        self.postmortems: List[dict] = []
+        self._pm_seq = 0
+        self._pm_last_mono = 0.0
+        self._pm_last_payload: Optional[dict] = None
+        journal.set_process_label("gcs", weak=True)
 
         r = self.rpc.register
         # kv
@@ -242,6 +251,9 @@ class GcsServer:
         # control-plane profiler (runtime sampling toggle)
         r("set_profile_config", self.h_set_profile_config)
         r("get_profile_config", self.h_get_profile_config)
+        # cluster black box (util/journal.py): failure-triggered capture
+        r("journal_trigger", self.h_journal_trigger)
+        r("get_postmortems", self.h_get_postmortems)
         # misc
         r("ping", self.h_ping)
 
@@ -616,6 +628,7 @@ class GcsServer:
 
         record_event("gcs", f"node marked DEAD: {reason}",
                      severity="ERROR", node_id=node_id.hex())
+        journal.emit("gcs.node_dead", node_id=node_id.hex(), reason=reason)
         # Fail actors living on that node; restart if budget remains.
         for actor_id, a in list(self.actors.items()):
             if a.get("node_id") == node_id and a["state"] in ("ALIVE", "PENDING", "RESTARTING"):
@@ -1151,6 +1164,9 @@ class GcsServer:
             a["port"] = d["port"]
             a["worker_id"] = d.get("worker_id")
             a["methods"] = d.get("methods") or []
+        journal.emit("gcs.actor", actor_id=d["actor_id"].hex(),
+                     state=a["state"], name=a.get("name") or "",
+                     class_name=a.get("class_name", ""))
         await self.publish(
             "actor_update:" + d["actor_id"].hex(), self._actor_view(a)
         )
@@ -1195,6 +1211,10 @@ class GcsServer:
                 actor_id=actor_id.hex(), class_name=a.get("class_name", ""),
                 restarts_used=a["restarts_used"],
             )
+            journal.emit("gcs.actor", actor_id=actor_id.hex(),
+                         state="RESTARTING", reason=reason,
+                         name=a.get("name") or "",
+                         class_name=a.get("class_name", ""))
             await self.publish("actor_update:" + actor_id.hex(), self._actor_view(a))
             ok = await self._schedule_actor(actor_id)
             if not ok:
@@ -1202,17 +1222,28 @@ class GcsServer:
             return
         a["state"] = "DEAD"
         a["death_cause"] = reason
+        journal.emit("gcs.actor", actor_id=actor_id.hex(), state="DEAD",
+                     reason=reason, name=a.get("name") or "",
+                     class_name=a.get("class_name", ""))
         await self.publish("actor_update:" + actor_id.hex(), self._actor_view(a))
 
     async def h_worker_dead(self, d, conn):
         """Raylet reports a worker process exit; fail any actor it hosted."""
         actor_id = d.get("actor_id")
+        journal.emit("gcs.worker_dead",
+                     actor_id=actor_id.hex() if actor_id else "",
+                     intended=bool(d.get("intended")),
+                     reason=d.get("reason", ""))
         if actor_id and actor_id in self.actors:
             a = self.actors[actor_id]
             if a["state"] != "DEAD":
                 if d.get("intended") and d.get("no_restart", True):
                     a["state"] = "DEAD"
                     a["death_cause"] = d.get("reason", "killed")
+                    journal.emit("gcs.actor", actor_id=actor_id.hex(),
+                                 state="DEAD",
+                                 reason=d.get("reason", "killed"),
+                                 name=a.get("name") or "")
                     await self.publish(
                         "actor_update:" + actor_id.hex(), self._actor_view(a)
                     )
@@ -1220,6 +1251,15 @@ class GcsServer:
                     await self._on_actor_failure(
                         actor_id, d.get("reason", "worker process died")
                     )
+            # ActorDied capture: an UNINTENDED worker exit is a primary
+            # failure — freeze every process's ring while the evidence of
+            # why is still in the buffers (cooldown keeps crash loops to
+            # one bundle per window).
+            if not d.get("intended") and get_config().journal_autodump:
+                await self._journal_postmortem(
+                    f"worker_dead:{d.get('reason', 'unknown')}",
+                    source="gcs",
+                )
         return {"ok": True}
 
     async def h_kill_actor(self, d, conn):
@@ -1478,6 +1518,8 @@ class GcsServer:
             return {"ok": False, "error": "placement group reservation failed"}
         pg["bundle_nodes"] = nodes
         pg["state"] = "CREATED"
+        journal.emit("gcs.pg", pg_id=pg_id.hex(), state="CREATED",
+                     bundles=len(nodes))
         # A placed claimant no longer needs its reclamation fences.
         self._clear_fences(pg_id)
         await self.publish("pg_update:" + pg_id.hex(), {"state": "CREATED"})
@@ -1588,6 +1630,7 @@ class GcsServer:
                             "cancel_bundle", {"pg_id": d["pg_id"], "bundle_index": i}
                         )
         pg["state"] = "REMOVED"
+        journal.emit("gcs.pg", pg_id=d["pg_id"].hex(), state="REMOVED")
         # Preemption hooks: a removed group may be a draining victim
         # handing its chips back (finish the record, un-drain its nodes)
         # or a pending claimant giving up (cancel its eviction).
@@ -1662,6 +1705,10 @@ class GcsServer:
                     "created": time.monotonic(),
                     "lifted_at": None,
                 }
+                journal.emit(
+                    "gcs.resize", pg_id=d["pg_id"].hex(), state="armed",
+                    bundles=len(rec["bundle_indices"]),
+                )
             from ray_tpu.util.event import record_event
 
             record_event(
@@ -1796,6 +1843,9 @@ class GcsServer:
             if ob["state"] == "armed" and ob.get("claimant") == claimant_id:
                 ob["state"] = "lifted"
                 ob["lifted_at"] = time.monotonic()
+                journal.emit("gcs.resize", pg_id=ob["victim"].hex()
+                             if isinstance(ob.get("victim"), bytes) else "",
+                             state="lifted")
                 from ray_tpu.util.event import record_event
 
                 record_event(
@@ -1992,6 +2042,9 @@ class GcsServer:
             f"(priority {claimant_priority}); grace {cfg.preempt_grace_s}s",
             severity="WARNING", pg_id=pg["pg_id"].hex(),
         )
+        journal.emit("gcs.preemption", pg_id=pg["pg_id"].hex(),
+                     state="draining", reason=reason, tenant=tenant,
+                     claimant_tenant=claimant_tenant)
 
     def _finish_preemption(self, rec: dict, outcome: str):
         """Victim released its chips (or was hard-killed): close the
@@ -2000,6 +2053,9 @@ class GcsServer:
         rec["state"] = "released"
         rec["outcome"] = outcome
         rec["released_at"] = time.monotonic()
+        journal.emit("gcs.preemption", pg_id=rec["victim"].hex()
+                     if isinstance(rec.get("victim"), bytes) else "",
+                     state="released", outcome=outcome)
         took = rec["released_at"] - rec["started"]
         h = self.preempt_grace
         h["buckets"][bisect_left(_PREEMPT_GRACE_BOUNDS, took)] += 1
@@ -2073,6 +2129,8 @@ class GcsServer:
             # The deadline is the guarantee: kill every actor living in
             # the victim group, then force-release its bundles.
             rec["state"] = "hard_killing"
+            journal.emit("gcs.preemption", pg_id=victim_id.hex(),
+                         state="hard_killing")
             for actor_id, a in list(self.actors.items()):
                 sched = a.get("scheduling") or {}
                 if (
@@ -2289,7 +2347,79 @@ class GcsServer:
 
     async def h_subscribe(self, d, conn):
         self.subscribers[d["channel"]].add(conn)
+        # Late joiners get a still-fresh dump trigger replayed: a
+        # replacement replica spawned BECAUSE of the failure connects
+        # after the publish, but its ring (spawn, first requests) is
+        # exactly the recovery half of the postmortem story.
+        if d["channel"] == "journal_dump" and self._pm_last_payload:
+            age = time.time() - self._pm_last_payload.get("ts", 0)
+            if age <= get_config().journal_window_s:
+                try:
+                    await conn.push("journal_dump", self._pm_last_payload)
+                except Exception:  # noqa: BLE001 — replay is best-effort
+                    pass
         return {"ok": True}
+
+    # -- cluster black box (failure-triggered postmortem capture) --------
+    async def _journal_postmortem(self, reason: str, source: str = "",
+                                  force: bool = False,
+                                  detail: Optional[dict] = None) -> Optional[str]:
+        """Mint a postmortem bundle and fan the dump trigger out to every
+        connected process over the journal_dump channel. Cooldown-gated
+        (unless forced, the `rt timeline --cluster` path) so a failure
+        storm produces one bundle, not a dump storm. Returns the bundle
+        directory, or None when suppressed."""
+        cfg = get_config()
+        if not cfg.journal_enabled:
+            return None
+        now = time.monotonic()
+        if not force and now - self._pm_last_mono < cfg.journal_cooldown_s:
+            return None
+        self._pm_last_mono = now
+        self._pm_seq += 1
+        slug = "".join(
+            c if c.isalnum() else "-" for c in reason
+        ).strip("-")[:48] or "trigger"
+        trigger_id = f"pm-{int(time.time())}-{self._pm_seq:03d}-{slug}"
+        bundle = os.path.join(journal.dump_dir(), trigger_id)
+        try:
+            os.makedirs(bundle, exist_ok=True)
+        except OSError:
+            return None
+        journal.emit("journal.trigger", reason=reason, source=source,
+                     bundle=trigger_id, **(detail or {}))
+        payload = {
+            "bundle": bundle, "trigger_id": trigger_id, "reason": reason,
+            "source": source, "ts": time.time(),
+            "window_s": cfg.journal_window_s, "hlc": journal.wire_stamp(),
+        }
+        self.postmortems.append({
+            "bundle": bundle, "trigger_id": trigger_id, "reason": reason,
+            "source": source, "ts": payload["ts"],
+            "detail": dict(detail or {}),
+        })
+        self._pm_last_payload = payload
+        del self.postmortems[:-64]
+        await self.publish("journal_dump", payload)
+        # This process's own ring (the GCS sees every state transition —
+        # its file anchors the merged timeline).
+        journal.on_dump_trigger(payload)
+        return bundle
+
+    async def h_journal_trigger(self, d, conn):
+        """Client-requested dump trigger: typed failure observers
+        (breaker-open, replica-death replacement, collective timeout,
+        HOL, deadline storms, gang restart) and `rt timeline --cluster`
+        land here."""
+        bundle = await self._journal_postmortem(
+            d.get("reason") or "manual", source=d.get("source") or "",
+            force=bool(d.get("force")), detail=d.get("detail") or {},
+        )
+        return {"ok": True, "triggered": bundle is not None,
+                "bundle": bundle or ""}
+
+    async def h_get_postmortems(self, d, conn):
+        return {"postmortems": list(self.postmortems)}
 
     # -- task events ------------------------------------------------------
     async def h_add_task_events(self, d, conn):

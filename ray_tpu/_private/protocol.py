@@ -13,6 +13,9 @@ Frame layout: u32 length | msgpack map {
     "m": method name (req) or channel (push),
     "d": payload (any msgpack value),
     "e": error string or null (resp),
+    "h": optional HLC stamp [physical_us, logical] (util/journal.py) —
+         senders tick, receivers merge, so cross-process happens-before
+         is recoverable from journal dumps despite host clock skew,
 }
 """
 
@@ -27,6 +30,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 import msgpack
 
 from ray_tpu._private.config import get_config
+from ray_tpu.util import journal
 
 _LEN = struct.Struct("<I")
 
@@ -183,6 +187,8 @@ class Connection:
             while True:
                 frame = await read_frame(self.reader)
                 kind = frame.get("k")
+                if "h" in frame:
+                    journal.observe_wire(frame["h"])
                 if kind == "resp":
                     payload = None
                     if frame.get("nb"):
@@ -214,7 +220,11 @@ class Connection:
         cid = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[cid] = fut
-        frame = pack_frame({"k": "req", "i": cid, "m": method, "d": payload})
+        obj = {"k": "req", "i": cid, "m": method, "d": payload}
+        h = journal.wire_stamp()
+        if h is not None:
+            obj["h"] = h
+        frame = pack_frame(obj)
         await self._sender.send(frame)
         if timeout is not None:
             return await asyncio.wait_for(fut, timeout)
@@ -222,7 +232,11 @@ class Connection:
 
     async def notify(self, method: str, payload: Any = None):
         """Fire-and-forget request (no response expected)."""
-        frame = pack_frame({"k": "req", "i": 0, "m": method, "d": payload})
+        obj = {"k": "req", "i": 0, "m": method, "d": payload}
+        h = journal.wire_stamp()
+        if h is not None:
+            obj["h"] = h
+        frame = pack_frame(obj)
         await self._sender.send(frame)
 
     async def close(self):
@@ -252,14 +266,22 @@ class ServerConnection:
     async def push(self, channel: str, payload: Any):
         if self.closed:
             return
-        frame = pack_frame({"k": "push", "m": channel, "d": payload})
+        obj = {"k": "push", "m": channel, "d": payload}
+        h = journal.wire_stamp()
+        if h is not None:
+            obj["h"] = h
+        frame = pack_frame(obj)
         try:
             await self._sender.send(frame)
         except (ConnectionError, RuntimeError):
             self.closed = True
 
     async def respond(self, cid: int, data: Any = None, error: str = None):
-        frame = pack_frame({"k": "resp", "i": cid, "d": data, "e": error})
+        obj = {"k": "resp", "i": cid, "d": data, "e": error}
+        h = journal.wire_stamp()
+        if h is not None:
+            obj["h"] = h
+        frame = pack_frame(obj)
         try:
             await self._sender.send(frame)
         except (ConnectionError, RuntimeError):
@@ -268,9 +290,11 @@ class ServerConnection:
     async def respond_bin(self, cid: int, data: Any, payload):
         """Header frame + raw payload bytes: the payload goes straight to
         the transport — no msgpack pass over the bulk bytes."""
-        frame = pack_frame(
-            {"k": "resp", "i": cid, "d": data, "nb": len(payload)}
-        )
+        obj = {"k": "resp", "i": cid, "d": data, "nb": len(payload)}
+        h = journal.wire_stamp()
+        if h is not None:
+            obj["h"] = h
+        frame = pack_frame(obj)
         try:
             await self._sender.send_pair(frame, payload)
         except (ConnectionError, RuntimeError):
@@ -340,6 +364,8 @@ class RpcServer:
     async def _dispatch(self, conn: ServerConnection, frame):
         cid = frame.get("i", 0)
         method = frame.get("m")
+        if "h" in frame:
+            journal.observe_wire(frame["h"])
         # Count only known methods: a malformed/unknown frame must not
         # plant unbounded (or None) keys in the metrics table.
         if self.on_request is not None and method in self.handlers:

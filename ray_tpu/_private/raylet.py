@@ -40,7 +40,7 @@ from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu._private.protocol import Connection, RpcServer, ServerConnection, connect, spawn
-from ray_tpu.util import lifecycle
+from ray_tpu.util import journal, lifecycle
 
 
 class _PullByteBudget:
@@ -354,6 +354,7 @@ class Raylet:
                 await asyncio.sleep(0.5)
 
     async def start(self) -> int:
+        journal.set_process_label("raylet", weak=True)
         port = await self.rpc.start()
         self.port = port
         self.gcs = await connect(
@@ -706,6 +707,14 @@ class Raylet:
             w.actor_resources = {}
 
     async def _report_worker_dead(self, w: WorkerHandle, intended=False, reason=""):
+        # The raylet death notice: first link after an injected kill in
+        # the postmortem causal chain (it sees the process exit before
+        # the GCS or any serve-layer observer).
+        journal.emit(
+            "raylet.worker_dead",
+            actor_id=w.actor_id.hex() if w.actor_id else "",
+            intended=bool(intended), reason=reason,
+        )
         if not intended:
             from ray_tpu.util.event import record_event
 
@@ -1676,6 +1685,16 @@ class Raylet:
                 blocked |= await self._dispatch_class(q, ctx, cfg)
             self._last_dispatch_batch = self._metric_tasks_dispatched - dispatched0
             self._last_dispatch_scan = self._metric_dispatch_scans - scans0
+            if self._last_dispatch_batch:
+                # Per-PASS summary, never per task: dispatch decisions
+                # reach the journal at wake-up granularity so a million-
+                # task drain costs journal appends proportional to passes.
+                journal.emit(
+                    "raylet.dispatch",
+                    granted=self._last_dispatch_batch,
+                    scanned=self._last_dispatch_scan,
+                    queued=sum(len(q) for q in self.task_queues.values()),
+                )
             if blocked:
                 # Blocked on resources/workers: rescan the moment anything
                 # completes (h_task_done sets the event) instead of a fixed

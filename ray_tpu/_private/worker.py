@@ -46,7 +46,7 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 
-from ray_tpu.util import lifecycle
+from ray_tpu.util import journal, lifecycle
 
 # Thread-local flag: serializing task args => promote refs to the shared store.
 _ser_ctx = threading.local()
@@ -429,6 +429,10 @@ class CoreClient:
         self._connected = True
 
     async def _connect(self, raylet_conn: Optional[Connection] = None):
+        # Name this process in journal dumps; weak so a more specific
+        # label (replica/controller/proxy) set later is never clobbered,
+        # and an in-process node's GCS never renames the driver.
+        journal.set_process_label(self.mode or "proc", weak=True)
         self.gcs = await connect(*self.gcs_addr, push_handler=self._on_push)
         # Workers already hold a raylet connection (push channel); reuse it
         # rather than paying a second TCP connect on the boot path.
@@ -457,6 +461,17 @@ class CoreClient:
             r = await self.gcs.call("get_profile_config", {})
             self._on_profile_config(r.get("profile_config") or {})
         except Exception:  # noqa: BLE001 — profiling is best-effort
+            pass
+        # Cluster black box: every connected process answers journal_dump
+        # broadcasts by freezing its event ring into the named postmortem
+        # bundle (util/journal.py). Best-effort, like profile_config.
+        try:
+            self._push_handlers.setdefault(
+                "journal_dump", []
+            ).append(journal.on_dump_trigger)
+            self._subscribed_channels.add("journal_dump")
+            await self.gcs.call("subscribe", {"channel": "journal_dump"})
+        except Exception:  # noqa: BLE001 — the black box never gates connect
             pass
 
     @staticmethod

@@ -267,6 +267,13 @@ _FLIGHT_RECORDER_PANELS = [
         {"expr": "loadgen_unattributed_gap_seconds",
          "legend": "gap seconds {{q}}"},
     ], "short"),
+    # -- cluster black box (event journal) ---------------------------------
+    ("Journal events by kind", [
+        {"expr": "rate(journal_events_total[1m])", "legend": "{{kind}}"},
+    ], "short"),
+    ("Journal ring overwrites (events lost to any future dump)", [
+        {"expr": "rate(journal_dropped_total[1m])", "legend": "dropped/s"},
+    ], "short"),
 ]
 
 
@@ -313,7 +320,7 @@ def generate_dashboard(
                 if token.startswith(("train_", "serve_", "device_", "data_",
                                      "rt_raylet_", "gcs_rpc_",
                                      "collective_", "preempt_",
-                                     "tenant_", "loadgen_")):
+                                     "tenant_", "loadgen_", "journal_")):
                     covered.add(token)
 
     for info in user_metrics:

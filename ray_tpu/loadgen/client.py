@@ -20,6 +20,7 @@ import time
 from typing import Dict, List, Optional
 
 from ray_tpu._private.config import get_config
+from ray_tpu.util import journal
 
 
 class StampCard:
@@ -89,6 +90,8 @@ def call_streaming(handle, request: Dict, card: StampCard) -> StampCard:
         # (shed, deadline, replica death past the retry budget).
         card.error = f"{type(e).__name__}: {e}"
         card.done_p = None
+        journal.emit("client.error", rid=card.rid, tenant=card.tenant,
+                     error=type(e).__name__)
     return card
 
 
@@ -107,4 +110,6 @@ def call_unary(handle, request: Dict, card: StampCard) -> StampCard:
         # failures are data, not crashes.
         card.error = f"{type(e).__name__}: {e}"
         card.done_p = None
+        journal.emit("client.error", rid=card.rid, tenant=card.tenant,
+                     error=type(e).__name__)
     return card

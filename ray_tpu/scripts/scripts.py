@@ -255,6 +255,8 @@ def cmd_summary(args):
 
 
 def cmd_timeline(args):
+    if getattr(args, "cluster", False):
+        return _timeline_cluster(args)
     from ray_tpu.util.state import get_timeline
 
     trace = get_timeline(
@@ -265,6 +267,118 @@ def cmd_timeline(args):
     with open(out, "w") as f:
         json.dump(trace, f)
     print(f"wrote {len(trace)} events to {out} (open in chrome://tracing)")
+
+
+def _wait_bundle(bundle: str, settle_s: float = 0.4,
+                 timeout_s: float = 5.0) -> None:
+    """Wait for a postmortem bundle to stop growing: processes dump on
+    the pubsub push asynchronously, so the CLI polls until the file
+    count holds still for `settle_s` (or gives up at `timeout_s`)."""
+    deadline = time.monotonic() + timeout_s
+    last_n, last_change = -1, time.monotonic()
+    while time.monotonic() < deadline:
+        try:
+            n = len([f for f in os.listdir(bundle) if f.endswith(".jsonl")])
+        except OSError:
+            n = 0
+        if n != last_n:
+            last_n, last_change = n, time.monotonic()
+        elif n > 0 and time.monotonic() - last_change >= settle_s:
+            return
+        time.sleep(0.1)
+
+
+def _timeline_cluster(args):
+    """Live merged spine: force a cluster-wide journal dump and render
+    the assembled HLC-ordered timeline."""
+    from ray_tpu.util import journal
+    from ray_tpu.util.state.api import StateApiClient
+
+    client = StateApiClient(_resolve_address(args))
+    try:
+        resp = client.call("journal_trigger", {
+            "reason": "manual", "source": "rt timeline", "force": True,
+        })
+    finally:
+        client.close()
+    bundle = resp.get("bundle")
+    if not bundle:
+        print("journal trigger suppressed (journal disabled?)",
+              file=sys.stderr)
+        sys.exit(1)
+    _wait_bundle(bundle)
+    events, metas = journal.load_bundle(bundle)
+    print(f"cluster spine: {len(events)} events from {len(metas)} "
+          f"process(es) — bundle {bundle}")
+    print(journal.render_timeline(events, limit=args.limit))
+
+
+def cmd_postmortem(args):
+    """Assemble a postmortem bundle into one causally-ordered timeline
+    and name the culprit chain."""
+    from ray_tpu.util import journal
+
+    bundle = args.bundle
+    if bundle in (None, "latest"):
+        bundle = _latest_bundle(args)
+        if bundle is None:
+            print("no postmortem bundles found (none triggered yet, or "
+                  f"look under {journal.dump_dir()})", file=sys.stderr)
+            sys.exit(1)
+    if not os.path.isdir(bundle):
+        print(f"not a bundle directory: {bundle}", file=sys.stderr)
+        sys.exit(1)
+    events, metas = journal.load_bundle(bundle)
+    if not events:
+        print(f"bundle {bundle} holds no events", file=sys.stderr)
+        sys.exit(1)
+    procs = sorted({f"{m.get('proc', '?')}({m.get('pid', '?')})"
+                    for m in metas})
+    trigger = next((m.get("trigger") for m in metas
+                    if m.get("trigger")), None) or {}
+    print(f"postmortem {os.path.basename(bundle)} — {len(events)} events "
+          f"from {len(metas)} process(es): {', '.join(procs)}")
+    if trigger:
+        print(f"trigger: {trigger.get('reason', '?')} "
+              f"(source: {trigger.get('source') or 'auto'})")
+    chain = journal.causal_chain(events)
+    if chain:
+        print("\nculprit chain:")
+        t0 = chain[0].get("ts", 0.0)
+        for i, e in enumerate(chain):
+            arrow = "   " if i == 0 else " → "
+            print(f" {arrow}{journal._fmt_event(e, t0)}")
+    else:
+        print("\nno causal chain found (no seed fault in the window)")
+    if not args.chain_only:
+        print("\nmerged timeline:")
+        print(journal.render_timeline(events, limit=args.limit))
+
+
+def _latest_bundle(args) -> Optional[str]:
+    """Newest bundle: ask the GCS first (it minted them), fall back to
+    scanning the dump directory (offline postmortems)."""
+    from ray_tpu.util import journal
+
+    try:
+        from ray_tpu.util.state.api import StateApiClient
+
+        client = StateApiClient(_resolve_address(args))
+        try:
+            pms = client.call("get_postmortems", {}).get("postmortems", [])
+        finally:
+            client.close()
+        if pms:
+            return pms[-1]["bundle"]
+    except Exception:  # noqa: BLE001 — no live cluster; scan the dir
+        pass
+    root = journal.dump_dir()
+    try:
+        cands = [os.path.join(root, d) for d in os.listdir(root)
+                 if os.path.isdir(os.path.join(root, d))]
+    except OSError:
+        return None
+    return max(cands, key=os.path.getmtime) if cands else None
 
 
 def cmd_profile(args):
@@ -998,8 +1112,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", "-o")
     sp.add_argument("--lifecycle", action="store_true",
                     help="include sampled per-phase lifecycle rows")
+    sp.add_argument("--cluster", action="store_true",
+                    help="render the live merged cluster event spine "
+                         "(forces a journal dump) instead of a trace file")
+    sp.add_argument("--limit", type=int, default=200,
+                    help="max events to render with --cluster")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "postmortem",
+        help="assemble a black-box bundle into a causal timeline",
+    )
+    sp.add_argument("bundle", nargs="?", default="latest",
+                    help="bundle directory (default: newest)")
+    sp.add_argument("--chain-only", action="store_true",
+                    help="print only the culprit chain")
+    sp.add_argument("--limit", type=int, default=0,
+                    help="max timeline events to render (0 = all)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_postmortem)
 
     sp = sub.add_parser(
         "profile", help="sampled task-lifecycle profiler (control plane)"

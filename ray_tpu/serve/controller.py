@@ -27,6 +27,7 @@ from ray_tpu.exceptions import (
 from ray_tpu.serve import autoscale
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment
 from ray_tpu.serve.replica import ReplicaActor
+from ray_tpu.util import journal
 
 logger = logging.getLogger("ray_tpu.serve")
 
@@ -39,6 +40,7 @@ CHECKPOINT_KEY = b"serve_controller_ckpt"
 @rt.remote
 class ServeController:
     def __init__(self):
+        journal.set_process_label("serve-controller")
         # app name -> {deployment, replicas: [handles], version}
         self.apps: Dict[str, Dict] = {}
         self._health_fails: Dict[bytes, int] = {}
@@ -285,6 +287,8 @@ class ServeController:
                 "last_scale_up": 0.0,
                 "last_scale_down": time.monotonic(),
             }
+        journal.emit("serve.controller", action="deploy", app=name,
+                     replicas=deployment.num_replicas)
         self._reconcile_once(name)
         self._checkpoint()
         # New replicas are up and published; the replaced generation
@@ -295,6 +299,7 @@ class ServeController:
     def delete(self, name: str):
         with self._lock:
             app = self.apps.pop(name, None)
+        journal.emit("serve.controller", action="delete", app=name)
         self._checkpoint()
         if app:
             # Short drain on delete: in-flight requests get a grace
@@ -394,6 +399,8 @@ class ServeController:
             with self._lock:
                 app["replicas"].extend(new)
                 app["version"] += 1
+            journal.emit("serve.controller", action="scale_up", app=name,
+                         added=len(new), target=target)
             self._publish_routes(name)
             self._checkpoint()
         elif current > target:
@@ -401,6 +408,8 @@ class ServeController:
                 excess = app["replicas"][target:]
                 app["replicas"] = app["replicas"][:target]
                 app["version"] += 1
+            journal.emit("serve.controller", action="scale_down", app=name,
+                         removed=len(excess), target=target)
             # Routes flip FIRST (handles stop picking the victims), then
             # the victims drain: new requests they still receive bounce
             # with ReplicaDrainingError and redispatch, in-flight ones
@@ -449,6 +458,8 @@ class ServeController:
 
             with self._lock:
                 version = self.apps[name]["version"]
+            journal.emit("serve.controller", action="route_flip", app=name,
+                         version=version)
             worker_mod.get_client().publish(
                 f"serve_routes:{name}", {"version": version}
             )
@@ -861,6 +872,8 @@ class ServeController:
             "evicting %d replica(s) of app %r from draining node(s)",
             len(victims), name,
         )
+        journal.emit("serve.controller", action="evict_draining", app=name,
+                     victims=len(victims))
         self._publish_routes(name)
         self._checkpoint()
         self._drain_then_kill(victims, name)
@@ -943,6 +956,15 @@ class ServeController:
                 if r._actor_id.binary() not in dead_ids
             ]
             app["version"] += 1
+        # A replica the controller had to declare dead is a cluster-
+        # visible failure: journal the replacement and freeze the black
+        # box so the postmortem shows what killed it.
+        journal.emit("serve.controller", action="replace_dead", app=name,
+                     dead=[d._actor_id.hex() for d in dead])
+        journal.trigger_postmortem(
+            f"replica_dead:{name}", app=name,
+            dead=[d._actor_id.hex() for d in dead],
+        )
         self._publish_routes(name)
         self._checkpoint()
         for r in dead:
@@ -1012,6 +1034,9 @@ class ServeController:
                 app["last_scale_down"] = now
         logger.info("autoscaler: app %r target %d -> %d (%s)",
                     name, target, new_target, state.last_reason)
+        journal.emit("serve.controller", action="autoscale", app=name,
+                     old_target=target, new_target=new_target,
+                     reason=state.last_reason)
         self._checkpoint()
 
     def _autoscale_probe(self, name: str):

@@ -58,6 +58,7 @@ from typing import Any, Dict, List, Optional
 import ray_tpu as rt
 from ray_tpu._private import chaos
 from ray_tpu._private.config import get_config
+from ray_tpu.util import journal
 from ray_tpu.exceptions import (
     ActorError,
     GetTimeoutError,
@@ -173,6 +174,12 @@ class DeploymentResponse:
                     self._cb_fail(self._replica_key, death=True)
                 if (not retryable or self._redispatch is None
                         or self._retries_left <= 0):
+                    journal.emit(
+                        "serve.request_error", error=type(e).__name__,
+                        replica=(self._replica_key.hex()
+                                 if isinstance(self._replica_key, bytes)
+                                 else str(self._replica_key or "")),
+                    )
                     raise
             self._retries_left -= 1
             if backoff:
@@ -190,6 +197,12 @@ class DeploymentResponse:
                     time.sleep(delay * (0.5 + 0.5 * random.random()))
             attempt += 1
             self.ref, self._replica_key = self._redispatch()
+            journal.emit(
+                "serve.redispatch", attempt=attempt,
+                replica=(self._replica_key.hex()
+                         if isinstance(self._replica_key, bytes)
+                         else str(self._replica_key or "")),
+            )
 
 
 class StreamingResponse:
@@ -723,6 +736,12 @@ class DeploymentHandle:
                     # Restart the request; next_chunks(sid, start) below
                     # skips the chunks the client already consumed.
                     replica, sid = start_fresh()
+                    journal.emit(
+                        "serve.stream_resume", app=self.app_name,
+                        rid=meta["rid"], offset=start,
+                        attempt=attempts[0],
+                        replica=replica._actor_id.hex(),
+                    )
                     continue
                 for c in out["chunks"]:
                     yield c

@@ -1382,6 +1382,14 @@ class ContinuousBatchingEngine:
                 "culprits": culprits,
             })
         _engine_metrics()["hol_s"].inc(blocked)
+        from ray_tpu.util import journal
+
+        journal.emit("serve.hol", prefill_s=round(prefill_s, 4),
+                     victims=n_active,
+                     blocked_slot_seconds=round(blocked, 4))
+        journal.trigger_postmortem(
+            "hol_blocking", prefill_s=round(prefill_s, 4),
+            victims=n_active)
 
     def _loop(self):
         """Pipelined decode loop with ASYNC double-buffered fetch:

@@ -57,6 +57,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ray_tpu._private.config import get_config
+from ray_tpu.util import journal
 from ray_tpu.util.lifecycle import SERVE_PHASE_ORDER
 
 logger = logging.getLogger("ray_tpu.serve")
@@ -223,6 +224,9 @@ def make_wire_ctx(tenant: str = "") -> Optional[Dict]:
         "tenant": tenant,
         "enq_t": time.time(),
         "sampled": bool(lifecycle.enabled and lifecycle.sample()),
+        # HLC stamp: the enqueue happens-before everything the replica
+        # does for this request, across the process boundary.
+        "hlc": journal.wire_stamp(),
     }
 
 
@@ -237,6 +241,7 @@ def begin(obs_ctx: Optional[Dict], app: str,
     if not get_config().serve_observatory:
         return None
     d = obs_ctx or {}
+    journal.observe_wire(d.get("hlc"))
     ctx = RequestContext(
         rid=d.get("rid") or os.urandom(8).hex(),
         tenant=d.get("tenant", ""),
@@ -436,6 +441,9 @@ class RequestProfiler:
             t.queue_s += queue_s
             if verdicts:
                 t.outcomes.append((rec["ts"], verdicts))
+        journal.emit("serve.request", rid=ctx.rid, app=self.app,
+                     tenant=ctx.tenant, e2e_s=round(e2e, 6),
+                     tokens_out=ctx.tokens_out)
         if warn_overwrites:
             # Rate-limited (once per minute per replica): sustained load
             # past ring capacity silently evicts phase records, which
@@ -665,6 +673,15 @@ def record_shed(app: str, tenant: str = "",
     if app and p.app in ("-", ""):
         p.app = app
     p.record_shed(tenant, reason)
+    journal.emit("serve.shed", app=app, tenant=tenant, reason=reason)
+
+
+# Deadline-storm detector: a burst of expiries across hops is the
+# signature of a systemic stall (dead replica, wedged engine), not a
+# slow request — it earns an automatic black-box capture.
+_expiry_times: deque = deque(maxlen=32)
+_EXPIRY_STORM_N = 8
+_EXPIRY_STORM_WINDOW_S = 5.0
 
 
 def record_deadline_expired(app: str, hop: str) -> None:
@@ -672,6 +689,16 @@ def record_deadline_expired(app: str, hop: str) -> None:
     if not get_config().serve_observatory:
         return
     profiler().record_deadline_expired(hop)
+    journal.emit("serve.deadline_expired", app=app, hop=hop)
+    now = time.monotonic()
+    _expiry_times.append(now)
+    if (len(_expiry_times) >= _EXPIRY_STORM_N
+            and now - _expiry_times[-_EXPIRY_STORM_N]
+            <= _EXPIRY_STORM_WINDOW_S):
+        journal.trigger_postmortem(
+            f"deadline_storm:{app}", app=app, hop=hop,
+            expiries=_EXPIRY_STORM_N, window_s=_EXPIRY_STORM_WINDOW_S,
+        )
 
 
 def record_drain(app: str, seconds: float) -> None:
@@ -679,15 +706,22 @@ def record_drain(app: str, seconds: float) -> None:
     if not get_config().serve_observatory:
         return
     _obs_metrics()["drain_s"].observe(seconds, tags={"app": app or "-"})
+    journal.emit("serve.drain", app=app, seconds=round(seconds, 3))
 
 
 def set_circuit_state(app: str, replica: str, state: int) -> None:
     """Publish a handle's view of one replica's breaker (0 closed,
-    1 half-open, 2 open)."""
+    1 half-open, 2 open). An open breaker is a client-visible failure
+    signal — it triggers a black-box capture."""
     if not get_config().serve_observatory:
         return
     _obs_metrics()["cb_state"].set(
         float(state), tags={"app": app or "-", "replica": replica or "-"})
+    journal.emit("serve.breaker", app=app, replica=replica,
+                 state=int(state))
+    if state == 2:
+        journal.trigger_postmortem(
+            f"breaker_open:{app}", app=app, replica=replica)
 
 
 def reset_for_tests() -> None:

@@ -119,7 +119,9 @@ class ProxyActor:
 
         from ray_tpu._private.worker import _IN_STORE
         from ray_tpu.serve.handle import DeploymentHandle
+        from ray_tpu.util import journal
 
+        journal.set_process_label("proxy")
         self.host = host
         self.port = port
         self._handles: Dict[str, DeploymentHandle] = {}

@@ -69,8 +69,10 @@ class ReplicaActor:
         # name + declared SLO (one replica per process).
         self._app_name = app_name or type(self.callable).__name__
         from ray_tpu.serve import observatory
+        from ray_tpu.util import journal
 
         observatory.configure(self._app_name, slo)
+        journal.set_process_label(f"replica:{self._app_name}")
 
     def _target(self, method: str):
         if self._is_function:

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 import ray_tpu as rt
 from ray_tpu._private import chaos
 from ray_tpu._private.config import get_config
+from ray_tpu.util import journal
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorError,
@@ -174,6 +175,8 @@ class BackendExecutor:
                 ) from e
             self.worker_group = None
         self.epoch += 1
+        journal.emit("train.gang_restart", epoch=self.epoch)
+        journal.trigger_postmortem("gang_restart", epoch=self.epoch)
         self.start()
 
     def shutdown(self):
@@ -263,6 +266,9 @@ class BackendExecutor:
         total.inc(1.0, tags={"direction": direction})
         gang_gauge.set(float(new_n))
         seconds.observe(time.monotonic() - t0)
+        journal.emit("train.resize", direction=direction,
+                     old_world=old_n, new_world=new_n, epoch=self.epoch,
+                     seconds=round(time.monotonic() - t0, 3))
         logger.info("gang resized %d→%d (%s) in %.3fs, epoch %d",
                     old_n, new_n, direction, time.monotonic() - t0,
                     self.epoch)

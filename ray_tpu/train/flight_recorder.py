@@ -49,6 +49,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu._private import chaos
+from ray_tpu.util import journal
 
 #: Phase keys every record carries (plus "other_s" for the remainder).
 PHASES = ("data", "compute", "collective", "checkpoint")
@@ -436,6 +437,9 @@ class StepProfiler:
                 )
             if "mfu" in rec:
                 m["mfu"].set_keyed(self._mfu_key, rec["mfu"])
+        journal.emit("train.step", step=rec["step"],
+                     wall_s=round(wall, 6), compiles=compiles,
+                     **({"tokens": tokens} if tokens is not None else {}))
 
     # -- observer-side API -----------------------------------------------
     def records(self) -> List[Dict]:

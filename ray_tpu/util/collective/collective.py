@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ray_tpu._private import worker as worker_mod
+from ray_tpu.util import journal
 from ray_tpu.util.collective.dcn_group import DcnGroup
 from ray_tpu.util.collective.types import Backend, ReduceOp
 from ray_tpu.util.collective.hier_group import HierarchicalGroup
@@ -264,6 +265,9 @@ def _observed(op_name: str, fn, group=None):
         info = group.last_op_info if records_info else None
         info = dict(info) if info else None  # snapshot; {} -> None
         _emit_metrics(op_name, dt, info)
+        journal.emit("collective.op", op=op_name, seconds=round(dt, 6),
+                     **({k: info[k] for k in ("tier", "algo", "bytes")
+                         if k in info} if info else {}))
         for cb in list(_op_observers):
             try:
                 try:

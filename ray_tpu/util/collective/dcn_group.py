@@ -51,6 +51,7 @@ import logging
 
 from ray_tpu._private import chaos
 from ray_tpu.exceptions import CollectiveTimeoutError
+from ray_tpu.util import journal
 from ray_tpu.util.collective import quant as quant_mod
 from ray_tpu.util.collective.topology import (
     ALGO_HIER,
@@ -64,8 +65,11 @@ logger = logging.getLogger("ray_tpu.collective")
 
 _LEN = struct.Struct("<Q")
 # Identification frame on every initiated connection: sender rank + the
-# gang epoch it believes it belongs to.
-_IDENT = struct.Struct("<II")
+# gang epoch it believes it belongs to + the sender's HLC stamp
+# (physical µs, logical counter) so the connect itself is causally
+# ordered in the cluster journal — a DCN dial happens-after whatever
+# the dialer saw last.
+_IDENT = struct.Struct("<IIQI")
 
 
 def _reduce(op: ReduceOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -261,7 +265,9 @@ class DcnGroup:
             # of a different epoch is a zombie from a torn-down attempt —
             # close the socket so it can never inject into this ring.
             try:
-                rank, epoch = _IDENT.unpack(peer.recv_bytes())
+                rank, epoch, pt, lc = _IDENT.unpack(peer.recv_bytes())
+                if pt:
+                    journal.observe_wire([pt, lc])
             except Exception:  # noqa: BLE001 — malformed/legacy handshake
                 try:
                     sock.close()
@@ -285,7 +291,8 @@ class DcnGroup:
             sock = socket.create_connection((host, port), timeout=self._timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             peer = _Peer(sock, self._op_timeout, on_send=self._count_sent)
-            peer.send_bytes(_IDENT.pack(self.rank, self.epoch))
+            pt, lc = journal.wire_stamp() or (0, 0)
+            peer.send_bytes(_IDENT.pack(self.rank, self.epoch, pt, lc))
             self._outgoing[rank] = peer
         return peer
 
@@ -306,6 +313,13 @@ class DcnGroup:
         )
 
     def _timeout_error(self, op: str, peer_rank: int) -> CollectiveTimeoutError:
+        journal.emit("collective.timeout", op=op, group=self.group_name,
+                     rank=self.rank, peer_rank=peer_rank,
+                     epoch=self.epoch, timeout_s=self._op_timeout)
+        journal.trigger_postmortem(
+            f"collective_timeout:{op}",
+            group=self.group_name, rank=self.rank, peer_rank=peer_rank,
+        )
         return CollectiveTimeoutError(
             f"collective {op} in group {self.group_name!r} (rank "
             f"{self.rank}, epoch {self.epoch}) timed out after "

@@ -123,6 +123,10 @@ def event(
     }
     if worker_id is not None:
         ev["worker_id"] = worker_id
+    from ray_tpu.util import journal
+
+    journal.emit("lifecycle.span", task=name, hop=hop,
+                 **({"e2e_s": round(e2e_s, 6)} if e2e_s is not None else {}))
     return ev
 
 

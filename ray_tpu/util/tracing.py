@@ -103,6 +103,10 @@ def _record(name: str, ctx: Dict[str, str], parent_id: str, start: float,
     with profiling._lock:
         profiling._buffer.append({**base, "state": "RUNNING", "ts": start})
         profiling._buffer.append({**base, "state": "FINISHED", "ts": end})
+    from ray_tpu.util import journal
+
+    journal.emit("trace.span", name=name, trace_id=ctx["trace_id"],
+                 kind=kind, dur_s=round(end - start, 6))
     # Bounded-delay batch flush: every span recorded inside the window
     # rides ONE add_task_events RPC (the old force-flush here cost one
     # GCS RPC per span — untenable once serve requests are traced).

@@ -143,13 +143,13 @@ def test_epoch_fence_rejects_stale_rank_mid_resize():
             stale._peer_out(0)
 
         s = socket.create_connection(tuple(resized.addr), timeout=2)
-        s.sendall(_LEN.pack(_IDENT.size) + _IDENT.pack(3, 0))
+        s.sendall(_LEN.pack(_IDENT.size) + _IDENT.pack(3, 0, 0, 0))
         with pytest.raises(CollectiveTimeoutError):
             resized._peer_in(3)
         s.close()
 
         s2 = socket.create_connection(tuple(resized.addr), timeout=2)
-        s2.sendall(_LEN.pack(_IDENT.size) + _IDENT.pack(2, 1))
+        s2.sendall(_LEN.pack(_IDENT.size) + _IDENT.pack(2, 1, 0, 0))
         assert resized._peer_in(2) is not None
         s2.close()
     finally:

@@ -119,7 +119,7 @@ def test_gang_epoch_rejects_stale_rank():
         # Handshake fence: even told the new address out-of-band, the
         # stale epoch in the ident frame gets the socket closed.
         s = socket.create_connection(tuple(fresh.addr), timeout=2)
-        ident = _IDENT.pack(1, 0)  # rank 1, stale epoch 0
+        ident = _IDENT.pack(1, 0, 0, 0)  # rank 1, stale epoch 0, null HLC
         s.sendall(_LEN.pack(len(ident)) + ident)
         with pytest.raises(CollectiveTimeoutError):
             fresh._peer_in(1)
@@ -127,7 +127,7 @@ def test_gang_epoch_rejects_stale_rank():
 
         # Control: the correct epoch is accepted.
         s2 = socket.create_connection(tuple(fresh.addr), timeout=2)
-        ident = _IDENT.pack(1, 1)
+        ident = _IDENT.pack(1, 1, 0, 0)
         s2.sendall(_LEN.pack(len(ident)) + ident)
         assert fresh._peer_in(1) is not None
         s2.close()
